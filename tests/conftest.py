@@ -1,0 +1,43 @@
+"""Test bootstrap: repo-root import path + virtual 8-device CPU JAX.
+
+Mirrors the reference's conftest sys.path trick
+(reference: tests/conftest.py:14-19) and forces JAX onto the host
+platform with 8 virtual devices so multi-chip sharding tests run in
+CPU-only CI (see SURVEY.md §4 "fake device layer").
+
+Must run before anything imports jax — conftest import time is early
+enough as long as test modules import jax at module scope or later.
+"""
+
+import os
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# If a PJRT plugin's sitecustomize already pinned a platform, re-pin to cpu
+# before the backend initializes (jax config wins over the env snapshot).
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def tmp_session_dir(tmp_path):
+    d = tmp_path / "session"
+    d.mkdir()
+    return d

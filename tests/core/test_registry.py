@@ -1,0 +1,43 @@
+import pytest
+
+from traceml_tpu.core.registry import Registry, RegistryError
+
+
+def test_register_get_require():
+    r = Registry("t")
+    r.register("a", 1)
+    assert r.get("a") == 1
+    assert r.require("a") == 1
+    assert r.get("missing") is None
+    assert r.get("missing", 42) == 42
+    with pytest.raises(RegistryError):
+        r.require("missing")
+
+
+def test_duplicate_and_overwrite():
+    r = Registry()
+    r.register("a", 1)
+    with pytest.raises(RegistryError):
+        r.register("a", 2)
+    r.register("a", 2, overwrite=True)
+    assert r.get("a") == 2
+
+
+def test_order_and_iteration():
+    r = Registry()
+    for k in ("z", "m", "a"):
+        r.register(k, k.upper())
+    assert r.keys() == ["z", "m", "a"]
+    assert list(r) == ["z", "m", "a"]
+    assert len(r) == 3
+    assert "m" in r
+
+
+def test_decorator():
+    r = Registry()
+
+    @r.decorator("fn")
+    def fn():
+        return 7
+
+    assert r.get("fn") is fn

@@ -1,0 +1,67 @@
+from pathlib import Path
+
+from traceml_tpu.database import Database, DBIncrementalSender, DatabaseWriter
+from traceml_tpu.database.database_writer import iter_backup_file
+from traceml_tpu.telemetry import SenderIdentity
+
+
+def test_bounded_append_and_tail():
+    db = Database(max_rows_per_table=5)
+    for i in range(8):
+        db.add_record("t", {"i": i})
+    assert db.append_count("t") == 8
+    rows = db.tail("t")
+    assert [r["i"] for r in rows] == [3, 4, 5, 6, 7]
+    assert [r["i"] for r in db.tail("t", 2)] == [6, 7]
+    assert db.tail("missing") == []
+
+
+def test_rows_since_with_eviction():
+    db = Database(max_rows_per_table=5)
+    for i in range(3):
+        db.add_record("t", {"i": i})
+    assert [r["i"] for r in db.rows_since("t", 0)] == [0, 1, 2]
+    for i in range(3, 10):
+        db.add_record("t", {"i": i})
+    # cursor at 3; 7 new appended but only 5 retained
+    got = [r["i"] for r in db.rows_since("t", 3)]
+    assert got == [5, 6, 7, 8, 9]
+    assert db.rows_since("t", 10) == []
+
+
+def test_incremental_sender_ships_only_new():
+    db = Database()
+    sender = DBIncrementalSender("step_time", db)
+    sender.set_identity(SenderIdentity(session_id="s", global_rank=1))
+    assert sender.collect_payload() is None
+    db.add_record("steps", {"step": 1})
+    p1 = sender.collect_payload()
+    assert p1 is not None
+    assert p1["meta"]["sampler"] == "step_time"
+    assert p1["meta"]["global_rank"] == 1
+    assert p1["body"]["tables"]["steps"] == [{"step": 1}]
+    # nothing new → None
+    assert sender.collect_payload() is None
+    db.add_record("steps", {"step": 2})
+    db.add_record("other", {"x": 1})
+    p2 = sender.collect_payload()
+    assert p2["body"]["tables"]["steps"] == [{"step": 2}]
+    assert p2["body"]["tables"]["other"] == [{"x": 1}]
+
+
+def test_disk_writer_roundtrip(tmp_path):
+    db = Database()
+    w = DatabaseWriter("s", db, tmp_path, flush_every=1)
+    db.add_records("t", [{"i": 0}, {"i": 1}])
+    assert w.flush(force=True) == 2
+    db.add_record("t", {"i": 2})
+    assert w.flush(force=True) == 1
+    rows = list(iter_backup_file(Path(tmp_path) / "s" / "t.msgpack"))
+    assert [r["i"] for r in rows] == [0, 1, 2]
+
+
+def test_disk_writer_disabled():
+    db = Database()
+    w = DatabaseWriter("s", db, None)
+    db.add_record("t", {"i": 0})
+    assert w.flush(force=True) == 0

@@ -1,0 +1,64 @@
+from traceml_tpu.telemetry import (
+    SenderIdentity,
+    build_rank_finished,
+    build_telemetry_envelope,
+    control_kind,
+    is_control_message,
+    normalize_telemetry_envelope,
+)
+
+
+def _identity(rank=3):
+    return SenderIdentity(
+        session_id="s1",
+        global_rank=rank,
+        local_rank=rank % 4,
+        world_size=8,
+        local_world_size=4,
+        node_rank=rank // 4,
+        hostname="host-a",
+        pid=1234,
+        platform="tpu",
+        device_kind="TPU v5p",
+    )
+
+
+def test_build_and_normalize_canonical():
+    env = build_telemetry_envelope(
+        "step_time", {"steps": [{"step": 1}]}, identity=_identity()
+    )
+    wire = env.to_wire()
+    norm = normalize_telemetry_envelope(wire)
+    assert norm is not None
+    assert norm.sampler == "step_time"
+    assert norm.global_rank == 3
+    assert norm.meta["node_rank"] == 0
+    assert norm.meta["world_size"] == 8
+    assert norm.tables == {"steps": [{"step": 1}]}
+    assert norm.meta["rank"] == norm.meta["global_rank"]
+
+
+def test_normalize_legacy_flat_shape():
+    legacy = {"sampler": "system", "rank": 2, "tables": {"t": [{"a": 1}]}}
+    norm = normalize_telemetry_envelope(legacy)
+    assert norm is not None
+    assert norm.sampler == "system"
+    assert norm.global_rank == 2
+    assert norm.tables == {"t": [{"a": 1}]}
+
+
+def test_normalize_rejects_garbage():
+    assert normalize_telemetry_envelope(None) is None
+    assert normalize_telemetry_envelope([1, 2]) is None
+    assert normalize_telemetry_envelope({"meta": {}, "body": {}}) is None
+    assert normalize_telemetry_envelope({"nope": 1}) is None
+
+
+def test_control_messages():
+    msg = build_rank_finished(_identity().to_meta())
+    assert is_control_message(msg)
+    assert control_kind(msg) == "rank_finished"
+    assert not is_control_message({"meta": {}})
+    assert control_kind({}) is None
+    # control messages are not telemetry
+    assert normalize_telemetry_envelope(msg) is None

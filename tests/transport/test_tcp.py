@@ -1,0 +1,84 @@
+import time
+
+from traceml_tpu.transport import TCPClient, TCPServer
+from traceml_tpu.transport.tcp_transport import _ClientBuffer, encode_frame
+
+
+def _drain_until(server, n, timeout=5.0):
+    out = []
+    deadline = time.monotonic() + timeout
+    while len(out) < n and time.monotonic() < deadline:
+        server.wait_for_data(0.1)
+        out.extend(server.drain())
+    return out
+
+
+def test_roundtrip_batch():
+    server = TCPServer()
+    server.start()
+    try:
+        client = TCPClient("127.0.0.1", server.port)
+        payloads = [{"i": i} for i in range(5)]
+        assert client.send_batch(payloads)
+        got = _drain_until(server, 5)
+        assert got == payloads
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_multiple_clients():
+    server = TCPServer()
+    server.start()
+    try:
+        clients = [TCPClient("127.0.0.1", server.port) for _ in range(4)]
+        for r, c in enumerate(clients):
+            assert c.send_batch([{"rank": r}])
+        got = _drain_until(server, 4)
+        assert sorted(m["rank"] for m in got) == [0, 1, 2, 3]
+        for c in clients:
+            c.close()
+    finally:
+        server.stop()
+
+
+def test_client_never_raises_when_server_down():
+    client = TCPClient("127.0.0.1", 1, reconnect_backoff=0.0)  # port 1: closed
+    assert client.send_batch([{"x": 1}]) is False
+    assert client.batches_dropped == 1
+    client.close()
+
+
+def test_partial_frame_reassembly():
+    buf = _ClientBuffer()
+    frame = encode_frame([{"k": "v" * 100}])
+    # feed in odd-sized chunks
+    frames = []
+    for i in range(0, len(frame), 7):
+        frames.extend(buf.feed(frame[i : i + 7]))
+    assert len(frames) == 1
+
+
+def test_buffer_many_small_frames_linear():
+    buf = _ClientBuffer()
+    blob = b"".join(encode_frame({"i": i}) for i in range(2000))
+    t0 = time.perf_counter()
+    frames = buf.feed(blob)
+    elapsed = time.perf_counter() - t0
+    assert len(frames) == 2000
+    assert elapsed < 0.5  # O(N) drain; O(N^2) would blow past this
+
+
+def test_large_batch_single_frame():
+    server = TCPServer()
+    server.start()
+    try:
+        client = TCPClient("127.0.0.1", server.port)
+        batch = [{"i": i, "pad": "x" * 256} for i in range(5000)]
+        assert client.send_batch(batch)
+        got = _drain_until(server, 5000, timeout=10)
+        assert len(got) == 5000
+        assert server.frames_received == 1
+        client.close()
+    finally:
+        server.stop()

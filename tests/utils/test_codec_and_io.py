@@ -1,0 +1,60 @@
+import json
+
+import numpy as np
+import pytest
+
+from traceml_tpu.utils import msgpack_codec
+from traceml_tpu.utils.atomic_io import (
+    atomic_write_json,
+    atomic_write_text,
+    read_json,
+)
+from traceml_tpu.utils.formatting import fmt_bytes, fmt_ms, fmt_pct
+
+
+def test_codec_roundtrip_basic():
+    obj = {"a": 1, "b": [1.5, "x", None, True], "nested": {"k": 2}}
+    assert msgpack_codec.decode(msgpack_codec.encode(obj)) == obj
+
+
+def test_codec_numpy_coercion():
+    obj = {"arr": np.arange(3), "scalar": np.float32(1.5)}
+    out = msgpack_codec.decode(msgpack_codec.encode(obj))
+    assert out["arr"] == [0, 1, 2]
+    assert abs(out["scalar"] - 1.5) < 1e-6
+
+
+def test_codec_decodes_json_fallback_frames():
+    body = b"\x02" + json.dumps({"x": 1}).encode()
+    assert msgpack_codec.decode(body) == {"x": 1}
+
+
+def test_codec_empty_frame_raises():
+    with pytest.raises(msgpack_codec.CodecError):
+        msgpack_codec.decode(b"")
+
+
+def test_atomic_json_roundtrip(tmp_path):
+    p = tmp_path / "deep" / "x.json"
+    atomic_write_json(p, {"k": [1, 2]})
+    assert read_json(p) == {"k": [1, 2]}
+    assert read_json(tmp_path / "missing.json", default={}) == {}
+
+
+def test_atomic_text_no_partial(tmp_path):
+    p = tmp_path / "t.txt"
+    atomic_write_text(p, "hello")
+    atomic_write_text(p, "world")
+    assert p.read_text() == "world"
+    # no stray tmp files left behind
+    assert [f.name for f in tmp_path.iterdir()] == ["t.txt"]
+
+
+def test_formatting():
+    assert fmt_bytes(512) == "512 B"
+    assert fmt_bytes(1536) == "1.50 KiB"
+    assert fmt_bytes(None) == "n/a"
+    assert fmt_ms(0.5).endswith("µs")
+    assert fmt_ms(12.3) == "12.3 ms"
+    assert fmt_ms(2500) == "2.50 s"
+    assert fmt_pct(0.1234) == "12.3%"

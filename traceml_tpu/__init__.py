@@ -1,0 +1,33 @@
+"""traceml-tpu — TPU-native training observability.
+
+A ground-up, TPU-first framework with the capabilities of TraceML
+(reference: /root/reference/src/traceml_ai): wrap an unmodified JAX
+(Flax/Optax/pjit) or torch training script, split every training step into
+phases (input wait, h2d/infeed, compute, compile, optimizer, residual),
+sample per-chip memory and host counters, ship per-rank telemetry to an
+out-of-process aggregator, and emit rule-based diagnoses plus a
+``final_summary.json`` artifact.
+
+The public API is a lazy facade (reference: src/traceml_ai/__init__.py:50-61)
+so that ``import traceml_tpu`` never imports jax/torch eagerly — import cost
+and fail-open behavior matter more than convenience here.
+"""
+
+from traceml_tpu.version import __version__
+
+# NOTE: grows as the SDK lands; every symbol here must resolve via api.py.
+_API_SYMBOLS = ()
+
+__all__ = list(_API_SYMBOLS) + ["__version__"]
+
+
+def __getattr__(name):
+    if name in _API_SYMBOLS:
+        from traceml_tpu import api
+
+        return getattr(api, name)
+    raise AttributeError(f"module 'traceml_tpu' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals().keys()) + list(_API_SYMBOLS))

@@ -1,0 +1,1 @@
+"""Core abstractions shared by every layer (reference: src/traceml_ai/core/)."""

@@ -1,0 +1,27 @@
+"""Lifecycle protocols (reference: src/traceml_ai/core/lifecycle.py:12-31).
+
+Components that participate in the runtime/aggregator lifecycle implement
+one or more of these.  Kept as runtime-checkable protocols so fakes in tests
+need no inheritance.
+"""
+
+from __future__ import annotations
+
+from typing import Protocol, runtime_checkable
+
+
+@runtime_checkable
+class Startable(Protocol):
+    def start(self) -> None: ...
+
+
+@runtime_checkable
+class Stoppable(Protocol):
+    def stop(self) -> None: ...
+
+
+@runtime_checkable
+class Tickable(Protocol):
+    """Called periodically from an owning loop (sampler tick, UI tick)."""
+
+    def tick(self) -> None: ...

@@ -1,0 +1,78 @@
+"""Small named-factory registry (reference: src/traceml_ai/core/registry.py:18-97).
+
+Used for sampler specs, diagnostic domains, projection writers and display
+drivers.  Deliberately tiny: register by key, optionally with metadata, look
+up or iterate in registration order.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+
+class RegistryError(KeyError):
+    """Raised on duplicate registration or missing key."""
+
+
+class Registry:
+    """Thread-safe, ordered name → value registry."""
+
+    def __init__(self, name: str = "registry") -> None:
+        self._name = name
+        self._lock = threading.Lock()
+        self._items: Dict[str, Any] = {}
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def register(self, key: str, value: Any, *, overwrite: bool = False) -> Any:
+        with self._lock:
+            if key in self._items and not overwrite:
+                raise RegistryError(
+                    f"{self._name}: key {key!r} already registered"
+                )
+            self._items[key] = value
+        return value
+
+    def decorator(self, key: str) -> Callable[[Any], Any]:
+        """``@registry.decorator("name")`` registration sugar."""
+
+        def _wrap(value: Any) -> Any:
+            self.register(key, value)
+            return value
+
+        return _wrap
+
+    def get(self, key: str, default: Any = None) -> Any:
+        with self._lock:
+            return self._items.get(key, default)
+
+    def require(self, key: str) -> Any:
+        with self._lock:
+            if key not in self._items:
+                raise RegistryError(
+                    f"{self._name}: unknown key {key!r}; "
+                    f"known: {sorted(self._items)}"
+                )
+            return self._items[key]
+
+    def keys(self) -> List[str]:
+        with self._lock:
+            return list(self._items.keys())
+
+    def items(self) -> List[Tuple[str, Any]]:
+        with self._lock:
+            return list(self._items.items())
+
+    def __contains__(self, key: str) -> bool:
+        with self._lock:
+            return key in self._items
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self.keys())
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)

@@ -1,0 +1,48 @@
+"""Summary-section contracts (reference: src/traceml_ai/core/summaries.py:12-45).
+
+A summary section is the unit of the final report: it has a key, a schema
+payload (JSON-safe dict) and a status.  Failed sections degrade to a
+schema-valid NO_DATA payload rather than breaking the report
+(reference: reporting/final.py:752-798).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+STATUS_OK = "OK"
+STATUS_NO_DATA = "NO_DATA"
+STATUS_ERROR = "ERROR"
+
+
+@dataclasses.dataclass
+class SummarySection:
+    key: str
+    title: str
+    status: str = STATUS_OK
+    payload: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    error: Optional[str] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        # Reserved fields win over payload keys of the same name, so a
+        # telemetry row carrying its own "status" can never mask a
+        # STATUS_ERROR section marker.
+        out: Dict[str, Any] = dict(self.payload)
+        out["key"] = self.key
+        out["title"] = self.title
+        out["status"] = self.status
+        if self.error is not None:
+            out["error"] = self.error
+        return out
+
+
+@dataclasses.dataclass
+class SummaryResult:
+    sections: Dict[str, SummarySection] = dataclasses.field(default_factory=dict)
+
+    def add(self, section: SummarySection) -> None:
+        self.sections[section.key] = section
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {k: s.to_dict() for k, s in self.sections.items()}

@@ -1,0 +1,5 @@
+"""Per-rank local store + incremental sender (reference: src/traceml_ai/database/)."""
+
+from traceml_tpu.database.database import Database  # noqa: F401
+from traceml_tpu.database.database_sender import DBIncrementalSender  # noqa: F401
+from traceml_tpu.database.database_writer import DatabaseWriter  # noqa: F401

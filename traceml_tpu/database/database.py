@@ -1,0 +1,97 @@
+"""Bounded in-memory table store
+(reference: src/traceml_ai/database/database.py:7-186).
+
+Each sampler owns one ``Database``: a dict of named tables, each a
+``deque(maxlen=N)`` of row dicts plus a **monotonic append counter** so an
+incremental sender can detect new rows in O(1) without scanning
+(rows may have been evicted from the left; the counter never decreases).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional
+
+DEFAULT_MAX_ROWS = 3000
+
+
+class _Table:
+    __slots__ = ("rows", "appended")
+
+    def __init__(self, maxlen: int) -> None:
+        self.rows: Deque[Dict[str, Any]] = deque(maxlen=maxlen)
+        self.appended: int = 0  # total rows ever appended
+
+
+class Database:
+    def __init__(self, max_rows_per_table: int = DEFAULT_MAX_ROWS) -> None:
+        self._max_rows = int(max_rows_per_table)
+        self._tables: Dict[str, _Table] = {}
+        self._lock = threading.Lock()
+
+    def add_record(self, table: str, row: Dict[str, Any]) -> None:
+        with self._lock:
+            t = self._tables.get(table)
+            if t is None:
+                t = self._tables[table] = _Table(self._max_rows)
+            t.rows.append(row)
+            t.appended += 1
+
+    def add_records(self, table: str, rows: List[Dict[str, Any]]) -> None:
+        if not rows:
+            return
+        with self._lock:
+            t = self._tables.get(table)
+            if t is None:
+                t = self._tables[table] = _Table(self._max_rows)
+            t.rows.extend(rows)
+            t.appended += len(rows)
+
+    def table_names(self) -> List[str]:
+        with self._lock:
+            return list(self._tables.keys())
+
+    def append_count(self, table: str) -> int:
+        with self._lock:
+            t = self._tables.get(table)
+            return t.appended if t else 0
+
+    def tail(self, table: str, n: Optional[int] = None) -> List[Dict[str, Any]]:
+        with self._lock:
+            t = self._tables.get(table)
+            if t is None:
+                return []
+            rows = list(t.rows)
+        return rows if n is None else rows[-n:]
+
+    def rows_since(self, table: str, cursor: int) -> List[Dict[str, Any]]:
+        """Rows appended after append-count ``cursor``.
+
+        If more rows were appended than the table retains, the evicted ones
+        are silently lost (bounded-memory contract); callers get what is
+        still buffered.
+        """
+        rows, _ = self.collect_since(table, cursor)
+        return rows
+
+    def collect_since(self, table: str, cursor: int):
+        """Atomic (rows, new_cursor) snapshot.
+
+        Senders MUST use this (not rows_since + append_count) so a row
+        appended between the two reads cannot be skipped.
+        """
+        with self._lock:
+            t = self._tables.get(table)
+            if t is None:
+                return [], cursor
+            new = t.appended - cursor
+            new_cursor = t.appended
+            if new <= 0:
+                return [], new_cursor
+            rows = list(t.rows)
+        return (rows[-new:] if new < len(rows) else rows), new_cursor
+
+    def clear(self) -> None:
+        with self._lock:
+            self._tables.clear()

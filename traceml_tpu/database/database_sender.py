@@ -1,0 +1,52 @@
+"""Incremental envelope builder over a Database
+(reference: src/traceml_ai/database/database_sender.py:29-188).
+
+Keeps a per-table cursor on the append counter; ``collect_payload`` ships
+only rows appended since the previous call, wrapped in a canonical
+telemetry envelope.  Returns ``None`` when there is nothing new (so the
+publisher can skip the network entirely on idle ticks).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from traceml_tpu.database.database import Database
+from traceml_tpu.telemetry.envelope import (
+    SenderIdentity,
+    TelemetryEnvelope,
+    build_telemetry_envelope,
+)
+
+
+class DBIncrementalSender:
+    def __init__(self, sampler_name: str, db: Database) -> None:
+        self._sampler = sampler_name
+        self._db = db
+        self._cursors: Dict[str, int] = {}
+        self._identity: Optional[SenderIdentity] = None
+
+    @property
+    def sampler_name(self) -> str:
+        return self._sampler
+
+    def set_identity(self, identity: SenderIdentity) -> None:
+        self._identity = identity
+
+    def collect_payload(self) -> Optional[Dict[str, Any]]:
+        tables: Dict[str, List[Dict[str, Any]]] = {}
+        for table in self._db.table_names():
+            cursor = self._cursors.get(table, 0)
+            rows, new_cursor = self._db.collect_since(table, cursor)
+            if rows:
+                tables[table] = rows
+            self._cursors[table] = new_cursor
+        if not tables:
+            return None
+        env: TelemetryEnvelope = build_telemetry_envelope(
+            self._sampler, tables, identity=self._identity
+        )
+        return env.to_wire()
+
+    def reset(self) -> None:
+        self._cursors.clear()

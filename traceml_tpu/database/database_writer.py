@@ -1,0 +1,95 @@
+"""Optional per-rank on-disk telemetry backup
+(reference: src/traceml_ai/database/database_writer.py:28-137).
+
+Append-only, length-prefixed codec frames per table under
+``<logs>/<session>/rank_N/data/<sampler>/<table>.msgpack``.  Used for
+post-mortem `inspect` when the aggregator was unreachable.  Flushes are
+throttled; failures are logged and swallowed.
+"""
+
+from __future__ import annotations
+
+import struct
+from pathlib import Path
+from typing import Dict, Optional
+
+from traceml_tpu.database.database import Database
+from traceml_tpu.utils import msgpack_codec
+from traceml_tpu.utils.error_log import get_error_log
+
+_LEN = struct.Struct(">I")
+
+
+class DatabaseWriter:
+    def __init__(
+        self,
+        sampler_name: str,
+        db: Database,
+        out_dir: Optional[Path],
+        flush_every: int = 20,
+    ) -> None:
+        self._sampler = sampler_name
+        self._db = db
+        self._dir = Path(out_dir) / sampler_name if out_dir else None
+        self._cursors: Dict[str, int] = {}
+        self._flush_every = max(1, flush_every)
+        self._calls = 0
+
+    def flush(self, force: bool = False) -> int:
+        """Write new rows to disk; returns rows written."""
+        if self._dir is None:
+            return 0
+        self._calls += 1
+        if not force and self._calls % self._flush_every:
+            return 0
+        written = 0
+        try:
+            self._dir.mkdir(parents=True, exist_ok=True)
+            for table in self._db.table_names():
+                cursor = self._cursors.get(table, 0)
+                rows, new_cursor = self._db.collect_since(table, cursor)
+                if not rows:
+                    self._cursors[table] = new_cursor
+                    continue
+                # One buffer, one write: a crash can only tear the final
+                # frame, and the cursor advances only after a successful
+                # write so no rows are silently dropped on OSError.
+                buf = bytearray()
+                for row in rows:
+                    frame = msgpack_codec.encode(row)
+                    buf += _LEN.pack(len(frame))
+                    buf += frame
+                path = self._dir / f"{table}.msgpack"
+                with open(path, "ab") as fh:
+                    fh.write(buf)
+                self._cursors[table] = new_cursor
+                written += len(rows)
+        except Exception as exc:
+            get_error_log().warning(
+                f"disk backup flush failed for sampler={self._sampler}", exc
+            )
+        return written
+
+
+def iter_backup_file(path: Path):
+    """Decode an append-only backup file → yields rows (used by `inspect`).
+
+    A torn/corrupt tail frame (crash mid-write) terminates iteration
+    instead of raising — post-mortem inspection must work on exactly the
+    runs that crashed.
+    """
+    with open(path, "rb") as fh:
+        while True:
+            hdr = fh.read(_LEN.size)
+            if len(hdr) < _LEN.size:
+                return
+            (n,) = _LEN.unpack(hdr)
+            if n > 64 * 1024 * 1024:  # corrupt length → stop
+                return
+            body = fh.read(n)
+            if len(body) < n:
+                return
+            try:
+                yield msgpack_codec.decode(body)
+            except msgpack_codec.CodecError:
+                return

@@ -1,0 +1,16 @@
+"""Wire contract between per-rank runtimes and the aggregator
+(reference: src/traceml_ai/telemetry/)."""
+
+from traceml_tpu.telemetry.envelope import (  # noqa: F401
+    SenderIdentity,
+    TelemetryEnvelope,
+    build_telemetry_envelope,
+    normalize_telemetry_envelope,
+)
+from traceml_tpu.telemetry.control import (  # noqa: F401
+    CONTROL_KEY,
+    RANK_FINISHED,
+    build_rank_finished,
+    is_control_message,
+    control_kind,
+)

@@ -1,0 +1,33 @@
+"""Control-plane messages sharing the telemetry channel
+(reference: src/traceml_ai/telemetry/control.py:24-81).
+
+The only control message today is ``rank_finished`` — the end-of-run
+barrier marker the aggregator counts against ``expected_world_size``
+before finalizing (reference: aggregator/trace_aggregator.py:440-499).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Mapping, Optional
+
+CONTROL_KEY = "_traceml_control"
+RANK_FINISHED = "rank_finished"
+
+
+def build_rank_finished(identity_meta: Mapping[str, Any]) -> Dict[str, Any]:
+    return {
+        CONTROL_KEY: RANK_FINISHED,
+        "meta": dict(identity_meta),
+        "timestamp": time.time(),
+    }
+
+
+def is_control_message(payload: Any) -> bool:
+    return isinstance(payload, Mapping) and CONTROL_KEY in payload
+
+
+def control_kind(payload: Any) -> Optional[str]:
+    if not is_control_message(payload):
+        return None
+    return str(payload[CONTROL_KEY])

@@ -1,0 +1,146 @@
+"""Telemetry envelope (reference: src/traceml_ai/telemetry/envelope.py:92-166).
+
+Canonical shape on the wire::
+
+    {
+      "meta": {
+        "schema": 1,
+        "session_id": str,
+        "sampler": str,                # e.g. "step_time"
+        "timestamp": float,            # sender host unix time
+        "rank": int,                   # == global_rank (back-compat alias)
+        "global_rank": int,
+        "local_rank": int,
+        "world_size": int,
+        "local_world_size": int,
+        "node_rank": int,
+        "hostname": str,
+        "pid": int,
+        "platform": str,               # "tpu" | "cpu" | "gpu"
+        "device_kind": str,            # e.g. "TPU v5p"
+      },
+      "body": {"tables": {table_name: [row, ...]}}
+    }
+
+``normalize_telemetry_envelope`` accepts the canonical shape and a legacy
+flat shape ``{"sampler":..., "tables":...}`` and always returns the
+canonical one — the aggregator only ever sees canonical envelopes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import socket
+import os
+import time
+from typing import Any, Dict, List, Mapping, Optional
+
+SCHEMA_VERSION = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class SenderIdentity:
+    """Identity attached to every envelope a rank emits
+    (reference: runtime/identity.py:88-131; extended with TPU fields)."""
+
+    session_id: str = "unknown"
+    global_rank: int = 0
+    local_rank: int = 0
+    world_size: int = 1
+    local_world_size: int = 1
+    node_rank: int = 0
+    hostname: str = dataclasses.field(default_factory=socket.gethostname)
+    pid: int = dataclasses.field(default_factory=os.getpid)
+    platform: str = "cpu"
+    device_kind: str = "unknown"
+
+    def to_meta(self) -> Dict[str, Any]:
+        return {
+            "schema": SCHEMA_VERSION,
+            "session_id": self.session_id,
+            "rank": self.global_rank,
+            "global_rank": self.global_rank,
+            "local_rank": self.local_rank,
+            "world_size": self.world_size,
+            "local_world_size": self.local_world_size,
+            "node_rank": self.node_rank,
+            "hostname": self.hostname,
+            "pid": self.pid,
+            "platform": self.platform,
+            "device_kind": self.device_kind,
+        }
+
+
+@dataclasses.dataclass
+class TelemetryEnvelope:
+    meta: Dict[str, Any]
+    tables: Dict[str, List[Dict[str, Any]]]
+
+    @property
+    def sampler(self) -> str:
+        return str(self.meta.get("sampler", "unknown"))
+
+    @property
+    def global_rank(self) -> int:
+        return int(self.meta.get("global_rank", self.meta.get("rank", 0)))
+
+    def to_wire(self) -> Dict[str, Any]:
+        return {"meta": dict(self.meta), "body": {"tables": self.tables}}
+
+
+def build_telemetry_envelope(
+    sampler: str,
+    tables: Mapping[str, List[Dict[str, Any]]],
+    identity: Optional[SenderIdentity] = None,
+    timestamp: Optional[float] = None,
+) -> TelemetryEnvelope:
+    identity = identity or SenderIdentity()
+    meta = identity.to_meta()
+    meta["sampler"] = sampler
+    meta["timestamp"] = time.time() if timestamp is None else timestamp
+    return TelemetryEnvelope(meta=meta, tables={k: list(v) for k, v in tables.items()})
+
+
+def normalize_telemetry_envelope(payload: Any) -> Optional[TelemetryEnvelope]:
+    """Coerce a decoded wire payload into a canonical envelope.
+
+    Returns None for payloads that are not telemetry (e.g. control
+    messages, garbage) — the caller decides what to do with those.
+    """
+    if not isinstance(payload, Mapping):
+        return None
+    if "meta" in payload and "body" in payload:
+        meta = payload.get("meta")
+        body = payload.get("body")
+        if not isinstance(meta, Mapping) or not isinstance(body, Mapping):
+            return None
+        tables = body.get("tables")
+        if not isinstance(tables, Mapping):
+            return None
+        meta = dict(meta)
+        meta.setdefault("schema", SCHEMA_VERSION)
+        meta.setdefault("global_rank", meta.get("rank", 0))
+        meta.setdefault("rank", meta.get("global_rank", 0))
+        return TelemetryEnvelope(
+            meta=meta,
+            tables={str(k): list(v) for k, v in tables.items() if isinstance(v, list)},
+        )
+    # Legacy flat shape: {"sampler": ..., "tables": {...}, **identity}
+    if "tables" in payload and "sampler" in payload:
+        tables = payload.get("tables")
+        if not isinstance(tables, Mapping):
+            return None
+        meta = {
+            k: v
+            for k, v in payload.items()
+            if k not in ("tables",) and not isinstance(v, (dict, list))
+        }
+        meta.setdefault("schema", SCHEMA_VERSION)
+        meta.setdefault("global_rank", meta.get("rank", 0))
+        meta.setdefault("rank", meta.get("global_rank", 0))
+        meta.setdefault("timestamp", time.time())
+        return TelemetryEnvelope(
+            meta=meta,
+            tables={str(k): list(v) for k, v in tables.items() if isinstance(v, list)},
+        )
+    return None

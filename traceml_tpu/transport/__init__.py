@@ -1,0 +1,3 @@
+"""Telemetry transport (reference: src/traceml_ai/transport/)."""
+
+from traceml_tpu.transport.tcp_transport import TCPServer, TCPClient  # noqa: F401

@@ -1,0 +1,329 @@
+"""Length-prefixed TCP transport
+(reference: src/traceml_ai/transport/tcp_transport.py:21-268).
+
+Frames: 4-byte big-endian length + codec body (see utils/msgpack_codec).
+One ``send_batch`` call encodes a *list* of payloads into ONE frame and one
+``sendall`` — the per-tick batching contract that keeps syscall count O(1)
+per sampler tick.
+
+Differences from the reference, chosen for the TPU build:
+
+* the server is a **single selector-driven thread** (accept + read for all
+  clients) instead of thread-per-client — hundreds of ranks on a pod slice
+  must not mean hundreds of threads in the aggregator;
+* the receive path drains complete frames in O(bytes) with a rolling
+  buffer offset (the reference ships an O(N) drain too, proved by its
+  bench tests/benchmarks/bench_tcp_drain.py).
+
+The client is best-effort and NEVER raises into training code: lazy
+connect, drop-on-failure, bounded reconnect backoff
+(reference contract: tcp_transport.py:182-268).
+"""
+
+from __future__ import annotations
+
+import selectors
+import socket
+import struct
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from traceml_tpu.utils import msgpack_codec
+from traceml_tpu.utils.error_log import get_error_log
+
+_LEN = struct.Struct(">I")
+MAX_FRAME_BYTES = 256 * 1024 * 1024  # sanity bound against corrupt lengths
+
+
+class _ClientBuffer:
+    """Incremental frame decoder with O(total bytes) drain."""
+
+    __slots__ = ("buf", "offset")
+
+    def __init__(self) -> None:
+        self.buf = bytearray()
+        self.offset = 0  # consumed prefix
+
+    def feed(self, data: bytes) -> List[bytes]:
+        self.buf.extend(data)
+        frames: List[bytes] = []
+        while True:
+            avail = len(self.buf) - self.offset
+            if avail < _LEN.size:
+                break
+            (n,) = _LEN.unpack_from(self.buf, self.offset)
+            if n > MAX_FRAME_BYTES:
+                raise ValueError(f"frame length {n} exceeds bound")
+            if avail < _LEN.size + n:
+                break
+            start = self.offset + _LEN.size
+            frames.append(bytes(self.buf[start : start + n]))
+            self.offset = start + n
+        # Compact once consumed prefix dominates — amortized O(1) per byte.
+        if self.offset > 65536 and self.offset * 2 > len(self.buf):
+            del self.buf[: self.offset]
+            self.offset = 0
+        return frames
+
+
+def encode_frame(payload: Any) -> bytes:
+    body = msgpack_codec.encode(payload)
+    return _LEN.pack(len(body)) + body
+
+
+class TCPServer:
+    """Aggregator-side ingest server.
+
+    Decoded payloads are appended to an internal thread-safe queue; the
+    aggregator loop calls :meth:`drain` and blocks on :meth:`wait_for_data`
+    for low-latency ingest (reference: tcp_transport.py:119-178).
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+        self._host = host
+        self._requested_port = port
+        self._sock: Optional[socket.socket] = None
+        self._selector: Optional[selectors.DefaultSelector] = None
+        self._thread: Optional[threading.Thread] = None
+        self._running = threading.Event()
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._lock = threading.Lock()
+        self._pending: List[Any] = []
+        self._data_event = threading.Event()
+        self._clients: Dict[int, _ClientBuffer] = {}
+        self.port: Optional[int] = None
+        self.frames_received = 0
+        self.decode_errors = 0
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        sock.bind((self._host, self._requested_port))
+        sock.listen(128)
+        sock.setblocking(False)
+        self._sock = sock
+        self.port = sock.getsockname()[1]
+        self._selector = selectors.DefaultSelector()
+        self._selector.register(sock, selectors.EVENT_READ, ("accept", None))
+        self._selector.register(self._wake_r, selectors.EVENT_READ, ("wake", None))
+        self._running.set()
+        self._thread = threading.Thread(
+            target=self._serve, name="traceml-tcp-server", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        """Stop and release every fd.  A stopped server is single-use."""
+        if self._thread is None:
+            return
+        self._running.clear()
+        try:
+            self._wake_w.send(b"x")
+        except OSError:
+            pass
+        self._thread.join(timeout=5)
+        self._thread = None
+        try:
+            if self._selector:
+                for key in list(self._selector.get_map().values()):
+                    try:
+                        self._selector.unregister(key.fileobj)
+                        if key.fileobj not in (self._sock, self._wake_r):
+                            key.fileobj.close()
+                    except Exception:
+                        pass
+                self._selector.close()
+        except Exception:
+            pass
+        self._clients.clear()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+        for s in (self._wake_r, self._wake_w):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    # -- consumer API --------------------------------------------------
+    def wait_for_data(self, timeout: float) -> bool:
+        fired = self._data_event.wait(timeout)
+        if fired:
+            self._data_event.clear()
+        return fired
+
+    def drain(self) -> List[Any]:
+        with self._lock:
+            out = self._pending
+            self._pending = []
+        return out
+
+    # -- server thread -------------------------------------------------
+    def _serve(self) -> None:
+        assert self._selector is not None and self._sock is not None
+        while self._running.is_set():
+            try:
+                events = self._selector.select(timeout=0.5)
+            except OSError:
+                break
+            for key, _mask in events:
+                kind, _ = key.data
+                if kind == "wake":
+                    try:
+                        self._wake_r.recv(4096)
+                    except OSError:
+                        pass
+                elif kind == "accept":
+                    self._accept()
+                else:
+                    self._read(key.fileobj)
+
+    def _accept(self) -> None:
+        assert self._sock is not None and self._selector is not None
+        try:
+            while True:
+                conn, _addr = self._sock.accept()
+                conn.setblocking(False)
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self._clients[conn.fileno()] = _ClientBuffer()
+                self._selector.register(conn, selectors.EVENT_READ, ("client", None))
+        except BlockingIOError:
+            return
+        except OSError:
+            return
+
+    def _read(self, conn: socket.socket) -> None:
+        assert self._selector is not None
+        fileno = conn.fileno()
+        try:
+            data = conn.recv(1 << 20)
+        except (BlockingIOError, InterruptedError):
+            return
+        except OSError:
+            data = b""
+        if not data:
+            try:
+                self._selector.unregister(conn)
+            except Exception:
+                pass
+            self._clients.pop(fileno, None)
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return
+        buf = self._clients.get(fileno)
+        if buf is None:
+            return
+        try:
+            frames = buf.feed(data)
+        except ValueError as exc:
+            get_error_log().warning(f"dropping client with bad frame: {exc}")
+            try:
+                self._selector.unregister(conn)
+            except Exception:
+                pass
+            self._clients.pop(fileno, None)
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return
+        if not frames:
+            return
+        decoded: List[Any] = []
+        for frame in frames:
+            try:
+                payload = msgpack_codec.decode(frame)
+            except msgpack_codec.CodecError as exc:
+                self.decode_errors += 1
+                get_error_log().warning(f"undecodable frame: {exc}")
+                continue
+            # A batch frame is a list of payloads; flatten here so the
+            # aggregator sees individual messages.
+            if isinstance(payload, list):
+                decoded.extend(payload)
+            else:
+                decoded.append(payload)
+        self.frames_received += len(frames)
+        if decoded:
+            with self._lock:
+                self._pending.extend(decoded)
+            self._data_event.set()
+
+
+class TCPClient:
+    """Best-effort sender: never raises, lazily connects, drops on failure."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        connect_timeout: float = 2.0,
+        reconnect_backoff: float = 1.0,
+    ) -> None:
+        self._host = host
+        self._port = port
+        self._timeout = connect_timeout
+        self._backoff = reconnect_backoff
+        self._sock: Optional[socket.socket] = None
+        self._last_fail = 0.0
+        self._lock = threading.Lock()
+        self.batches_sent = 0
+        self.batches_dropped = 0
+
+    def _connect_locked(self) -> bool:
+        if self._sock is not None:
+            return True
+        now = time.monotonic()
+        if now - self._last_fail < self._backoff:
+            return False
+        try:
+            sock = socket.create_connection(
+                (self._host, self._port), timeout=self._timeout
+            )
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            sock.settimeout(self._timeout)
+            self._sock = sock
+            return True
+        except OSError:
+            self._last_fail = now
+            return False
+
+    def send_batch(self, payloads: List[Any]) -> bool:
+        """Encode ``payloads`` as ONE frame, one sendall. True on success."""
+        if not payloads:
+            return True
+        with self._lock:
+            if not self._connect_locked():
+                self.batches_dropped += 1
+                return False
+            try:
+                assert self._sock is not None
+                self._sock.sendall(encode_frame(payloads))
+                self.batches_sent += 1
+                return True
+            except Exception:
+                self.batches_dropped += 1
+                self._teardown_locked()
+                return False
+
+    def _teardown_locked(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+            self._last_fail = time.monotonic()
+
+    def close(self) -> None:
+        with self._lock:
+            self._teardown_locked()

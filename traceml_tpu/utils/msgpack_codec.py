@@ -1,0 +1,96 @@
+"""Wire codec (reference: src/traceml_ai/utils/msgpack_codec.py:30-100).
+
+msgpack (C extension, baked in) with a JSON fallback so the wire protocol
+still works on minimal hosts.  The fallback stamps a one-byte prefix so a
+receiver can decode either format regardless of its local codec choice:
+
+    b"\\x01" + msgpack bytes      — msgpack payload
+    b"\\x02" + utf-8 JSON bytes   — JSON payload
+
+The prefix is part of the frame body (inside the length prefix added by the
+transport layer), not a transport concern.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+_MSGPACK_PREFIX = b"\x01"
+_JSON_PREFIX = b"\x02"
+
+try:  # pragma: no cover - exercised implicitly
+    import msgpack as _msgpack
+
+    _HAVE_MSGPACK = True
+except Exception:  # pragma: no cover
+    _msgpack = None
+    _HAVE_MSGPACK = False
+
+
+class CodecError(ValueError):
+    pass
+
+
+def _json_default(obj: Any) -> Any:
+    # numpy scalars & arrays show up in telemetry rows; coerce.
+    try:
+        import numpy as np
+
+        if isinstance(obj, np.ndarray):
+            return obj.tolist()
+        if isinstance(obj, np.generic):
+            return obj.item()
+    except Exception:
+        pass
+    if isinstance(obj, (bytes, bytearray)):
+        return obj.decode("utf-8", "replace")
+    return str(obj)
+
+
+def encode(obj: Any) -> bytes:
+    """Encode a JSON-safe object to wire bytes (prefix + body)."""
+    if _HAVE_MSGPACK:
+        try:
+            return _MSGPACK_PREFIX + _msgpack.packb(
+                obj, use_bin_type=True, default=_json_default
+            )
+        except Exception:
+            pass  # fall through to JSON
+    try:
+        return _JSON_PREFIX + json.dumps(obj, default=_json_default).encode("utf-8")
+    except Exception as exc:  # pragma: no cover - last resort
+        raise CodecError(f"cannot encode payload: {exc}") from exc
+
+
+def decode(data: bytes) -> Any:
+    """Decode wire bytes produced by :func:`encode`."""
+    if not data:
+        raise CodecError("empty frame")
+    prefix, body = data[:1], data[1:]
+    if prefix == _MSGPACK_PREFIX:
+        if not _HAVE_MSGPACK:
+            raise CodecError("msgpack frame received but msgpack unavailable")
+        try:
+            return _msgpack.unpackb(body, raw=False, strict_map_key=False)
+        except Exception as exc:
+            raise CodecError(f"bad msgpack frame: {exc}") from exc
+    if prefix == _JSON_PREFIX:
+        try:
+            return json.loads(body.decode("utf-8"))
+        except Exception as exc:
+            raise CodecError(f"bad json frame: {exc}") from exc
+    # Legacy/unknown prefix: try msgpack then JSON on the whole buffer.
+    if _HAVE_MSGPACK:
+        try:
+            return _msgpack.unpackb(data, raw=False, strict_map_key=False)
+        except Exception:
+            pass
+    try:
+        return json.loads(data.decode("utf-8"))
+    except Exception as exc:
+        raise CodecError(f"undecodable frame (prefix={prefix!r}): {exc}") from exc
+
+
+def codec_name() -> str:
+    return "msgpack" if _HAVE_MSGPACK else "json"

@@ -1,0 +1,3 @@
+"""Version of the traceml-tpu framework."""
+
+__version__ = "0.1.0"
