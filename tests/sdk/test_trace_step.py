@@ -1,0 +1,131 @@
+import time
+
+import pytest
+
+from traceml_tpu.sdk import state as state_mod
+from traceml_tpu.sdk.instrumentation import trace_step, trace_time
+from traceml_tpu.utils import timing
+from traceml_tpu.utils.step_memory import FakeMemoryBackend, StepMemoryTracker
+from traceml_tpu.utils.timing import (
+    DATALOADER_NEXT,
+    GLOBAL_STEP_QUEUE,
+    STEP_TIME,
+    drain_step_memory_rows,
+)
+
+
+@pytest.fixture(autouse=True)
+def fresh_state():
+    st = state_mod.reset_state_for_tests()
+    st.mem_tracker = StepMemoryTracker(
+        FakeMemoryBackend(
+            [[{"device_id": 0, "device_kind": "fake", "current_bytes": 100,
+               "peak_bytes": 120, "limit_bytes": 1000}]]
+        )
+    )
+    GLOBAL_STEP_QUEUE.drain()
+    drain_step_memory_rows()
+    yield st
+    GLOBAL_STEP_QUEUE.drain()
+    drain_step_memory_rows()
+
+
+def test_trace_step_advances_counter_and_flushes(fresh_state):
+    st = fresh_state
+    with trace_step():
+        time.sleep(0.005)
+    assert st.current_step == 1
+    batches = GLOBAL_STEP_QUEUE.drain()
+    assert len(batches) == 1
+    names = [e.name for e in batches[0].events]
+    assert STEP_TIME in names
+    step_ev = next(e for e in batches[0].events if e.name == STEP_TIME)
+    assert step_ev.cpu_ms >= 5
+
+
+def test_trace_step_emits_memory_rows(fresh_state):
+    with trace_step():
+        pass
+    rows = drain_step_memory_rows()
+    assert len(rows) == 1
+    assert rows[0]["step"] == 1
+    assert rows[0]["current_bytes"] == 100
+    assert rows[0]["backend"] == "fake"
+
+
+def test_nested_trace_step_is_inert(fresh_state):
+    st = fresh_state
+    with trace_step():
+        with trace_step():
+            pass
+    assert st.current_step == 1
+    assert len(GLOBAL_STEP_QUEUE.drain()) == 1
+
+
+def test_trace_step_never_raises_with_broken_memtracker(fresh_state):
+    st = fresh_state
+
+    class Boom:
+        def reset(self, step):
+            raise RuntimeError("boom")
+
+        def record(self, step):
+            raise RuntimeError("boom")
+
+    st.mem_tracker = Boom()
+    with trace_step():
+        pass  # must not raise
+    assert st.current_step == 1
+
+
+def test_trace_time_user_region(fresh_state):
+    with trace_step():
+        with trace_time("tokenize"):
+            time.sleep(0.002)
+    batch = GLOBAL_STEP_QUEUE.drain()[0]
+    names = [e.name for e in batch.events]
+    assert "user:tokenize" in names
+
+
+def test_exception_propagates_but_flushes(fresh_state):
+    st = fresh_state
+    with pytest.raises(ValueError):
+        with trace_step():
+            raise ValueError("user error")
+    assert st.current_step == 1
+    assert len(GLOBAL_STEP_QUEUE.drain()) == 1
+    assert not st.tls.in_step  # gate released
+
+
+def test_dataloader_wrapper_times_next(fresh_state):
+    from traceml_tpu.instrumentation.dataloader import wrap_dataloader
+
+    def slow_gen():
+        for i in range(3):
+            time.sleep(0.004)
+            yield i
+
+    st = fresh_state
+    items = []
+    loader = wrap_dataloader(slow_gen())
+    it = iter(loader)
+    with trace_step():
+        items.append(next(it))
+    with trace_step():
+        items.append(next(it))
+    assert items == [0, 1]
+    batches = GLOBAL_STEP_QUEUE.drain()
+    dl_events = [
+        e for b in batches for e in b.events if e.name == DATALOADER_NEXT
+    ]
+    assert len(dl_events) == 2
+    assert all(e.cpu_ms >= 3 for e in dl_events)
+
+
+def test_wrap_dataloader_duplicate_guard(fresh_state):
+    from traceml_tpu.instrumentation.dataloader import wrap_dataloader
+
+    inner = wrap_dataloader([1, 2, 3])
+    outer = wrap_dataloader(inner)
+    assert outer is inner
+    assert list(outer) == [1, 2, 3]

@@ -1,0 +1,132 @@
+import time
+
+from traceml_tpu.utils.marker_resolver import MarkerResolver
+from traceml_tpu.utils.timing import (
+    BoundedStepQueue,
+    DeviceMarker,
+    StepEventBuffer,
+    StepTimeBatch,
+    TimeEvent,
+    smallest_leaf,
+    timed_region,
+)
+
+
+class FakeHandle:
+    """Controllable is_ready stand-in (the 'fake device layer')."""
+
+    def __init__(self, ready=False):
+        self.ready = ready
+        self.polls = 0
+
+    def is_ready(self):
+        self.polls += 1
+        return self.ready
+
+
+def test_time_event_host_only():
+    ev = TimeEvent("x", 1)
+    time.sleep(0.01)
+    ev.close()
+    assert ev.cpu_ms >= 10
+    assert ev.try_resolve()  # no marker → resolved once closed
+    assert ev.device_ready_at is None
+
+
+def test_device_marker_poll_lifecycle():
+    h = FakeHandle(ready=False)
+    m = DeviceMarker([h])
+    assert not m.poll()
+    assert not m.resolved
+    h.ready = True
+    assert m.poll(now=123.0)
+    assert m.resolved
+    assert m.ready_at == 123.0
+    # handles are dropped after resolution; further polls are cheap
+    polls = h.polls
+    assert m.poll()
+    assert h.polls == polls
+
+
+def test_device_marker_empty_handles_instant():
+    m = DeviceMarker([object()])  # no is_ready attr → filtered out
+    assert m.resolved
+    assert m.ready_at == m.dispatched_at
+
+
+def test_event_with_marker_resolution():
+    ev = TimeEvent("y", 2)
+    h = FakeHandle(ready=False)
+    ev.marker = DeviceMarker([h])
+    ev.close()
+    assert not ev.try_resolve()
+    h.ready = True
+    assert ev.try_resolve()
+    assert ev.device_ready_at is not None
+
+
+def test_timed_region_sink_and_mark():
+    buf = StepEventBuffer()
+    h = FakeHandle(ready=True)
+
+    class Tree:
+        pass
+
+    with timed_region("phase", 3, sink=buf.add) as tr:
+        tr.event.marker = DeviceMarker([h])  # direct, bypassing jax tree
+    assert len(buf) == 1
+    batch = buf.flush(3)
+    assert isinstance(batch, StepTimeBatch)
+    assert batch.step == 3
+    assert batch.resolved()
+    assert buf.flush(3) is None  # empty after flush
+
+
+def test_bounded_queue_drops_not_blocks():
+    q = BoundedStepQueue("test", maxsize=2)
+    for i in range(4):
+        q.put(StepTimeBatch(i, []))
+    assert q.qsize() == 2
+    assert q.dropped == 2
+    got = q.drain()
+    assert [b.step for b in got] == [0, 1]
+    assert q.drain() == []
+
+
+def test_smallest_leaf_picks_min_size():
+    class Arr:
+        def __init__(self, size):
+            self.size = size
+
+        def is_ready(self):
+            return True
+
+    tree = {"a": Arr(100), "b": [Arr(4), Arr(50)]}
+    picked = smallest_leaf(tree)
+    assert len(picked) == 1
+    assert picked[0].size == 4
+
+
+def test_marker_resolver_stamps_ready():
+    r = MarkerResolver(poll_interval=0.001)
+    h = FakeHandle(ready=False)
+    m = DeviceMarker([h])
+    r.submit(m)
+    time.sleep(0.05)
+    assert not m.resolved
+    h.ready = True
+    deadline = time.monotonic() + 2
+    while not m.resolved and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert m.resolved
+    assert r.pending_count() == 0
+    r.stop()
+
+
+def test_marker_resolver_submit_resolved_is_noop():
+    r = MarkerResolver()
+    m = DeviceMarker([FakeHandle(ready=True)])
+    m.poll()
+    r.submit(m)
+    assert r.pending_count() == 0
+    r.stop()

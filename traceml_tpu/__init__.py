@@ -16,7 +16,19 @@ and fail-open behavior matter more than convenience here.
 from traceml_tpu.version import __version__
 
 # NOTE: grows as the SDK lands; every symbol here must resolve via api.py.
-_API_SYMBOLS = ()
+_API_SYMBOLS = (
+    "init",
+    "start",
+    "trace_step",
+    "trace_time",
+    "wrap_dataloader",
+    "wrap_step_fn",
+    "wrap_h2d",
+    "wrap_forward",
+    "wrap_backward",
+    "wrap_optimizer",
+    "current_step",
+)
 
 __all__ = list(_API_SYMBOLS) + ["__version__"]
 

@@ -1,0 +1,25 @@
+"""Public API surface (reference: src/traceml_ai/api.py:12-131).
+
+Everything here is lazily resolved through ``traceml_tpu.__getattr__`` so
+``import traceml_tpu`` stays free of jax/torch imports.
+"""
+
+from __future__ import annotations
+
+from traceml_tpu.sdk.initial import init, start  # noqa: F401
+from traceml_tpu.sdk.instrumentation import trace_step, trace_time  # noqa: F401
+from traceml_tpu.sdk.step_fn import wrap_step_fn  # noqa: F401
+from traceml_tpu.sdk.wrappers import (  # noqa: F401
+    wrap_backward,
+    wrap_forward,
+    wrap_h2d,
+    wrap_optimizer,
+)
+from traceml_tpu.instrumentation.dataloader import wrap_dataloader  # noqa: F401
+
+
+def current_step() -> int:
+    """The current trace step counter (0 before the first step)."""
+    from traceml_tpu.sdk.state import get_state
+
+    return get_state().current_step

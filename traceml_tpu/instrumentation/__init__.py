@@ -1,0 +1,2 @@
+"""Instrumentation: measurement hooks around user training code
+(reference: src/traceml_ai/instrumentation/)."""

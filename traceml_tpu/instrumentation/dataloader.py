@@ -1,0 +1,160 @@
+"""Input-pipeline (dataloader) timing
+(reference: src/traceml_ai/instrumentation/patches/dataloader_patch.py:8-34).
+
+JAX has no canonical DataLoader class, so the primary surface is a
+generic iterator wrapper: each ``next()`` is timed as
+``dataloader_next`` — the input-wait phase that drives the INPUT_BOUND
+and INPUT_STRAGGLER diagnoses.  For torch, an auto-patch replaces
+``DataLoader.__iter__`` with the same wrapper.
+
+Optionally the wrapper also moves each batch to device with timed
+``device_put`` (``to_device=True``) — the recommended JAX pattern, since
+an implicit transfer inside a jitted call cannot be attributed to h2d.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Iterator, Optional
+
+from traceml_tpu.sdk.state import TraceState, get_state
+from traceml_tpu.utils.error_log import get_error_log
+from traceml_tpu.utils.marker_resolver import get_marker_resolver
+from traceml_tpu.utils.timing import DATALOADER_NEXT, H2D_TIME, timed_region
+
+_PATCHED_FLAG = "_traceml_tpu_patched"
+
+
+def _timed_device_put(batch: Any, state: TraceState, device: Any = None) -> Any:
+    import jax
+
+    region = timed_region(H2D_TIME, state.current_step, sink=state.buffer.add)
+    with region as tr:
+        out = (
+            jax.device_put(batch) if device is None else jax.device_put(batch, device)
+        )
+        tr.mark(out)
+    ev = region.event
+    if ev.marker is not None and not ev.marker.resolved:
+        get_marker_resolver().submit(ev.marker)
+    return out
+
+
+class wrap_dataloader:
+    """Iterate a dataloader with per-``next()`` input-wait timing.
+
+    Duplicate-instrumentation guard: wrapping an already-wrapped iterator
+    returns it unchanged (reference: sdk/wrappers.py duplicate guards).
+    """
+
+    def __new__(cls, iterable: Iterable, *args: Any, **kwargs: Any):
+        if isinstance(iterable, wrap_dataloader):
+            return iterable
+        return super().__new__(cls)
+
+    def __init__(
+        self,
+        iterable: Iterable,
+        *,
+        to_device: bool = False,
+        device: Any = None,
+        state: Optional[TraceState] = None,
+    ) -> None:
+        if getattr(self, "_init_done", False):
+            return
+        self._init_done = True
+        self._iterable = iterable
+        self._to_device = to_device
+        self._device = device
+        self._state = state or get_state()
+
+    def __iter__(self) -> Iterator[Any]:
+        st = self._state
+        it = iter(self._iterable)
+        while True:
+            # Nested-timer guard: a DataLoader whose __iter__ was patched
+            # would double-time `next()`; the TLS depth gate prevents it.
+            if st.tls.dataloader_depth > 0:
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    return
+            else:
+                st.tls.dataloader_depth += 1
+                region = timed_region(
+                    DATALOADER_NEXT, st.current_step, sink=None
+                )
+                try:
+                    with region:
+                        batch = next(it)
+                except StopIteration:
+                    return
+                finally:
+                    st.tls.dataloader_depth -= 1
+                # Only record real batches (not the StopIteration probe).
+                try:
+                    st.buffer.add(region.event)
+                except Exception as exc:
+                    get_error_log().warning("dataloader event add failed", exc)
+            if self._to_device:
+                try:
+                    batch = _timed_device_put(batch, st, self._device)
+                except Exception as exc:
+                    get_error_log().warning("dataloader device_put failed", exc)
+            yield batch
+
+    def __len__(self) -> int:
+        return len(self._iterable)  # type: ignore[arg-type]
+
+
+def patch_torch_dataloader(state: Optional[TraceState] = None) -> bool:
+    """Replace ``torch.utils.data.DataLoader.__iter__`` with a timing
+    generator (reference: dataloader_patch.py:8-34).  Idempotent."""
+    try:
+        from torch.utils.data import DataLoader
+    except Exception:
+        return False
+    if getattr(DataLoader, _PATCHED_FLAG, False):
+        return True
+    st = state or get_state()
+    original_iter = DataLoader.__iter__
+
+    def patched_iter(self):  # noqa: ANN001
+        it = original_iter(self)
+        while True:
+            if st.tls.dataloader_depth > 0:
+                try:
+                    yield next(it)
+                except StopIteration:
+                    return
+                continue
+            st.tls.dataloader_depth += 1
+            region = timed_region(DATALOADER_NEXT, st.current_step, sink=None)
+            try:
+                with region:
+                    batch = next(it)
+            except StopIteration:
+                return
+            finally:
+                st.tls.dataloader_depth -= 1
+            try:
+                st.buffer.add(region.event)
+            except Exception:
+                pass
+            yield batch
+
+    patched_iter._traceml_original = original_iter  # type: ignore[attr-defined]
+    DataLoader.__iter__ = patched_iter
+    setattr(DataLoader, _PATCHED_FLAG, True)
+    return True
+
+
+def unpatch_torch_dataloader() -> None:
+    try:
+        from torch.utils.data import DataLoader
+    except Exception:
+        return
+    patched = DataLoader.__iter__
+    original = getattr(patched, "_traceml_original", None)
+    if original is not None:
+        DataLoader.__iter__ = original
+        setattr(DataLoader, _PATCHED_FLAG, False)

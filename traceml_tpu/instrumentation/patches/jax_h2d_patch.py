@@ -1,0 +1,96 @@
+"""H2D (host→device transfer) auto-timer for JAX
+(reference concept: src/traceml_ai/instrumentation/patches/h2d_auto_timer_patch.py:65-110,
+which patches ``torch.Tensor.to``; the JAX equivalent surface is
+``jax.device_put`` — "H2D timing hooks TPU infeed" per BASELINE.json).
+
+Gates (mirror of reference ``should_time_h2d``, h2d.py:8-67):
+
+* only while inside a ``trace_step`` (TLS),
+* outermost-only (depth counter),
+* never under a jax trace (tracers → pass through untouched),
+* only host-side values (numpy/python containers); moving an existing
+  committed ``jax.Array`` between devices is D2D, not H2D.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from traceml_tpu.sdk.state import TraceState, get_state
+from traceml_tpu.utils.error_log import get_error_log
+from traceml_tpu.utils.marker_resolver import get_marker_resolver
+from traceml_tpu.utils.timing import H2D_TIME, timed_region
+
+_original_device_put = None
+
+
+def _contains_tracer_or_device_array(x: Any) -> bool:
+    try:
+        import jax
+
+        for leaf in jax.tree_util.tree_leaves(x):
+            if isinstance(leaf, jax.core.Tracer):
+                return True
+            if isinstance(leaf, jax.Array):
+                return True  # already on device → D2D or no-op
+        return False
+    except Exception:
+        return True  # unsure → don't time
+
+
+def patch_jax_h2d(state: Optional[TraceState] = None) -> bool:
+    """Replace ``jax.device_put`` with a timing wrapper.  Idempotent."""
+    global _original_device_put
+    try:
+        import jax
+    except Exception:
+        return False
+    if _original_device_put is not None:
+        return True
+    st = state or get_state()
+    original = jax.device_put
+
+    def timed_device_put(x, device=None, *args, **kwargs):  # noqa: ANN001
+        try:
+            should_time = (
+                st.tls.in_step
+                and st.tls.h2d_depth == 0
+                and not _contains_tracer_or_device_array(x)
+            )
+        except Exception:
+            should_time = False
+        if not should_time:
+            return original(x, device, *args, **kwargs)
+        st.tls.h2d_depth += 1
+        try:
+            region = timed_region(H2D_TIME, st.current_step, sink=st.buffer.add)
+            with region as tr:
+                out = original(x, device, *args, **kwargs)
+                tr.mark(out)
+            ev = region.event
+            if ev.marker is not None and not ev.marker.resolved:
+                get_marker_resolver().submit(ev.marker)
+            return out
+        except Exception as exc:
+            get_error_log().warning("timed device_put failed; passthrough", exc)
+            return original(x, device, *args, **kwargs)
+        finally:
+            st.tls.h2d_depth -= 1
+
+    timed_device_put._traceml_original = original  # type: ignore[attr-defined]
+    jax.device_put = timed_device_put
+    _original_device_put = original
+    return True
+
+
+def unpatch_jax_h2d() -> None:
+    global _original_device_put
+    if _original_device_put is None:
+        return
+    try:
+        import jax
+
+        jax.device_put = _original_device_put
+    except Exception:
+        pass
+    _original_device_put = None

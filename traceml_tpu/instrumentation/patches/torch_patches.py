@@ -1,0 +1,181 @@
+"""Torch-path auto-timers (forward / backward / optimizer)
+(reference: src/traceml_ai/instrumentation/patches/forward_auto_timer_patch.py:33-106,
+backward_auto_timer_patch.py:26-104, hooks/optimizer_hooks.py:17-101).
+
+The torch path exists for torch-xla jobs on TPU and for CPU smoke runs;
+CUDA never enters the picture.  Timers are host-clock; on torch-xla the
+step is lazily executed so the ``step_time`` envelope (plus xm.mark_step
+boundaries) carries the device truth — phase times are dispatch-side,
+matching how torch-xla jobs are actually diagnosed.
+
+Gating mirrors the reference: TLS in-step flag, outermost-only depth
+counters, optional target-model filter with DDP/FSDP unwrap.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+from traceml_tpu.sdk.state import TraceState, get_state
+from traceml_tpu.utils.error_log import get_error_log
+from traceml_tpu.utils.timing import (
+    BACKWARD_TIME,
+    FORWARD_TIME,
+    OPTIMIZER_STEP,
+    timed_region,
+)
+
+_lock = threading.Lock()
+_originals: dict = {}
+_traced_model_ids: set = set()
+
+
+def set_traced_model(model: Any) -> None:
+    """Restrict forward timing to one model (reference targets the traced
+    model id + its DDP ``.module`` / FSDP ``_fsdp_wrapped_module``)."""
+    ids = {id(model)}
+    for attr in ("module", "_fsdp_wrapped_module"):
+        inner = getattr(model, attr, None)
+        if inner is not None:
+            ids.add(id(inner))
+    _traced_model_ids.update(ids)
+
+
+def clear_traced_models() -> None:
+    _traced_model_ids.clear()
+
+
+def _is_target(module: Any) -> bool:
+    return not _traced_model_ids or id(module) in _traced_model_ids
+
+
+def patch_torch_forward(state: Optional[TraceState] = None) -> bool:
+    try:
+        import torch.nn as nn
+    except Exception:
+        return False
+    with _lock:
+        if "forward" in _originals:
+            return True
+        st = state or get_state()
+        original = nn.Module.__call__
+
+        def patched_call(self, *args, **kwargs):  # noqa: ANN001
+            if (
+                not st.tls.in_step
+                or st.tls.forward_depth > 0
+                or not _is_target(self)
+            ):
+                return original(self, *args, **kwargs)
+            st.tls.forward_depth += 1
+            try:
+                with timed_region(
+                    FORWARD_TIME, st.current_step, sink=st.buffer.add
+                ):
+                    return original(self, *args, **kwargs)
+            finally:
+                st.tls.forward_depth -= 1
+
+        nn.Module.__call__ = patched_call
+        _originals["forward"] = original
+    return True
+
+
+def patch_torch_backward(state: Optional[TraceState] = None) -> bool:
+    try:
+        import torch
+    except Exception:
+        return False
+    with _lock:
+        if "backward" in _originals:
+            return True
+        st = state or get_state()
+        orig_tensor_bwd = torch.Tensor.backward
+        orig_autograd_bwd = torch.autograd.backward
+
+        def _timed(fn, *args, **kwargs):  # noqa: ANN001
+            if not st.tls.in_step or st.tls.backward_depth > 0:
+                return fn(*args, **kwargs)
+            st.tls.backward_depth += 1
+            try:
+                with timed_region(
+                    BACKWARD_TIME, st.current_step, sink=st.buffer.add
+                ):
+                    return fn(*args, **kwargs)
+            finally:
+                st.tls.backward_depth -= 1
+
+        def patched_tensor_backward(self, *args, **kwargs):  # noqa: ANN001
+            return _timed(orig_tensor_bwd, self, *args, **kwargs)
+
+        def patched_autograd_backward(*args, **kwargs):  # noqa: ANN001
+            return _timed(orig_autograd_bwd, *args, **kwargs)
+
+        torch.Tensor.backward = patched_tensor_backward
+        torch.autograd.backward = patched_autograd_backward
+        _originals["backward"] = (orig_tensor_bwd, orig_autograd_bwd)
+    return True
+
+
+def install_torch_optimizer_hooks(state: Optional[TraceState] = None) -> bool:
+    """Global pre/post optimizer-step hooks emitting ``optimizer_step``
+    (reference: optimizer_hooks.py:17-101).  Idempotent."""
+    try:
+        import torch.optim as optim
+    except Exception:
+        return False
+    with _lock:
+        if "optimizer" in _originals:
+            return True
+        st = state or get_state()
+        open_regions: dict = {}
+
+        def pre_hook(optimizer, args, kwargs):  # noqa: ANN001
+            try:
+                if not st.tls.in_step:
+                    return
+                region = timed_region(
+                    OPTIMIZER_STEP, st.current_step, sink=st.buffer.add
+                )
+                region.__enter__()
+                open_regions[id(optimizer)] = region
+            except Exception as exc:
+                get_error_log().warning("optimizer pre-hook failed", exc)
+
+        def post_hook(optimizer, args, kwargs):  # noqa: ANN001
+            try:
+                region = open_regions.pop(id(optimizer), None)
+                if region is not None:
+                    region.__exit__(None, None, None)
+            except Exception as exc:
+                get_error_log().warning("optimizer post-hook failed", exc)
+
+        try:
+            h1 = optim.Optimizer.register_optimizer_step_pre_hook(pre_hook)
+            h2 = optim.Optimizer.register_optimizer_step_post_hook(post_hook)
+        except AttributeError:
+            return False
+        _originals["optimizer"] = (h1, h2)
+    return True
+
+
+def unpatch_all_torch() -> None:
+    with _lock:
+        try:
+            import torch
+            import torch.nn as nn
+
+            if "forward" in _originals:
+                nn.Module.__call__ = _originals.pop("forward")
+            if "backward" in _originals:
+                t_bwd, a_bwd = _originals.pop("backward")
+                torch.Tensor.backward = t_bwd
+                torch.autograd.backward = a_bwd
+            if "optimizer" in _originals:
+                h1, h2 = _originals.pop("optimizer")
+                h1.remove()
+                h2.remove()
+        except Exception:
+            _originals.clear()
+    clear_traced_models()

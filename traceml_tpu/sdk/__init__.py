@@ -1,0 +1,1 @@
+"""Public SDK (reference: src/traceml_ai/sdk/)."""

@@ -1,0 +1,137 @@
+"""``init``/``start`` — patch policy
+(reference: src/traceml_ai/sdk/initial.py:12-33, 81-125, 128-175, 192-276).
+
+Modes:
+
+* ``auto``      — apply every applicable patch (jax h2d; torch
+  dataloader/forward/backward/optimizer when torch is importable),
+* ``manual``    — none; user calls the wrappers,
+* ``selective`` — explicit per-patch booleans.
+
+Idempotent; a re-``init`` with a *conflicting* mode raises (the one place
+the SDK is allowed to raise — silently switching patch policy mid-run
+would corrupt the phase stream).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+from typing import Optional
+
+from traceml_tpu.sdk.state import get_state
+from traceml_tpu.utils.error_log import get_error_log
+
+VALID_MODES = ("auto", "manual", "selective")
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceMLInitConfig:
+    mode: str = "auto"
+    patch_dataloader: bool = True
+    patch_forward: bool = True
+    patch_backward: bool = True
+    patch_optimizer: bool = True
+    patch_h2d: bool = True
+    traced_model: object = None
+
+
+class TraceMLInitError(RuntimeError):
+    pass
+
+
+def _torch_loaded() -> bool:
+    return "torch" in sys.modules
+
+
+def _jax_loaded() -> bool:
+    return "jax" in sys.modules
+
+
+def init(mode: str = "auto", **kwargs) -> TraceMLInitConfig:
+    """Apply the requested patch policy.  Safe to call more than once
+    with the same mode; conflicting re-init raises."""
+    if mode not in VALID_MODES:
+        raise TraceMLInitError(f"mode must be one of {VALID_MODES}, got {mode!r}")
+    st = get_state()
+    if st.initialized:
+        if st.patch_mode != mode:
+            raise TraceMLInitError(
+                f"traceml already initialized with mode={st.patch_mode!r}; "
+                f"re-init with mode={mode!r} conflicts"
+            )
+        return TraceMLInitConfig(mode=mode, **kwargs)
+
+    cfg = TraceMLInitConfig(mode=mode, **kwargs)
+    applied = []
+    if mode != "manual":
+        # per-patch kwargs are honored in every non-manual mode ("auto"
+        # defaults them all True; passing patch_x=False narrows it).
+        want = cfg
+        # JAX-side patches: only if jax is (or will be) in play.  Importing
+        # jax here is fine — jax jobs import it anyway, and the patch is a
+        # cheap function swap.
+        if want.patch_h2d:
+            try:
+                from traceml_tpu.instrumentation.patches.jax_h2d_patch import (
+                    patch_jax_h2d,
+                )
+
+                if patch_jax_h2d(st):
+                    applied.append("jax_h2d")
+            except Exception as exc:
+                get_error_log().warning("jax h2d patch failed", exc)
+        # Torch-side patches: only when torch is already imported — we
+        # never pull torch into a pure-JAX process.
+        if _torch_loaded():
+            from traceml_tpu.instrumentation.dataloader import (
+                patch_torch_dataloader,
+            )
+            from traceml_tpu.instrumentation.patches.torch_patches import (
+                install_torch_optimizer_hooks,
+                patch_torch_backward,
+                patch_torch_forward,
+                set_traced_model,
+            )
+
+            if want.patch_dataloader and patch_torch_dataloader(st):
+                applied.append("torch_dataloader")
+            if want.patch_forward and patch_torch_forward(st):
+                applied.append("torch_forward")
+            if want.patch_backward and patch_torch_backward(st):
+                applied.append("torch_backward")
+            if want.patch_optimizer and install_torch_optimizer_hooks(st):
+                applied.append("torch_optimizer")
+            if cfg.traced_model is not None:
+                set_traced_model(cfg.traced_model)
+    st.initialized = True
+    st.patch_mode = mode
+    get_error_log().info(f"traceml init mode={mode} patches={applied}")
+    return cfg
+
+
+# alias (reference exposes both init and start)
+start = init
+
+
+def shutdown_patches() -> None:
+    """Remove every patch (tests / clean embedding)."""
+    st = get_state()
+    try:
+        from traceml_tpu.instrumentation.patches.jax_h2d_patch import unpatch_jax_h2d
+
+        unpatch_jax_h2d()
+    except Exception:
+        pass
+    try:
+        from traceml_tpu.instrumentation.dataloader import unpatch_torch_dataloader
+        from traceml_tpu.instrumentation.patches.torch_patches import (
+            unpatch_all_torch,
+        )
+
+        unpatch_torch_dataloader()
+        unpatch_all_torch()
+    except Exception:
+        pass
+    st.initialized = False
+    st.patch_mode = None

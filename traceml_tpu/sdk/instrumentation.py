@@ -1,0 +1,126 @@
+"""``trace_step`` — the per-step bracket
+(reference: src/traceml_ai/sdk/instrumentation.py:140-233).
+
+One ``with trace_step():`` per optimizer step:
+
+* advances the step counter (outermost-only; nesting is a no-op),
+* records the step-start memory edge,
+* opens the ``step_time`` envelope region,
+* arms the TLS gates the auto-timers consult,
+* on exit: closes the envelope, records the step-end memory edge,
+  flushes the step's events into the global queue, and submits device
+  markers to the background resolver.
+
+Never raises into user code; a failure downgrades to a no-op step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from traceml_tpu.sdk.state import TraceState, get_state
+from traceml_tpu.utils.error_log import get_error_log
+from traceml_tpu.utils.marker_resolver import get_marker_resolver
+from traceml_tpu.utils.timing import STEP_TIME, TimeEvent, timed_region
+
+
+class trace_step:
+    """Context manager bracketing one optimizer step."""
+
+    def __init__(self, state: Optional[TraceState] = None) -> None:
+        self._state = state or get_state()
+        self._region: Optional[timed_region] = None
+        self._step: Optional[int] = None
+        self._outermost = False
+
+    @property
+    def step(self) -> Optional[int]:
+        return self._step
+
+    def mark(self, outputs: Any) -> Any:
+        """Attach the step's device-completion probe (explicit form).
+
+        ``wrap_step_fn`` calls this automatically; manual loops may call
+        ``ts.mark(new_state)`` themselves.
+        """
+        try:
+            self._state.mark_step_outputs(outputs)
+        except Exception as exc:
+            get_error_log().warning("trace_step.mark failed", exc)
+        return outputs
+
+    def __enter__(self) -> "trace_step":
+        st = self._state
+        try:
+            if st.tls.in_step:
+                return self  # nested: inert (reference: outermost-only)
+            self._outermost = True
+            st.tls.in_step = True
+            self._step = st.begin_step()
+            st.ensure_mem_tracker().reset(self._step)
+            self._region = timed_region(STEP_TIME, self._step, sink=st.buffer.add)
+            self._region.__enter__()
+            st.active_step_event = self._region.event
+        except Exception as exc:
+            get_error_log().warning("trace_step enter failed", exc)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if not self._outermost:
+            return False
+        st = self._state
+        try:
+            st.tls.in_step = False
+            if self._region is not None:
+                self._region.__exit__(exc_type, exc, tb)
+            st.active_step_event = None
+            step = self._step if self._step is not None else st.current_step
+            if exc_type is None:
+                st.ensure_mem_tracker().record(step)
+            batch = st.flush_step(step)
+            if batch is not None:
+                resolver = get_marker_resolver()
+                for ev in batch.events:
+                    if ev.marker is not None and not ev.marker.resolved:
+                        resolver.submit(ev.marker)
+        except Exception as err:
+            get_error_log().warning("trace_step exit failed", err)
+        return False
+
+
+class trace_time:
+    """Named user region inside a step
+    (reference: sdk/instrumentation.py trace_time — user-visible custom
+    phases land in the same event stream, prefixed ``user:``)."""
+
+    def __init__(self, name: str, state: Optional[TraceState] = None) -> None:
+        self._state = state or get_state()
+        self._name = f"user:{name}"
+        self._region: Optional[timed_region] = None
+
+    def mark(self, outputs: Any) -> Any:
+        if self._region is not None:
+            self._region.mark(outputs)
+        return outputs
+
+    def __enter__(self) -> "trace_time":
+        try:
+            st = self._state
+            self._region = timed_region(
+                self._name, st.current_step, sink=st.buffer.add
+            )
+            self._region.__enter__()
+        except Exception as exc:
+            get_error_log().warning("trace_time enter failed", exc)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        try:
+            if self._region is not None:
+                self._region.__exit__(exc_type, exc, tb)
+                ev: TimeEvent = self._region.event
+                if ev.marker is not None and not ev.marker.resolved:
+                    get_marker_resolver().submit(ev.marker)
+        except Exception as err:
+            get_error_log().warning("trace_time exit failed", err)
+        return False

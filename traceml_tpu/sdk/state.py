@@ -1,0 +1,99 @@
+"""Process-wide trace state
+(reference: src/traceml_ai/runtime/state.py:27-91 + sdk/instrumentation.py:104-137).
+
+Holds the step counter, the per-step event buffer, the step-memory
+tracker, and the TLS gates the auto-timers consult.  Everything is
+RLock-guarded; the hot-path reads are plain attribute loads on a
+``threading.local``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional
+
+from traceml_tpu.utils.step_memory import StepMemoryTracker
+from traceml_tpu.utils.timing import (
+    GLOBAL_STEP_QUEUE,
+    StepEventBuffer,
+    StepTimeBatch,
+    TimeEvent,
+)
+
+
+class _TLS(threading.local):
+    def __init__(self) -> None:
+        self.in_step = False
+        self.forward_depth = 0
+        self.backward_depth = 0
+        self.h2d_depth = 0
+        self.dataloader_depth = 0
+
+
+class TraceState:
+    """Singleton-ish process state (tests may construct their own)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self.tls = _TLS()
+        self.step_counter = 0
+        self.buffer = StepEventBuffer()
+        self.mem_tracker: Optional[StepMemoryTracker] = None
+        self.initialized = False
+        self.patch_mode: Optional[str] = None
+        self.active_step_event: Optional[TimeEvent] = None
+        # called with the step number after each flush (max-steps lifecycle)
+        self.on_step_flushed: List[Callable[[int], None]] = []
+
+    # -- step lifecycle ------------------------------------------------
+    def begin_step(self) -> int:
+        with self._lock:
+            self.step_counter += 1
+            return self.step_counter
+
+    @property
+    def current_step(self) -> int:
+        with self._lock:
+            return self.step_counter
+
+    def ensure_mem_tracker(self) -> StepMemoryTracker:
+        with self._lock:
+            if self.mem_tracker is None:
+                self.mem_tracker = StepMemoryTracker()
+            return self.mem_tracker
+
+    def mark_step_outputs(self, outputs: Any) -> None:
+        """Point the open step envelope's device marker at ``outputs``.
+
+        Called by wrap_step_fn / wrappers after each device dispatch; the
+        last call before step exit wins, so the envelope's device end is
+        the readiness of the final dispatched phase.
+        """
+        ev = self.active_step_event
+        if ev is not None:
+            ev.attach_marker(outputs)
+
+    def flush_step(self, step: int) -> Optional[StepTimeBatch]:
+        batch = self.buffer.flush(step)
+        if batch is not None:
+            GLOBAL_STEP_QUEUE.put(batch)
+        for cb in list(self.on_step_flushed):
+            try:
+                cb(step)
+            except Exception:
+                pass
+        return batch
+
+
+_state = TraceState()
+
+
+def get_state() -> TraceState:
+    return _state
+
+
+def reset_state_for_tests() -> TraceState:
+    """Replace global state (test isolation only)."""
+    global _state
+    _state = TraceState()
+    return _state

@@ -1,0 +1,113 @@
+"""Manual-mode wrappers (reference: src/traceml_ai/sdk/wrappers.py:78-330).
+
+For users who opt out of auto-patching (``init(mode="manual")``): each
+wrapper times one phase explicitly.  All wrappers are duplicate-guarded:
+the TLS depth gates shared with the auto-patches mean a manually wrapped
+call under an active auto-patch is timed exactly once.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+from traceml_tpu.sdk.state import TraceState, get_state
+from traceml_tpu.utils.marker_resolver import get_marker_resolver
+from traceml_tpu.utils.timing import (
+    BACKWARD_TIME,
+    FORWARD_TIME,
+    H2D_TIME,
+    OPTIMIZER_STEP,
+    timed_region,
+)
+
+
+def _timed_call(
+    phase: str,
+    depth_attr: str,
+    fn: Callable,
+    st: TraceState,
+    mark_output: bool,
+    *args: Any,
+    **kwargs: Any,
+):
+    tls = st.tls
+    depth = getattr(tls, depth_attr)
+    if depth > 0:  # auto-patch (or outer wrapper) already timing
+        return fn(*args, **kwargs)
+    setattr(tls, depth_attr, depth + 1)
+    try:
+        region = timed_region(phase, st.current_step, sink=st.buffer.add)
+        with region as tr:
+            out = fn(*args, **kwargs)
+            if mark_output:
+                tr.mark(out)
+        ev = region.event
+        if ev.marker is not None and not ev.marker.resolved:
+            get_marker_resolver().submit(ev.marker)
+        return out
+    finally:
+        setattr(tls, depth_attr, depth)
+
+
+def wrap_forward(fn: Callable, state: Optional[TraceState] = None) -> Callable:
+    """Time a forward callable (a flax ``apply``, torch module, …)."""
+    st = state or get_state()
+
+    @functools.wraps(fn)
+    def wrapped(*args: Any, **kwargs: Any):
+        return _timed_call(FORWARD_TIME, "forward_depth", fn, st, True, *args, **kwargs)
+
+    wrapped._traceml_wrapped = True  # type: ignore[attr-defined]
+    return wrapped
+
+
+def wrap_backward(fn: Callable, state: Optional[TraceState] = None) -> Callable:
+    st = state or get_state()
+
+    @functools.wraps(fn)
+    def wrapped(*args: Any, **kwargs: Any):
+        return _timed_call(
+            BACKWARD_TIME, "backward_depth", fn, st, True, *args, **kwargs
+        )
+
+    wrapped._traceml_wrapped = True  # type: ignore[attr-defined]
+    return wrapped
+
+
+def wrap_optimizer(optimizer: Any, state: Optional[TraceState] = None) -> Any:
+    """Wrap a torch-style optimizer's ``.step`` in-place.
+
+    (Optax updates run inside the jitted step — they are part of
+    ``compute_time`` and need no wrapper; see sdk/step_fn.py.)
+    """
+    st = state or get_state()
+    if getattr(optimizer, "_traceml_wrapped", False):
+        return optimizer
+    original_step = optimizer.step
+
+    @functools.wraps(original_step)
+    def step(*args: Any, **kwargs: Any):
+        if not st.tls.in_step:
+            return original_step(*args, **kwargs)
+        with timed_region(OPTIMIZER_STEP, st.current_step, sink=st.buffer.add):
+            return original_step(*args, **kwargs)
+
+    optimizer.step = step
+    optimizer._traceml_wrapped = True
+    return optimizer
+
+
+def wrap_h2d(value: Any, device: Any = None, state: Optional[TraceState] = None) -> Any:
+    """Explicitly timed host→device transfer (JAX ``device_put``)."""
+    import jax
+
+    st = state or get_state()
+    return _timed_call(
+        H2D_TIME,
+        "h2d_depth",
+        (lambda v: jax.device_put(v) if device is None else jax.device_put(v, device)),
+        st,
+        True,
+        value,
+    )

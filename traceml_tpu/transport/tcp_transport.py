@@ -92,12 +92,17 @@ class TCPServer:
         self._pending: List[Any] = []
         self._data_event = threading.Event()
         self._clients: Dict[int, _ClientBuffer] = {}
+        self._stopped = False
         self.port: Optional[int] = None
         self.frames_received = 0
         self.decode_errors = 0
 
     # -- lifecycle -----------------------------------------------------
     def start(self) -> None:
+        if self._stopped:
+            raise RuntimeError(
+                "TCPServer is single-use: construct a new instance after stop()"
+            )
         if self._thread is not None:
             return
         sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -120,6 +125,7 @@ class TCPServer:
         """Stop and release every fd.  A stopped server is single-use."""
         if self._thread is None:
             return
+        self._stopped = True
         self._running.clear()
         try:
             self._wake_w.send(b"x")

@@ -1,0 +1,98 @@
+"""Background device-marker resolver.
+
+The reference resolves CUDA events on the 1 Hz sampler thread because the
+events carry exact device timestamps (timing.py:66).  On TPU the
+readiness *observation time* IS the timestamp, so resolution cadence
+bounds timing accuracy.  This daemon polls pending
+:class:`~traceml_tpu.utils.timing.DeviceMarker`s at millisecond cadence
+while work is in flight and parks when idle — ~hundreds of cheap local
+PJRT ``is_ready()`` calls per second, no device sync, no GIL-heavy work.
+
+This replaces the reference's CUDA event pool (cuda_event_pool.py): there
+is nothing to pool — markers are just array refs — but the *resolution
+service* is the shared infrastructure both designs need.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+from traceml_tpu.utils.error_log import get_error_log
+from traceml_tpu.utils.timing import DeviceMarker
+
+_DEFAULT_INTERVAL = 0.002  # 2 ms poll while markers are pending
+_IDLE_TIMEOUT = 0.25  # park after this long with nothing pending
+
+
+class MarkerResolver:
+    def __init__(self, poll_interval: float = _DEFAULT_INTERVAL) -> None:
+        self._interval = poll_interval
+        self._pending: List[DeviceMarker] = []
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="traceml-marker-resolver", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2)
+        self._thread = None
+
+    def submit(self, marker: DeviceMarker) -> None:
+        if marker.resolved:
+            return
+        with self._lock:
+            self._pending.append(marker)
+        self._wake.set()
+        # Lazy-start so merely importing the sdk never spawns threads.
+        if self._thread is None or not self._thread.is_alive():
+            self.start()
+
+    def pending_count(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def _run(self) -> None:
+        try:
+            while not self._stop.is_set():
+                with self._lock:
+                    pending = list(self._pending)
+                if not pending:
+                    fired = self._wake.wait(timeout=_IDLE_TIMEOUT)
+                    if fired:
+                        self._wake.clear()
+                    continue
+                still: List[DeviceMarker] = []
+                for m in pending:
+                    try:
+                        if not m.poll():
+                            still.append(m)
+                    except Exception:
+                        pass  # poll() itself fails open, but belt+braces
+                with self._lock:
+                    # new markers may have arrived during the sweep
+                    new = self._pending[len(pending):]
+                    self._pending = still + new
+                self._stop.wait(self._interval)
+        except Exception as exc:  # pragma: no cover
+            get_error_log().error("marker resolver crashed", exc)
+
+
+_resolver = MarkerResolver()
+
+
+def get_marker_resolver() -> MarkerResolver:
+    return _resolver

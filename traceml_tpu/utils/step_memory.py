@@ -1,0 +1,199 @@
+"""Per-step device memory tracking
+(reference: src/traceml_ai/utils/step_memory.py:32-112).
+
+The reference resets ``torch.cuda`` peak stats at step start and reads
+``max_memory_allocated/reserved`` at step end.  TPU runtimes expose
+``jax.Device.memory_stats()`` (libtpu-backed: ``bytes_in_use``,
+``peak_bytes_in_use``, ``bytes_limit``, …) but **no per-step peak reset**
+— the peak is cumulative.  So the tracker records, per step and device:
+
+* ``current_bytes``   — bytes in use at step end
+* ``peak_bytes``      — cumulative allocator peak (monotone)
+* ``step_peak_bytes`` — max of the observations this tracker made during
+  the step (start/end edges) — a lower bound on the true step peak
+* ``limit_bytes``     — device capacity
+
+Backends are pluggable because ``memory_stats()`` returns ``None`` on
+some runtimes (CPU, tunneled devices): the live-arrays backend sums
+``jax.live_arrays()`` nbytes per device, and tests inject a deterministic
+fake (SURVEY.md §4 "fake device layer").
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Protocol
+
+from traceml_tpu.utils.error_log import get_error_log
+from traceml_tpu.utils.timing import push_step_memory_row
+
+
+class DeviceMemorySample(dict):
+    """Row shape: {device_id, device_kind, current_bytes, peak_bytes,
+    limit_bytes} — plain dict subclass for codec friendliness."""
+
+
+class MemoryBackend(Protocol):
+    name: str
+
+    def sample(self) -> List[Dict[str, Any]]: ...
+
+
+class JaxMemoryStatsBackend:
+    """libtpu allocator counters via ``jax.Device.memory_stats()``."""
+
+    name = "jax_memory_stats"
+
+    def __init__(self) -> None:
+        import jax
+
+        self._devices = jax.local_devices()
+        # Probe once: some runtimes return None.
+        probe = self._devices[0].memory_stats() if self._devices else None
+        if not probe:
+            raise RuntimeError("memory_stats unavailable on this runtime")
+
+    def sample(self) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        for d in self._devices:
+            stats = d.memory_stats() or {}
+            out.append(
+                {
+                    "device_id": int(d.id),
+                    "device_kind": str(d.device_kind),
+                    "current_bytes": int(stats.get("bytes_in_use", 0)),
+                    "peak_bytes": int(
+                        stats.get("peak_bytes_in_use", stats.get("bytes_in_use", 0))
+                    ),
+                    "limit_bytes": int(stats.get("bytes_limit", 0)) or None,
+                }
+            )
+        return out
+
+
+class LiveArraysBackend:
+    """Fallback: per-device sum of live ``jax.Array`` buffer sizes.
+
+    Approximates allocated bytes (misses allocator overhead / temp
+    buffers) but works on every backend, including CPU CI.
+    """
+
+    name = "live_arrays"
+
+    def __init__(self) -> None:
+        import jax
+
+        self._jax = jax
+        self._kinds = {d.id: str(d.device_kind) for d in jax.local_devices()}
+
+    def sample(self) -> List[Dict[str, Any]]:
+        per_dev: Dict[int, int] = {}
+        for arr in self._jax.live_arrays():
+            try:
+                for shard in arr.addressable_shards:
+                    if shard.data is not None:
+                        did = shard.device.id
+                        per_dev[did] = per_dev.get(did, 0) + int(shard.data.nbytes)
+            except Exception:
+                continue
+        return [
+            {
+                "device_id": did,
+                "device_kind": self._kinds.get(did, "unknown"),
+                "current_bytes": n,
+                "peak_bytes": n,  # no allocator peak; tracker maxes edges
+                "limit_bytes": None,
+            }
+            for did, n in sorted(per_dev.items())
+        ]
+
+
+class FakeMemoryBackend:
+    """Deterministic scripted backend for tests."""
+
+    name = "fake"
+
+    def __init__(self, script: Optional[List[List[Dict[str, Any]]]] = None):
+        self._script = list(script or [])
+        self._i = 0
+        self.calls = 0
+
+    def push(self, sample: List[Dict[str, Any]]) -> None:
+        self._script.append(sample)
+
+    def sample(self) -> List[Dict[str, Any]]:
+        self.calls += 1
+        if not self._script:
+            return []
+        sample = self._script[min(self._i, len(self._script) - 1)]
+        self._i += 1
+        return [dict(row) for row in sample]
+
+
+class NullMemoryBackend:
+    name = "null"
+
+    def sample(self) -> List[Dict[str, Any]]:
+        return []
+
+
+def detect_backend() -> MemoryBackend:
+    """Best available backend, fail-open to null."""
+    try:
+        return JaxMemoryStatsBackend()
+    except Exception:
+        pass
+    try:
+        return LiveArraysBackend()
+    except Exception:
+        pass
+    return NullMemoryBackend()
+
+
+class StepMemoryTracker:
+    """Records device memory at step edges and emits one row per
+    (step, device) into the global step-memory queue."""
+
+    def __init__(self, backend: Optional[MemoryBackend] = None) -> None:
+        self._backend = backend or detect_backend()
+        self._step_start: Dict[int, Dict[str, Any]] = {}
+
+    @property
+    def backend_name(self) -> str:
+        return getattr(self._backend, "name", "unknown")
+
+    def reset(self, step: int) -> None:
+        """Step-start edge (reference: reset_peak_memory_stats analogue)."""
+        try:
+            self._step_start = {row["device_id"]: row for row in self._backend.sample()}
+        except Exception as exc:
+            get_error_log().warning("step memory reset failed", exc)
+            self._step_start = {}
+
+    def record(self, step: int) -> List[Dict[str, Any]]:
+        """Step-end edge; emits rows and returns them (for tests)."""
+        rows: List[Dict[str, Any]] = []
+        try:
+            ts = time.time()
+            for row in self._backend.sample():
+                start = self._step_start.get(row["device_id"], {})
+                step_peak = max(
+                    int(row.get("current_bytes", 0)),
+                    int(start.get("current_bytes", 0)),
+                )
+                out = {
+                    "step": step,
+                    "timestamp": ts,
+                    "device_id": row["device_id"],
+                    "device_kind": row.get("device_kind", "unknown"),
+                    "current_bytes": int(row.get("current_bytes", 0)),
+                    "peak_bytes": int(row.get("peak_bytes", 0)),
+                    "step_peak_bytes": step_peak,
+                    "limit_bytes": row.get("limit_bytes"),
+                    "backend": self.backend_name,
+                }
+                rows.append(out)
+                push_step_memory_row(out)
+        except Exception as exc:
+            get_error_log().warning("step memory record failed", exc)
+        return rows

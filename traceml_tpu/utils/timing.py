@@ -1,0 +1,345 @@
+"""TPU-native step-phase timing core
+(reference concept: src/traceml_ai/utils/timing.py:44-265).
+
+The reference brackets each phase with a pair of CUDA events and later
+resolves them without synchronization via non-blocking ``event.query()``.
+TPU/XLA has no user-visible device events, but it has something equally
+useful: **async dispatch + per-array readiness**.  A jitted call returns
+immediately; its output ``jax.Array``s expose a non-blocking
+``is_ready()``.  Because a TPU core executes enqueued programs serially,
+the host time at which a phase's outputs become ready is the device-side
+end of that phase, and consecutive readiness edges delimit device
+occupancy:
+
+    device_ms(phase_k) = ready(phase_k) − max(ready(phase_{k−1}),
+                                              dispatch(phase_k))
+
+So each :class:`TimeEvent` records host enter/exit times and, optionally,
+a :class:`DeviceMarker` — a strong reference to the *smallest* output leaf
+of the phase's dispatched computation (smallest to keep pinned buffer
+bytes negligible; output buffers are never donation targets, so holding
+one is safe).  A background resolver (see utils/marker_resolver.py) polls
+``is_ready()`` at millisecond cadence and stamps ``ready_at``.  Nothing on
+the hot path blocks, synchronizes, or raises — the reference's core
+contract (architecture.md:61 "never synchronize") holds.
+
+Accuracy note: ``ready_at`` is quantized by the resolver poll interval
+(~2 ms default), a deliberate trade against always-on profiler overhead.
+The reference carries the mirror-image caveat for very short steps
+(architecture.md:73,89).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from traceml_tpu.utils.error_log import get_error_log
+
+# --- internal phase vocabulary (reference: utils/step_time_window.py:41-56,
+# extended with TPU-only phases: compile / compute / collective) ------------
+INTERNAL_PREFIX = "_traceml_internal:"
+STEP_TIME = INTERNAL_PREFIX + "step_time"
+DATALOADER_NEXT = INTERNAL_PREFIX + "dataloader_next"
+H2D_TIME = INTERNAL_PREFIX + "h2d_time"
+FORWARD_TIME = INTERNAL_PREFIX + "forward_time"
+BACKWARD_TIME = INTERNAL_PREFIX + "backward_time"
+OPTIMIZER_STEP = INTERNAL_PREFIX + "optimizer_step"
+COMPUTE_TIME = INTERNAL_PREFIX + "compute_time"  # fused fwd+bwd+opt (JAX jit)
+COMPILE_TIME = INTERNAL_PREFIX + "compile_time"
+COLLECTIVE_TIME = INTERNAL_PREFIX + "collective_time"
+
+ALL_PHASES = (
+    STEP_TIME,
+    DATALOADER_NEXT,
+    H2D_TIME,
+    FORWARD_TIME,
+    BACKWARD_TIME,
+    OPTIMIZER_STEP,
+    COMPUTE_TIME,
+    COMPILE_TIME,
+    COLLECTIVE_TIME,
+)
+
+_QUEUE_MAX = 2048  # reference: bounded step/global queues maxsize 2048
+
+
+def _now() -> float:
+    return time.perf_counter()
+
+
+class DeviceMarker:
+    """A readiness probe over dispatched device work.
+
+    Wraps one or more objects exposing ``is_ready() -> bool`` (jax.Array
+    does; tests use fakes).  ``poll(now)`` is non-blocking and idempotent:
+    once every handle reports ready, the handle refs are dropped (so
+    buffers are not pinned past resolution) and ``ready_at`` is stamped
+    with the observation time.
+    """
+
+    __slots__ = ("_handles", "dispatched_at", "ready_at")
+
+    def __init__(self, handles: Sequence[Any], dispatched_at: Optional[float] = None):
+        self._handles: Optional[List[Any]] = [
+            h for h in handles if hasattr(h, "is_ready")
+        ]
+        self.dispatched_at = _now() if dispatched_at is None else dispatched_at
+        self.ready_at: Optional[float] = None
+        if not self._handles:
+            # nothing to wait on → ready at dispatch
+            self.ready_at = self.dispatched_at
+            self._handles = None
+
+    @property
+    def resolved(self) -> bool:
+        return self.ready_at is not None
+
+    def poll(self, now: Optional[float] = None) -> bool:
+        if self.ready_at is not None:
+            return True
+        handles = self._handles
+        if handles is None:
+            return True
+        try:
+            for h in handles:
+                if not h.is_ready():
+                    return False
+        except Exception:
+            # A deleted/donated buffer can make is_ready raise; treat as
+            # completed at observation time — fail open, never raise.
+            pass
+        self.ready_at = _now() if now is None else now
+        self._handles = None
+        return True
+
+
+def smallest_leaf(tree: Any) -> List[Any]:
+    """Pick the smallest array leaf of a pytree as the readiness handle.
+
+    One output leaf is enough on TPU: an XLA program's outputs materialize
+    together when the program retires, so the scalar loss is as good a
+    completion probe as the full state — and pins ~0 bytes.
+    """
+    try:
+        import jax
+
+        leaves = [x for x in jax.tree_util.tree_leaves(tree) if hasattr(x, "is_ready")]
+    except Exception:
+        leaves = [tree] if hasattr(tree, "is_ready") else []
+    if not leaves:
+        return []
+
+    def _size(x: Any) -> int:
+        try:
+            return int(x.size)
+        except Exception:
+            return 1 << 60
+
+    return [min(leaves, key=_size)]
+
+
+class TimeEvent:
+    """One timed phase occurrence inside one step."""
+
+    __slots__ = (
+        "name",
+        "step",
+        "cpu_start",
+        "cpu_end",
+        "marker",
+        "meta",
+    )
+
+    def __init__(self, name: str, step: int) -> None:
+        self.name = name
+        self.step = step
+        self.cpu_start: float = _now()
+        self.cpu_end: Optional[float] = None
+        self.marker: Optional[DeviceMarker] = None
+        self.meta: Optional[Dict[str, Any]] = None
+
+    def close(self) -> None:
+        if self.cpu_end is None:
+            self.cpu_end = _now()
+
+    def attach_marker(self, outputs: Any) -> None:
+        """Attach a device-readiness marker from a phase's outputs."""
+        try:
+            handles = smallest_leaf(outputs)
+            if handles:
+                self.marker = DeviceMarker(handles)
+        except Exception as exc:
+            get_error_log().warning("attach_marker failed", exc)
+
+    @property
+    def cpu_ms(self) -> Optional[float]:
+        if self.cpu_end is None:
+            return None
+        return (self.cpu_end - self.cpu_start) * 1000.0
+
+    def try_resolve(self) -> bool:
+        """Non-blocking; True when device side (if any) is complete
+        (reference: TimeEvent.try_resolve, timing.py:66)."""
+        if self.cpu_end is None:
+            return False
+        if self.marker is None:
+            return True
+        return self.marker.poll()
+
+    @property
+    def device_ready_at(self) -> Optional[float]:
+        if self.marker is None:
+            return None
+        return self.marker.ready_at
+
+    def to_row(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "step": self.step,
+            "cpu_start": self.cpu_start,
+            "cpu_end": self.cpu_end,
+            "cpu_ms": self.cpu_ms,
+            "device_ready_at": self.device_ready_at,
+            "has_marker": self.marker is not None,
+        }
+
+
+class StepTimeBatch:
+    """All events of one completed step (reference: timing.py:94-106)."""
+
+    __slots__ = ("step", "events", "flushed_at")
+
+    def __init__(self, step: int, events: List[TimeEvent]) -> None:
+        self.step = step
+        self.events = events
+        self.flushed_at = _now()
+
+    def resolved(self) -> bool:
+        return all(e.try_resolve() for e in self.events)
+
+
+class StepEventBuffer:
+    """Per-step accumulation buffer, flushed into the global queue at
+    step exit (reference: flush_buffers.py:13)."""
+
+    def __init__(self) -> None:
+        self._events: List[TimeEvent] = []
+        self._lock = threading.Lock()
+
+    def add(self, event: TimeEvent) -> None:
+        with self._lock:
+            self._events.append(event)
+
+    def flush(self, step: int) -> Optional[StepTimeBatch]:
+        with self._lock:
+            events, self._events = self._events, []
+        if not events:
+            return None
+        return StepTimeBatch(step, events)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+
+class BoundedDropQueue:
+    """Thread-safe bounded queue; drops (and counts) on overflow rather
+    than blocking user code (reference: timing.py:133-146).  Shared by
+    the step-batch and step-memory streams so both get identical drop
+    accounting."""
+
+    def __init__(self, label: str, maxsize: int = _QUEUE_MAX) -> None:
+        self._label = label
+        self._q: "queue.Queue[Any]" = queue.Queue(maxsize=maxsize)
+        self.dropped = 0
+        self._warned = False
+
+    def put(self, item: Any) -> bool:
+        try:
+            self._q.put_nowait(item)
+            return True
+        except queue.Full:
+            self.dropped += 1
+            if not self._warned:
+                self._warned = True
+                get_error_log().warning(
+                    f"{self._label} queue full; dropping (sampler stalled?)"
+                )
+            return False
+
+    def drain(self, max_items: Optional[int] = None) -> List[Any]:
+        out: List[Any] = []
+        while max_items is None or len(out) < max_items:
+            try:
+                out.append(self._q.get_nowait())
+            except queue.Empty:
+                break
+        return out
+
+    def qsize(self) -> int:
+        return self._q.qsize()
+
+
+# kept as an alias for the step-batch use of the shared queue class
+BoundedStepQueue = BoundedDropQueue
+
+# Global step queue shared by sdk flush and the StepTimeSampler.
+GLOBAL_STEP_QUEUE = BoundedDropQueue("step_time")
+
+# Global step-memory queue (rows produced by StepMemoryTracker).
+GLOBAL_STEP_MEMORY_QUEUE = BoundedDropQueue("step_memory")
+
+
+def push_step_memory_row(row: Dict[str, Any]) -> bool:
+    return GLOBAL_STEP_MEMORY_QUEUE.put(row)
+
+
+def drain_step_memory_rows(max_items: int = 10000) -> List[Dict[str, Any]]:
+    return GLOBAL_STEP_MEMORY_QUEUE.drain(max_items)
+
+
+class timed_region:
+    """Context manager timing one phase; optional device marker at exit
+    (reference: timing.py:184-265).
+
+    Usage::
+
+        with timed_region(FORWARD_TIME, step=3, sink=buffer.add) as tr:
+            out = forward(...)
+            tr.mark(out)        # optional: device-side completion probe
+    """
+
+    __slots__ = ("event", "_sink", "_on_close")
+
+    def __init__(
+        self,
+        name: str,
+        step: int,
+        sink: Optional[Callable[[TimeEvent], None]] = None,
+        on_close: Optional[Callable[[TimeEvent], None]] = None,
+    ) -> None:
+        self.event = TimeEvent(name, step)
+        self._sink = sink
+        self._on_close = on_close
+
+    def mark(self, outputs: Any) -> Any:
+        self.event.attach_marker(outputs)
+        return outputs
+
+    def __enter__(self) -> "timed_region":
+        self.event.cpu_start = _now()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        try:
+            self.event.close()
+            if self._sink is not None:
+                self._sink(self.event)
+            if self._on_close is not None:
+                self._on_close(self.event)
+        except Exception as err:  # never raise into user code
+            get_error_log().warning("timed_region exit failed", err)
+        return False
