@@ -1,0 +1,96 @@
+from pathlib import Path
+
+from traceml_tpu.runtime.identity import resolve_runtime_identity
+from traceml_tpu.runtime.session import generate_session_id
+from traceml_tpu.runtime.settings import (
+    TraceMLSettings,
+    settings_from_env,
+    settings_to_env,
+)
+
+
+def test_identity_torchrun_env():
+    env = {
+        "RANK": "5",
+        "WORLD_SIZE": "8",
+        "LOCAL_RANK": "1",
+        "LOCAL_WORLD_SIZE": "4",
+        "GROUP_RANK": "1",
+    }
+    ident = resolve_runtime_identity(env)
+    assert ident.global_rank == 5
+    assert ident.local_rank == 1
+    assert ident.world_size == 8
+    assert ident.node_rank == 1
+    assert ident.source == "env:torchrun"
+    assert not ident.is_global_primary
+    assert not ident.is_node_primary
+
+
+def test_identity_tpu_worker_env():
+    env = {"TPU_WORKER_ID": "2", "TPU_WORKER_HOSTNAMES": "h0,h1,h2,h3"}
+    ident = resolve_runtime_identity(env)
+    assert ident.global_rank == 2
+    assert ident.world_size == 4
+    assert ident.local_world_size == 1
+    assert ident.source == "env:tpu_worker"
+
+
+def test_identity_megascale_env():
+    env = {"MEGASCALE_SLICE_ID": "1", "MEGASCALE_NUM_SLICES": "2"}
+    ident = resolve_runtime_identity(env)
+    assert ident.global_rank == 1
+    assert ident.world_size == 2
+    assert ident.source == "env:megascale"
+
+
+def test_identity_defaults():
+    ident = resolve_runtime_identity({})
+    assert ident.global_rank == 0
+    assert ident.world_size == 1
+    assert ident.is_global_primary
+
+
+def test_identity_bad_env_falls_through():
+    ident = resolve_runtime_identity({"RANK": "x", "WORLD_SIZE": "y"})
+    assert ident.source == "defaults"
+
+
+def test_settings_env_roundtrip(tmp_path):
+    s = TraceMLSettings(
+        session_id="sess1",
+        logs_dir=tmp_path,
+        mode="summary",
+        sampler_interval_sec=0.5,
+        trace_max_steps=100,
+        run_name="exp-1",
+        expected_world_size=8,
+        disk_backup=True,
+    )
+    env = settings_to_env(s)
+    s2 = settings_from_env(env)
+    assert s2.session_id == "sess1"
+    assert s2.mode == "summary"
+    assert s2.sampler_interval_sec == 0.5
+    assert s2.trace_max_steps == 100
+    assert s2.run_name == "exp-1"
+    assert s2.expected_world_size == 8
+    assert s2.disk_backup is True
+    assert s2.session_dir == Path(tmp_path) / "sess1"
+    assert s2.rank_dir(3).name == "rank_3"
+
+
+def test_settings_defaults_from_empty_env():
+    s = settings_from_env({})
+    assert s.session_id == "local"
+    assert s.mode == "cli"
+    assert s.trace_max_steps is None
+    assert not s.disabled
+
+
+def test_session_id_generation():
+    a = generate_session_id()
+    b = generate_session_id()
+    assert a != b
+    c = generate_session_id("my run/exp#1")
+    assert c.startswith("my-run-exp-1_")

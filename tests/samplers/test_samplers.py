@@ -1,0 +1,199 @@
+import time
+
+from traceml_tpu.samplers.process_sampler import ProcessSampler
+from traceml_tpu.samplers.step_memory_sampler import StepMemorySampler
+from traceml_tpu.samplers.step_time_sampler import StepTimeSampler, _aggregate_step
+from traceml_tpu.samplers.system_sampler import SystemSampler, build_system_manifest
+from traceml_tpu.utils.step_memory import FakeMemoryBackend
+from traceml_tpu.utils.timing import (
+    COMPUTE_TIME,
+    DATALOADER_NEXT,
+    GLOBAL_STEP_QUEUE,
+    STEP_TIME,
+    DeviceMarker,
+    StepTimeBatch,
+    TimeEvent,
+    push_step_memory_row,
+)
+
+
+class ReadyHandle:
+    def __init__(self, ready=True):
+        self.ready = ready
+
+    def is_ready(self):
+        return self.ready
+
+
+def _event(name, step, t0, cpu_ms, ready_at=None):
+    ev = TimeEvent(name, step)
+    ev.cpu_start = t0
+    ev.cpu_end = t0 + cpu_ms / 1000.0
+    if ready_at is not None:
+        m = DeviceMarker([ReadyHandle()], dispatched_at=t0)
+        m.ready_at = ready_at
+        m._handles = None
+        ev.marker = m
+    return ev
+
+
+def test_aggregate_step_device_edges():
+    t0 = 100.0
+    # dataloader (host only), compute (device 50ms after 5ms queue), step env
+    events = [
+        _event(STEP_TIME, 1, t0, 80.0, ready_at=t0 + 0.060),
+        _event(DATALOADER_NEXT, 1, t0 + 0.001, 4.0),
+        _event(COMPUTE_TIME, 1, t0 + 0.010, 1.0, ready_at=t0 + 0.060),
+    ]
+    row, last_ready = _aggregate_step(events)
+    assert row["clock"] == "device"
+    assert abs(last_ready - 100.060) < 1e-9
+    agg = row["events"]
+    assert abs(agg[DATALOADER_NEXT]["cpu_ms"] - 4.0) < 1e-6
+    assert agg[DATALOADER_NEXT]["device_ms"] is None
+    # compute: ready at +60ms, dispatched at +10ms → 50ms device
+    assert abs(agg[COMPUTE_TIME]["device_ms"] - 50.0) < 1e-6
+    # envelope: t0 → last ready edge
+    assert abs(agg[STEP_TIME]["device_ms"] - 60.0) < 1e-6
+
+
+def test_aggregate_consecutive_edges():
+    t0 = 10.0
+    events = [
+        _event(STEP_TIME, 2, t0, 30.0, ready_at=t0 + 0.030),
+        _event("_traceml_internal:h2d_time", 2, t0 + 0.001, 1.0, ready_at=t0 + 0.010),
+        _event(COMPUTE_TIME, 2, t0 + 0.002, 1.0, ready_at=t0 + 0.030),
+    ]
+    agg = _aggregate_step(events)[0]["events"]
+    # h2d: first marked event → from its dispatch (t0+1ms) to ready (+10ms) = 9ms
+    assert abs(agg["_traceml_internal:h2d_time"]["device_ms"] - 9.0) < 1e-6
+    # compute: prev ready +10ms → own ready +30ms = 20ms (not 28ms)
+    assert abs(agg[COMPUTE_TIME]["device_ms"] - 20.0) < 1e-6
+
+
+def test_step_time_sampler_fifo_and_rows():
+    GLOBAL_STEP_QUEUE.drain()
+    s = StepTimeSampler()
+    t0 = time.perf_counter()
+    # step 1 resolved, step 2 unresolved, step 3 resolved
+    b1 = StepTimeBatch(1, [_event(STEP_TIME, 1, t0, 10.0)])
+    pending = _event(STEP_TIME, 2, t0, 10.0)
+    pending.marker = DeviceMarker([ReadyHandle(ready=False)])
+    b2 = StepTimeBatch(2, [pending])
+    b3 = StepTimeBatch(3, [_event(STEP_TIME, 3, t0, 10.0)])
+    for b in (b1, b2, b3):
+        GLOBAL_STEP_QUEUE.put(b)
+    s.sample()
+    rows = s.db.tail("step_time")
+    assert [r["step"] for r in rows] == [1]  # FIFO blocks on step 2
+    pending.marker._handles[0].ready = True
+    pending.marker.poll()  # fine-cadence resolver stamps it
+    s.sample()
+    rows = s.db.tail("step_time")
+    assert [r["step"] for r in rows] == [1, 2, 3]
+
+
+def test_step_time_sampler_timeout_emits_host_only():
+    GLOBAL_STEP_QUEUE.drain()
+    s = StepTimeSampler(resolve_timeout_s=0.0)
+    ev = _event(STEP_TIME, 1, time.perf_counter(), 5.0)
+    ev.marker = DeviceMarker([ReadyHandle(ready=False)])
+    GLOBAL_STEP_QUEUE.put(StepTimeBatch(1, [ev]))
+    time.sleep(0.01)
+    s.sample()
+    assert s.steps_timed_out == 1
+    assert [r["step"] for r in s.db.tail("step_time")] == [1]
+
+
+def test_step_memory_sampler_drains_queue():
+    from traceml_tpu.utils.timing import drain_step_memory_rows
+
+    drain_step_memory_rows()
+    push_step_memory_row({"step": 1, "device_id": 0, "current_bytes": 10})
+    push_step_memory_row({"step": 1, "device_id": 1, "current_bytes": 20})
+    s = StepMemorySampler()
+    s.sample()
+    rows = s.db.tail("step_memory")
+    assert len(rows) == 2
+
+
+def test_system_sampler_rows_and_manifest(tmp_path):
+    import jax
+
+    jax.devices()  # manifest waits for user-side jax init (safety gate)
+    manifest = tmp_path / "system_manifest.json"
+    backend = FakeMemoryBackend(
+        [[{"device_id": 0, "device_kind": "fake", "current_bytes": 5,
+           "peak_bytes": 9, "limit_bytes": 100}]]
+    )
+    s = SystemSampler(manifest_path=manifest, memory_backend=backend)
+    s.sample()
+    host = s.db.tail("system")
+    assert len(host) == 1
+    assert host[0]["memory_total_bytes"] > 0
+    dev = s.db.tail("system_device")
+    assert dev[0]["memory_used_bytes"] == 5
+    assert manifest.exists()
+    m = build_system_manifest()
+    assert "hostname" in m
+
+
+def test_process_sampler_rows():
+    backend = FakeMemoryBackend([[{"device_id": 0, "device_kind": "fake",
+                                   "current_bytes": 7, "peak_bytes": 7,
+                                   "limit_bytes": None}]])
+    s = ProcessSampler(memory_backend=backend)
+    s.sample()
+    rows = s.db.tail("process")
+    assert len(rows) == 1
+    assert rows[0]["rss_bytes"] > 0
+    dev = s.db.tail("process_device")
+    assert dev[0]["memory_used_bytes"] == 7
+
+
+def test_sampler_never_raises():
+    class Boom(StepMemorySampler):
+        def _sample(self):
+            raise RuntimeError("boom")
+
+    s = Boom()
+    s.sample()  # must not raise
+    assert s.sample_errors == 1
+
+
+def test_aggregate_cross_step_occupancy():
+    """Host runs ahead (async dispatch): step N's device work starts at
+    step N-1's readiness edge, not at step N's host start."""
+    t0 = 50.0
+    # step 1: dispatched at t0, device busy t0 .. t0+0.100
+    e1 = [
+        _event(STEP_TIME, 1, t0, 2.0, ready_at=t0 + 0.100),
+        _event(COMPUTE_TIME, 1, t0 + 0.0005, 0.5, ready_at=t0 + 0.100),
+    ]
+    # step 2: dispatched at t0+2ms (host ran ahead), device busy +0.100..+0.180
+    e2 = [
+        _event(STEP_TIME, 2, t0 + 0.002, 2.0, ready_at=t0 + 0.180),
+        _event(COMPUTE_TIME, 2, t0 + 0.0025, 0.5, ready_at=t0 + 0.180),
+    ]
+    row1, edge = _aggregate_step(e1, None)
+    row2, edge2 = _aggregate_step(e2, edge)
+    assert abs(row1["events"][COMPUTE_TIME]["device_ms"] - 99.5) < 1e-6
+    assert abs(row1["events"][STEP_TIME]["device_ms"] - 100.0) < 1e-6
+    # without the cross-step edge this would read ~177.5ms; true occupancy is 80ms
+    assert abs(row2["events"][COMPUTE_TIME]["device_ms"] - 80.0) < 1e-6
+    assert abs(row2["events"][STEP_TIME]["device_ms"] - 80.0) < 1e-6
+    assert abs(edge2 - (t0 + 0.180)) < 1e-9
+
+
+def test_system_sampler_no_jax_init_gate(tmp_path, monkeypatch):
+    """Sampler must not write a manifest or probe devices before the
+    user's process has initialized jax (safety-gate contract)."""
+    import traceml_tpu.utils.step_memory as sm
+
+    monkeypatch.setattr(sm, "jax_is_initialized", lambda: False)
+    manifest = tmp_path / "m.json"
+    s = SystemSampler(manifest_path=manifest, memory_backend=None)
+    s.sample()
+    assert not manifest.exists()
+    assert s.db.tail("system_device") == []
+    assert len(s.db.tail("system")) == 1  # host stats still sampled

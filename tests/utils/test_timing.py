@@ -78,7 +78,11 @@ def test_timed_region_sink_and_mark():
     batch = buf.flush(3)
     assert isinstance(batch, StepTimeBatch)
     assert batch.step == 3
+    # resolved() never stamps: ready-but-unstamped marker reports False
+    assert not batch.resolved()
+    assert tr.event.marker.poll()  # fine-cadence poller stamps
     assert batch.resolved()
+    assert not tr.event.marker.late_stamp
     assert buf.flush(3) is None  # empty after flush
 
 

@@ -1,0 +1,1 @@
+"""Per-rank runtime agent (reference: src/traceml_ai/runtime/)."""

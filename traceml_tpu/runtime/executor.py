@@ -1,0 +1,105 @@
+"""Training-rank child-process entry
+(reference: src/traceml_ai/runtime/executor.py:153-447).
+
+The launcher starts each rank as::
+
+    python -m traceml_tpu.runtime.executor
+
+with the script path/args and all settings carried by TRACEML_* env vars.
+The executor starts the runtime agent, runs the user script via
+``runpy.run_path`` with argv/cwd preserved, and guarantees: crash logs to
+``runtime_error.log``, exit-code normalization, runtime stopped (and
+telemetry drained) no matter how the script ends.  Fail-open: a broken
+runtime downgrades to NoOpRuntime and the user script still runs.
+"""
+
+from __future__ import annotations
+
+import os
+import runpy
+import shlex
+import sys
+import traceback
+from pathlib import Path
+
+from traceml_tpu.runtime import lifecycle
+from traceml_tpu.runtime.settings import (
+    ENV_SCRIPT,
+    ENV_SCRIPT_ARGS,
+    settings_from_env,
+)
+from traceml_tpu.utils.error_log import get_error_log
+
+
+def run_user_script(script: str, args: list[str]) -> int:
+    """runpy with argv swap; returns exit code."""
+    old_argv = sys.argv
+    sys.argv = [script] + args
+    script_dir = str(Path(script).resolve().parent)
+    path_added = False
+    if script_dir not in sys.path:
+        sys.path.insert(0, script_dir)
+        path_added = True
+    try:
+        runpy.run_path(script, run_name="__main__")
+        return 0
+    except SystemExit as exc:
+        code = exc.code
+        if code is None:
+            return 0
+        return code if isinstance(code, int) else 1
+    finally:
+        sys.argv = old_argv
+        if path_added:
+            try:
+                sys.path.remove(script_dir)
+            except ValueError:
+                pass
+
+
+def main() -> int:
+    script = os.environ.get(ENV_SCRIPT)
+    raw_args = os.environ.get(ENV_SCRIPT_ARGS, "")
+    args = shlex.split(raw_args) if raw_args else []
+    settings = settings_from_env()
+
+    if not script:
+        print("[TraceML] executor: TRACEML_SCRIPT not set", file=sys.stderr)
+        return 2
+
+    runtime = lifecycle.start_runtime(settings)
+    exit_code = 0
+    try:
+        # auto-apply SDK patches so unmodified scripts still get
+        # dataloader/h2d phase timing (scripts may also call init()
+        # themselves — it is idempotent).
+        try:
+            from traceml_tpu.sdk.initial import init as sdk_init
+
+            if not settings.disabled:
+                sdk_init(mode="auto")
+        except Exception as exc:
+            get_error_log().warning("executor sdk init failed", exc)
+        exit_code = run_user_script(script, args)
+    except BaseException as exc:  # noqa: BLE001 - crash log then normalize
+        try:
+            rank = getattr(runtime, "identity", None)
+            rank_no = getattr(rank, "global_rank", 0) if rank else 0
+            err_path = settings.rank_dir(rank_no) / "runtime_error.log"
+            err_path.parent.mkdir(parents=True, exist_ok=True)
+            with open(err_path, "a", encoding="utf-8") as fh:
+                fh.write("".join(traceback.format_exception(type(exc), exc, exc.__traceback__)))
+        except Exception:
+            pass
+        if isinstance(exc, KeyboardInterrupt):
+            exit_code = 130
+        else:
+            traceback.print_exc()
+            exit_code = 1
+    finally:
+        lifecycle.stop_runtime()
+    return exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,173 @@
+"""Runtime identity resolution
+(reference: src/traceml_ai/runtime/identity.py:88-234, extended with the
+TPU identity sources named in SURVEY.md §2.10: ``TPU_WORKER_ID``,
+``MEGASCALE_*``, JAX process index).
+
+Resolution precedence (first source that yields a rank wins):
+
+1. torchrun-style env: RANK / WORLD_SIZE / LOCAL_RANK / LOCAL_WORLD_SIZE /
+   GROUP_RANK|NODE_RANK
+2. TPU pod env: TPU_WORKER_ID (+ TPU_WORKER_HOSTNAMES for world size)
+3. MEGASCALE slice env: MEGASCALE_SLICE_ID / MEGASCALE_NUM_SLICES
+4. live JAX distributed state (process_index/process_count) — only if
+   jax is already imported AND initialized (never force backend init)
+5. single-process defaults
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import socket
+import sys
+from typing import Dict, Optional
+
+from traceml_tpu.telemetry.envelope import SenderIdentity
+
+
+@dataclasses.dataclass(frozen=True)
+class RuntimeIdentity:
+    global_rank: int = 0
+    local_rank: int = 0
+    world_size: int = 1
+    local_world_size: int = 1
+    node_rank: int = 0
+    hostname: str = dataclasses.field(default_factory=socket.gethostname)
+    pid: int = dataclasses.field(default_factory=os.getpid)
+    platform: str = "cpu"
+    device_kind: str = "unknown"
+    source: str = "defaults"
+
+    def to_sender_identity(self, session_id: str) -> SenderIdentity:
+        return SenderIdentity(
+            session_id=session_id,
+            global_rank=self.global_rank,
+            local_rank=self.local_rank,
+            world_size=self.world_size,
+            local_world_size=self.local_world_size,
+            node_rank=self.node_rank,
+            hostname=self.hostname,
+            pid=self.pid,
+            platform=self.platform,
+            device_kind=self.device_kind,
+        )
+
+    @property
+    def is_global_primary(self) -> bool:
+        return self.global_rank == 0
+
+    @property
+    def is_node_primary(self) -> bool:
+        return self.local_rank == 0
+
+
+def _device_info() -> Dict[str, str]:
+    """platform/device_kind from live jax — only if already initialized."""
+    if "jax" not in sys.modules:
+        return {}
+    try:
+        import jax
+        import jax._src.xla_bridge as xb
+
+        if not getattr(xb, "_backends", None):
+            return {}
+        devs = jax.local_devices()
+        return {
+            "platform": jax.default_backend(),
+            "device_kind": str(devs[0].device_kind) if devs else "unknown",
+        }
+    except Exception:
+        return {}
+
+
+def resolve_runtime_identity(env: Optional[Dict[str, str]] = None) -> RuntimeIdentity:
+    e = os.environ if env is None else env
+    dev = _device_info()
+    common = dict(
+        hostname=socket.gethostname(),
+        pid=os.getpid(),
+        platform=dev.get("platform", "cpu"),
+        device_kind=dev.get("device_kind", "unknown"),
+    )
+
+    # 1. torchrun-style env
+    if "RANK" in e and "WORLD_SIZE" in e:
+        try:
+            rank = int(e["RANK"])
+            world = int(e["WORLD_SIZE"])
+            local_rank = int(e.get("LOCAL_RANK", rank))
+            local_world = int(e.get("LOCAL_WORLD_SIZE", max(1, world)))
+            node_rank = int(e.get("GROUP_RANK", e.get("NODE_RANK", 0)))
+            return RuntimeIdentity(
+                global_rank=rank,
+                local_rank=local_rank,
+                world_size=world,
+                local_world_size=local_world,
+                node_rank=node_rank,
+                source="env:torchrun",
+                **common,
+            )
+        except (ValueError, TypeError):
+            pass
+
+    # 2. TPU pod env (one process per host; local_rank 0)
+    if "TPU_WORKER_ID" in e:
+        try:
+            worker = int(e["TPU_WORKER_ID"])
+            hosts = [
+                h for h in (e.get("TPU_WORKER_HOSTNAMES", "") or "").split(",") if h
+            ]
+            world = len(hosts) if hosts else int(e.get("TPU_WORKER_COUNT", 1) or 1)
+            return RuntimeIdentity(
+                global_rank=worker,
+                local_rank=0,
+                world_size=max(world, worker + 1),
+                local_world_size=1,
+                node_rank=worker,
+                source="env:tpu_worker",
+                **common,
+            )
+        except (ValueError, TypeError):
+            pass
+
+    # 3. MEGASCALE multi-slice
+    if "MEGASCALE_SLICE_ID" in e:
+        try:
+            slice_id = int(e["MEGASCALE_SLICE_ID"])
+            num_slices = int(e.get("MEGASCALE_NUM_SLICES", 1) or 1)
+            return RuntimeIdentity(
+                global_rank=slice_id,
+                local_rank=0,
+                world_size=max(num_slices, slice_id + 1),
+                local_world_size=1,
+                node_rank=slice_id,
+                source="env:megascale",
+                **common,
+            )
+        except (ValueError, TypeError):
+            pass
+
+    # 4. live JAX distributed state
+    if "jax" in sys.modules:
+        try:
+            import jax
+            import jax._src.xla_bridge as xb
+
+            if getattr(xb, "_backends", None):
+                pi = jax.process_index()
+                pc = jax.process_count()
+                if pc > 1 or pi > 0:
+                    return RuntimeIdentity(
+                        global_rank=pi,
+                        local_rank=0,
+                        world_size=pc,
+                        local_world_size=1,
+                        node_rank=pi,
+                        source="jax:distributed",
+                        **common,
+                    )
+        except Exception:
+            pass
+
+    # 5. defaults
+    return RuntimeIdentity(source="defaults", **common)

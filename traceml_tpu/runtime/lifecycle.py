@@ -1,0 +1,68 @@
+"""In-process lifecycle entries (reference: src/traceml_ai/runtime/lifecycle.py).
+
+``start_runtime`` / ``start_aggregator`` are the embedding API used by
+the executor, the integrations (HF/Flax/Ray-style), and tests.  Both are
+fail-open: any error returns a no-op object and logs.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from traceml_tpu.runtime.identity import RuntimeIdentity, resolve_runtime_identity
+from traceml_tpu.runtime.runtime import NoOpRuntime, TraceMLRuntime
+from traceml_tpu.runtime.settings import TraceMLSettings, settings_from_env
+from traceml_tpu.utils.error_log import get_error_log
+
+_active_runtime: Optional[TraceMLRuntime] = None
+
+
+def start_runtime(
+    settings: Optional[TraceMLSettings] = None,
+    identity: Optional[RuntimeIdentity] = None,
+):
+    """Start the per-rank agent; returns it (or NoOpRuntime on failure)."""
+    global _active_runtime
+    if _active_runtime is not None:
+        return _active_runtime
+    try:
+        settings = settings or settings_from_env()
+        if settings.disabled:
+            return NoOpRuntime()
+        rt = TraceMLRuntime(settings, identity or resolve_runtime_identity())
+        rt.start()
+        _active_runtime = rt
+        return rt
+    except Exception as exc:
+        get_error_log().error("start_runtime failed; tracing disabled", exc)
+        return NoOpRuntime()
+
+
+def stop_runtime() -> None:
+    global _active_runtime
+    rt = _active_runtime
+    _active_runtime = None
+    if rt is not None:
+        try:
+            rt.stop()
+        except Exception as exc:
+            get_error_log().warning("stop_runtime failed", exc)
+
+
+def get_active_runtime():
+    return _active_runtime
+
+
+def start_aggregator(settings: Optional[TraceMLSettings] = None):
+    """Start an in-process aggregator (the out-of-process entry is
+    aggregator/aggregator_main.py).  Returns the aggregator or None."""
+    try:
+        from traceml_tpu.aggregator.trace_aggregator import TraceMLAggregator
+
+        settings = settings or settings_from_env()
+        agg = TraceMLAggregator(settings)
+        agg.start()
+        return agg
+    except Exception as exc:
+        get_error_log().error("start_aggregator failed", exc)
+        return None

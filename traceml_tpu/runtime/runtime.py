@@ -1,0 +1,163 @@
+"""Per-rank runtime agent
+(reference: src/traceml_ai/runtime/runtime.py:40-258).
+
+Owns the samplers, the TCP client, and a daemon tick thread at
+``sampler_interval_sec``.  Lifecycle: start → tick loop → (max-steps
+DRAINING) → stop: final drain + ``rank_finished`` control marker.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+from traceml_tpu.runtime.identity import RuntimeIdentity, resolve_runtime_identity
+from traceml_tpu.runtime.sampler_registry import build_samplers
+from traceml_tpu.runtime.sender import TelemetryPublisher
+from traceml_tpu.runtime.settings import TraceMLSettings
+from traceml_tpu.runtime.state import RecordingState
+from traceml_tpu.runtime.stdout_capture import StreamCapture
+from traceml_tpu.samplers.base_sampler import BaseSampler
+from traceml_tpu.sdk.state import get_state
+from traceml_tpu.telemetry.control import build_rank_finished
+from traceml_tpu.transport.tcp_transport import TCPClient
+from traceml_tpu.utils.error_log import get_error_log
+
+
+class TraceMLRuntime:
+    def __init__(
+        self,
+        settings: TraceMLSettings,
+        identity: Optional[RuntimeIdentity] = None,
+    ) -> None:
+        self.settings = settings
+        self.identity = identity or resolve_runtime_identity()
+        self.recording = RecordingState(settings.trace_max_steps)
+        self.capture: Optional[StreamCapture] = None
+        if settings.mode == "cli":
+            self.capture = StreamCapture(capture_stderr=settings.capture_stderr)
+        self.samplers: List[BaseSampler] = []
+        self.client: Optional[TCPClient] = None
+        self.publisher: Optional[TelemetryPublisher] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop_evt = threading.Event()
+        self._started = False
+        self._finished_sent = False
+        self._lock = threading.Lock()
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> None:
+        with self._lock:
+            if self._started:
+                return
+            self._started = True
+        try:
+            get_error_log().set_path(
+                self.settings.rank_dir(self.identity.global_rank) / "error.log"
+            )
+        except Exception:
+            pass
+        if self.capture is not None:
+            self.capture.start()
+        self.samplers = build_samplers(self.settings, self.identity, self.capture)
+        if self.settings.aggregator.port:
+            self.client = TCPClient(
+                self.settings.aggregator.connect_host,
+                self.settings.aggregator.port,
+            )
+        sender_identity = self.identity.to_sender_identity(self.settings.session_id)
+        self.publisher = TelemetryPublisher(self.samplers, self.client, sender_identity)
+        # max-steps lifecycle: observe sdk step flushes
+        get_state().on_step_flushed.append(self.recording.on_step_flushed)
+        self._stop_evt.clear()
+        self._thread = threading.Thread(
+            target=self._sampler_loop, name="traceml-runtime", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        with self._lock:
+            if not self._started:
+                return
+            self._started = False
+        self._stop_evt.set()
+        if self._thread is not None:
+            self._thread.join(timeout=max(5.0, self.settings.sampler_interval_sec * 3))
+            self._thread = None
+        try:
+            self._final_drain()
+        except Exception as exc:
+            get_error_log().warning("final drain failed", exc)
+        if self.capture is not None:
+            self.capture.stop()
+        for s in self.samplers:
+            s.stop()
+        if self.client is not None:
+            self.client.close()
+        try:
+            get_state().on_step_flushed.remove(self.recording.on_step_flushed)
+        except ValueError:
+            pass
+
+    def _take_rank_finished(self) -> Optional[list]:
+        """The send-once rank_finished marker, or None if already sent."""
+        if self._finished_sent:
+            return None
+        self._finished_sent = True
+        return [
+            build_rank_finished(
+                self.identity.to_sender_identity(self.settings.session_id).to_meta()
+            )
+        ]
+
+    # -- tick loop -----------------------------------------------------
+    def _tick(self) -> None:
+        phase = self.recording.phase
+        for s in self.samplers:
+            drains = getattr(
+                getattr(s, "_spec", None), "drain_on_recording_stop", False
+            )
+            # RECORDING: everyone samples.  DRAINING: only drain samplers
+            # flush their buffered tail.  COMPLETE: nobody samples — the
+            # rank goes quiet (--trace-max-steps contract).
+            if phase == "RECORDING" or (phase == "DRAINING" and drains):
+                s.sample()
+        if phase == "DRAINING":
+            for s in self.samplers:
+                if getattr(getattr(s, "_spec", None), "drain_on_recording_stop", False):
+                    s.drain()
+            self.recording.mark_drained()
+        extra = None
+        if self.recording.phase == "COMPLETE":
+            extra = self._take_rank_finished()
+        if self.publisher is not None and (
+            self.recording.phase != "COMPLETE" or extra
+        ):
+            self.publisher.publish(extra)
+
+    def _sampler_loop(self) -> None:
+        interval = max(0.05, self.settings.sampler_interval_sec)
+        while not self._stop_evt.wait(interval):
+            try:
+                self._tick()
+            except Exception as exc:  # belt+braces; samplers fail-open anyway
+                get_error_log().warning("runtime tick failed", exc)
+
+    def _final_drain(self) -> None:
+        """Shutdown: drain every sampler, publish leftovers + rank_finished."""
+        for s in self.samplers:
+            s.drain()
+        if self.publisher is not None:
+            self.publisher.publish(self._take_rank_finished())
+
+
+class NoOpRuntime:
+    """Fail-open stand-in (reference: lifecycle.py:29): every method is a
+    no-op so a broken runtime can never break training."""
+
+    settings = None
+    identity = None
+
+    def start(self) -> None: ...
+
+    def stop(self) -> None: ...

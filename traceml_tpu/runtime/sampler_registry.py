@@ -1,0 +1,88 @@
+"""Declarative sampler registry
+(reference: src/traceml_ai/runtime/sampler_registry.py:20-88).
+
+Each spec declares which profiles/modes it applies to, whether it is
+rank-0-per-node only, and whether it drains on recording stop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+from typing import Any, Callable, List, Optional
+
+from traceml_tpu.core.registry import Registry
+from traceml_tpu.runtime.identity import RuntimeIdentity
+from traceml_tpu.runtime.settings import TraceMLSettings
+from traceml_tpu.samplers.base_sampler import BaseSampler
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplerSpec:
+    key: str
+    factory: Callable[..., BaseSampler]
+    node_primary_only: bool = False
+    cli_mode_only: bool = False
+    drain_on_recording_stop: bool = False
+
+
+SAMPLER_REGISTRY = Registry("samplers")
+
+
+def register_default_samplers() -> None:
+    from traceml_tpu.samplers.process_sampler import ProcessSampler
+    from traceml_tpu.samplers.step_memory_sampler import StepMemorySampler
+    from traceml_tpu.samplers.step_time_sampler import StepTimeSampler
+    from traceml_tpu.samplers.system_sampler import SystemSampler
+
+    defaults = [
+        SamplerSpec("system", SystemSampler, node_primary_only=True),
+        SamplerSpec("process", ProcessSampler),
+        SamplerSpec("step_time", StepTimeSampler, drain_on_recording_stop=True),
+        SamplerSpec("step_memory", StepMemorySampler, drain_on_recording_stop=True),
+    ]
+    for spec in defaults:
+        if spec.key not in SAMPLER_REGISTRY:
+            SAMPLER_REGISTRY.register(spec.key, spec)
+
+
+def build_samplers(
+    settings: TraceMLSettings,
+    identity: RuntimeIdentity,
+    capture: Any = None,
+) -> List[BaseSampler]:
+    """Instantiate the samplers this rank should run."""
+    register_default_samplers()
+    backup_dir: Optional[Path] = None
+    if settings.disk_backup:
+        backup_dir = settings.rank_dir(identity.global_rank) / "data"
+
+    out: List[BaseSampler] = []
+    for key in SAMPLER_REGISTRY.keys():
+        spec: SamplerSpec = SAMPLER_REGISTRY.require(key)
+        if spec.node_primary_only and not identity.is_node_primary:
+            continue
+        if spec.cli_mode_only and settings.mode != "cli":
+            continue
+        kwargs: dict = {"disk_backup_dir": backup_dir}
+        if key == "system":
+            kwargs["manifest_path"] = (
+                settings.session_dir / "system_manifest.json"
+            )
+        sampler = spec.factory(**kwargs)
+        sampler._spec = spec  # type: ignore[attr-defined]
+        out.append(sampler)
+
+    # stdout capture is wired explicitly (needs the StreamCapture object)
+    if capture is not None and settings.mode == "cli":
+        from traceml_tpu.samplers.stdout_stderr_sampler import StdoutStderrSampler
+
+        sampler = StdoutStderrSampler(
+            capture,
+            disk_backup_dir=backup_dir,
+            log_path=settings.rank_dir(identity.global_rank) / "stdout.log",
+            mirror_to_db=identity.is_global_primary,
+        )
+        sampler._spec = SamplerSpec("stdout_stderr", StdoutStderrSampler)  # type: ignore[attr-defined]
+        out.append(sampler)
+    return out

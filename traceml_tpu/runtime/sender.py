@@ -1,0 +1,51 @@
+"""Telemetry publisher (reference: src/traceml_ai/runtime/sender.py:17-174).
+
+Per tick: flush disk writers, collect each sampler sender's incremental
+payload, ship ONE batch over TCP.  Best-effort all the way down.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from traceml_tpu.samplers.base_sampler import BaseSampler
+from traceml_tpu.telemetry.envelope import SenderIdentity
+from traceml_tpu.transport.tcp_transport import TCPClient
+from traceml_tpu.utils.error_log import get_error_log
+
+
+class TelemetryPublisher:
+    def __init__(
+        self,
+        samplers: List[BaseSampler],
+        client: Optional[TCPClient],
+        identity: SenderIdentity,
+    ) -> None:
+        self._samplers = samplers
+        self._client = client
+        self._identity = identity
+        for s in samplers:
+            s.sender.set_identity(identity)
+        self.ticks = 0
+        self.payloads_sent = 0
+
+    def publish(self, extra_payloads: Optional[List[Any]] = None) -> int:
+        """Collect + send; returns number of payloads in the batch."""
+        self.ticks += 1
+        batch: List[Any] = []
+        for s in self._samplers:
+            try:
+                s.writer.flush()
+                payload = s.sender.collect_payload()
+                if payload is not None:
+                    batch.append(payload)
+            except Exception as exc:
+                get_error_log().warning(
+                    f"collect failed for sampler {s.name}", exc
+                )
+        if extra_payloads:
+            batch.extend(extra_payloads)
+        if batch and self._client is not None:
+            if self._client.send_batch(batch):
+                self.payloads_sent += len(batch)
+        return len(batch)

@@ -1,0 +1,134 @@
+"""Frozen runtime settings + the TRACEML_* env contract
+(reference: src/traceml_ai/runtime/settings.py:26-82 and the env block
+launcher/commands.py:292-341 — the ONLY contract between the launcher
+and child processes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+ENV_PREFIX = "TRACEML_"
+
+# canonical env var names
+ENV_SESSION_ID = "TRACEML_SESSION_ID"
+ENV_LOGS_DIR = "TRACEML_LOGS_DIR"
+ENV_MODE = "TRACEML_MODE"  # cli | summary
+ENV_AGG_HOST = "TRACEML_AGGREGATOR_HOST"
+ENV_AGG_PORT = "TRACEML_AGGREGATOR_PORT"
+ENV_SAMPLER_INTERVAL = "TRACEML_SAMPLER_INTERVAL_SEC"
+ENV_MAX_STEPS = "TRACEML_TRACE_MAX_STEPS"
+ENV_DISABLE = "TRACEML_DISABLE"
+ENV_DISK_BACKUP = "TRACEML_DISK_BACKUP"
+ENV_CAPTURE_STDERR = "TRACEML_CAPTURE_STDERR"
+ENV_RUN_NAME = "TRACEML_RUN_NAME"
+ENV_EXPECTED_WORLD_SIZE = "TRACEML_EXPECTED_WORLD_SIZE"
+ENV_FINALIZE_TIMEOUT = "TRACEML_FINALIZE_TIMEOUT_SEC"
+ENV_SUMMARY_WINDOW_ROWS = "TRACEML_SUMMARY_WINDOW_ROWS"
+ENV_SCRIPT = "TRACEML_SCRIPT"
+ENV_SCRIPT_ARGS = "TRACEML_SCRIPT_ARGS"
+
+
+@dataclasses.dataclass(frozen=True)
+class AggregatorEndpoint:
+    """connect_host vs bind_host split for multi-node
+    (reference: settings.py:36-49)."""
+
+    connect_host: str = "127.0.0.1"
+    bind_host: str = "127.0.0.1"
+    port: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceMLSettings:
+    session_id: str = "local"
+    logs_dir: Path = Path("./traceml_logs")
+    mode: str = "cli"  # cli | summary
+    aggregator: AggregatorEndpoint = dataclasses.field(
+        default_factory=AggregatorEndpoint
+    )
+    sampler_interval_sec: float = 1.0
+    trace_max_steps: Optional[int] = None
+    disabled: bool = False
+    disk_backup: bool = False
+    capture_stderr: bool = True
+    run_name: Optional[str] = None
+    expected_world_size: Optional[int] = None
+    finalize_timeout_sec: float = 300.0
+    summary_window_rows: int = 10000
+
+    @property
+    def session_dir(self) -> Path:
+        return Path(self.logs_dir) / self.session_id
+
+    def rank_dir(self, global_rank: int) -> Path:
+        return self.session_dir / f"rank_{global_rank}"
+
+    @property
+    def control_dir(self) -> Path:
+        return self.session_dir / "control"
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+def settings_from_env(env: Optional[Dict[str, str]] = None) -> TraceMLSettings:
+    e = os.environ if env is None else env
+
+    def get(name: str, default: Any = None) -> Any:
+        return e.get(name, default)
+
+    max_steps = get(ENV_MAX_STEPS)
+    expected_ws = get(ENV_EXPECTED_WORLD_SIZE)
+    return TraceMLSettings(
+        session_id=get(ENV_SESSION_ID, "local"),
+        logs_dir=Path(get(ENV_LOGS_DIR, "./traceml_logs")),
+        mode=get(ENV_MODE, "cli"),
+        aggregator=AggregatorEndpoint(
+            connect_host=get(ENV_AGG_HOST, "127.0.0.1"),
+            bind_host=get(ENV_AGG_HOST, "127.0.0.1"),
+            port=int(get(ENV_AGG_PORT, 0) or 0),
+        ),
+        sampler_interval_sec=float(get(ENV_SAMPLER_INTERVAL, 1.0) or 1.0),
+        trace_max_steps=int(max_steps) if max_steps else None,
+        disabled=(str(get(ENV_DISABLE, "")).strip().lower() in ("1", "true", "yes")),
+        disk_backup=(str(get(ENV_DISK_BACKUP, "")).strip().lower() in ("1", "true", "yes")),
+        capture_stderr=(str(get(ENV_CAPTURE_STDERR, "1")).strip().lower() in ("1", "true", "yes")),
+        run_name=get(ENV_RUN_NAME) or None,
+        expected_world_size=int(expected_ws) if expected_ws else None,
+        finalize_timeout_sec=float(get(ENV_FINALIZE_TIMEOUT, 300.0) or 300.0),
+        summary_window_rows=int(get(ENV_SUMMARY_WINDOW_ROWS, 10000) or 10000),
+    )
+
+
+def settings_to_env(s: TraceMLSettings) -> Dict[str, str]:
+    """The launcher-side half of the contract."""
+    env = {
+        ENV_SESSION_ID: s.session_id,
+        ENV_LOGS_DIR: str(s.logs_dir),
+        ENV_MODE: s.mode,
+        ENV_AGG_HOST: s.aggregator.connect_host,
+        ENV_AGG_PORT: str(s.aggregator.port),
+        ENV_SAMPLER_INTERVAL: str(s.sampler_interval_sec),
+        ENV_CAPTURE_STDERR: "1" if s.capture_stderr else "0",
+        ENV_FINALIZE_TIMEOUT: str(s.finalize_timeout_sec),
+        ENV_SUMMARY_WINDOW_ROWS: str(s.summary_window_rows),
+    }
+    if s.trace_max_steps is not None:
+        env[ENV_MAX_STEPS] = str(s.trace_max_steps)
+    if s.disabled:
+        env[ENV_DISABLE] = "1"
+    if s.disk_backup:
+        env[ENV_DISK_BACKUP] = "1"
+    if s.run_name:
+        env[ENV_RUN_NAME] = s.run_name
+    if s.expected_world_size is not None:
+        env[ENV_EXPECTED_WORLD_SIZE] = str(s.expected_world_size)
+    return env

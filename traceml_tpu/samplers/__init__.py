@@ -1,0 +1,3 @@
+"""Per-rank samplers (reference: src/traceml_ai/samplers/)."""
+
+from traceml_tpu.samplers.base_sampler import BaseSampler  # noqa: F401

@@ -1,0 +1,45 @@
+"""Sampler base (reference: src/traceml_ai/samplers/base_sampler.py:23-93).
+
+Every sampler owns a bounded in-memory :class:`Database` and an
+incremental sender; the runtime tick calls ``sample()`` (errors logged,
+never raised) and the publisher collects each sender's new rows.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Optional
+
+from traceml_tpu.database import Database, DBIncrementalSender, DatabaseWriter
+from traceml_tpu.utils.error_log import get_error_log
+
+
+class BaseSampler:
+    name: str = "base"
+
+    def __init__(self, disk_backup_dir: Optional[Path] = None) -> None:
+        self.db = Database()
+        self.sender = DBIncrementalSender(self.name, self.db)
+        self.writer = DatabaseWriter(self.name, self.db, disk_backup_dir)
+        self.sample_errors = 0
+
+    def sample(self) -> None:
+        """Called on every runtime tick; must be cheap and non-raising."""
+        try:
+            self._sample()
+        except Exception as exc:
+            self.sample_errors += 1
+            get_error_log().warning(f"sampler {self.name} sample failed", exc)
+
+    def _sample(self) -> None:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def drain(self) -> None:
+        """Final sample pass during shutdown (drain-on-stop samplers)."""
+        self.sample()
+
+    def stop(self) -> None:
+        try:
+            self.writer.flush(force=True)
+        except Exception:
+            pass

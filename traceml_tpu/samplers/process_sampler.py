@@ -1,0 +1,58 @@
+"""Process sampler — this rank's host + device footprint
+(reference: src/traceml_ai/samplers/process_sampler.py:25-246).
+
+Per tick: process CPU %, RSS, thread count, plus per-addressable-device
+memory for THIS process (the reference's ``torch.cuda.memory_allocated``
+analogue).  The reference's CUDA-safety gate (never touch CUDA before
+``init_process_group``) maps to: never force jax backend init — only
+sample devices once jax is already initialized in this process.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from traceml_tpu.samplers.base_sampler import BaseSampler
+
+TABLE = "process"
+TABLE_DEVICE = "process_device"
+
+
+class ProcessSampler(BaseSampler):
+    name = "process"
+
+    def __init__(self, *args: Any, memory_backend: Any = None, **kw: Any) -> None:
+        super().__init__(*args, **kw)
+        self._backend_holder = {"backend": memory_backend}
+        try:
+            import psutil
+
+            self._proc = psutil.Process()
+            self._proc.cpu_percent(interval=None)
+        except Exception:
+            self._proc = None
+
+    def _device_rows(self, ts: float) -> List[Dict[str, Any]]:
+        from traceml_tpu.utils.step_memory import device_memory_rows
+
+        return device_memory_rows(self._backend_holder, ts)
+
+    def _sample(self) -> None:
+        ts = time.time()
+        if self._proc is not None:
+            with self._proc.oneshot():
+                mem = self._proc.memory_info()
+                row = {
+                    "timestamp": ts,
+                    "pid": self._proc.pid,
+                    "cpu_pct": self._proc.cpu_percent(interval=None),
+                    "rss_bytes": mem.rss,
+                    "vms_bytes": mem.vms,
+                    "num_threads": self._proc.num_threads(),
+                }
+            self.db.add_record(TABLE, row)
+        rows = self._device_rows(ts)
+        if rows:
+            self.db.add_records(TABLE_DEVICE, rows)

@@ -1,0 +1,54 @@
+"""stdout/stderr sampler
+(reference: src/traceml_ai/samplers/stdout_stderr_sampler.py:25-76).
+
+Drains the StreamCapture buffer into telemetry rows (the aggregator's
+live CLI shows rank-0 output) and appends every rank's lines to a local
+log file.
+"""
+
+from __future__ import annotations
+
+import time
+from pathlib import Path
+from typing import Any, Optional
+
+from traceml_tpu.runtime.stdout_capture import StreamCapture
+from traceml_tpu.samplers.base_sampler import BaseSampler
+
+TABLE = "stdout_stderr"
+
+
+class StdoutStderrSampler(BaseSampler):
+    name = "stdout_stderr"
+
+    def __init__(
+        self,
+        capture: StreamCapture,
+        *args: Any,
+        log_path: Optional[Path] = None,
+        mirror_to_db: bool = True,
+        **kw: Any,
+    ) -> None:
+        super().__init__(*args, **kw)
+        self._capture = capture
+        self._log_path = Path(log_path) if log_path else None
+        self._mirror = mirror_to_db
+
+    def _sample(self) -> None:
+        lines = self._capture.drain()
+        if not lines:
+            return
+        ts = time.time()
+        if self._log_path is not None:
+            self._log_path.parent.mkdir(parents=True, exist_ok=True)
+            with open(self._log_path, "a", encoding="utf-8") as fh:
+                for stream, line in lines:
+                    fh.write(f"[{stream}] {line}\n")
+        if self._mirror:
+            self.db.add_records(
+                TABLE,
+                [
+                    {"timestamp": ts, "stream": stream, "line": line[:4096]}
+                    for stream, line in lines
+                ],
+            )

@@ -1,0 +1,22 @@
+"""Step-memory sampler
+(reference: src/traceml_ai/samplers/step_memory_sampler.py:12-65).
+
+Drains the step-memory queue verbatim — rows were fully formed by
+StepMemoryTracker at the step edges; no aggregation here.
+"""
+
+from __future__ import annotations
+
+from traceml_tpu.samplers.base_sampler import BaseSampler
+from traceml_tpu.utils.timing import drain_step_memory_rows
+
+TABLE = "step_memory"
+
+
+class StepMemorySampler(BaseSampler):
+    name = "step_memory"
+
+    def _sample(self) -> None:
+        rows = drain_step_memory_rows()
+        if rows:
+            self.db.add_records(TABLE, rows)

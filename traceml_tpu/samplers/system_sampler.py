@@ -1,0 +1,149 @@
+"""System sampler — host + TPU chip counters, rank-0-per-node only
+(reference: src/traceml_ai/samplers/system_sampler.py:44-223 and
+system_manifest.py:44-218; NVML replaced by jax/libtpu surfaces).
+
+Tables:
+
+* ``system``         — psutil host CPU%, RAM used/total, load avg
+* ``system_device``  — per local chip: bytes in use / peak / limit
+  (libtpu allocator counters via ``Device.memory_stats()``; utilization
+  duty-cycle has no public Python surface — reported null, a documented
+  gap vs NVML, compensated by step-level device timing)
+
+One-time ``system_manifest.json``: hostname, platform, accelerator kind,
+device inventory with coords (TPU topology), process index/count —
+the TPU analogue of the reference's NVML UUID manifest.
+"""
+
+from __future__ import annotations
+
+import os
+import platform
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+from traceml_tpu.samplers.base_sampler import BaseSampler
+from traceml_tpu.utils.atomic_io import atomic_write_json
+from traceml_tpu.utils.error_log import get_error_log
+
+TABLE_HOST = "system"
+TABLE_DEVICE = "system_device"
+
+
+def build_system_manifest() -> Dict[str, Any]:
+    manifest: Dict[str, Any] = {
+        "hostname": platform.node(),
+        "os": platform.platform(),
+        "python": platform.python_version(),
+        "pid": os.getpid(),
+        "created_at": time.time(),
+    }
+    try:
+        import psutil
+
+        manifest["cpu_count"] = psutil.cpu_count()
+        manifest["host_memory_total_bytes"] = psutil.virtual_memory().total
+    except Exception:
+        pass
+    try:
+        import jax
+
+        devices = jax.local_devices()
+        manifest["platform"] = jax.default_backend()
+        manifest["process_index"] = jax.process_index()
+        manifest["process_count"] = jax.process_count()
+        manifest["local_device_count"] = len(devices)
+        manifest["global_device_count"] = jax.device_count()
+        manifest["devices"] = [
+            {
+                "id": int(d.id),
+                "kind": str(d.device_kind),
+                "process_index": int(d.process_index),
+                "coords": list(getattr(d, "coords", ()) or ()),
+                "core_on_chip": getattr(d, "core_on_chip", None),
+            }
+            for d in devices
+        ]
+    except Exception as exc:
+        manifest["platform"] = "unknown"
+        get_error_log().warning("system manifest device probe failed", exc)
+    return manifest
+
+
+class SystemSampler(BaseSampler):
+    name = "system"
+
+    def __init__(
+        self,
+        *args: Any,
+        manifest_path: Optional[Path] = None,
+        memory_backend: Any = None,
+        **kw: Any,
+    ) -> None:
+        super().__init__(*args, **kw)
+        self._manifest_path = manifest_path
+        self._manifest_written = False
+        self._backend_holder = {"backend": memory_backend}
+        try:
+            import psutil
+
+            self._psutil = psutil
+            psutil.cpu_percent(interval=None)  # prime the delta
+        except Exception:
+            self._psutil = None
+
+    def _ensure_manifest(self) -> None:
+        if self._manifest_written or self._manifest_path is None:
+            return
+        from traceml_tpu.utils.step_memory import jax_is_initialized
+
+        # The manifest wants device topology, so wait until the user's
+        # process has initialized jax itself (never force init from the
+        # sampler thread — see jax_is_initialized).  Written on the first
+        # tick after that.
+        if not jax_is_initialized():
+            return
+        try:
+            atomic_write_json(self._manifest_path, build_system_manifest())
+            self._manifest_written = True
+        except Exception as exc:
+            get_error_log().warning("system manifest write failed", exc)
+
+    def _device_rows(self, ts: float) -> List[Dict[str, Any]]:
+        from traceml_tpu.utils.step_memory import device_memory_rows
+
+        rows = device_memory_rows(self._backend_holder, ts)
+        for r in rows:
+            # no public per-chip duty-cycle/thermal counters (NVML gap on
+            # TPU); reported null, compensated by step-level device timing
+            r["utilization_pct"] = None
+            r["temperature_c"] = None
+            r["power_w"] = None
+        return rows
+
+    def _sample(self) -> None:
+        self._ensure_manifest()
+        ts = time.time()
+        if self._psutil is not None:
+            vm = self._psutil.virtual_memory()
+            try:
+                load1, load5, load15 = os.getloadavg()
+            except OSError:
+                load1 = load5 = load15 = None
+            self.db.add_record(
+                TABLE_HOST,
+                {
+                    "timestamp": ts,
+                    "cpu_pct": self._psutil.cpu_percent(interval=None),
+                    "memory_used_bytes": vm.used,
+                    "memory_total_bytes": vm.total,
+                    "memory_pct": vm.percent,
+                    "load_1m": load1,
+                    "load_5m": load5,
+                    "load_15m": load15,
+                },
+            )
+        rows = self._device_rows(ts)
+        if rows:
+            self.db.add_records(TABLE_DEVICE, rows)

@@ -55,6 +55,9 @@ class trace_step:
             if st.tls.in_step:
                 return self  # nested: inert (reference: outermost-only)
             self._outermost = True
+            # Stamp the previous step's markers from this thread before
+            # opening a new step — see MarkerResolver.sweep_inline.
+            get_marker_resolver().sweep_inline()
             st.tls.in_step = True
             self._step = st.begin_step()
             st.ensure_mem_tracker().reset(self._step)

@@ -52,8 +52,9 @@ class MarkerResolver:
         self._thread = None
 
     def submit(self, marker: DeviceMarker) -> None:
-        if marker.resolved:
+        if marker.resolved or marker.submitted:
             return
+        marker.submitted = True
         with self._lock:
             self._pending.append(marker)
         self._wake.set()
@@ -65,6 +66,32 @@ class MarkerResolver:
         with self._lock:
             return len(self._pending)
 
+    def sweep_inline(self, max_n: int = 64) -> int:
+        """Opportunistic poll on the CALLER thread; returns #resolved.
+
+        Called at step boundaries (trace_step.__enter__): in a hot
+        training loop the GIL can starve the resolver thread for tens of
+        ms, so the main thread stamps the previous step's markers itself
+        — the stamp error is then bounded by one inter-step gap instead
+        of the resolver's scheduling luck.  Cost: a handful of local
+        ``is_ready()`` calls, microseconds.
+        """
+        with self._lock:
+            pending = list(self._pending[:max_n])
+        if not pending:
+            return 0
+        resolved = 0
+        for m in pending:
+            try:
+                if m.poll():
+                    resolved += 1
+            except Exception:
+                pass
+        if resolved:
+            with self._lock:
+                self._pending = [m for m in self._pending if not m.resolved]
+        return resolved
+
     def _run(self) -> None:
         try:
             while not self._stop.is_set():
@@ -75,17 +102,16 @@ class MarkerResolver:
                     if fired:
                         self._wake.clear()
                     continue
-                still: List[DeviceMarker] = []
                 for m in pending:
                     try:
-                        if not m.poll():
-                            still.append(m)
+                        m.poll()
                     except Exception:
                         pass  # poll() itself fails open, but belt+braces
                 with self._lock:
-                    # new markers may have arrived during the sweep
-                    new = self._pending[len(pending):]
-                    self._pending = still + new
+                    # Identity-based prune: concurrent submits and
+                    # sweep_inline() prunes both mutate _pending, so a
+                    # slice-by-stale-length merge would drop markers.
+                    self._pending = [m for m in self._pending if not m.resolved]
                 self._stop.wait(self._interval)
         except Exception as exc:  # pragma: no cover
             get_error_log().error("marker resolver crashed", exc)

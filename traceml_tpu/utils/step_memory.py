@@ -150,6 +150,55 @@ def detect_backend() -> MemoryBackend:
     return NullMemoryBackend()
 
 
+def jax_is_initialized() -> bool:
+    """True only when a jax backend already exists in this process.
+
+    Samplers MUST consult this before touching devices: triggering XLA
+    backend init from a background thread before the user's own
+    ``jax.distributed.initialize`` is the TPU analogue of the
+    reference's touch-CUDA-before-init_process_group hazard
+    (reference: process_sampler.py CUDA-safety gate)."""
+    import sys
+
+    if "jax" not in sys.modules:
+        return False
+    try:
+        import jax._src.xla_bridge as xb
+
+        return bool(getattr(xb, "_backends", None))
+    except Exception:
+        return False
+
+
+def device_memory_rows(backend_holder: Dict[str, Any], ts: float) -> List[Dict[str, Any]]:
+    """Shared per-device row builder for the system/process samplers.
+
+    ``backend_holder`` is a one-key dict {"backend": MemoryBackend|None}
+    owned by the caller; detection is lazy and gated on jax being
+    initialized so the sampler thread can never force backend init.
+    """
+    backend = backend_holder.get("backend")
+    if backend is None:
+        if not jax_is_initialized():
+            return []
+        try:
+            backend = detect_backend()
+        except Exception:
+            return []
+        backend_holder["backend"] = backend
+    return [
+        {
+            "timestamp": ts,
+            "device_id": r["device_id"],
+            "device_kind": r.get("device_kind", "unknown"),
+            "memory_used_bytes": r.get("current_bytes"),
+            "memory_peak_bytes": r.get("peak_bytes"),
+            "memory_total_bytes": r.get("limit_bytes"),
+        }
+        for r in backend.sample()
+    ]
+
+
 class StepMemoryTracker:
     """Records device memory at step edges and emits one row per
     (step, device) into the global step-memory queue."""

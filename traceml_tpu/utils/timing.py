@@ -80,7 +80,7 @@ class DeviceMarker:
     with the observation time.
     """
 
-    __slots__ = ("_handles", "dispatched_at", "ready_at")
+    __slots__ = ("_handles", "dispatched_at", "ready_at", "late_stamp", "submitted")
 
     def __init__(self, handles: Sequence[Any], dispatched_at: Optional[float] = None):
         self._handles: Optional[List[Any]] = [
@@ -88,6 +88,8 @@ class DeviceMarker:
         ]
         self.dispatched_at = _now() if dispatched_at is None else dispatched_at
         self.ready_at: Optional[float] = None
+        self.late_stamp = False
+        self.submitted = False  # resolver dedupe flag
         if not self._handles:
             # nothing to wait on → ready at dispatch
             self.ready_at = self.dispatched_at
@@ -97,7 +99,15 @@ class DeviceMarker:
     def resolved(self) -> bool:
         return self.ready_at is not None
 
-    def poll(self, now: Optional[float] = None) -> bool:
+    def poll(self, now: Optional[float] = None, late: bool = False) -> bool:
+        """Stamping readiness check.
+
+        ``ready_at`` is the OBSERVATION time, so only fine-cadence pollers
+        (the marker resolver, step-boundary inline sweeps) may call this —
+        a coarse caller would silently inflate device durations.  Coarse
+        last-resort callers (shutdown drains) must pass ``late=True`` so
+        downstream can discount the stamp quality.
+        """
         if self.ready_at is not None:
             return True
         handles = self._handles
@@ -112,6 +122,7 @@ class DeviceMarker:
             # completed at observation time — fail open, never raise.
             pass
         self.ready_at = _now() if now is None else now
+        self.late_stamp = late
         self._handles = None
         return True
 
@@ -180,14 +191,27 @@ class TimeEvent:
             return None
         return (self.cpu_end - self.cpu_start) * 1000.0
 
-    def try_resolve(self) -> bool:
-        """Non-blocking; True when device side (if any) is complete
-        (reference: TimeEvent.try_resolve, timing.py:66)."""
+    def is_resolved(self) -> bool:
+        """Non-stamping check: True when host side is closed and the
+        device marker (if any) has already been stamped by a fine-cadence
+        poller.  Never stamps — see DeviceMarker.poll."""
         if self.cpu_end is None:
             return False
         if self.marker is None:
             return True
-        return self.marker.poll()
+        return self.marker.resolved
+
+    def try_resolve(self, late: bool = True) -> bool:
+        """Stamping resolution for last-resort paths (shutdown drain,
+        resolve-timeout).  Marks the stamp as late by default
+        (reference: TimeEvent.try_resolve, timing.py:66 — there the CUDA
+        event carries the true device time, so stamping cadence doesn't
+        matter; here it does)."""
+        if self.cpu_end is None:
+            return False
+        if self.marker is None:
+            return True
+        return self.marker.poll(late=late)
 
     @property
     def device_ready_at(self) -> Optional[float]:
@@ -218,7 +242,13 @@ class StepTimeBatch:
         self.flushed_at = _now()
 
     def resolved(self) -> bool:
-        return all(e.try_resolve() for e in self.events)
+        """Non-stamping: safe to call at any cadence."""
+        return all(e.is_resolved() for e in self.events)
+
+    def force_resolve(self) -> None:
+        """Stamp any still-pending markers (late-quality stamps)."""
+        for e in self.events:
+            e.try_resolve(late=True)
 
 
 class StepEventBuffer:
