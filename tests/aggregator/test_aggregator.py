@@ -1,0 +1,121 @@
+"""Aggregator loop + finalization barrier tests.
+
+The settle/finalize paths run against the real TCP server with scripted
+clients (reference pattern: tests/aggregator/test_finalization.py uses
+fakes; here the transport is cheap enough to use for real).
+"""
+
+import json
+import time
+
+from traceml_tpu.aggregator.trace_aggregator import TraceMLAggregator
+from traceml_tpu.runtime.settings import AggregatorEndpoint, TraceMLSettings
+from traceml_tpu.telemetry.control import build_rank_finished
+from traceml_tpu.telemetry.envelope import SenderIdentity, build_telemetry_envelope
+from traceml_tpu.transport import TCPClient
+from traceml_tpu.utils import timing as T
+
+
+def _settings(tmp_path, expected_ws=None):
+    return TraceMLSettings(
+        session_id="agg-test",
+        logs_dir=tmp_path,
+        mode="summary",
+        aggregator=AggregatorEndpoint(port=0),
+        expected_world_size=expected_ws,
+        finalize_timeout_sec=3.0,
+    )
+
+
+def _send_rank(port, rank, n_steps=60, finish=True):
+    ident = SenderIdentity(session_id="agg-test", global_rank=rank, world_size=2)
+    client = TCPClient("127.0.0.1", port)
+    rows = [
+        {"step": s, "timestamp": float(s), "clock": "device",
+         "events": {
+             T.STEP_TIME: {"cpu_ms": 100.0, "device_ms": 100.0, "count": 1},
+             T.COMPUTE_TIME: {"cpu_ms": 1.0, "device_ms": 92.0, "count": 1},
+         }}
+        for s in range(1, n_steps + 1)
+    ]
+    batch = [build_telemetry_envelope("step_time", {"step_time": rows}, ident).to_wire()]
+    if finish:
+        batch.append(build_rank_finished(ident.to_meta()))
+    assert client.send_batch(batch)
+    client.close()
+
+
+def test_aggregator_end_to_end_with_summary(tmp_path):
+    settings = _settings(tmp_path, expected_ws=2)
+    agg = TraceMLAggregator(settings)
+    agg.start()
+    try:
+        for rank in (0, 1):
+            _send_rank(agg.port, rank)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and len(agg._finished_ranks) < 2:
+            time.sleep(0.05)
+    finally:
+        agg.stop()
+    payload = json.loads((settings.session_dir / "final_summary.json").read_text())
+    assert payload["sections"]["step_time"]["status"] == "OK"
+    assert payload["meta"]["topology"]["world_size"] == 2
+    assert not (settings.session_dir / "finalization_warning.json").exists()
+
+
+def test_aggregator_missing_rank_warning(tmp_path):
+    settings = _settings(tmp_path, expected_ws=2)
+    agg = TraceMLAggregator(settings)
+    agg.start()
+    try:
+        _send_rank(agg.port, 0)  # rank 1 never reports
+        time.sleep(0.3)
+    finally:
+        agg.stop(finalize_timeout=1.0)
+    warning = json.loads(
+        (settings.session_dir / "finalization_warning.json").read_text()
+    )
+    assert warning["missing_ranks"] == [1]
+    # summary still generated from what arrived
+    assert (settings.session_dir / "final_summary.json").exists()
+
+
+def test_summary_service_file_ipc(tmp_path):
+    settings = _settings(tmp_path, expected_ws=1)
+    agg = TraceMLAggregator(settings)
+    agg.start()
+    try:
+        _send_rank(agg.port, 0, finish=False)
+        time.sleep(0.3)
+        from traceml_tpu.sdk import protocol
+
+        protocol.write_summary_request(settings.session_dir)
+        deadline = time.monotonic() + 5
+        resp = None
+        while time.monotonic() < deadline:
+            resp = protocol.read_summary_response(settings.session_dir)
+            if resp:
+                break
+            time.sleep(0.1)
+        assert resp is not None and resp["ok"]
+        assert (settings.session_dir / "final_summary.json").exists()
+    finally:
+        agg.stop(finalize_timeout=1.0)
+
+
+def test_sdk_summary_client_roundtrip(tmp_path):
+    settings = _settings(tmp_path, expected_ws=1)
+    agg = TraceMLAggregator(settings)
+    agg.start()
+    try:
+        _send_rank(agg.port, 0, finish=False)
+        time.sleep(0.3)
+        from traceml_tpu.sdk.summary_client import final_summary, summary
+
+        data = final_summary(timeout=10, session_dir=settings.session_dir)
+        assert data is not None
+        assert data["sections"]["step_time"]["status"] == "OK"
+        flat = summary(timeout=10, session_dir=settings.session_dir)
+        assert any(k.startswith("traceml/") for k in flat)
+    finally:
+        agg.stop(finalize_timeout=1.0)

@@ -1,0 +1,124 @@
+import json
+import sqlite3
+
+from traceml_tpu.aggregator.sqlite_writer import SQLiteWriter
+from traceml_tpu.telemetry.envelope import SenderIdentity, build_telemetry_envelope
+
+
+def _env(sampler, tables, rank=0, node=0):
+    ident = SenderIdentity(
+        session_id="s1",
+        global_rank=rank,
+        local_rank=rank % 4,
+        world_size=4,
+        node_rank=node,
+        hostname=f"host-{node}",
+        pid=100 + rank,
+    )
+    return build_telemetry_envelope(sampler, tables, identity=ident)
+
+
+def test_writer_projections_and_flush(tmp_path):
+    db = tmp_path / "t.sqlite"
+    w = SQLiteWriter(db)
+    w.start()
+    w.ingest(
+        _env(
+            "step_time",
+            {"step_time": [
+                {"step": 1, "timestamp": 1.0, "clock": "device",
+                 "events": {"_traceml_internal:step_time": {"cpu_ms": 100, "device_ms": 101, "count": 1}}},
+            ]},
+            rank=1,
+        )
+    )
+    w.ingest(
+        _env("step_memory", {"step_memory": [
+            {"step": 1, "timestamp": 1.0, "device_id": 0, "device_kind": "tpu",
+             "current_bytes": 100, "peak_bytes": 120, "step_peak_bytes": 110,
+             "limit_bytes": 1000, "backend": "fake"}]}, rank=1)
+    )
+    w.ingest(
+        _env("system", {
+            "system": [{"timestamp": 1.0, "cpu_pct": 10.0,
+                        "memory_used_bytes": 1, "memory_total_bytes": 2,
+                        "memory_pct": 50.0}],
+            "system_device": [{"timestamp": 1.0, "device_id": 0,
+                               "device_kind": "tpu", "memory_used_bytes": 5,
+                               "memory_peak_bytes": 6, "memory_total_bytes": 10}],
+        })
+    )
+    w.ingest(
+        _env("process", {"process": [
+            {"timestamp": 1.0, "cpu_pct": 5.0, "rss_bytes": 10,
+             "vms_bytes": 20, "num_threads": 3}]}, rank=2)
+    )
+    w.ingest(
+        _env("stdout_stderr", {"stdout_stderr": [
+            {"timestamp": 1.0, "stream": "stdout", "line": "hello"}]})
+    )
+    assert w.force_flush()
+    conn = sqlite3.connect(db)
+    assert conn.execute("SELECT COUNT(*) FROM step_time_samples").fetchone()[0] == 1
+    row = conn.execute(
+        "SELECT global_rank, clock, events_json FROM step_time_samples"
+    ).fetchone()
+    assert row[0] == 1
+    assert row[1] == "device"
+    assert json.loads(row[2])["_traceml_internal:step_time"]["device_ms"] == 101
+    assert conn.execute("SELECT COUNT(*) FROM step_memory_samples").fetchone()[0] == 1
+    assert conn.execute("SELECT COUNT(*) FROM system_samples").fetchone()[0] == 1
+    assert conn.execute("SELECT COUNT(*) FROM system_device_samples").fetchone()[0] == 1
+    assert conn.execute("SELECT COUNT(*) FROM process_samples").fetchone()[0] == 1
+    assert conn.execute("SELECT COUNT(*) FROM stdout_samples").fetchone()[0] == 1
+    conn.close()
+    assert w.finalize()
+
+
+def test_writer_retention_prunes_per_rank(tmp_path):
+    db = tmp_path / "t.sqlite"
+    w = SQLiteWriter(db, summary_window_rows=10, retention_factor=1.5)
+    w.start()
+    for rank in (0, 1):
+        for step in range(1, 101):
+            w.ingest(
+                _env("step_time", {"step_time": [
+                    {"step": step, "timestamp": float(step), "clock": "host",
+                     "events": {}}]}, rank=rank)
+            )
+    w.force_flush()
+    assert w.finalize()
+    conn = sqlite3.connect(db)
+    for rank in (0, 1):
+        n = conn.execute(
+            "SELECT COUNT(*) FROM step_time_samples WHERE global_rank=?", (rank,)
+        ).fetchone()[0]
+        assert n == 15  # 1.5 × 10
+        newest = conn.execute(
+            "SELECT MAX(step) FROM step_time_samples WHERE global_rank=?", (rank,)
+        ).fetchone()[0]
+        assert newest == 100  # newest retained
+    conn.close()
+
+
+def test_writer_unknown_sampler_ignored(tmp_path):
+    db = tmp_path / "t.sqlite"
+    w = SQLiteWriter(db)
+    w.start()
+    w.ingest(_env("mystery", {"rows": [{"a": 1}]}))
+    assert w.force_flush()
+    assert w.finalize()
+    assert w.written == 0
+
+
+def test_writer_wal_checkpointed_on_finalize(tmp_path):
+    db = tmp_path / "t.sqlite"
+    w = SQLiteWriter(db)
+    w.start()
+    w.ingest(_env("process", {"process": [
+        {"timestamp": 1.0, "cpu_pct": 5.0, "rss_bytes": 10,
+         "vms_bytes": 20, "num_threads": 3}]}))
+    w.force_flush()
+    assert w.finalize()
+    wal = db.with_suffix(".sqlite-wal")
+    assert (not wal.exists()) or wal.stat().st_size == 0

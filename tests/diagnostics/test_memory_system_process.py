@@ -1,0 +1,121 @@
+from traceml_tpu.diagnostics.process.api import diagnose as diagnose_process
+from traceml_tpu.diagnostics.step_memory.api import diagnose_rank_rows as diagnose_memory
+from traceml_tpu.diagnostics.step_memory.policy import StepMemoryPolicy
+from traceml_tpu.diagnostics.system.api import diagnose as diagnose_system
+
+GiB = 1024**3
+
+
+def _mem_row(step, cur, limit=16 * GiB, dev=0):
+    return {
+        "step": step,
+        "device_id": dev,
+        "current_bytes": cur,
+        "step_peak_bytes": cur,
+        "limit_bytes": limit,
+    }
+
+
+def test_memory_healthy():
+    rows = {0: [_mem_row(s, 4 * GiB) for s in range(100)]}
+    result = diagnose_memory(rows)
+    assert result.healthy
+    assert result.diagnosis.kind == "HEALTHY"
+
+
+def test_memory_high_pressure():
+    rows = {0: [_mem_row(s, int(15.8 * GiB)) for s in range(100)]}
+    result = diagnose_memory(rows)
+    assert result.diagnosis.kind == "HIGH_MEMORY_PRESSURE"
+    assert result.diagnosis.severity == "critical"  # 98.75%
+
+
+def test_memory_imbalance_requires_pressure():
+    # big skew but low absolute pressure → no issue
+    rows = {
+        0: [_mem_row(s, 1 * GiB) for s in range(50)],
+        1: [_mem_row(s, 2 * GiB) for s in range(50)],
+    }
+    assert diagnose_memory(rows).healthy
+    # same skew with pressure → fires
+    rows = {
+        0: [_mem_row(s, 9 * GiB) for s in range(50)],
+        1: [_mem_row(s, 14 * GiB) for s in range(50)],
+    }
+    result = diagnose_memory(rows)
+    assert result.diagnosis.kind == "MEMORY_IMBALANCE"
+    assert result.diagnosis.ranks == [1]
+
+
+def test_memory_creep_confirmed():
+    policy = StepMemoryPolicy(creep_min_steps=90)  # shrink for test speed
+    rows = {0: []}
+    base = 4 * GiB
+    for s in range(900):
+        rows[0].append(_mem_row(s, base + s * (2 * GiB // 900)))
+    result = diagnose_memory(rows, policy=policy)
+    assert result.diagnosis.kind == "MEMORY_CREEP_CONFIRMED"
+
+
+def test_memory_creep_not_fired_on_recovery():
+    policy = StepMemoryPolicy(creep_min_steps=90)
+    rows = {0: []}
+    base = 4 * GiB
+    for s in range(900):
+        # grows then recovers (cache warmup, not a leak)
+        growth = min(s, 450) * (2 * GiB // 450)
+        recovery = max(0, s - 600) * (3 * GiB // 300)
+        rows[0].append(_mem_row(s, base + growth - recovery))
+    result = diagnose_memory(rows, policy=policy)
+    assert result.diagnosis.kind != "MEMORY_CREEP_CONFIRMED"
+
+
+def test_system_rules():
+    host = {0: [{"cpu_pct": 97.0, "memory_used_bytes": 90 * GiB,
+                 "memory_total_bytes": 100 * GiB}] * 30}
+    devices = {(0, 0): [{"memory_used_bytes": int(15.7 * GiB),
+                         "memory_total_bytes": 16 * GiB}]}
+    result = diagnose_system(host, devices)
+    kinds = {i.kind for i in result.issues}
+    assert "HIGH_HOST_CPU" in kinds
+    assert "HIGH_HOST_MEMORY" in kinds
+    assert "HIGH_DEVICE_MEMORY" in kinds
+    # worst first: critical severity leads
+    assert result.diagnosis.severity == "critical"
+
+
+def test_system_healthy():
+    host = {0: [{"cpu_pct": 30.0, "memory_used_bytes": 20 * GiB,
+                 "memory_total_bytes": 100 * GiB}] * 30}
+    result = diagnose_system(host, {})
+    assert result.healthy
+
+
+def test_process_rules():
+    procs = {0: [{"rss_bytes": 50 * 1024**3}], 1: [{"rss_bytes": 1 * GiB}]}
+    devices = {
+        (0, 0): [{"memory_used_bytes": 14 * GiB, "memory_peak_bytes": 14 * GiB,
+                  "memory_total_bytes": 16 * GiB}],
+        (1, 0): [{"memory_used_bytes": 9 * GiB, "memory_peak_bytes": 9 * GiB,
+                  "memory_total_bytes": 16 * GiB}],
+    }
+    result = diagnose_process(procs, devices)
+    kinds = {i.kind for i in result.issues}
+    assert "HIGH_PROCESS_RSS" in kinds
+    assert "RANK_DEVICE_MEMORY_IMBALANCE" in kinds
+
+
+def test_process_overhang():
+    devices = {
+        (0, 0): [{"memory_used_bytes": 3 * GiB, "memory_peak_bytes": 10 * GiB,
+                  "memory_total_bytes": 16 * GiB}],
+    }
+    result = diagnose_process({}, devices)
+    assert result.diagnosis.kind == "DEVICE_MEMORY_OVERHANG"
+
+
+def test_rules_never_raise_on_garbage():
+    result = diagnose_memory({0: [{"weird": True}]})
+    assert result.diagnosis is not None
+    result = diagnose_system({0: [{}]}, {(0, 0): [{}]})
+    assert result.diagnosis is not None

@@ -1,0 +1,97 @@
+"""Multi-rank summary pipeline over an injected SQLite DB
+(reference trick: tests/reporting/summary/test_fixtures.py:20-31 —
+multi-rank = data shape, not processes)."""
+
+import json
+
+from traceml_tpu.aggregator.sqlite_writer import SQLiteWriter
+from traceml_tpu.reporting.final import generate_summary
+from traceml_tpu.runtime.settings import TraceMLSettings
+from traceml_tpu.telemetry.envelope import SenderIdentity, build_telemetry_envelope
+from traceml_tpu.utils import timing as T
+
+
+def _step_row(step, step_ms, input_ms, compute_ms):
+    events = {
+        T.STEP_TIME: {"cpu_ms": step_ms, "device_ms": step_ms, "count": 1},
+        T.DATALOADER_NEXT: {"cpu_ms": input_ms, "device_ms": None, "count": 1},
+        T.COMPUTE_TIME: {"cpu_ms": 0.5, "device_ms": compute_ms, "count": 1},
+    }
+    return {"step": step, "timestamp": float(step), "clock": "device", "events": events}
+
+
+def _inject(db_path, n_ranks=2, n_steps=60, straggler_rank=None):
+    w = SQLiteWriter(db_path)
+    w.start()
+    for rank in range(n_ranks):
+        ident = SenderIdentity(
+            session_id="s1", global_rank=rank, world_size=n_ranks,
+            node_rank=rank // 4, hostname=f"host{rank // 4}", pid=100 + rank,
+        )
+        rows = []
+        for step in range(1, n_steps + 1):
+            if rank == straggler_rank:
+                rows.append(_step_row(step, 300.0, 204.0, 90.0))
+            else:
+                rows.append(_step_row(step, 100.0, 4.0, 90.0))
+        w.ingest(build_telemetry_envelope("step_time", {"step_time": rows}, ident))
+        mem_rows = [
+            {"step": s, "timestamp": float(s), "device_id": 0,
+             "device_kind": "tpu", "current_bytes": 4 << 30,
+             "peak_bytes": 5 << 30, "step_peak_bytes": 5 << 30,
+             "limit_bytes": 16 << 30, "backend": "fake"}
+            for s in range(1, n_steps + 1)
+        ]
+        w.ingest(build_telemetry_envelope("step_memory", {"step_memory": mem_rows}, ident))
+    w.force_flush()
+    w.finalize()
+
+
+def test_summary_healthy_two_ranks(tmp_path):
+    db = tmp_path / "telemetry.sqlite"
+    _inject(db, n_ranks=2)
+    settings = TraceMLSettings(session_id="s1", logs_dir=tmp_path, mode="summary")
+    assert generate_summary(db, tmp_path, settings)
+    payload = json.loads((tmp_path / "final_summary.json").read_text())
+    assert payload["schema"].startswith("traceml-tpu/")
+    assert payload["meta"]["topology"]["world_size"] == 2
+    assert sorted(payload["meta"]["topology"]["ranks_seen"]) == [0, 1]
+    st = payload["sections"]["step_time"]
+    assert st["status"] == "OK"
+    assert st["global"]["clock"] == "device"
+    assert st["global"]["n_steps"] == 60
+    assert payload["primary_diagnosis"]["kind"] == "COMPUTE_BOUND"
+    txt = (tmp_path / "final_summary.txt").read_text()
+    assert "VERDICT" in txt
+    assert "COMPUTE_BOUND" in txt
+
+
+def test_summary_input_straggler_detected(tmp_path):
+    db = tmp_path / "telemetry.sqlite"
+    _inject(db, n_ranks=4, straggler_rank=2)
+    settings = TraceMLSettings(session_id="s1", logs_dir=tmp_path, mode="summary")
+    assert generate_summary(db, tmp_path, settings)
+    payload = json.loads((tmp_path / "final_summary.json").read_text())
+    primary = payload["primary_diagnosis"]
+    assert primary["kind"] == "INPUT_STRAGGLER"
+    assert primary["ranks"] == [2]
+    assert "rank 2" in primary["summary"].lower()
+
+
+def test_summary_no_db(tmp_path):
+    settings = TraceMLSettings(session_id="s1", logs_dir=tmp_path, mode="summary")
+    assert generate_summary(tmp_path / "missing.sqlite", tmp_path, settings)
+    payload = json.loads((tmp_path / "final_summary.json").read_text())
+    assert payload["sections"]["step_time"]["status"] == "NO_DATA"
+
+
+def test_summary_sections_degrade_independently(tmp_path):
+    db = tmp_path / "telemetry.sqlite"
+    _inject(db, n_ranks=1)
+    settings = TraceMLSettings(session_id="s1", logs_dir=tmp_path, mode="summary")
+    assert generate_summary(db, tmp_path, settings)
+    payload = json.loads((tmp_path / "final_summary.json").read_text())
+    # no system/process telemetry injected → NO_DATA, but step_time OK
+    assert payload["sections"]["system"]["status"] == "NO_DATA"
+    assert payload["sections"]["process"]["status"] == "NO_DATA"
+    assert payload["sections"]["step_time"]["status"] == "OK"

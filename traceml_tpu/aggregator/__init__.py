@@ -1,0 +1,1 @@
+"""Out-of-process aggregator (reference: src/traceml_ai/aggregator/)."""

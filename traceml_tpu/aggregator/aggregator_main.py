@@ -1,0 +1,55 @@
+"""Standalone aggregator process entry
+(reference: src/traceml_ai/aggregator/aggregator_main.py:86-280).
+
+Launched as ``python -m traceml_tpu.aggregator.aggregator_main`` with
+TRACEML_* env config.  Binds the TCP server (port 0 → ephemeral, the
+bound port is advertised via ``aggregator_ready.json``), then runs until
+SIGTERM/SIGINT, finalizing on the way out.  Fatal errors land in
+``aggregator_error.log``.
+"""
+
+from __future__ import annotations
+
+import signal
+import sys
+import threading
+import traceback
+
+from traceml_tpu.aggregator.trace_aggregator import TraceMLAggregator, write_ready_file
+from traceml_tpu.runtime.settings import settings_from_env
+from traceml_tpu.utils.error_log import get_error_log
+
+
+def main() -> int:
+    settings = settings_from_env()
+    stop_evt = threading.Event()
+
+    def _on_signal(signum, frame):  # noqa: ANN001
+        stop_evt.set()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+
+    try:
+        agg = TraceMLAggregator(settings)
+        agg.start()
+        assert agg.port is not None
+        write_ready_file(settings, agg.port)
+        while not stop_evt.wait(0.25):
+            pass
+        agg.stop()
+        return 0
+    except Exception as exc:
+        get_error_log().error("aggregator fatal", exc)
+        try:
+            path = settings.session_dir / "aggregator_error.log"
+            path.parent.mkdir(parents=True, exist_ok=True)
+            with open(path, "a", encoding="utf-8") as fh:
+                fh.write("".join(traceback.format_exception(type(exc), exc, exc.__traceback__)))
+        except Exception:
+            pass
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

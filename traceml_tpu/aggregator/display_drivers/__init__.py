@@ -1,0 +1,19 @@
+"""Display drivers (reference: src/traceml_ai/aggregator/display_drivers/)."""
+
+from traceml_tpu.aggregator.display_drivers.base import (  # noqa: F401
+    BaseDisplayDriver,
+    SummaryDisplayDriver,
+)
+
+
+def resolve_display_driver(mode: str):
+    """cli → live Rich display; summary/other → no live UI
+    (reference: trace_aggregator.py:65 _resolve_display_driver)."""
+    if mode == "cli":
+        try:
+            from traceml_tpu.aggregator.display_drivers.cli import CLIDisplayDriver
+
+            return CLIDisplayDriver()
+        except Exception:
+            return SummaryDisplayDriver()
+    return SummaryDisplayDriver()

@@ -1,0 +1,19 @@
+"""Display-driver protocol (reference: display_drivers/base.py:9-40)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+class BaseDisplayDriver:
+    """start/tick/stop; tick is rate-limited by the aggregator loop."""
+
+    def start(self, context: Optional[Any] = None) -> None: ...
+
+    def tick(self, context: Optional[Any] = None) -> None: ...
+
+    def stop(self) -> None: ...
+
+
+class SummaryDisplayDriver(BaseDisplayDriver):
+    """No live UI (summary mode / multi-node default)."""

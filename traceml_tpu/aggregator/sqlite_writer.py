@@ -1,0 +1,209 @@
+"""Async SQLite writer
+(reference: src/traceml_ai/aggregator/sqlite_writer.py:112-647).
+
+One dedicated writer thread owns the connection (sqlite is
+single-writer anyway): bounded ingest queue (50k), per-batch
+transactions, WAL + ``synchronous=NORMAL``, periodic per-rank retention
+pruning to ``1.5×summary_window_rows`` via ``ROW_NUMBER() OVER
+(PARTITION BY ...)``, flush barriers for read-your-writes, and
+``finalize()`` = drain → prune → ``wal_checkpoint(TRUNCATE)`` → close.
+"""
+
+from __future__ import annotations
+
+import queue
+import sqlite3
+import threading
+import time
+from pathlib import Path
+from typing import List, Optional
+
+from traceml_tpu.aggregator.sqlite_writers import ALL_WRITERS, writer_for
+from traceml_tpu.telemetry.envelope import TelemetryEnvelope
+from traceml_tpu.utils.error_log import get_error_log
+
+_QUEUE_MAX = 50_000
+_PRUNE_EVERY_BATCHES = 50
+
+
+class _FlushBarrier:
+    __slots__ = ("event",)
+
+    def __init__(self) -> None:
+        self.event = threading.Event()
+
+
+class SQLiteWriter:
+    def __init__(
+        self,
+        db_path: Path,
+        summary_window_rows: int = 10_000,
+        retention_factor: float = 1.5,
+    ) -> None:
+        self.db_path = Path(db_path)
+        self._retention_rows = int(summary_window_rows * retention_factor)
+        self._queue: "queue.Queue[object]" = queue.Queue(maxsize=_QUEUE_MAX)
+        self._thread: Optional[threading.Thread] = None
+        self._stop_evt = threading.Event()
+        self._finalized = threading.Event()
+        self.enqueued = 0
+        self.dropped = 0
+        self.written = 0
+        self._batches = 0
+
+    # -- producer side (aggregator loop) --------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self.db_path.parent.mkdir(parents=True, exist_ok=True)
+        self._thread = threading.Thread(
+            target=self._run, name="traceml-sqlite-writer", daemon=True
+        )
+        self._thread.start()
+
+    def ingest(self, env: TelemetryEnvelope) -> bool:
+        try:
+            self._queue.put_nowait(env)
+            self.enqueued += 1
+            return True
+        except queue.Full:
+            self.dropped += 1
+            return False
+
+    def force_flush(self, timeout: float = 10.0) -> bool:
+        """Barrier: returns once everything enqueued so far is committed
+        (reference: sqlite_writer.py:168)."""
+        if self._thread is None or self._finalized.is_set():
+            return False
+        barrier = _FlushBarrier()
+        try:
+            self._queue.put(barrier, timeout=timeout)
+        except queue.Full:
+            return False
+        return barrier.event.wait(timeout)
+
+    def finalize(self, timeout: float = 30.0) -> bool:
+        """Drain, prune, checkpoint, close (reference: 206-272, 554-622)."""
+        if self._thread is None:
+            return True
+        ok = self.force_flush(timeout)
+        self._stop_evt.set()
+        try:
+            self._queue.put_nowait(None)  # wake
+        except queue.Full:
+            pass
+        self._thread.join(timeout=timeout)
+        alive = self._thread.is_alive()
+        self._thread = None
+        return ok and not alive
+
+    # -- writer thread ---------------------------------------------------
+    def _connect(self) -> sqlite3.Connection:
+        conn = sqlite3.connect(str(self.db_path))
+        conn.execute("PRAGMA journal_mode=WAL")
+        conn.execute("PRAGMA synchronous=NORMAL")
+        for w in ALL_WRITERS:
+            w.init_schema(conn)
+        conn.commit()
+        return conn
+
+    def _run(self) -> None:
+        try:
+            conn = self._connect()
+        except Exception as exc:
+            get_error_log().error("sqlite writer failed to open db", exc)
+            self._finalized.set()
+            return
+        try:
+            while True:
+                batch: List[TelemetryEnvelope] = []
+                barriers: List[_FlushBarrier] = []
+                try:
+                    item = self._queue.get(timeout=0.25)
+                except queue.Empty:
+                    if self._stop_evt.is_set():
+                        break
+                    continue
+                # greedily drain available items into one transaction
+                while item is not None or not self._queue.empty():
+                    if item is None:
+                        pass
+                    elif isinstance(item, _FlushBarrier):
+                        barriers.append(item)
+                    else:
+                        batch.append(item)
+                    try:
+                        item = self._queue.get_nowait()
+                    except queue.Empty:
+                        item = None
+                        break
+                if batch:
+                    self._write_batch(conn, batch)
+                for b in barriers:
+                    b.event.set()
+                if self._stop_evt.is_set() and self._queue.empty():
+                    break
+            self._prune(conn)
+            try:
+                conn.execute("PRAGMA wal_checkpoint(TRUNCATE)")
+                conn.commit()
+            except sqlite3.Error:
+                pass
+        except Exception as exc:  # pragma: no cover
+            get_error_log().error("sqlite writer thread crashed", exc)
+        finally:
+            try:
+                conn.close()
+            except Exception:
+                pass
+            self._finalized.set()
+
+    def _write_batch(self, conn: sqlite3.Connection, batch: List[TelemetryEnvelope]) -> None:
+        try:
+            conn.execute("BEGIN")
+            for env in batch:
+                writer = writer_for(env.sampler)
+                if writer is None:
+                    continue
+                try:
+                    table_rows = writer.build_rows(env)
+                except Exception as exc:
+                    get_error_log().warning(
+                        f"projection build failed for {env.sampler}", exc
+                    )
+                    continue
+                for table, rows in table_rows.items():
+                    if rows:
+                        conn.executemany(writer.insert_sql(table), rows)
+                        self.written += len(rows)
+            conn.commit()
+        except sqlite3.Error as exc:
+            get_error_log().warning("sqlite batch write failed", exc)
+            try:
+                conn.rollback()
+            except sqlite3.Error:
+                pass
+        self._batches += 1
+        if self._batches % _PRUNE_EVERY_BATCHES == 0:
+            self._prune(conn)
+
+    def _prune(self, conn: sqlite3.Connection) -> None:
+        """Keep the newest ``retention`` rows per (session, rank) per table
+        (reference: sqlite_writer.py:416-509)."""
+        for w in ALL_WRITERS:
+            for table in getattr(w, "RETENTION_TABLES", ()):
+                try:
+                    conn.execute(
+                        f"""DELETE FROM {table} WHERE id IN (
+                            SELECT id FROM (
+                                SELECT id, ROW_NUMBER() OVER (
+                                    PARTITION BY session_id, global_rank
+                                    ORDER BY id DESC
+                                ) AS rn FROM {table}
+                            ) WHERE rn > ?
+                        )""",
+                        (self._retention_rows,),
+                    )
+                    conn.commit()
+                except sqlite3.Error as exc:
+                    get_error_log().warning(f"prune failed for {table}", exc)

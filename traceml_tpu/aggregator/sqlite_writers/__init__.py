@@ -1,0 +1,30 @@
+"""Per-sampler SQLite projection writers
+(reference: src/traceml_ai/aggregator/sqlite_writers/).
+
+Uniform contract per module: ``accepts_sampler(name)``,
+``init_schema(conn)``, ``build_rows(envelope)`` → {table: [tuple,...]},
+``insert_sql(table)``, ``RETENTION_TABLES`` (tables pruned per-rank).
+"""
+
+from traceml_tpu.aggregator.sqlite_writers import (  # noqa: F401
+    process_writer,
+    step_memory_writer,
+    step_time_writer,
+    stdout_writer,
+    system_writer,
+)
+
+ALL_WRITERS = [
+    system_writer,
+    process_writer,
+    step_time_writer,
+    step_memory_writer,
+    stdout_writer,
+]
+
+
+def writer_for(sampler: str):
+    for w in ALL_WRITERS:
+        if w.accepts_sampler(sampler):
+            return w
+    return None

@@ -1,0 +1,73 @@
+"""Shared identity-column helpers for projection writers
+(reference: aggregator/sqlite_writers/step_time.py:131-419 shows the
+stable-identity-columns + payload-json pattern)."""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Tuple
+
+from traceml_tpu.telemetry.envelope import TelemetryEnvelope
+
+IDENTITY_COLS = (
+    "session_id",
+    "global_rank",
+    "local_rank",
+    "world_size",
+    "local_world_size",
+    "node_rank",
+    "hostname",
+    "pid",
+)
+
+IDENTITY_SCHEMA = """
+    session_id TEXT,
+    global_rank INTEGER,
+    local_rank INTEGER,
+    world_size INTEGER,
+    local_world_size INTEGER,
+    node_rank INTEGER,
+    hostname TEXT,
+    pid INTEGER
+"""
+
+
+def identity_tuple(env: TelemetryEnvelope) -> Tuple[Any, ...]:
+    m = env.meta
+    return (
+        str(m.get("session_id", "unknown")),
+        int(m.get("global_rank", m.get("rank", 0))),
+        int(m.get("local_rank", 0)),
+        int(m.get("world_size", 1)),
+        int(m.get("local_world_size", 1)),
+        int(m.get("node_rank", 0)),
+        str(m.get("hostname", "")),
+        int(m.get("pid", 0)),
+    )
+
+
+def dumps(obj: Any) -> str:
+    try:
+        return json.dumps(obj)
+    except (TypeError, ValueError):
+        return json.dumps(str(obj))
+
+
+def fnum(row: Dict[str, Any], key: str):
+    v = row.get(key)
+    if v is None:
+        return None
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return None
+
+
+def inum(row: Dict[str, Any], key: str):
+    v = row.get(key)
+    if v is None:
+        return None
+    try:
+        return int(v)
+    except (TypeError, ValueError):
+        return None

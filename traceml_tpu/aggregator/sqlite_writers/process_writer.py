@@ -1,0 +1,107 @@
+"""process projection → ``process_samples`` + ``process_device_samples``
+(reference: aggregator/sqlite_writers/process.py)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from traceml_tpu.aggregator.sqlite_writers.common import (
+    IDENTITY_SCHEMA,
+    fnum,
+    identity_tuple,
+    inum,
+)
+from traceml_tpu.telemetry.envelope import TelemetryEnvelope
+
+TABLE = "process_samples"
+TABLE_DEVICE = "process_device_samples"
+RETENTION_TABLES = (TABLE, TABLE_DEVICE)
+
+
+def accepts_sampler(name: str) -> bool:
+    return name == "process"
+
+
+def init_schema(conn) -> None:
+    conn.execute(
+        f"""CREATE TABLE IF NOT EXISTS {TABLE} (
+            id INTEGER PRIMARY KEY AUTOINCREMENT,
+            {IDENTITY_SCHEMA},
+            timestamp REAL,
+            cpu_pct REAL,
+            rss_bytes INTEGER,
+            vms_bytes INTEGER,
+            num_threads INTEGER
+        )"""
+    )
+    conn.execute(
+        f"""CREATE TABLE IF NOT EXISTS {TABLE_DEVICE} (
+            id INTEGER PRIMARY KEY AUTOINCREMENT,
+            {IDENTITY_SCHEMA},
+            timestamp REAL,
+            device_id INTEGER,
+            device_kind TEXT,
+            memory_used_bytes INTEGER,
+            memory_peak_bytes INTEGER,
+            memory_total_bytes INTEGER
+        )"""
+    )
+    conn.execute(
+        f"CREATE INDEX IF NOT EXISTS idx_{TABLE}_rank "
+        f"ON {TABLE} (session_id, global_rank, timestamp)"
+    )
+    conn.execute(
+        f"CREATE INDEX IF NOT EXISTS idx_{TABLE_DEVICE}_rank "
+        f"ON {TABLE_DEVICE} (session_id, global_rank, device_id, timestamp)"
+    )
+
+
+def insert_sql(table: str) -> str:
+    if table == TABLE:
+        return (
+            f"INSERT INTO {TABLE} (session_id, global_rank, local_rank,"
+            " world_size, local_world_size, node_rank, hostname, pid, timestamp,"
+            " cpu_pct, rss_bytes, vms_bytes, num_threads)"
+            " VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)"
+        )
+    return (
+        f"INSERT INTO {TABLE_DEVICE} (session_id, global_rank, local_rank,"
+        " world_size, local_world_size, node_rank, hostname, pid, timestamp,"
+        " device_id, device_kind, memory_used_bytes, memory_peak_bytes,"
+        " memory_total_bytes) VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?)"
+    )
+
+
+def build_rows(env: TelemetryEnvelope) -> Dict[str, List[Tuple]]:
+    ident = identity_tuple(env)
+    out: Dict[str, List[Tuple]] = {}
+    rows = []
+    for row in env.tables.get("process", []):
+        rows.append(
+            ident
+            + (
+                fnum(row, "timestamp"),
+                fnum(row, "cpu_pct"),
+                inum(row, "rss_bytes"),
+                inum(row, "vms_bytes"),
+                inum(row, "num_threads"),
+            )
+        )
+    if rows:
+        out[TABLE] = rows
+    dev = []
+    for row in env.tables.get("process_device", []):
+        dev.append(
+            ident
+            + (
+                fnum(row, "timestamp"),
+                inum(row, "device_id"),
+                str(row.get("device_kind", "unknown")),
+                inum(row, "memory_used_bytes"),
+                inum(row, "memory_peak_bytes"),
+                inum(row, "memory_total_bytes"),
+            )
+        )
+    if dev:
+        out[TABLE_DEVICE] = dev
+    return out

@@ -1,0 +1,55 @@
+"""stdout/stderr projection → ``stdout_samples``
+(reference: aggregator/sqlite_writers/stdout_stderr.py)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from traceml_tpu.aggregator.sqlite_writers.common import (
+    IDENTITY_SCHEMA,
+    fnum,
+    identity_tuple,
+)
+from traceml_tpu.telemetry.envelope import TelemetryEnvelope
+
+TABLE = "stdout_samples"
+RETENTION_TABLES = (TABLE,)
+
+
+def accepts_sampler(name: str) -> bool:
+    return name == "stdout_stderr"
+
+
+def init_schema(conn) -> None:
+    conn.execute(
+        f"""CREATE TABLE IF NOT EXISTS {TABLE} (
+            id INTEGER PRIMARY KEY AUTOINCREMENT,
+            {IDENTITY_SCHEMA},
+            timestamp REAL,
+            stream TEXT,
+            line TEXT
+        )"""
+    )
+
+
+def insert_sql(table: str) -> str:
+    return (
+        f"INSERT INTO {TABLE} (session_id, global_rank, local_rank, world_size,"
+        " local_world_size, node_rank, hostname, pid, timestamp, stream, line)"
+        " VALUES (?,?,?,?,?,?,?,?,?,?,?)"
+    )
+
+
+def build_rows(env: TelemetryEnvelope) -> Dict[str, List[Tuple]]:
+    ident = identity_tuple(env)
+    out = []
+    for row in env.tables.get("stdout_stderr", []):
+        out.append(
+            ident
+            + (
+                fnum(row, "timestamp"),
+                str(row.get("stream", "stdout")),
+                str(row.get("line", ""))[:4096],
+            )
+        )
+    return {TABLE: out} if out else {}

@@ -1,0 +1,73 @@
+"""step_memory projection → ``step_memory_samples``
+(reference: aggregator/sqlite_writers/step_memory.py)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from traceml_tpu.aggregator.sqlite_writers.common import (
+    IDENTITY_SCHEMA,
+    fnum,
+    identity_tuple,
+    inum,
+)
+from traceml_tpu.telemetry.envelope import TelemetryEnvelope
+
+TABLE = "step_memory_samples"
+RETENTION_TABLES = (TABLE,)
+
+
+def accepts_sampler(name: str) -> bool:
+    return name == "step_memory"
+
+
+def init_schema(conn) -> None:
+    conn.execute(
+        f"""CREATE TABLE IF NOT EXISTS {TABLE} (
+            id INTEGER PRIMARY KEY AUTOINCREMENT,
+            {IDENTITY_SCHEMA},
+            step INTEGER,
+            timestamp REAL,
+            device_id INTEGER,
+            device_kind TEXT,
+            current_bytes INTEGER,
+            peak_bytes INTEGER,
+            step_peak_bytes INTEGER,
+            limit_bytes INTEGER,
+            backend TEXT
+        )"""
+    )
+    conn.execute(
+        f"CREATE INDEX IF NOT EXISTS idx_{TABLE}_rank_step "
+        f"ON {TABLE} (session_id, global_rank, step)"
+    )
+
+
+def insert_sql(table: str) -> str:
+    return (
+        f"INSERT INTO {TABLE} (session_id, global_rank, local_rank, world_size,"
+        " local_world_size, node_rank, hostname, pid, step, timestamp, device_id,"
+        " device_kind, current_bytes, peak_bytes, step_peak_bytes, limit_bytes,"
+        " backend) VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)"
+    )
+
+
+def build_rows(env: TelemetryEnvelope) -> Dict[str, List[Tuple]]:
+    ident = identity_tuple(env)
+    out = []
+    for row in env.tables.get("step_memory", []):
+        out.append(
+            ident
+            + (
+                inum(row, "step"),
+                fnum(row, "timestamp"),
+                inum(row, "device_id"),
+                str(row.get("device_kind", "unknown")),
+                inum(row, "current_bytes"),
+                inum(row, "peak_bytes"),
+                inum(row, "step_peak_bytes"),
+                inum(row, "limit_bytes"),
+                str(row.get("backend", "unknown")),
+            )
+        )
+    return {TABLE: out} if out else {}

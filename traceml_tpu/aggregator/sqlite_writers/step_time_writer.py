@@ -1,0 +1,70 @@
+"""step_time projection → ``step_time_samples``
+(reference: aggregator/sqlite_writers/step_time.py:131-419).
+
+One row per (rank, step): stable identity columns + ``events_json``
+payload (the per-phase {cpu_ms, device_ms, count} dict from the
+step-time sampler) + the selected clock.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from traceml_tpu.aggregator.sqlite_writers.common import (
+    IDENTITY_SCHEMA,
+    dumps,
+    fnum,
+    identity_tuple,
+    inum,
+)
+from traceml_tpu.telemetry.envelope import TelemetryEnvelope
+
+TABLE = "step_time_samples"
+RETENTION_TABLES = (TABLE,)
+
+
+def accepts_sampler(name: str) -> bool:
+    return name == "step_time"
+
+
+def init_schema(conn) -> None:
+    conn.execute(
+        f"""CREATE TABLE IF NOT EXISTS {TABLE} (
+            id INTEGER PRIMARY KEY AUTOINCREMENT,
+            {IDENTITY_SCHEMA},
+            step INTEGER,
+            timestamp REAL,
+            clock TEXT,
+            late_markers INTEGER,
+            events_json TEXT
+        )"""
+    )
+    conn.execute(
+        f"CREATE INDEX IF NOT EXISTS idx_{TABLE}_rank_step "
+        f"ON {TABLE} (session_id, global_rank, step)"
+    )
+
+
+def insert_sql(table: str) -> str:
+    return (
+        f"INSERT INTO {TABLE} (session_id, global_rank, local_rank, world_size,"
+        " local_world_size, node_rank, hostname, pid, step, timestamp, clock,"
+        " late_markers, events_json) VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)"
+    )
+
+
+def build_rows(env: TelemetryEnvelope) -> Dict[str, List[Tuple]]:
+    ident = identity_tuple(env)
+    out = []
+    for row in env.tables.get("step_time", []):
+        out.append(
+            ident
+            + (
+                inum(row, "step"),
+                fnum(row, "timestamp"),
+                str(row.get("clock", "host")),
+                inum(row, "late_markers") or 0,
+                dumps(row.get("events", {})),
+            )
+        )
+    return {TABLE: out} if out else {}

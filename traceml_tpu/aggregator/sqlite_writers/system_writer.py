@@ -1,0 +1,120 @@
+"""system projection → ``system_samples`` + ``system_device_samples``
+(reference: aggregator/sqlite_writers/system.py)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from traceml_tpu.aggregator.sqlite_writers.common import (
+    IDENTITY_SCHEMA,
+    fnum,
+    identity_tuple,
+    inum,
+)
+from traceml_tpu.telemetry.envelope import TelemetryEnvelope
+
+TABLE_HOST = "system_samples"
+TABLE_DEVICE = "system_device_samples"
+RETENTION_TABLES = (TABLE_HOST, TABLE_DEVICE)
+
+
+def accepts_sampler(name: str) -> bool:
+    return name == "system"
+
+
+def init_schema(conn) -> None:
+    conn.execute(
+        f"""CREATE TABLE IF NOT EXISTS {TABLE_HOST} (
+            id INTEGER PRIMARY KEY AUTOINCREMENT,
+            {IDENTITY_SCHEMA},
+            timestamp REAL,
+            cpu_pct REAL,
+            memory_used_bytes INTEGER,
+            memory_total_bytes INTEGER,
+            memory_pct REAL,
+            load_1m REAL,
+            load_5m REAL,
+            load_15m REAL
+        )"""
+    )
+    conn.execute(
+        f"""CREATE TABLE IF NOT EXISTS {TABLE_DEVICE} (
+            id INTEGER PRIMARY KEY AUTOINCREMENT,
+            {IDENTITY_SCHEMA},
+            timestamp REAL,
+            device_id INTEGER,
+            device_kind TEXT,
+            memory_used_bytes INTEGER,
+            memory_peak_bytes INTEGER,
+            memory_total_bytes INTEGER,
+            utilization_pct REAL,
+            temperature_c REAL,
+            power_w REAL
+        )"""
+    )
+    conn.execute(
+        f"CREATE INDEX IF NOT EXISTS idx_{TABLE_HOST}_rank "
+        f"ON {TABLE_HOST} (session_id, node_rank, timestamp)"
+    )
+    conn.execute(
+        f"CREATE INDEX IF NOT EXISTS idx_{TABLE_DEVICE}_rank "
+        f"ON {TABLE_DEVICE} (session_id, node_rank, device_id, timestamp)"
+    )
+
+
+def insert_sql(table: str) -> str:
+    if table == TABLE_HOST:
+        return (
+            f"INSERT INTO {TABLE_HOST} (session_id, global_rank, local_rank,"
+            " world_size, local_world_size, node_rank, hostname, pid, timestamp,"
+            " cpu_pct, memory_used_bytes, memory_total_bytes, memory_pct,"
+            " load_1m, load_5m, load_15m) VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)"
+        )
+    return (
+        f"INSERT INTO {TABLE_DEVICE} (session_id, global_rank, local_rank,"
+        " world_size, local_world_size, node_rank, hostname, pid, timestamp,"
+        " device_id, device_kind, memory_used_bytes, memory_peak_bytes,"
+        " memory_total_bytes, utilization_pct, temperature_c, power_w)"
+        " VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)"
+    )
+
+
+def build_rows(env: TelemetryEnvelope) -> Dict[str, List[Tuple]]:
+    ident = identity_tuple(env)
+    out: Dict[str, List[Tuple]] = {}
+    host = []
+    for row in env.tables.get("system", []):
+        host.append(
+            ident
+            + (
+                fnum(row, "timestamp"),
+                fnum(row, "cpu_pct"),
+                inum(row, "memory_used_bytes"),
+                inum(row, "memory_total_bytes"),
+                fnum(row, "memory_pct"),
+                fnum(row, "load_1m"),
+                fnum(row, "load_5m"),
+                fnum(row, "load_15m"),
+            )
+        )
+    if host:
+        out[TABLE_HOST] = host
+    dev = []
+    for row in env.tables.get("system_device", []):
+        dev.append(
+            ident
+            + (
+                fnum(row, "timestamp"),
+                inum(row, "device_id"),
+                str(row.get("device_kind", "unknown")),
+                inum(row, "memory_used_bytes"),
+                inum(row, "memory_peak_bytes"),
+                inum(row, "memory_total_bytes"),
+                fnum(row, "utilization_pct"),
+                fnum(row, "temperature_c"),
+                fnum(row, "power_w"),
+            )
+        )
+    if dev:
+        out[TABLE_DEVICE] = dev
+    return out

@@ -1,0 +1,222 @@
+"""The aggregator
+(reference: src/traceml_ai/aggregator/trace_aggregator.py:89-586).
+
+Owns the TCP ingest server, the SQLite writer, the final-summary
+service, and a display driver.  Event-driven loop: block on
+``wait_for_data`` (bounded by the render interval), split telemetry from
+control messages, ingest, rate-limited UI tick + summary poll.
+
+Shutdown (``stop()``): settle late telemetry until every expected rank
+sent ``rank_finished`` or the deadline passes (writing a
+``finalization_warning.json`` naming missing ranks), budgeted SQLite
+finalize, then generate the final summary and write artifacts.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Set
+
+from traceml_tpu.aggregator.display_drivers import resolve_display_driver
+from traceml_tpu.aggregator.sqlite_writer import SQLiteWriter
+from traceml_tpu.aggregator.summary_service import FinalSummaryService
+from traceml_tpu.runtime.settings import TraceMLSettings
+from traceml_tpu.sdk import protocol
+from traceml_tpu.telemetry.control import RANK_FINISHED, control_kind, is_control_message
+from traceml_tpu.telemetry.envelope import TelemetryEnvelope, normalize_telemetry_envelope
+from traceml_tpu.transport.tcp_transport import TCPServer
+from traceml_tpu.utils.atomic_io import atomic_write_json
+from traceml_tpu.utils.error_log import get_error_log
+
+_RENDER_INTERVAL = 0.5
+_SETTLE_POLL = 0.1
+
+
+class TraceMLAggregator:
+    def __init__(self, settings: TraceMLSettings) -> None:
+        self.settings = settings
+        self.server = TCPServer(
+            host=settings.aggregator.bind_host, port=settings.aggregator.port
+        )
+        self.db_path = settings.session_dir / "telemetry.sqlite"
+        self.writer = SQLiteWriter(
+            self.db_path, summary_window_rows=settings.summary_window_rows
+        )
+        self.display = resolve_display_driver(settings.mode)
+        self.summary_service = FinalSummaryService(
+            settings,
+            generate=self.generate_final_summary,
+            settle=self.settle_telemetry,
+        )
+        self._thread: Optional[threading.Thread] = None
+        self._stop_evt = threading.Event()
+        self._finished_ranks: Set[int] = set()
+        self._seen_ranks: Set[int] = set()
+        self._drain_lock = threading.Lock()
+        self._last_ui_tick = 0.0
+        self.envelopes_ingested = 0
+        self.started = False
+        self.port: Optional[int] = None
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> None:
+        if self.started:
+            return
+        self.started = True
+        get_error_log().set_path(self.settings.session_dir / "aggregator_error.log")
+        self.settings.session_dir.mkdir(parents=True, exist_ok=True)
+        self.server.start()
+        self.port = self.server.port
+        self.writer.start()
+        try:
+            self.display.start(self)
+        except Exception as exc:
+            get_error_log().warning("display start failed", exc)
+        self._stop_evt.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="traceml-aggregator", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self, finalize_timeout: Optional[float] = None) -> None:
+        if not self.started:
+            return
+        self.started = False
+        budget = (
+            finalize_timeout
+            if finalize_timeout is not None
+            else self.settings.finalize_timeout_sec
+        )
+        deadline = time.monotonic() + max(1.0, budget)
+        try:
+            self._settle_end_of_run(deadline)
+        except Exception as exc:
+            get_error_log().warning("end-of-run settle failed", exc)
+        self._stop_evt.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        self.server.stop()
+        try:
+            self.display.stop()
+        except Exception as exc:
+            get_error_log().warning("display stop failed", exc)
+        ok = self.writer.finalize(timeout=max(5.0, deadline - time.monotonic()))
+        if not ok:
+            get_error_log().warning("sqlite finalize incomplete within budget")
+        try:
+            if not self.generate_final_summary():
+                atomic_write_json(
+                    self.settings.session_dir / "finalization_error.json",
+                    {"error": "final summary generation failed", "ts": time.time()},
+                )
+        except Exception as exc:
+            get_error_log().error("final summary at shutdown failed", exc)
+            atomic_write_json(
+                self.settings.session_dir / "finalization_error.json",
+                {"error": str(exc), "ts": time.time()},
+            )
+
+    # -- ingest ----------------------------------------------------------
+    def _drain_once(self) -> int:
+        with self._drain_lock:
+            payloads = self.server.drain()
+            n = 0
+            for p in payloads:
+                if is_control_message(p):
+                    self._handle_control(p)
+                    continue
+                env = normalize_telemetry_envelope(p)
+                if env is None:
+                    continue
+                self._seen_ranks.add(env.global_rank)
+                self.writer.ingest(env)
+                n += 1
+            self.envelopes_ingested += n
+            return n
+
+    def _handle_control(self, payload: Dict[str, Any]) -> None:
+        kind = control_kind(payload)
+        if kind == RANK_FINISHED:
+            meta = payload.get("meta") or {}
+            try:
+                rank = int(meta.get("global_rank", meta.get("rank", 0)))
+            except (TypeError, ValueError):
+                rank = 0
+            self._finished_ranks.add(rank)
+
+    # -- loop ------------------------------------------------------------
+    def _loop(self) -> None:
+        while not self._stop_evt.is_set():
+            try:
+                self.server.wait_for_data(_RENDER_INTERVAL)
+                self._drain_once()
+                now = time.monotonic()
+                if now - self._last_ui_tick >= _RENDER_INTERVAL:
+                    self._last_ui_tick = now
+                    self.summary_service.poll()
+                    try:
+                        self.display.tick(self)
+                    except Exception as exc:
+                        get_error_log().warning("display tick failed", exc)
+            except Exception as exc:  # keep the loop alive
+                get_error_log().warning("aggregator loop error", exc)
+                time.sleep(0.1)
+
+    # -- settle / finalize ------------------------------------------------
+    def expected_world_size(self) -> int:
+        if self.settings.expected_world_size:
+            return self.settings.expected_world_size
+        return max(len(self._seen_ranks), 1)
+
+    def settle_telemetry(self, timeout: float = 5.0) -> None:
+        """Drain whatever is in flight and wait for it to be committed
+        (reference: trace_aggregator.py:518)."""
+        deadline = time.monotonic() + timeout
+        self._drain_once()
+        self.writer.force_flush(timeout=max(0.5, deadline - time.monotonic()))
+
+    def _settle_end_of_run(self, deadline: float) -> None:
+        """Wait for all expected rank_finished markers or the deadline
+        (reference: trace_aggregator.py:440-499)."""
+        expected = self.expected_world_size()
+        while time.monotonic() < deadline:
+            self._drain_once()
+            if len(self._finished_ranks) >= expected:
+                break
+            time.sleep(_SETTLE_POLL)
+        self._drain_once()
+        self.writer.force_flush(timeout=max(1.0, deadline - time.monotonic()))
+        missing = sorted(
+            set(range(expected)) - self._finished_ranks
+        )
+        if missing:
+            atomic_write_json(
+                self.settings.session_dir / "finalization_warning.json",
+                {
+                    "missing_ranks": missing,
+                    "finished_ranks": sorted(self._finished_ranks),
+                    "expected_world_size": expected,
+                    "ts": time.time(),
+                },
+            )
+
+    # -- summary ----------------------------------------------------------
+    def generate_final_summary(self) -> bool:
+        """Build final_summary artifacts from the SQLite DB."""
+        from traceml_tpu.reporting.final import generate_summary
+
+        return generate_summary(
+            db_path=self.db_path,
+            session_dir=self.settings.session_dir,
+            settings=self.settings,
+        )
+
+
+def write_ready_file(settings: TraceMLSettings, port: int) -> None:
+    """The launcher polls this to learn the bound port."""
+    atomic_write_json(
+        settings.session_dir / "aggregator_ready.json",
+        {"port": port, "pid": __import__("os").getpid(), "ts": time.time()},
+    )

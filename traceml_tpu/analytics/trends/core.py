@@ -1,0 +1,63 @@
+"""Generic band-based trend analysis
+(reference: src/traceml_ai/analytics/trends/core.py:50-146).
+
+Splits a series into baseline / mid / recent thirds and compares band
+means — robust to noise, cheap, explainable.  Used by the memory-creep
+rules and the compare verdicts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+
+@dataclasses.dataclass
+class TrendEvidence:
+    n: int
+    baseline_mean: float
+    mid_mean: float
+    recent_mean: float
+    delta: float  # recent − baseline
+    growth_pct: float  # delta / max(baseline, eps)
+    slope_per_100: float  # least-squares slope × 100 samples
+    monotonic_band_growth: bool  # baseline ≤ mid ≤ recent
+    weak_recovery: bool  # recent dipped below mid (recovering)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _mean(xs: Sequence[float]) -> float:
+    return sum(xs) / len(xs) if xs else 0.0
+
+
+def compute_trend_evidence(series: Sequence[float]) -> Optional[TrendEvidence]:
+    xs: List[float] = [float(v) for v in series if v is not None]
+    n = len(xs)
+    if n < 9:  # need ≥3 per band
+        return None
+    third = n // 3
+    baseline = xs[:third]
+    mid = xs[third : 2 * third]
+    recent = xs[2 * third :]
+    b, m, r = _mean(baseline), _mean(mid), _mean(recent)
+    delta = r - b
+    growth = delta / b if b > 0 else (0.0 if delta == 0 else float("inf"))
+    # least-squares slope per sample, scaled to per-100-samples
+    mean_i = (n - 1) / 2.0
+    mean_x = _mean(xs)
+    num = sum((i - mean_i) * (x - mean_x) for i, x in enumerate(xs))
+    den = sum((i - mean_i) ** 2 for i in range(n))
+    slope = (num / den if den else 0.0) * 100.0
+    return TrendEvidence(
+        n=n,
+        baseline_mean=b,
+        mid_mean=m,
+        recent_mean=r,
+        delta=delta,
+        growth_pct=growth,
+        slope_per_100=slope,
+        monotonic_band_growth=(b <= m <= r),
+        weak_recovery=(r < m),
+    )

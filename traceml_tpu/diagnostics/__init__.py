@@ -1,0 +1,13 @@
+"""Rule-based diagnosis engine (reference: src/traceml_ai/diagnostics/).
+
+See DIAGNOSIS.md in this package for the taxonomy and formulas.
+"""
+
+from traceml_tpu.diagnostics.common import (  # noqa: F401
+    DiagnosticIssue,
+    DiagnosticResult,
+    SEVERITY_CRITICAL,
+    SEVERITY_INFO,
+    SEVERITY_WARNING,
+    sort_issues,
+)

@@ -1,0 +1,107 @@
+"""Shared diagnostic contracts
+(reference: src/traceml_ai/diagnostics/common.py:24-215).
+
+``DiagnosticResult.issues`` is always non-empty — when nothing fires,
+the domain emits a HEALTHY info issue — and ``diagnosis`` is the
+top-ranked issue after :func:`sort_issues` (severity → score →
+breadth).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Protocol, Sequence
+
+SEVERITY_INFO = "info"
+SEVERITY_WARNING = "warning"
+SEVERITY_CRITICAL = "critical"
+
+_SEVERITY_ORDER = {SEVERITY_CRITICAL: 2, SEVERITY_WARNING: 1, SEVERITY_INFO: 0}
+
+STATUS_OK = "ok"
+STATUS_ISSUE = "issue"
+
+
+@dataclasses.dataclass
+class DiagnosticIssue:
+    kind: str  # e.g. "INPUT_BOUND", "COMPUTE_STRAGGLER"
+    severity: str = SEVERITY_INFO
+    status: str = STATUS_ISSUE
+    summary: str = ""
+    action: str = ""
+    metric: Optional[str] = None  # canonical metric name
+    phase: Optional[str] = None  # phase key (input/h2d/.../residual)
+    score: float = 0.0  # rule-specific magnitude (higher = worse)
+    share_pct: Optional[float] = None  # phase share of step (0..1)
+    skew_pct: Optional[float] = None  # cross-rank skew (0..1+)
+    ranks: List[int] = dataclasses.field(default_factory=list)
+    evidence: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        return d
+
+
+def healthy_issue(domain: str, summary: str = "") -> DiagnosticIssue:
+    return DiagnosticIssue(
+        kind="HEALTHY",
+        severity=SEVERITY_INFO,
+        status=STATUS_OK,
+        summary=summary or f"No {domain} issues detected in the analyzed window.",
+    )
+
+
+def sort_issues(issues: Sequence[DiagnosticIssue]) -> List[DiagnosticIssue]:
+    """severity desc → score desc → breadth (#ranks) desc → kind asc."""
+    return sorted(
+        issues,
+        key=lambda i: (
+            -_SEVERITY_ORDER.get(i.severity, 0),
+            -(i.score or 0.0),
+            -len(i.ranks),
+            i.kind,
+        ),
+    )
+
+
+@dataclasses.dataclass
+class DiagnosticResult:
+    domain: str
+    issues: List[DiagnosticIssue]
+
+    def __post_init__(self) -> None:
+        if not self.issues:
+            self.issues = [healthy_issue(self.domain)]
+        self.issues = sort_issues(self.issues)
+
+    @property
+    def diagnosis(self) -> DiagnosticIssue:
+        return self.issues[0]
+
+    @property
+    def healthy(self) -> bool:
+        return self.diagnosis.status == STATUS_OK
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "domain": self.domain,
+            "diagnosis": self.diagnosis.to_dict(),
+            "issues": [i.to_dict() for i in self.issues],
+        }
+
+
+class DiagnosticRule(Protocol):
+    """A rule inspects a domain context and yields issues (possibly none)."""
+
+    def evaluate(self, ctx: Any) -> List[DiagnosticIssue]: ...
+
+
+def run_rules(domain: str, rules: Sequence[DiagnosticRule], ctx: Any) -> DiagnosticResult:
+    issues: List[DiagnosticIssue] = []
+    for rule in rules:
+        try:
+            issues.extend(rule.evaluate(ctx) or [])
+        except Exception:
+            # a broken rule must never take down the report
+            continue
+    return DiagnosticResult(domain=domain, issues=issues)

@@ -1,0 +1,27 @@
+"""Step-memory thresholds
+(reference: src/traceml_ai/diagnostics/step_memory/policy.py:13-93)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+MiB = 1024 * 1024
+GiB = 1024 * MiB
+
+
+@dataclasses.dataclass(frozen=True)
+class StepMemoryPolicy:
+    pressure_warn: float = 0.92  # used / capacity
+    pressure_critical: float = 0.97
+    imbalance_warn: float = 0.20  # cross-rank skew
+    imbalance_critical: float = 0.30
+    imbalance_pressure_gate: float = 0.5  # only interesting when ≥50% full
+    # creep heuristics (reference: trend.py:31-57, policy.py:27)
+    creep_min_steps: int = 800
+    creep_min_delta_bytes: int = 512 * MiB
+    creep_min_growth_pct: float = 0.06
+    creep_min_slope_per_100: float = 0.00015  # fraction of capacity
+    creep_confirmed_delta_bytes: int = 1 * GiB
+
+
+DEFAULT_POLICY = StepMemoryPolicy()

@@ -1,0 +1,210 @@
+"""Step-memory rules
+(reference: src/traceml_ai/diagnostics/step_memory/rules.py:60-196,
+trend.py:31-376).
+
+Context shape: per-rank per-device step series of
+``{step, current_bytes, step_peak_bytes, limit_bytes}``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import statistics
+from typing import Any, Dict, List, Mapping, Optional, Sequence
+
+from traceml_tpu.analytics.trends.core import compute_trend_evidence
+from traceml_tpu.diagnostics.common import (
+    SEVERITY_CRITICAL,
+    SEVERITY_WARNING,
+    DiagnosticIssue,
+)
+from traceml_tpu.diagnostics.step_memory.policy import DEFAULT_POLICY, StepMemoryPolicy
+from traceml_tpu.utils.formatting import fmt_bytes
+
+
+@dataclasses.dataclass
+class MemoryContext:
+    # (rank, device_id) → ordered step rows
+    series: Dict[tuple, List[Dict[str, Any]]]
+    policy: StepMemoryPolicy = DEFAULT_POLICY
+
+    @property
+    def ranks(self) -> List[int]:
+        return sorted({r for r, _ in self.series})
+
+
+def build_memory_context(
+    rank_rows: Mapping[int, Sequence[Mapping[str, Any]]],
+    policy: StepMemoryPolicy = DEFAULT_POLICY,
+) -> MemoryContext:
+    series: Dict[tuple, List[Dict[str, Any]]] = {}
+    for rank, rows in rank_rows.items():
+        for row in rows:
+            key = (int(rank), int(row.get("device_id", 0)))
+            series.setdefault(key, []).append(dict(row))
+    for rows in series.values():
+        rows.sort(key=lambda r: (r.get("step") or 0))
+    return MemoryContext(series=series, policy=policy)
+
+
+def _latest_pressure(rows: List[Dict[str, Any]]) -> Optional[float]:
+    for row in reversed(rows):
+        used = row.get("step_peak_bytes") or row.get("current_bytes")
+        limit = row.get("limit_bytes")
+        if used and limit:
+            return float(used) / float(limit)
+    return None
+
+
+class HighPressureRule:
+    def evaluate(self, ctx: MemoryContext) -> List[DiagnosticIssue]:
+        issues = []
+        p = ctx.policy
+        for (rank, dev), rows in ctx.series.items():
+            pressure = _latest_pressure(rows)
+            if pressure is None or pressure < p.pressure_warn:
+                continue
+            severity = (
+                SEVERITY_CRITICAL
+                if pressure >= p.pressure_critical
+                else SEVERITY_WARNING
+            )
+            last = rows[-1]
+            issues.append(
+                DiagnosticIssue(
+                    kind="HIGH_MEMORY_PRESSURE",
+                    severity=severity,
+                    summary=(
+                        f"Rank {rank} device {dev} at {pressure * 100:.0f}% of "
+                        f"HBM capacity "
+                        f"({fmt_bytes(last.get('step_peak_bytes') or last.get('current_bytes'))}"
+                        f" / {fmt_bytes(last.get('limit_bytes'))})."
+                    ),
+                    action=(
+                        "Reduce per-chip footprint: smaller microbatch, "
+                        "jax.checkpoint/remat, optimizer-state sharding "
+                        "(ZeRO-style), bf16 activations, or shard the model "
+                        "further."
+                    ),
+                    metric="memory_pressure",
+                    score=pressure,
+                    share_pct=pressure,
+                    ranks=[rank],
+                    evidence={"device_id": dev},
+                )
+            )
+        return issues
+
+
+class ImbalanceRule:
+    def evaluate(self, ctx: MemoryContext) -> List[DiagnosticIssue]:
+        p = ctx.policy
+        # latest used bytes per rank (max over that rank's devices)
+        per_rank: Dict[int, float] = {}
+        per_rank_pressure: Dict[int, float] = {}
+        for (rank, _dev), rows in ctx.series.items():
+            if not rows:
+                continue
+            last = rows[-1]
+            used = last.get("step_peak_bytes") or last.get("current_bytes") or 0
+            per_rank[rank] = max(per_rank.get(rank, 0.0), float(used))
+            pres = _latest_pressure(rows)
+            if pres is not None:
+                per_rank_pressure[rank] = max(
+                    per_rank_pressure.get(rank, 0.0), pres
+                )
+        if len(per_rank) < 2:
+            return []
+        med = statistics.median(per_rank.values())
+        if med <= 0:
+            return []
+        worst_rank = max(per_rank, key=lambda r: per_rank[r])
+        skew = (per_rank[worst_rank] - med) / med
+        if skew < p.imbalance_warn:
+            return []
+        # only interesting when somebody is actually under pressure
+        if max(per_rank_pressure.values(), default=0.0) < p.imbalance_pressure_gate:
+            return []
+        severity = (
+            SEVERITY_CRITICAL if skew >= p.imbalance_critical else SEVERITY_WARNING
+        )
+        return [
+            DiagnosticIssue(
+                kind="MEMORY_IMBALANCE",
+                severity=severity,
+                summary=(
+                    f"Rank {worst_rank} holds {skew * 100:.0f}% more device "
+                    f"memory than the median rank "
+                    f"({fmt_bytes(per_rank[worst_rank])} vs {fmt_bytes(med)})."
+                ),
+                action=(
+                    "Check sharding balance: uneven parameter/optimizer "
+                    "partitions, rank-0-only buffers (eval/logging replicas), "
+                    "or padding asymmetries."
+                ),
+                metric="memory_skew",
+                score=skew,
+                skew_pct=skew,
+                ranks=[worst_rank],
+                evidence={"per_rank_bytes": {str(r): v for r, v in per_rank.items()}},
+            )
+        ]
+
+
+class CreepRule:
+    """CREEP_EARLY / CREEP_CONFIRMED
+    (reference heuristics: ≥800 steps, ≥512 MiB delta, ≥6% growth, slope
+    gate, weak-recovery check; confirmed at ≥1 GiB)."""
+
+    def evaluate(self, ctx: MemoryContext) -> List[DiagnosticIssue]:
+        p = ctx.policy
+        issues = []
+        for (rank, dev), rows in ctx.series.items():
+            if len(rows) < p.creep_min_steps:
+                continue
+            series = [float(r.get("current_bytes") or 0) for r in rows]
+            ev = compute_trend_evidence(series)
+            if ev is None:
+                continue
+            limit = next(
+                (r.get("limit_bytes") for r in reversed(rows) if r.get("limit_bytes")),
+                None,
+            )
+            slope_frac = (
+                (ev.slope_per_100 / float(limit)) if limit else
+                (ev.slope_per_100 / ev.baseline_mean if ev.baseline_mean else 0.0)
+            )
+            if (
+                ev.delta < p.creep_min_delta_bytes
+                or ev.growth_pct < p.creep_min_growth_pct
+                or slope_frac < p.creep_min_slope_per_100
+                or ev.weak_recovery
+            ):
+                continue
+            confirmed = ev.delta >= p.creep_confirmed_delta_bytes and ev.monotonic_band_growth
+            issues.append(
+                DiagnosticIssue(
+                    kind="MEMORY_CREEP_CONFIRMED" if confirmed else "MEMORY_CREEP_EARLY",
+                    severity=SEVERITY_CRITICAL if confirmed else SEVERITY_WARNING,
+                    summary=(
+                        f"Rank {rank} device {dev} memory grew "
+                        f"{fmt_bytes(ev.delta)} (+{ev.growth_pct * 100:.1f}%) "
+                        f"over {ev.n} steps"
+                        + (" — sustained, likely a leak." if confirmed else ".")
+                    ),
+                    action=(
+                        "Hunt Python-side references to device arrays "
+                        "(growing metric lists, retained batches), "
+                        "check for per-step recompiles creating executables, "
+                        "and confirm donated buffers are actually donated."
+                    ),
+                    metric="memory_creep",
+                    score=ev.growth_pct,
+                    ranks=[rank],
+                    evidence={"device_id": dev, "trend": ev.to_dict()},
+                )
+            )
+        return issues
+
+
+DEFAULT_RULES = (HighPressureRule(), ImbalanceRule(), CreepRule())

@@ -1,0 +1,50 @@
+"""Step-time diagnosis entrypoint
+(reference: src/traceml_ai/diagnostics/step_time/api.py +
+utils/step_time_window.py diagnose_step_time_window:510)."""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, Optional, Sequence
+
+from traceml_tpu.diagnostics.common import (
+    DiagnosticIssue,
+    DiagnosticResult,
+    SEVERITY_INFO,
+    run_rules,
+)
+from traceml_tpu.diagnostics.step_time.policy import policy_for
+from traceml_tpu.diagnostics.step_time.rules import DEFAULT_RULES, build_context
+from traceml_tpu.utils.step_time_window import StepTimeWindow, build_step_time_window
+
+DOMAIN = "step_time"
+
+
+def diagnose_window(window: Optional[StepTimeWindow], mode: str = "summary") -> DiagnosticResult:
+    policy = policy_for(mode)
+    if window is None or window.n_steps < policy.min_steps:
+        return DiagnosticResult(
+            domain=DOMAIN,
+            issues=[
+                DiagnosticIssue(
+                    kind="INSUFFICIENT_STEP_TIME_DATA",
+                    severity=SEVERITY_INFO,
+                    status="ok",
+                    summary=(
+                        "Not enough aligned steps for a reliable step-time "
+                        f"diagnosis (have {0 if window is None else window.n_steps}, "
+                        f"need {policy.min_steps})."
+                    ),
+                )
+            ],
+        )
+    ctx = build_context(window, policy)
+    return run_rules(DOMAIN, DEFAULT_RULES, ctx)
+
+
+def diagnose_rank_rows(
+    rank_rows: Mapping[int, Sequence[Mapping[str, Any]]],
+    mode: str = "summary",
+    max_steps: int = 200,
+) -> DiagnosticResult:
+    window = build_step_time_window(rank_rows, max_steps=max_steps)
+    return diagnose_window(window, mode=mode)

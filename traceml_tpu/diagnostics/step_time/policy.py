@@ -1,0 +1,55 @@
+"""Step-time thresholds, live vs summary
+(reference: src/traceml_ai/diagnostics/step_time/policy.py:9-75 — the
+numeric policy is kept compatible so verdicts line up with the
+reference's on equivalent data; the compile policy is TPU-new).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class StepTimePolicy:
+    # input share of step (median across ranks)
+    input_share_warn: float
+    input_share_critical: float
+    # residual share
+    residual_share_warn: float
+    residual_share_critical: float
+    # compute-bound (info-grade: the job is healthy-but-saturated)
+    compute_share_info: float
+    compute_share_high: float
+    # straggler scoring
+    straggler_score_fire: float = 0.10
+    straggler_dominance: float = 1.25  # component must beat 2nd by this
+    skew_gate: float = 0.06
+    # compile share (TPU-new): recompilation storms
+    compile_share_warn: float = 0.10
+    compile_share_critical: float = 0.25
+    min_steps: int = 20
+
+
+LIVE_POLICY = StepTimePolicy(
+    input_share_warn=0.25,
+    input_share_critical=0.35,
+    residual_share_warn=0.15,
+    residual_share_critical=0.25,
+    compute_share_info=0.85,
+    compute_share_high=0.92,
+    min_steps=20,
+)
+
+SUMMARY_POLICY = StepTimePolicy(
+    input_share_warn=0.30,
+    input_share_critical=0.40,
+    residual_share_warn=0.18,
+    residual_share_critical=0.28,
+    compute_share_info=0.85,
+    compute_share_high=0.92,
+    min_steps=50,
+)
+
+
+def policy_for(mode: str) -> StepTimePolicy:
+    return SUMMARY_POLICY if mode == "summary" else LIVE_POLICY

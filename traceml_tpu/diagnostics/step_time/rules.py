@@ -1,0 +1,347 @@
+"""Step-time diagnostic rules
+(reference: src/traceml_ai/diagnostics/step_time/rules.py:88-315 and the
+formulas in diagnostics/DIAGNOSIS.md:96-112).
+
+Rules:
+
+* ``InputBoundRule``    — INPUT_BOUND when the input-wait share of the
+  step crosses policy thresholds on the median rank.
+* ``CleanStragglerRule`` — the clean-straggler math:  in synchronous
+  data-parallel training, a FAST rank's sync phase is inflated by
+  waiting for the slowest rank, so raw per-phase comparison misattributes
+  skew.  Discount the sync phase by the wait explainable by other ranks'
+  non-sync skew::
+
+      clean_sync_r = max(0, sync_r − max(0, max(non_sync) − non_sync_r))
+      clean_step_r = non_sync_r + clean_sync_r
+      score        = (max(clean_step) − median(clean_step))
+                     / median(actual_step)
+
+  fire at score ≥ 0.10; attribute to the phase whose worst-rank delta
+  dominates the runner-up by ≥1.25×, else a mixed STRAGGLER.
+
+  TPU generalization: the sync phase is ``backward`` when present
+  (torch DDP — allreduce overlaps backward) else the fused ``compute``
+  phase (JAX pjit — collectives live inside the compiled step).
+* ``ResidualHeavyRule`` — untyped time (neither input, h2d, compute,
+  …) above policy share.
+* ``ComputeBoundRule``  — info-grade: the device is the bottleneck and
+  healthy (share ≥ 0.85 / 0.92).
+* ``CompileBoundRule``  — TPU-new: recompilation storms surface as a
+  first-class verdict instead of a straggler artifact.
+"""
+
+from __future__ import annotations
+
+import statistics
+from typing import Dict, List, Optional
+
+from traceml_tpu.diagnostics.common import (
+    SEVERITY_CRITICAL,
+    SEVERITY_INFO,
+    SEVERITY_WARNING,
+    DiagnosticIssue,
+)
+from traceml_tpu.diagnostics.step_time.policy import StepTimePolicy
+from traceml_tpu.utils.step_time_window import RESIDUAL_KEY, STEP_KEY, StepTimeWindow
+
+_STRAGGLER_KIND_BY_PHASE = {
+    "input": "INPUT_STRAGGLER",
+    "h2d": "H2D_STRAGGLER",
+    "residual": "RESIDUAL_STRAGGLER",
+    "forward": "COMPUTE_STRAGGLER",
+    "backward": "COMPUTE_STRAGGLER",
+    "optimizer": "COMPUTE_STRAGGLER",
+    "compute": "COMPUTE_STRAGGLER",
+    "collective": "COLLECTIVE_STRAGGLER",
+    "compile": "COMPILE_STRAGGLER",
+}
+
+
+class _Ctx:
+    """Evaluation context: the window + policy."""
+
+    def __init__(self, window: StepTimeWindow, policy: StepTimePolicy):
+        self.window = window
+        self.policy = policy
+
+
+def build_context(window: StepTimeWindow, policy: StepTimePolicy) -> _Ctx:
+    return _Ctx(window, policy)
+
+
+def _enough_data(ctx: _Ctx) -> bool:
+    return ctx.window is not None and ctx.window.n_steps >= ctx.policy.min_steps
+
+
+class InputBoundRule:
+    def evaluate(self, ctx: _Ctx) -> List[DiagnosticIssue]:
+        if not _enough_data(ctx):
+            return []
+        share = ctx.window.share_of_step("input")
+        if share is None:
+            return []
+        p = ctx.policy
+        if share < p.input_share_warn:
+            return []
+        severity = (
+            SEVERITY_CRITICAL if share >= p.input_share_critical else SEVERITY_WARNING
+        )
+        m = ctx.window.metric("input")
+        return [
+            DiagnosticIssue(
+                kind="INPUT_BOUND",
+                severity=severity,
+                summary=(
+                    f"Input pipeline consumes {share * 100:.0f}% of the median "
+                    f"step ({m.median_ms:.1f} ms of "
+                    f"{ctx.window.metric(STEP_KEY).median_ms:.1f} ms)."
+                ),
+                action=(
+                    "Speed up the input pipeline: more dataloader workers / "
+                    "host prefetch, cache or pre-tokenize the dataset, overlap "
+                    "host input with device compute (double-buffer device_put)."
+                ),
+                metric="input_share",
+                phase="input",
+                score=share,
+                share_pct=share,
+                ranks=list(ctx.window.ranks),
+                evidence={
+                    "input_median_ms": m.median_ms,
+                    "step_median_ms": ctx.window.metric(STEP_KEY).median_ms,
+                    "clock": ctx.window.clock,
+                },
+            )
+        ]
+
+
+class CleanStragglerRule:
+    def _sync_phase(self, ctx: _Ctx) -> Optional[str]:
+        if "backward" in ctx.window.phases_present:
+            return "backward"
+        if "compute" in ctx.window.phases_present:
+            return "compute"
+        return None
+
+    def evaluate(self, ctx: _Ctx) -> List[DiagnosticIssue]:
+        w = ctx.window
+        if not _enough_data(ctx) or len(w.ranks) < 2:
+            return []
+        p = ctx.policy
+        step_m = w.metric(STEP_KEY)
+        if step_m is None or step_m.median_ms <= 0:
+            return []
+        sync_phase = self._sync_phase(ctx)
+        step_avg = {r: w.rank_windows[r].averages[STEP_KEY] for r in w.ranks}
+        sync_avg = {
+            r: (w.rank_windows[r].averages.get(sync_phase, 0.0) if sync_phase else 0.0)
+            for r in w.ranks
+        }
+        non_sync = {r: max(0.0, step_avg[r] - sync_avg[r]) for r in w.ranks}
+        max_non_sync = max(non_sync.values())
+        clean_sync = {
+            r: max(0.0, sync_avg[r] - max(0.0, max_non_sync - non_sync[r]))
+            for r in w.ranks
+        }
+        clean_step = {r: non_sync[r] + clean_sync[r] for r in w.ranks}
+        med_clean = statistics.median(clean_step.values())
+        worst_rank = max(clean_step, key=lambda r: clean_step[r])
+        med_actual = statistics.median(step_avg.values())
+        if med_actual <= 0:
+            return []
+        score = (clean_step[worst_rank] - med_clean) / med_actual
+        if score < p.straggler_score_fire:
+            return []
+
+        # Component attribution on the worst rank: per-phase delta vs the
+        # cross-rank median, with the sync phase replaced by its clean form.
+        deltas: Dict[str, float] = {}
+        for key in list(w.phases_present) + [RESIDUAL_KEY]:
+            per_rank = {
+                r: (
+                    clean_sync[r]
+                    if key == sync_phase
+                    else w.rank_windows[r].averages.get(key, 0.0)
+                )
+                for r in w.ranks
+            }
+            med = statistics.median(per_rank.values())
+            deltas[key] = max(0.0, per_rank[worst_rank] - med)
+        ordered = sorted(deltas.items(), key=lambda kv: -kv[1])
+        kind = "STRAGGLER"
+        dominant_phase: Optional[str] = None
+        if ordered and ordered[0][1] > 0:
+            top_key, top_delta = ordered[0]
+            second = ordered[1][1] if len(ordered) > 1 else 0.0
+            if second <= 0 or top_delta / max(second, 1e-9) >= p.straggler_dominance:
+                kind = _STRAGGLER_KIND_BY_PHASE.get(top_key, "STRAGGLER")
+                dominant_phase = top_key
+        severity = SEVERITY_CRITICAL if score >= 0.25 else SEVERITY_WARNING
+        phase_label = dominant_phase or "mixed"
+        return [
+            DiagnosticIssue(
+                kind=kind,
+                severity=severity,
+                summary=(
+                    f"Rank {worst_rank} runs {score * 100:.0f}% behind the "
+                    f"median step after discounting sync waits "
+                    f"(dominant component: {phase_label})."
+                ),
+                action=(
+                    "Inspect the slow rank's host (input sharding, CPU "
+                    "contention, thermal) and its chip; a persistent single-"
+                    "rank lag gates every synchronous step."
+                ),
+                metric="clean_straggler_score",
+                phase=dominant_phase,
+                score=score,
+                skew_pct=score,
+                ranks=[worst_rank],
+                evidence={
+                    "clean_step_ms": {str(r): v for r, v in clean_step.items()},
+                    "step_avg_ms": {str(r): v for r, v in step_avg.items()},
+                    "sync_phase": sync_phase,
+                    "component_deltas_ms": {k: v for k, v in ordered[:4]},
+                    "clock": w.clock,
+                },
+            )
+        ]
+
+
+class ResidualHeavyRule:
+    def evaluate(self, ctx: _Ctx) -> List[DiagnosticIssue]:
+        if not _enough_data(ctx):
+            return []
+        share = ctx.window.share_of_step(RESIDUAL_KEY)
+        if share is None:
+            return []
+        p = ctx.policy
+        if share < p.residual_share_warn:
+            return []
+        severity = (
+            SEVERITY_CRITICAL
+            if share >= p.residual_share_critical
+            else SEVERITY_WARNING
+        )
+        return [
+            DiagnosticIssue(
+                kind="RESIDUAL_HEAVY",
+                severity=severity,
+                summary=(
+                    f"{share * 100:.0f}% of the step is unattributed time "
+                    "(outside input/h2d/compute/optimizer phases)."
+                ),
+                action=(
+                    "Look for untimed host work between phases: logging, "
+                    "metric syncs (device→host reads), checkpoint writes, "
+                    "Python overhead; on TPU also check for hidden "
+                    "host-device round trips forcing early sync."
+                ),
+                metric="residual_share",
+                phase=RESIDUAL_KEY,
+                score=share,
+                share_pct=share,
+                ranks=list(ctx.window.ranks),
+            )
+        ]
+
+
+class ComputeBoundRule:
+    def evaluate(self, ctx: _Ctx) -> List[DiagnosticIssue]:
+        if not _enough_data(ctx):
+            return []
+        compute_keys = [
+            k for k in ("compute", "forward", "backward", "optimizer")
+            if k in ctx.window.phases_present
+        ]
+        if not compute_keys:
+            return []
+        share = 0.0
+        for k in compute_keys:
+            s = ctx.window.share_of_step(k)
+            share += s or 0.0
+        p = ctx.policy
+        if share < p.compute_share_info:
+            return []
+        return [
+            DiagnosticIssue(
+                kind="COMPUTE_BOUND",
+                severity=SEVERITY_INFO,
+                summary=(
+                    f"Device compute accounts for {share * 100:.0f}% of the "
+                    "step — the accelerator is the bottleneck (healthy for "
+                    "a well-fed training job)."
+                ),
+                action=(
+                    "To go faster: larger per-chip batch, bf16 everywhere, "
+                    "remat tuning, or scale out over more chips."
+                ),
+                metric="compute_share",
+                phase="compute",
+                score=share,
+                share_pct=share,
+                ranks=list(ctx.window.ranks),
+            )
+        ]
+
+
+class CompileBoundRule:
+    """TPU-new: recompilation eating wall-clock."""
+
+    def evaluate(self, ctx: _Ctx) -> List[DiagnosticIssue]:
+        w = ctx.window
+        if w is None or "compile" not in w.phases_present:
+            return []
+        # compile share is computed over MEAN (not median) because
+        # compiles are spiky: a few huge steps, most zero.
+        comp = w.metric("compile")
+        step = w.metric(STEP_KEY)
+        if comp is None or step is None or step.mean_ms <= 0:
+            return []
+        share = comp.mean_ms / step.mean_ms
+        p = ctx.policy
+        if share < p.compile_share_warn:
+            return []
+        n_compile_steps = sum(
+            1
+            for rw in w.rank_windows.values()
+            for v in rw.series.get("compile", [])
+            if v > 0
+        )
+        severity = (
+            SEVERITY_CRITICAL
+            if share >= p.compile_share_critical
+            else SEVERITY_WARNING
+        )
+        return [
+            DiagnosticIssue(
+                kind="COMPILE_BOUND",
+                severity=severity,
+                summary=(
+                    f"XLA compilation consumes {share * 100:.0f}% of mean "
+                    f"step time across the window ({n_compile_steps} steps "
+                    "triggered compilation)."
+                ),
+                action=(
+                    "Eliminate recompiles: pad/bucket batch shapes to a fixed "
+                    "set, avoid Python-value-dependent jit branches, check "
+                    "for dtype or sharding churn between steps."
+                ),
+                metric="compile_share",
+                phase="compile",
+                score=share,
+                share_pct=share,
+                ranks=list(w.ranks),
+                evidence={"compile_steps": n_compile_steps},
+            )
+        ]
+
+
+DEFAULT_RULES = (
+    CleanStragglerRule(),
+    InputBoundRule(),
+    CompileBoundRule(),
+    ResidualHeavyRule(),
+    ComputeBoundRule(),
+)

@@ -1,0 +1,171 @@
+"""System (host + chip) rules
+(reference: src/traceml_ai/diagnostics/system/rules.py:22-234,
+policy.py:16-72; NVML-only rules (temperature, power, GPU util %) have
+no public TPU counter — their slots are preserved with device-memory
+and host-side equivalents, and utilization insight comes from the
+step-time compute share instead).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import statistics
+from typing import Any, Dict, List, Mapping, Sequence
+
+from traceml_tpu.diagnostics.common import (
+    SEVERITY_CRITICAL,
+    SEVERITY_WARNING,
+    DiagnosticIssue,
+)
+from traceml_tpu.utils.formatting import fmt_bytes
+
+
+@dataclasses.dataclass(frozen=True)
+class SystemPolicy:
+    host_cpu_warn: float = 80.0  # %
+    host_cpu_critical: float = 95.0
+    host_mem_warn: float = 0.85
+    host_mem_critical: float = 0.95
+    device_mem_warn: float = 0.92
+    device_mem_critical: float = 0.97
+
+
+DEFAULT_POLICY = SystemPolicy()
+
+
+@dataclasses.dataclass
+class SystemContext:
+    # node_rank → host sample rows
+    host: Dict[int, List[Dict[str, Any]]]
+    # (node_rank, device_id) → device sample rows
+    devices: Dict[tuple, List[Dict[str, Any]]]
+    policy: SystemPolicy = DEFAULT_POLICY
+
+
+def build_system_context(
+    host_rows: Mapping[int, Sequence[Mapping[str, Any]]],
+    device_rows: Mapping[tuple, Sequence[Mapping[str, Any]]],
+    policy: SystemPolicy = DEFAULT_POLICY,
+) -> SystemContext:
+    return SystemContext(
+        host={int(k): list(v) for k, v in host_rows.items()},
+        devices={k: list(v) for k, v in device_rows.items()},
+        policy=policy,
+    )
+
+
+def _recent_mean(rows: List[Dict[str, Any]], key: str, n: int = 30):
+    vals = [float(r[key]) for r in rows[-n:] if r.get(key) is not None]
+    return statistics.mean(vals) if vals else None
+
+
+class HighHostCPURule:
+    def evaluate(self, ctx: SystemContext) -> List[DiagnosticIssue]:
+        issues = []
+        p = ctx.policy
+        for node, rows in ctx.host.items():
+            cpu = _recent_mean(rows, "cpu_pct")
+            if cpu is None or cpu < p.host_cpu_warn:
+                continue
+            severity = (
+                SEVERITY_CRITICAL if cpu >= p.host_cpu_critical else SEVERITY_WARNING
+            )
+            issues.append(
+                DiagnosticIssue(
+                    kind="HIGH_HOST_CPU",
+                    severity=severity,
+                    summary=f"Node {node} host CPU at {cpu:.0f}% (recent mean).",
+                    action=(
+                        "Host CPU saturation starves the input pipeline and "
+                        "dispatch: reduce dataloader workers' work per sample, "
+                        "move preprocessing offline, or get more host cores."
+                    ),
+                    metric="host_cpu_pct",
+                    score=cpu / 100.0,
+                    ranks=[node],
+                )
+            )
+        return issues
+
+
+class HighHostMemoryRule:
+    def evaluate(self, ctx: SystemContext) -> List[DiagnosticIssue]:
+        issues = []
+        p = ctx.policy
+        for node, rows in ctx.host.items():
+            if not rows:
+                continue
+            last = rows[-1]
+            used, total = last.get("memory_used_bytes"), last.get("memory_total_bytes")
+            if not used or not total:
+                continue
+            frac = float(used) / float(total)
+            if frac < p.host_mem_warn:
+                continue
+            severity = (
+                SEVERITY_CRITICAL if frac >= p.host_mem_critical else SEVERITY_WARNING
+            )
+            issues.append(
+                DiagnosticIssue(
+                    kind="HIGH_HOST_MEMORY",
+                    severity=severity,
+                    summary=(
+                        f"Node {node} host RAM at {frac * 100:.0f}% "
+                        f"({fmt_bytes(used)} / {fmt_bytes(total)})."
+                    ),
+                    action=(
+                        "OOM-killer risk: shrink host-side caches/prefetch "
+                        "buffers, fewer dataloader workers, stream instead of "
+                        "materializing datasets."
+                    ),
+                    metric="host_mem_pct",
+                    score=frac,
+                    share_pct=frac,
+                    ranks=[node],
+                )
+            )
+        return issues
+
+
+class HighDeviceMemoryRule:
+    def evaluate(self, ctx: SystemContext) -> List[DiagnosticIssue]:
+        issues = []
+        p = ctx.policy
+        for (node, dev), rows in ctx.devices.items():
+            if not rows:
+                continue
+            last = rows[-1]
+            used, total = last.get("memory_used_bytes"), last.get("memory_total_bytes")
+            if not used or not total:
+                continue
+            frac = float(used) / float(total)
+            if frac < p.device_mem_warn:
+                continue
+            severity = (
+                SEVERITY_CRITICAL
+                if frac >= p.device_mem_critical
+                else SEVERITY_WARNING
+            )
+            issues.append(
+                DiagnosticIssue(
+                    kind="HIGH_DEVICE_MEMORY",
+                    severity=severity,
+                    summary=(
+                        f"Node {node} chip {dev} HBM at {frac * 100:.0f}% "
+                        f"({fmt_bytes(used)} / {fmt_bytes(total)})."
+                    ),
+                    action=(
+                        "One allocation spike from OOM: add remat, reduce "
+                        "microbatch, or rebalance sharding."
+                    ),
+                    metric="device_mem_pct",
+                    score=frac,
+                    share_pct=frac,
+                    ranks=[node],
+                    evidence={"device_id": dev},
+                )
+            )
+        return issues
+
+
+DEFAULT_RULES = (HighHostCPURule(), HighHostMemoryRule(), HighDeviceMemoryRule())

@@ -1,0 +1,1 @@
+"""Final reporting (reference: src/traceml_ai/reporting/)."""

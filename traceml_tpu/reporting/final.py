@@ -1,0 +1,376 @@
+"""Final summary generator
+(reference: src/traceml_ai/reporting/final.py:765-989).
+
+Builds the four ordered sections (system, process, step_time,
+step_memory) from the SQLite projections, runs each domain's diagnosis,
+promotes a run-level primary diagnosis, and writes
+``final_summary.json`` + ``final_summary.txt`` (boxed text verdict)
+atomically.  A failed section degrades to a schema-valid NO_DATA payload
+(reference: final.py:752-798) — the report never fails because one
+domain did.
+
+Schema: ``traceml-tpu/1`` (field-compatible superset of the concepts in
+the reference's SCHEMA.md 1.6: meta/topology, primary_diagnosis,
+per-section metadata/diagnosis/issues/global/groups/units).
+"""
+
+from __future__ import annotations
+
+import statistics
+import time
+from pathlib import Path
+from typing import Any, Callable, Dict, Optional
+
+from traceml_tpu.diagnostics.common import DiagnosticResult
+from traceml_tpu.diagnostics.process.api import diagnose as diagnose_process
+from traceml_tpu.diagnostics.step_memory.api import (
+    diagnose_rank_rows as diagnose_memory,
+)
+from traceml_tpu.diagnostics.step_time.api import diagnose_window
+from traceml_tpu.diagnostics.system.api import diagnose as diagnose_system
+from traceml_tpu.reporting import loaders
+from traceml_tpu.reporting.primary_diagnosis import build_primary_diagnosis
+from traceml_tpu.sdk import protocol
+from traceml_tpu.utils.atomic_io import atomic_write_json, atomic_write_text
+from traceml_tpu.utils.error_log import get_error_log
+from traceml_tpu.utils.formatting import fmt_bytes, fmt_ms, fmt_pct
+from traceml_tpu.utils.step_time_window import (
+    RESIDUAL_KEY,
+    STEP_KEY,
+    StepTimeWindow,
+    build_step_time_window,
+)
+
+SCHEMA_VERSION = "traceml-tpu/1"
+
+
+def _no_data_section(key: str, error: Optional[str] = None) -> Dict[str, Any]:
+    out: Dict[str, Any] = {"status": "NO_DATA", "diagnosis": None, "issues": []}
+    if error:
+        out["error"] = error
+    return out
+
+
+def _safe_section(key: str, builder: Callable[[], Dict[str, Any]]) -> Dict[str, Any]:
+    try:
+        section = builder()
+        return section if section is not None else _no_data_section(key)
+    except Exception as exc:
+        get_error_log().warning(f"summary section {key} failed", exc)
+        return _no_data_section(key, error=str(exc))
+
+
+# -- section builders ----------------------------------------------------
+
+
+def _build_step_time_section(db_path: Path, mode: str):
+    rank_rows = loaders.load_step_time_rows(db_path)
+    if not rank_rows:
+        return _no_data_section("step_time"), None
+    window: Optional[StepTimeWindow] = build_step_time_window(rank_rows)
+    result = diagnose_window(window, mode=mode)
+    section: Dict[str, Any] = {
+        "status": "OK" if window else "NO_DATA",
+        "diagnosis": result.diagnosis.to_dict(),
+        "issues": [i.to_dict() for i in result.issues],
+        "units": {"time": "ms"},
+    }
+    if window:
+        phases = {}
+        for key in [STEP_KEY] + window.phases_present + [RESIDUAL_KEY]:
+            m = window.metric(key)
+            if m is None:
+                continue
+            phases[key] = {
+                "median_ms": m.median_ms,
+                "mean_ms": m.mean_ms,
+                "worst_ms": m.worst_ms,
+                "worst_rank": m.worst_rank,
+                "skew_pct": m.skew_pct,
+                "share_of_step": window.share_of_step(key),
+                "per_rank_avg_ms": {str(r): v for r, v in m.per_rank_avg_ms.items()},
+            }
+        section["global"] = {
+            "clock": window.clock,
+            "n_steps": window.n_steps,
+            "step_range": [window.steps[0], window.steps[-1]],
+            "ranks": window.ranks,
+            "phases": phases,
+        }
+    return section, result
+
+
+def _build_step_memory_section(db_path: Path):
+    rank_rows = loaders.load_step_memory_rows(db_path)
+    if not rank_rows:
+        return _no_data_section("step_memory"), None
+    result = diagnose_memory(rank_rows)
+    per_rank = {}
+    for rank, rows in rank_rows.items():
+        if not rows:
+            continue
+        last = rows[-1]
+        series = [r.get("current_bytes") or 0 for r in rows]
+        per_rank[str(rank)] = {
+            "devices": sorted({int(r.get("device_id") or 0) for r in rows}),
+            "current_bytes": last.get("current_bytes"),
+            "step_peak_bytes": max(
+                (r.get("step_peak_bytes") or 0 for r in rows), default=0
+            ),
+            "limit_bytes": last.get("limit_bytes"),
+            "mean_bytes": int(statistics.mean(series)) if series else 0,
+            "n_rows": len(rows),
+        }
+    section = {
+        "status": "OK",
+        "diagnosis": result.diagnosis.to_dict(),
+        "issues": [i.to_dict() for i in result.issues],
+        "global": {"per_rank": per_rank},
+        "units": {"memory": "bytes"},
+    }
+    return section, result
+
+
+def _build_system_section(db_path: Path):
+    host, devices = loaders.load_system_rows(db_path)
+    if not host and not devices:
+        return _no_data_section("system"), None
+    result = diagnose_system(host, devices)
+    nodes = {}
+    for node, rows in host.items():
+        if not rows:
+            continue
+        last = rows[-1]
+        cpu_vals = [r["cpu_pct"] for r in rows if r.get("cpu_pct") is not None]
+        nodes[str(node)] = {
+            "hostname": last.get("hostname"),
+            "cpu_pct_mean": statistics.mean(cpu_vals) if cpu_vals else None,
+            "cpu_pct_max": max(cpu_vals) if cpu_vals else None,
+            "memory_used_bytes": last.get("memory_used_bytes"),
+            "memory_total_bytes": last.get("memory_total_bytes"),
+            "n_samples": len(rows),
+        }
+    chips = {}
+    for (node, dev), rows in devices.items():
+        if not rows:
+            continue
+        last = rows[-1]
+        chips[f"{node}:{dev}"] = {
+            "device_kind": last.get("device_kind"),
+            "memory_used_bytes": last.get("memory_used_bytes"),
+            "memory_peak_bytes": last.get("memory_peak_bytes"),
+            "memory_total_bytes": last.get("memory_total_bytes"),
+        }
+    section = {
+        "status": "OK",
+        "diagnosis": result.diagnosis.to_dict(),
+        "issues": [i.to_dict() for i in result.issues],
+        "global": {"nodes": nodes, "devices": chips},
+        "units": {"memory": "bytes", "cpu": "%"},
+    }
+    return section, result
+
+
+def _build_process_section(db_path: Path):
+    procs, devices = loaders.load_process_rows(db_path)
+    if not procs and not devices:
+        return _no_data_section("process"), None
+    result = diagnose_process(procs, devices)
+    per_rank = {}
+    for rank, rows in procs.items():
+        if not rows:
+            continue
+        last = rows[-1]
+        per_rank[str(rank)] = {
+            "pid": last.get("pid"),
+            "rss_bytes": last.get("rss_bytes"),
+            "cpu_pct": last.get("cpu_pct"),
+            "num_threads": last.get("num_threads"),
+        }
+    section = {
+        "status": "OK",
+        "diagnosis": result.diagnosis.to_dict(),
+        "issues": [i.to_dict() for i in result.issues],
+        "global": {"per_rank": per_rank},
+        "units": {"memory": "bytes", "cpu": "%"},
+    }
+    return section, result
+
+
+# -- text rendering ------------------------------------------------------
+
+
+def _box(lines) -> str:
+    width = max((len(l) for l in lines), default=0)
+    top = "┌" + "─" * (width + 2) + "┐"
+    bottom = "└" + "─" * (width + 2) + "┘"
+    body = "\n".join(f"│ {l.ljust(width)} │" for l in lines)
+    return f"{top}\n{body}\n{bottom}"
+
+
+def render_text_summary(payload: Dict[str, Any]) -> str:
+    primary = payload.get("primary_diagnosis") or {}
+    meta = payload.get("meta") or {}
+    lines = [
+        "TraceML-TPU — final training summary",
+        f"session: {meta.get('session_id', '?')}   "
+        f"ranks: {meta.get('topology', {}).get('world_size', '?')}   "
+        f"mode: {meta.get('topology', {}).get('mode', '?')}",
+        "",
+        f"VERDICT [{str(primary.get('severity', 'info')).upper()}] "
+        f"{primary.get('kind', 'UNKNOWN')}",
+    ]
+    if primary.get("summary"):
+        lines.append(primary["summary"])
+    if primary.get("action"):
+        lines.append(f"→ {primary['action']}")
+    out = [_box(lines), ""]
+
+    st = (payload.get("sections") or {}).get("step_time") or {}
+    g = st.get("global") or {}
+    phases = g.get("phases") or {}
+    if phases:
+        out.append(
+            f"Step time ({g.get('clock')} clock, {g.get('n_steps')} steps, "
+            f"steps {g.get('step_range', ['?', '?'])[0]}–{g.get('step_range', ['?', '?'])[1]}):"
+        )
+        step = phases.get(STEP_KEY, {})
+        out.append(
+            f"  step: median {fmt_ms(step.get('median_ms'))}  "
+            f"worst {fmt_ms(step.get('worst_ms'))} (rank {step.get('worst_rank')})  "
+            f"skew {fmt_pct(step.get('skew_pct'))}"
+        )
+        for key, p in phases.items():
+            if key == STEP_KEY:
+                continue
+            share = p.get("share_of_step")
+            out.append(
+                f"  {key:<10} median {fmt_ms(p.get('median_ms')):>10}  "
+                f"share {fmt_pct(share) if share is not None else 'n/a':>6}  "
+                f"worst rank {p.get('worst_rank')}"
+            )
+        out.append("")
+
+    sm = (payload.get("sections") or {}).get("step_memory") or {}
+    per_rank = (sm.get("global") or {}).get("per_rank") or {}
+    if per_rank:
+        out.append("Device memory (per rank):")
+        for rank, info in sorted(per_rank.items(), key=lambda kv: int(kv[0])):
+            out.append(
+                f"  rank {rank}: current {fmt_bytes(info.get('current_bytes'))}  "
+                f"peak {fmt_bytes(info.get('step_peak_bytes'))}  "
+                f"limit {fmt_bytes(info.get('limit_bytes'))}"
+            )
+        out.append("")
+
+    for key in ("system", "process", "step_memory", "step_time"):
+        sec = (payload.get("sections") or {}).get(key) or {}
+        diag = sec.get("diagnosis") or {}
+        if diag and diag.get("status") == "issue":
+            out.append(f"[{key}] {diag.get('kind')}: {diag.get('summary')}")
+    return "\n".join(out) + "\n"
+
+
+# -- entrypoint ----------------------------------------------------------
+
+
+def generate_summary(
+    db_path: Path,
+    session_dir: Path,
+    settings: Any = None,
+    mode: Optional[str] = None,
+) -> bool:
+    """Build + write final_summary.{json,txt}; True on success."""
+    db_path = Path(db_path)
+    session_dir = Path(session_dir)
+    mode = mode or (getattr(settings, "mode", None) or "summary")
+    if not db_path.exists():
+        get_error_log().warning(f"no telemetry db at {db_path}")
+        payload = {
+            "schema": SCHEMA_VERSION,
+            "meta": {
+                "session_id": getattr(settings, "session_id", "unknown"),
+                "generated_at": time.time(),
+                "topology": {"mode": "unknown", "world_size": 0},
+            },
+            "primary_diagnosis": {
+                "kind": "INSUFFICIENT_STEP_TIME_DATA",
+                "severity": "info",
+                "summary": "No telemetry was recorded.",
+            },
+            "sections": {
+                k: _no_data_section(k)
+                for k in ("system", "process", "step_time", "step_memory")
+            },
+        }
+        atomic_write_json(protocol.get_final_summary_json_path(session_dir), payload)
+        atomic_write_text(
+            protocol.get_final_summary_txt_path(session_dir),
+            render_text_summary(payload),
+        )
+        return True
+
+    results: Dict[str, Optional[DiagnosticResult]] = {}
+
+    def run_step_time():
+        section, result = _build_step_time_section(db_path, mode)
+        results["step_time"] = result
+        return section
+
+    def run_step_memory():
+        section, result = _build_step_memory_section(db_path)
+        results["step_memory"] = result
+        return section
+
+    def run_system():
+        section, result = _build_system_section(db_path)
+        results["system"] = result
+        return section
+
+    def run_process():
+        section, result = _build_process_section(db_path)
+        results["process"] = result
+        return section
+
+    sections = {
+        "system": _safe_section("system", run_system),
+        "process": _safe_section("process", run_process),
+        "step_time": _safe_section("step_time", run_step_time),
+        "step_memory": _safe_section("step_memory", run_step_memory),
+    }
+    try:
+        topology = loaders.load_topology(db_path)
+    except Exception:
+        topology = {"mode": "unknown", "world_size": 0}
+    primary = build_primary_diagnosis(
+        results.get("step_time"),
+        results.get("step_memory"),
+        results.get("system"),
+        results.get("process"),
+    )
+    payload = {
+        "schema": SCHEMA_VERSION,
+        "meta": {
+            "session_id": getattr(settings, "session_id", "unknown"),
+            "run_name": getattr(settings, "run_name", None),
+            "generated_at": time.time(),
+            "mode": mode,
+            "topology": topology,
+        },
+        "primary_diagnosis": primary,
+        "sections": sections,
+    }
+    atomic_write_json(protocol.get_final_summary_json_path(session_dir), payload)
+    atomic_write_text(
+        protocol.get_final_summary_txt_path(session_dir),
+        render_text_summary(payload),
+    )
+    try:
+        from traceml_tpu.reporting.html.writer import write_html_summary
+
+        write_html_summary(
+            payload, protocol.get_final_summary_html_path(session_dir)
+        )
+    except Exception:
+        pass  # HTML artifact is best-effort
+    return True

@@ -1,0 +1,165 @@
+"""SQLite read paths for reporting
+(reference: reporting/sections/*/loader.py, e.g. step_time/loader.py:41-90
+pulls bounded events_json rows per global rank).
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+from pathlib import Path
+from typing import Any, Dict, List, Tuple
+
+
+def _connect_ro(db_path: Path) -> sqlite3.Connection:
+    conn = sqlite3.connect(f"file:{db_path}?mode=ro", uri=True)
+    conn.row_factory = sqlite3.Row
+    return conn
+
+
+def _table_exists(conn: sqlite3.Connection, table: str) -> bool:
+    row = conn.execute(
+        "SELECT name FROM sqlite_master WHERE type='table' AND name=?", (table,)
+    ).fetchone()
+    return row is not None
+
+
+def load_step_time_rows(
+    db_path: Path, max_steps_per_rank: int = 600
+) -> Dict[int, List[Dict[str, Any]]]:
+    """global_rank → step rows (events decoded), ascending by step."""
+    out: Dict[int, List[Dict[str, Any]]] = {}
+    with _connect_ro(db_path) as conn:
+        if not _table_exists(conn, "step_time_samples"):
+            return out
+        ranks = [
+            r[0]
+            for r in conn.execute(
+                "SELECT DISTINCT global_rank FROM step_time_samples"
+            )
+        ]
+        for rank in ranks:
+            rows = conn.execute(
+                "SELECT step, timestamp, clock, late_markers, events_json "
+                "FROM step_time_samples WHERE global_rank=? "
+                "ORDER BY step DESC LIMIT ?",
+                (rank, max_steps_per_rank),
+            ).fetchall()
+            decoded = []
+            for r in reversed(rows):
+                try:
+                    events = json.loads(r["events_json"] or "{}")
+                except ValueError:
+                    events = {}
+                decoded.append(
+                    {
+                        "step": r["step"],
+                        "timestamp": r["timestamp"],
+                        "clock": r["clock"],
+                        "late_markers": r["late_markers"],
+                        "events": events,
+                    }
+                )
+            out[int(rank)] = decoded
+    return out
+
+
+def load_step_memory_rows(
+    db_path: Path, max_rows_per_rank: int = 20000
+) -> Dict[int, List[Dict[str, Any]]]:
+    out: Dict[int, List[Dict[str, Any]]] = {}
+    with _connect_ro(db_path) as conn:
+        if not _table_exists(conn, "step_memory_samples"):
+            return out
+        ranks = [
+            r[0]
+            for r in conn.execute(
+                "SELECT DISTINCT global_rank FROM step_memory_samples"
+            )
+        ]
+        for rank in ranks:
+            rows = conn.execute(
+                "SELECT step, timestamp, device_id, device_kind, current_bytes,"
+                " peak_bytes, step_peak_bytes, limit_bytes FROM"
+                " step_memory_samples WHERE global_rank=?"
+                " ORDER BY step DESC LIMIT ?",
+                (rank, max_rows_per_rank),
+            ).fetchall()
+            out[int(rank)] = [dict(r) for r in reversed(rows)]
+    return out
+
+
+def load_system_rows(
+    db_path: Path, max_rows: int = 2000
+) -> Tuple[Dict[int, List[Dict[str, Any]]], Dict[tuple, List[Dict[str, Any]]]]:
+    host: Dict[int, List[Dict[str, Any]]] = {}
+    devices: Dict[tuple, List[Dict[str, Any]]] = {}
+    with _connect_ro(db_path) as conn:
+        if _table_exists(conn, "system_samples"):
+            for r in conn.execute(
+                "SELECT * FROM (SELECT * FROM system_samples ORDER BY id DESC"
+                f" LIMIT {int(max_rows)}) ORDER BY id ASC"
+            ):
+                host.setdefault(int(r["node_rank"]), []).append(dict(r))
+        if _table_exists(conn, "system_device_samples"):
+            for r in conn.execute(
+                "SELECT * FROM (SELECT * FROM system_device_samples ORDER BY id"
+                f" DESC LIMIT {int(max_rows)}) ORDER BY id ASC"
+            ):
+                devices.setdefault(
+                    (int(r["node_rank"]), int(r["device_id"] or 0)), []
+                ).append(dict(r))
+    return host, devices
+
+
+def load_process_rows(
+    db_path: Path, max_rows: int = 2000
+) -> Tuple[Dict[int, List[Dict[str, Any]]], Dict[tuple, List[Dict[str, Any]]]]:
+    procs: Dict[int, List[Dict[str, Any]]] = {}
+    devices: Dict[tuple, List[Dict[str, Any]]] = {}
+    with _connect_ro(db_path) as conn:
+        if _table_exists(conn, "process_samples"):
+            for r in conn.execute(
+                "SELECT * FROM (SELECT * FROM process_samples ORDER BY id DESC"
+                f" LIMIT {int(max_rows)}) ORDER BY id ASC"
+            ):
+                procs.setdefault(int(r["global_rank"]), []).append(dict(r))
+        if _table_exists(conn, "process_device_samples"):
+            for r in conn.execute(
+                "SELECT * FROM (SELECT * FROM process_device_samples ORDER BY"
+                f" id DESC LIMIT {int(max_rows)}) ORDER BY id ASC"
+            ):
+                devices.setdefault(
+                    (int(r["global_rank"]), int(r["device_id"] or 0)), []
+                ).append(dict(r))
+    return procs, devices
+
+
+def load_topology(db_path: Path) -> Dict[str, Any]:
+    """Run topology from identity columns (reference: reporting/topology.py:63)."""
+    with _connect_ro(db_path) as conn:
+        if not _table_exists(conn, "step_time_samples"):
+            tables = [
+                t
+                for t in ("process_samples", "system_samples")
+                if _table_exists(conn, t)
+            ]
+            if not tables:
+                return {"mode": "unknown", "world_size": 0, "nodes": 0}
+            table = tables[0]
+        else:
+            table = "step_time_samples"
+        rows = conn.execute(
+            f"SELECT DISTINCT global_rank, node_rank, hostname, world_size"
+            f" FROM {table}"
+        ).fetchall()
+    ranks = sorted({int(r["global_rank"]) for r in rows})
+    nodes = sorted({int(r["node_rank"]) for r in rows})
+    world = max((int(r["world_size"]) for r in rows), default=len(ranks))
+    return {
+        "mode": "multi_node" if len(nodes) > 1 else "single_node",
+        "world_size": max(world, len(ranks)),
+        "ranks_seen": ranks,
+        "nodes": len(nodes),
+        "hostnames": sorted({str(r["hostname"]) for r in rows}),
+    }

@@ -1,0 +1,64 @@
+"""Run-level primary diagnosis
+(reference: src/traceml_ai/reporting/primary_diagnosis.py:617-673).
+
+Promotes the step-time finding to run level; falls back to
+``NO_CLEAR_PERFORMANCE_BOTTLENECK`` / ``INSUFFICIENT_STEP_TIME_DATA``.
+A non-healthy memory/system finding of higher severity can outrank an
+info-grade step-time verdict.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from traceml_tpu.diagnostics.common import (
+    SEVERITY_CRITICAL,
+    SEVERITY_WARNING,
+    DiagnosticResult,
+)
+
+_SEV_ORDER = {SEVERITY_CRITICAL: 2, SEVERITY_WARNING: 1}
+
+
+def build_primary_diagnosis(
+    step_time: Optional[DiagnosticResult],
+    step_memory: Optional[DiagnosticResult] = None,
+    system: Optional[DiagnosticResult] = None,
+    process: Optional[DiagnosticResult] = None,
+) -> Dict[str, Any]:
+    candidates = []
+    if step_time is not None:
+        issue = step_time.diagnosis
+        if issue.kind == "INSUFFICIENT_STEP_TIME_DATA":
+            candidates.append((0.5, "step_time", issue))
+        elif not step_time.healthy or issue.kind == "COMPUTE_BOUND":
+            # step-time issues get a priority bump: they ARE the
+            # performance story (reference promotes step-time first)
+            candidates.append(
+                (_SEV_ORDER.get(issue.severity, 0) + 0.6, "step_time", issue)
+            )
+    for domain, result in (
+        ("step_memory", step_memory),
+        ("system", system),
+        ("process", process),
+    ):
+        if result is not None and not result.healthy:
+            issue = result.diagnosis
+            candidates.append((_SEV_ORDER.get(issue.severity, 0), domain, issue))
+
+    if not candidates:
+        return {
+            "kind": "NO_CLEAR_PERFORMANCE_BOTTLENECK",
+            "domain": "run",
+            "severity": "info",
+            "summary": (
+                "No dominant bottleneck or anomaly detected in the analyzed "
+                "window."
+            ),
+            "action": "",
+        }
+    candidates.sort(key=lambda c: -c[0])
+    _prio, domain, issue = candidates[0]
+    out = issue.to_dict()
+    out["domain"] = domain
+    return out

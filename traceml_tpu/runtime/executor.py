@@ -61,7 +61,15 @@ def main() -> int:
     script = os.environ.get(ENV_SCRIPT)
     raw_args = os.environ.get(ENV_SCRIPT_ARGS, "")
     args = shlex.split(raw_args) if raw_args else []
-    settings = settings_from_env()
+    try:
+        settings = settings_from_env()
+    except Exception as exc:
+        # fail-open: malformed TRACEML_* env must not keep the user
+        # script from running — run untraced instead.
+        print(f"[TraceML] bad TRACEML_* env, tracing disabled: {exc}", file=sys.stderr)
+        from traceml_tpu.runtime.settings import TraceMLSettings
+
+        settings = TraceMLSettings(disabled=True)
 
     if not script:
         print("[TraceML] executor: TRACEML_SCRIPT not set", file=sys.stderr)

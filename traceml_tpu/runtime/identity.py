@@ -19,7 +19,6 @@ from __future__ import annotations
 import dataclasses
 import os
 import socket
-import sys
 from typing import Dict, Optional
 
 from traceml_tpu.telemetry.envelope import SenderIdentity
@@ -63,14 +62,13 @@ class RuntimeIdentity:
 
 def _device_info() -> Dict[str, str]:
     """platform/device_kind from live jax — only if already initialized."""
-    if "jax" not in sys.modules:
+    from traceml_tpu.utils.step_memory import jax_is_initialized
+
+    if not jax_is_initialized():
         return {}
     try:
         import jax
-        import jax._src.xla_bridge as xb
 
-        if not getattr(xb, "_backends", None):
-            return {}
         devs = jax.local_devices()
         return {
             "platform": jax.default_backend(),
@@ -148,24 +146,24 @@ def resolve_runtime_identity(env: Optional[Dict[str, str]] = None) -> RuntimeIde
             pass
 
     # 4. live JAX distributed state
-    if "jax" in sys.modules:
+    from traceml_tpu.utils.step_memory import jax_is_initialized
+
+    if jax_is_initialized():
         try:
             import jax
-            import jax._src.xla_bridge as xb
 
-            if getattr(xb, "_backends", None):
-                pi = jax.process_index()
-                pc = jax.process_count()
-                if pc > 1 or pi > 0:
-                    return RuntimeIdentity(
-                        global_rank=pi,
-                        local_rank=0,
-                        world_size=pc,
-                        local_world_size=1,
-                        node_rank=pi,
-                        source="jax:distributed",
-                        **common,
-                    )
+            pi = jax.process_index()
+            pc = jax.process_count()
+            if pc > 1 or pi > 0:
+                return RuntimeIdentity(
+                    global_rank=pi,
+                    local_rank=0,
+                    world_size=pc,
+                    local_world_size=1,
+                    node_rank=pi,
+                    source="jax:distributed",
+                    **common,
+                )
         except Exception:
             pass
 

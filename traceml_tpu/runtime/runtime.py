@@ -100,10 +100,13 @@ class TraceMLRuntime:
             pass
 
     def _take_rank_finished(self) -> Optional[list]:
-        """The send-once rank_finished marker, or None if already sent."""
-        if self._finished_sent:
-            return None
-        self._finished_sent = True
+        """The send-once rank_finished marker, or None if already sent.
+        Lock-guarded: the tick thread and stop()'s final drain can race
+        when the join times out."""
+        with self._lock:
+            if self._finished_sent:
+                return None
+            self._finished_sent = True
         return [
             build_rank_finished(
                 self.identity.to_sender_identity(self.settings.session_id).to_meta()
@@ -113,16 +116,13 @@ class TraceMLRuntime:
     # -- tick loop -----------------------------------------------------
     def _tick(self) -> None:
         phase = self.recording.phase
-        for s in self.samplers:
-            drains = getattr(
-                getattr(s, "_spec", None), "drain_on_recording_stop", False
-            )
-            # RECORDING: everyone samples.  DRAINING: only drain samplers
-            # flush their buffered tail.  COMPLETE: nobody samples — the
-            # rank goes quiet (--trace-max-steps contract).
-            if phase == "RECORDING" or (phase == "DRAINING" and drains):
+        # RECORDING: everyone samples.  DRAINING: only drain samplers, via
+        # their (possibly heavier) drain() path.  COMPLETE: nobody samples
+        # — the rank goes quiet (--trace-max-steps contract).
+        if phase == "RECORDING":
+            for s in self.samplers:
                 s.sample()
-        if phase == "DRAINING":
+        elif phase == "DRAINING":
             for s in self.samplers:
                 if getattr(getattr(s, "_spec", None), "drain_on_recording_stop", False):
                     s.drain()

@@ -18,6 +18,7 @@ ENV_SESSION_ID = "TRACEML_SESSION_ID"
 ENV_LOGS_DIR = "TRACEML_LOGS_DIR"
 ENV_MODE = "TRACEML_MODE"  # cli | summary
 ENV_AGG_HOST = "TRACEML_AGGREGATOR_HOST"
+ENV_AGG_BIND_HOST = "TRACEML_AGGREGATOR_BIND_HOST"
 ENV_AGG_PORT = "TRACEML_AGGREGATOR_PORT"
 ENV_SAMPLER_INTERVAL = "TRACEML_SAMPLER_INTERVAL_SEC"
 ENV_MAX_STEPS = "TRACEML_TRACE_MAX_STEPS"
@@ -72,35 +73,36 @@ class TraceMLSettings:
         return self.session_dir / "control"
 
 
-def _env_bool(name: str, default: bool) -> bool:
-    v = os.environ.get(name)
+def _env_bool(env: Dict[str, str], name: str, default: bool) -> bool:
+    v = env.get(name)
     if v is None:
         return default
-    return v.strip().lower() in ("1", "true", "yes", "on")
+    return str(v).strip().lower() in ("1", "true", "yes", "on")
 
 
 def settings_from_env(env: Optional[Dict[str, str]] = None) -> TraceMLSettings:
-    e = os.environ if env is None else env
+    e = dict(os.environ) if env is None else dict(env)
 
     def get(name: str, default: Any = None) -> Any:
         return e.get(name, default)
 
     max_steps = get(ENV_MAX_STEPS)
     expected_ws = get(ENV_EXPECTED_WORLD_SIZE)
+    connect_host = get(ENV_AGG_HOST, "127.0.0.1")
     return TraceMLSettings(
         session_id=get(ENV_SESSION_ID, "local"),
         logs_dir=Path(get(ENV_LOGS_DIR, "./traceml_logs")),
         mode=get(ENV_MODE, "cli"),
         aggregator=AggregatorEndpoint(
-            connect_host=get(ENV_AGG_HOST, "127.0.0.1"),
-            bind_host=get(ENV_AGG_HOST, "127.0.0.1"),
+            connect_host=connect_host,
+            bind_host=get(ENV_AGG_BIND_HOST, connect_host),
             port=int(get(ENV_AGG_PORT, 0) or 0),
         ),
         sampler_interval_sec=float(get(ENV_SAMPLER_INTERVAL, 1.0) or 1.0),
         trace_max_steps=int(max_steps) if max_steps else None,
-        disabled=(str(get(ENV_DISABLE, "")).strip().lower() in ("1", "true", "yes")),
-        disk_backup=(str(get(ENV_DISK_BACKUP, "")).strip().lower() in ("1", "true", "yes")),
-        capture_stderr=(str(get(ENV_CAPTURE_STDERR, "1")).strip().lower() in ("1", "true", "yes")),
+        disabled=_env_bool(e, ENV_DISABLE, False),
+        disk_backup=_env_bool(e, ENV_DISK_BACKUP, False),
+        capture_stderr=_env_bool(e, ENV_CAPTURE_STDERR, True),
         run_name=get(ENV_RUN_NAME) or None,
         expected_world_size=int(expected_ws) if expected_ws else None,
         finalize_timeout_sec=float(get(ENV_FINALIZE_TIMEOUT, 300.0) or 300.0),
@@ -115,6 +117,7 @@ def settings_to_env(s: TraceMLSettings) -> Dict[str, str]:
         ENV_LOGS_DIR: str(s.logs_dir),
         ENV_MODE: s.mode,
         ENV_AGG_HOST: s.aggregator.connect_host,
+        ENV_AGG_BIND_HOST: s.aggregator.bind_host,
         ENV_AGG_PORT: str(s.aggregator.port),
         ENV_SAMPLER_INTERVAL: str(s.sampler_interval_sec),
         ENV_CAPTURE_STDERR: "1" if s.capture_stderr else "0",

@@ -59,6 +59,11 @@ class StreamCapture:
         def encoding(self):
             return getattr(self._orig, "encoding", "utf-8")
 
+        def __getattr__(self, name):
+            # Proxy everything else (buffer, writable, readable, mode, …)
+            # so user code poking sys.stdout keeps working under capture.
+            return getattr(self._orig, name)
+
     def _add(self, label: str, line: str) -> None:
         with self._lock:
             self._lines.append((label, line))

@@ -10,7 +10,6 @@ sample devices once jax is already initialized in this process.
 
 from __future__ import annotations
 
-import sys
 import time
 from typing import Any, Dict, List, Optional
 

@@ -63,6 +63,12 @@ class trace_step:
             st.ensure_mem_tracker().reset(self._step)
             self._region = timed_region(STEP_TIME, self._step, sink=st.buffer.add)
             self._region.__enter__()
+            # Back-date the envelope to the previous step's exit so steps
+            # tile the wall clock: the inter-step gap (input fetch, logging)
+            # lands in THIS step's envelope, where its dataloader_next /
+            # user events already land via the flush ordering.
+            if st.last_step_exit is not None:
+                self._region.event.cpu_start = st.last_step_exit
             st.active_step_event = self._region.event
         except Exception as exc:
             get_error_log().warning("trace_step enter failed", exc)
@@ -76,6 +82,7 @@ class trace_step:
             st.tls.in_step = False
             if self._region is not None:
                 self._region.__exit__(exc_type, exc, tb)
+                st.last_step_exit = self._region.event.cpu_end
             st.active_step_event = None
             step = self._step if self._step is not None else st.current_step
             if exc_type is None:

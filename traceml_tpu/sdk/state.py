@@ -42,6 +42,11 @@ class TraceState:
         self.initialized = False
         self.patch_mode: Optional[str] = None
         self.active_step_event: Optional[TimeEvent] = None
+        # wall-clock of the previous trace_step exit: successive steps
+        # tile the wall clock, so inter-step host time (input fetch in the
+        # idiomatic `for batch in loader: with trace_step():` pattern) is
+        # attributed to the step that consumes the batch
+        self.last_step_exit: Optional[float] = None
         # called with the step number after each flush (max-steps lifecycle)
         self.on_step_flushed: List[Callable[[int], None]] = []
 
