@@ -1,0 +1,160 @@
+import json
+
+from traceml_tpu.launcher.manifest import (
+    analyze_script,
+    update_run_manifest,
+    write_run_manifest,
+)
+from traceml_tpu.config.yaml_loader import load_yaml_config
+from traceml_tpu.launcher.commands import resolve_settings
+from traceml_tpu.reporting.compare.command import build_compare_payload
+
+
+def test_run_manifest_lifecycle(tmp_path):
+    write_run_manifest(
+        tmp_path, session_id="s", script="t.py", mode="summary", world_size=4
+    )
+    data = json.loads((tmp_path / "manifest.json").read_text())
+    assert data["status"] == "starting"
+    assert data["world_size"] == 4
+    update_run_manifest(tmp_path, status="running")
+    data = json.loads((tmp_path / "manifest.json").read_text())
+    assert data["status"] == "running"
+    assert data["session_id"] == "s"
+
+
+def test_code_manifest_jax_hints(tmp_path):
+    script = tmp_path / "train.py"
+    script.write_text(
+        "import jax\nimport optax\n"
+        "from jax.sharding import Mesh, PartitionSpec\n"
+        "import jax.numpy as jnp\n"
+        "opt = optax.adamw(1e-3)\n"
+        "x = jax.device_put(jnp.ones(3).astype(jnp.bfloat16))\n"
+    )
+    info = analyze_script(script)
+    assert info["framework"] == "jax"
+    assert "gspmd" in info["parallelism_hints"]
+    assert "adamw" in info["optimizer_hints"]
+    assert "bf16" in info["precision_hints"]
+    assert "explicit_device_put" in info["input_hints"]
+
+
+def test_code_manifest_bad_script(tmp_path):
+    script = tmp_path / "bad.py"
+    script.write_text("def broken(:\n")
+    info = analyze_script(script)
+    assert "error" in info
+
+
+def test_yaml_loader(tmp_path, monkeypatch):
+    (tmp_path / "traceml.yaml").write_text(
+        "mode: summary\nsampler_interval_sec: 0.5\ntrace_max_steps: 42\n"
+        "unknown_key: zap\ndisk_backup: 'true'\n"
+    )
+    monkeypatch.chdir(tmp_path)
+    cfg = load_yaml_config()
+    assert cfg["mode"] == "summary"
+    assert cfg["sampler_interval_sec"] == 0.5
+    assert cfg["trace_max_steps"] == 42
+    assert cfg["disk_backup"] is True
+    assert "unknown_key" not in cfg
+
+
+def test_resolve_settings_precedence(tmp_path, monkeypatch):
+    (tmp_path / "traceml.yaml").write_text("mode: summary\nsampler_interval_sec: 0.7\n")
+    monkeypatch.chdir(tmp_path)
+    # CLI beats yaml
+    s = resolve_settings({"mode": "cli", "nprocs": 2, "nnodes": 1,
+                          "logs_dir": str(tmp_path)})
+    assert s.mode == "cli"
+    assert s.sampler_interval_sec == 0.7  # yaml survives for unset CLI
+    assert s.expected_world_size == 2
+    # multi-node default flips to summary (explicit port required)
+    s = resolve_settings({"nnodes": 2, "nprocs": 1, "logs_dir": str(tmp_path),
+                          "aggregator_port": 7777})
+    assert s.mode == "summary"
+    assert s.aggregator.bind_host == "0.0.0.0"
+
+
+def _summary(step_ms, input_share, peak, kind="COMPUTE_BOUND", session="a"):
+    return {
+        "meta": {"session_id": session},
+        "primary_diagnosis": {
+            "kind": kind,
+            "severity": "info" if kind in ("COMPUTE_BOUND",
+                                           "NO_CLEAR_PERFORMANCE_BOTTLENECK")
+            else "critical",
+        },
+        "sections": {
+            "step_time": {
+                "global": {
+                    "phases": {
+                        "step_time": {"median_ms": step_ms},
+                        "input": {"median_ms": step_ms * input_share,
+                                  "share_of_step": input_share},
+                        "compute": {"median_ms": step_ms * (1 - input_share),
+                                    "share_of_step": 1 - input_share},
+                    }
+                }
+            },
+            "step_memory": {
+                "global": {"per_rank": {"0": {"step_peak_bytes": peak}}}
+            },
+        },
+    }
+
+
+def test_compare_regression_detected():
+    base = _summary(100.0, 0.05, 8 << 30)
+    cand = _summary(115.0, 0.05, 8 << 30, session="b")
+    payload = build_compare_payload(base, cand)
+    assert payload["verdict"] == "REGRESSION"
+    assert any(f["kind"] == "STEP_TIME_REGRESSION" for f in payload["findings"])
+
+
+def test_compare_improvement_and_equivalent():
+    base = _summary(100.0, 0.05, 8 << 30)
+    cand = _summary(90.0, 0.05, 8 << 30, session="b")
+    assert build_compare_payload(base, cand)["verdict"] == "IMPROVEMENT"
+    cand2 = _summary(101.0, 0.05, 8 << 30, session="c")  # 1% — noise
+    assert build_compare_payload(base, cand2)["verdict"] == "EQUIVALENT"
+
+
+def test_compare_diagnosis_change_and_memory():
+    base = _summary(100.0, 0.05, 8 << 30)
+    cand = _summary(100.0, 0.40, 10 << 30, kind="INPUT_BOUND", session="b")
+    payload = build_compare_payload(base, cand)
+    kinds = {f["kind"] for f in payload["findings"]}
+    assert "DIAGNOSIS_CHANGED" in kinds
+    assert "PHASE_SHIFT" in kinds
+    assert "MEMORY_REGRESSION" in kinds
+    assert payload["verdict"] == "REGRESSION"
+
+
+def test_resolve_settings_env_bool_strings(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("TRACEML_CAPTURE_STDERR", "0")
+    monkeypatch.setenv("TRACEML_DISK_BACKUP", "false")
+    s = resolve_settings({"nprocs": 1, "nnodes": 1, "logs_dir": str(tmp_path)})
+    assert s.capture_stderr is False
+    assert s.disk_backup is False
+
+
+def test_resolve_settings_multinode_requires_port(tmp_path, monkeypatch):
+    import pytest as _pytest
+
+    monkeypatch.chdir(tmp_path)
+    with _pytest.raises(ValueError):
+        resolve_settings({"nnodes": 2, "nprocs": 1, "logs_dir": str(tmp_path)})
+    s = resolve_settings({"nnodes": 2, "nprocs": 1, "logs_dir": str(tmp_path),
+                          "aggregator_port": 9999})
+    assert s.aggregator.port == 9999
+
+
+def test_compare_diagnosis_change_to_healthy_is_not_regression():
+    base = _summary(100.0, 0.40, 8 << 30, kind="INPUT_BOUND")
+    cand = _summary(90.0, 0.05, 8 << 30, kind="COMPUTE_BOUND", session="b")
+    cand["primary_diagnosis"]["severity"] = "info"
+    payload = build_compare_payload(base, cand)
+    assert payload["verdict"] == "IMPROVEMENT"

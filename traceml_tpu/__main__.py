@@ -1,0 +1,7 @@
+"""``python -m traceml_tpu`` → the CLI."""
+
+import sys
+
+from traceml_tpu.launcher.cli import main
+
+sys.exit(main())

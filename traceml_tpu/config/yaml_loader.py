@@ -1,0 +1,90 @@
+"""traceml.yaml resolution
+(reference: src/traceml_ai/config/yaml_loader.py:1-215).
+
+Precedence: CLI > TRACEML_* env > traceml.yaml > built-in defaults.
+The yaml file is searched upward from cwd (10 levels).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+YAML_NAME = "traceml.yaml"
+_SEARCH_LEVELS = 10
+
+# keys the yaml may set, mapped onto TraceMLSettings field names
+VALID_KEYS = {
+    "mode": str,
+    "logs_dir": str,
+    "sampler_interval_sec": float,
+    "trace_max_steps": int,
+    "run_name": str,
+    "finalize_timeout_sec": float,
+    "summary_window_rows": int,
+    "disk_backup": bool,
+    "capture_stderr": bool,
+    "aggregator_host": str,
+    "aggregator_bind_host": str,
+    "aggregator_port": int,
+}
+
+
+def find_yaml(start: Optional[Path] = None) -> Optional[Path]:
+    d = Path(start or Path.cwd()).resolve()
+    for _ in range(_SEARCH_LEVELS):
+        candidate = d / YAML_NAME
+        if candidate.is_file():
+            return candidate
+        if d.parent == d:
+            break
+        d = d.parent
+    return None
+
+
+def load_yaml_config(path: Optional[Path] = None) -> Dict[str, Any]:
+    """Typed, validated yaml config.  A config file the user wrote but we
+    cannot honor is warned about loudly — silently ignoring it would
+    degrade the run behind their back."""
+    import sys
+
+    target = Path(path) if path else find_yaml()
+    if target is None or not target.is_file():
+        return {}
+    try:
+        import yaml
+
+        raw = yaml.safe_load(target.read_text(encoding="utf-8")) or {}
+    except Exception as exc:
+        print(
+            f"[TraceML] WARNING: ignoring unreadable {target}: {exc}",
+            file=sys.stderr,
+        )
+        return {}
+    if not isinstance(raw, dict):
+        print(
+            f"[TraceML] WARNING: {target} is not a mapping; ignoring it",
+            file=sys.stderr,
+        )
+        return {}
+    out: Dict[str, Any] = {}
+    for key, caster in VALID_KEYS.items():
+        if key not in raw or raw[key] is None:
+            continue
+        try:
+            if caster is bool and isinstance(raw[key], str):
+                out[key] = raw[key].strip().lower() in ("1", "true", "yes", "on")
+            else:
+                out[key] = caster(raw[key])
+        except (TypeError, ValueError):
+            print(
+                f"[TraceML] WARNING: {target}: bad value for {key!r}; ignored",
+                file=sys.stderr,
+            )
+    unknown = sorted(set(raw) - set(VALID_KEYS))
+    if unknown:
+        print(
+            f"[TraceML] WARNING: {target}: unknown keys ignored: {unknown}",
+            file=sys.stderr,
+        )
+    return out

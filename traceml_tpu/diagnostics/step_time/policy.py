@@ -24,9 +24,11 @@ class StepTimePolicy:
     straggler_score_fire: float = 0.10
     straggler_dominance: float = 1.25  # component must beat 2nd by this
     skew_gate: float = 0.06
-    # compile share (TPU-new): recompilation storms
+    # compile share (TPU-new): recompilation storms.  Compiles within the
+    # first N absolute steps are warmup, not recompiles.
     compile_share_warn: float = 0.10
     compile_share_critical: float = 0.25
+    compile_warmup_steps: int = 3
     min_steps: int = 20
 
 

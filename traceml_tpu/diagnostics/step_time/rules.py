@@ -293,22 +293,33 @@ class CompileBoundRule:
         w = ctx.window
         if w is None or "compile" not in w.phases_present:
             return []
-        # compile share is computed over MEAN (not median) because
-        # compiles are spiky: a few huge steps, most zero.
-        comp = w.metric("compile")
+        # Warmup compiles are expected — only RE-compilation is
+        # pathological.  Warmup = compile events within the first
+        # ``compile_warmup_steps`` ABSOLUTE steps of the run (the window
+        # carries absolute step ids, so this stays correct after warmup
+        # scrolls out of a live window).  Share is computed over MEANS
+        # (not medians) because recompiles are spiky: a few huge steps,
+        # most zero.
         step = w.metric(STEP_KEY)
-        if comp is None or step is None or step.mean_ms <= 0:
+        if step is None or step.mean_ms <= 0:
             return []
-        share = comp.mean_ms / step.mean_ms
         p = ctx.policy
+        recompile_ms_per_rank = []
+        n_compile_steps = 0
+        for rw in w.rank_windows.values():
+            series = rw.series.get("compile", [])
+            recompile_total = 0.0
+            for step_id, v in zip(rw.steps, series):
+                if v > 0 and step_id > p.compile_warmup_steps:
+                    recompile_total += v
+                    n_compile_steps += 1
+            recompile_ms_per_rank.append(recompile_total / max(1, len(series)))
+        if n_compile_steps == 0:
+            return []
+        mean_recompile = sum(recompile_ms_per_rank) / len(recompile_ms_per_rank)
+        share = mean_recompile / step.mean_ms
         if share < p.compile_share_warn:
             return []
-        n_compile_steps = sum(
-            1
-            for rw in w.rank_windows.values()
-            for v in rw.series.get("compile", [])
-            if v > 0
-        )
         severity = (
             SEVERITY_CRITICAL
             if share >= p.compile_share_critical
@@ -319,9 +330,9 @@ class CompileBoundRule:
                 kind="COMPILE_BOUND",
                 severity=severity,
                 summary=(
-                    f"XLA compilation consumes {share * 100:.0f}% of mean "
+                    f"XLA re-compilation consumes {share * 100:.0f}% of mean "
                     f"step time across the window ({n_compile_steps} steps "
-                    "triggered compilation)."
+                    "recompiled after warmup)."
                 ),
                 action=(
                     "Eliminate recompiles: pad/bucket batch shapes to a fixed "

@@ -1,0 +1,1 @@
+"""CLI launcher (reference: src/traceml_ai/launcher/)."""

@@ -1,0 +1,151 @@
+"""``traceml-tpu`` CLI
+(reference: src/traceml_ai/launcher/cli.py:24-320).
+
+Subcommands: run, watch, view, compare, inspect.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+from typing import List, Optional
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="traceml-tpu",
+        description=(
+            "TPU-native training observability: wrap a JAX or torch "
+            "training script, split every step into phases, diagnose "
+            "bottlenecks, and emit a final summary."
+        ),
+    )
+    sub = p.add_subparsers(dest="command", required=True)
+
+    run = sub.add_parser("run", help="launch a training script under tracing")
+    run.add_argument("script", help="path to the training script")
+    run.add_argument("script_args", nargs=argparse.REMAINDER, default=[])
+    run.add_argument("--mode", choices=("cli", "summary"), default=None)
+    run.add_argument("--run-name", dest="run_name", default=None)
+    run.add_argument("--logs-dir", dest="logs_dir", default=None)
+    run.add_argument("--nprocs", type=int, default=1, help="ranks on this node")
+    run.add_argument("--nnodes", type=int, default=1)
+    run.add_argument("--node-rank", dest="node_rank", type=int, default=0)
+    run.add_argument(
+        "--aggregator-host",
+        dest="aggregator_host",
+        default=None,
+        help="address workers connect to (owner node's address in multi-node)",
+    )
+    run.add_argument(
+        "--aggregator-port", dest="aggregator_port", type=int, default=None
+    )
+    run.add_argument(
+        "--sampler-interval",
+        dest="sampler_interval_sec",
+        type=float,
+        default=None,
+    )
+    run.add_argument(
+        "--trace-max-steps", dest="trace_max_steps", type=int, default=None
+    )
+    run.add_argument(
+        "--summary-window-rows",
+        dest="summary_window_rows",
+        type=int,
+        default=None,
+    )
+    run.add_argument(
+        "--finalize-timeout",
+        dest="finalize_timeout_sec",
+        type=float,
+        default=None,
+    )
+    run.add_argument("--disk-backup", dest="disk_backup", action="store_true", default=None)
+    run.add_argument(
+        "--no-capture-stderr",
+        dest="capture_stderr",
+        action="store_false",
+        default=None,
+    )
+    run.add_argument(
+        "--disable-traceml", dest="disable", action="store_true", default=False
+    )
+
+    watch = sub.add_parser(
+        "watch", help="attach a live view to a running/finished session"
+    )
+    watch.add_argument("session_dir", help="path to <logs>/<session>")
+    watch.add_argument("--interval", type=float, default=1.0)
+
+    view = sub.add_parser("view", help="print a stored final summary")
+    view.add_argument("path", help="final_summary.json (or session dir)")
+    view.add_argument("--format", choices=("text", "json"), default="text")
+
+    cmp_ = sub.add_parser("compare", help="compare two final summaries")
+    cmp_.add_argument("baseline")
+    cmp_.add_argument("candidate")
+    cmp_.add_argument("--output", default=None)
+
+    insp = sub.add_parser("inspect", help="decode per-rank disk backups")
+    insp.add_argument("path", help="a rank data dir or .msgpack file")
+    insp.add_argument("--limit", type=int, default=20)
+
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = _build_parser().parse_args(argv)
+    if args.command == "run":
+        from traceml_tpu.launcher.commands import launch_process
+
+        cli = {
+            k: getattr(args, k)
+            for k in (
+                "mode",
+                "run_name",
+                "logs_dir",
+                "nprocs",
+                "nnodes",
+                "node_rank",
+                "aggregator_host",
+                "aggregator_port",
+                "sampler_interval_sec",
+                "trace_max_steps",
+                "summary_window_rows",
+                "finalize_timeout_sec",
+                "disk_backup",
+                "capture_stderr",
+                "disable",
+            )
+        }
+        script_args = list(args.script_args or [])
+        if script_args[:1] == ["--"]:
+            script_args = script_args[1:]
+        return launch_process(args.script, script_args, **cli)
+    if args.command == "view":
+        from traceml_tpu.reporting.view.command import run_view
+
+        return run_view(Path(args.path), fmt=args.format)
+    if args.command == "compare":
+        from traceml_tpu.reporting.compare.command import run_compare
+
+        return run_compare(
+            Path(args.baseline),
+            Path(args.candidate),
+            output=Path(args.output) if args.output else None,
+        )
+    if args.command == "inspect":
+        from traceml_tpu.launcher.inspect_cmd import run_inspect
+
+        return run_inspect(Path(args.path), limit=args.limit)
+    if args.command == "watch":
+        from traceml_tpu.launcher.watch_cmd import run_watch
+
+        return run_watch(Path(args.session_dir), interval=args.interval)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,31 @@
+"""``traceml-tpu inspect`` — decode per-rank msgpack backups
+(reference: launcher/commands.py:580-616)."""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+from traceml_tpu.database.database_writer import iter_backup_file
+
+
+def run_inspect(path: Path, limit: int = 20) -> int:
+    path = Path(path)
+    files = []
+    if path.is_file():
+        files = [path]
+    elif path.is_dir():
+        files = sorted(path.rglob("*.msgpack"))
+    if not files:
+        print(f"no .msgpack backups under {path}")
+        return 1
+    for f in files:
+        print(f"── {f}")
+        n = 0
+        for row in iter_backup_file(f):
+            print(json.dumps(row, default=str))
+            n += 1
+            if n >= limit:
+                print(f"… (showing first {limit})")
+                break
+    return 0

@@ -1,0 +1,178 @@
+"""Run + code manifests
+(reference: src/traceml_ai/launcher/manifest.py:58-228 and the AST code
+manifest utils/ast_analysis/ — here a single-pass static scan of the
+entry script tuned to JAX/TPU signals).
+"""
+
+from __future__ import annotations
+
+import ast
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+from traceml_tpu.utils.atomic_io import atomic_write_json, read_json
+
+STATUS_STARTING = "starting"
+STATUS_RUNNING = "running"
+STATUS_COMPLETED = "completed"
+STATUS_FAILED = "failed"
+STATUS_DEGRADED = "degraded"
+
+
+def manifest_path(session_dir: Path) -> Path:
+    return Path(session_dir) / "manifest.json"
+
+
+def write_run_manifest(
+    session_dir: Path,
+    *,
+    session_id: str,
+    script: str,
+    mode: str,
+    world_size: int,
+    status: str = STATUS_STARTING,
+    extra: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    data = {
+        "schema": 1,
+        "session_id": session_id,
+        "script": script,
+        "mode": mode,
+        "world_size": world_size,
+        "status": status,
+        "telemetry_status": "ok",
+        "created_at": time.time(),
+        "updated_at": time.time(),
+        "artifacts": {
+            "final_summary_json": str(Path(session_dir) / "final_summary.json"),
+            "final_summary_txt": str(Path(session_dir) / "final_summary.txt"),
+            "telemetry_db": str(Path(session_dir) / "telemetry.sqlite"),
+        },
+    }
+    if extra:
+        data.update(extra)
+    atomic_write_json(manifest_path(session_dir), data)
+    return data
+
+
+def update_run_manifest(session_dir: Path, **fields: Any) -> None:
+    data = read_json(manifest_path(session_dir), default={}) or {}
+    data.update(fields)
+    data["updated_at"] = time.time()
+    atomic_write_json(manifest_path(session_dir), data)
+
+
+# -- code manifest (static analysis) --------------------------------------
+
+
+class _ScriptVisitor(ast.NodeVisitor):
+    def __init__(self) -> None:
+        self.imports: set = set()
+        self.calls: List[str] = []
+        self.attrs: List[str] = []
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for a in node.names:
+            self.imports.add(a.name.split(".")[0])
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module:
+            self.imports.add(node.module.split(".")[0])
+        for a in node.names:
+            # imported symbol names carry parallelism signals
+            # (Mesh, PartitionSpec, shard_map, …)
+            self.attrs.append(a.name)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = _dotted(node.func)
+        if name:
+            self.calls.append(name)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        name = _dotted(node)
+        if name:
+            self.attrs.append(name)
+        self.generic_visit(node)
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def analyze_script(script: Path) -> Dict[str, Any]:
+    """Best-effort static scan: framework, parallelism hints, precision,
+    optimizer, input-pipeline hints (reference: ast_analysis/scanner.py:59)."""
+    out: Dict[str, Any] = {
+        "script": str(script),
+        "framework": "unknown",
+        "uses": [],
+        "parallelism_hints": [],
+        "precision_hints": [],
+        "optimizer_hints": [],
+        "input_hints": [],
+    }
+    try:
+        tree = ast.parse(Path(script).read_text(encoding="utf-8"))
+    except Exception as exc:
+        out["error"] = str(exc)
+        return out
+    v = _ScriptVisitor()
+    v.visit(tree)
+    names = set(v.calls) | set(v.attrs)
+    imports = v.imports
+
+    if "jax" in imports or "flax" in imports:
+        out["framework"] = "jax"
+    elif "torch" in imports:
+        out["framework"] = "torch"
+    out["uses"] = sorted(
+        imports
+        & {
+            "jax", "flax", "optax", "orbax", "torch", "transformers",
+            "numpy", "tensorflow", "grain",
+        }
+    )
+
+    def any_in(*subs: str) -> bool:
+        return any(any(s in n for n in names) for s in subs)
+
+    if any_in("pjit", "shard_map", "NamedSharding", "PartitionSpec", "Mesh"):
+        out["parallelism_hints"].append("gspmd")
+    if any_in("pmap"):
+        out["parallelism_hints"].append("pmap")
+    if any_in("distributed.initialize"):
+        out["parallelism_hints"].append("multi_host")
+    if any_in("DistributedDataParallel"):
+        out["parallelism_hints"].append("ddp")
+    if any_in("FSDP", "fully_shard"):
+        out["parallelism_hints"].append("fsdp")
+    if any_in("bfloat16", "bf16"):
+        out["precision_hints"].append("bf16")
+    if any_in("float16", "fp16", "autocast"):
+        out["precision_hints"].append("fp16/amp")
+    for opt in ("adamw", "adam", "sgd", "adafactor", "lion", "lamb"):
+        if any_in(opt):
+            out["optimizer_hints"].append(opt)
+    if any_in("DataLoader"):
+        out["input_hints"].append("torch_dataloader")
+    if any_in("device_put"):
+        out["input_hints"].append("explicit_device_put")
+    if any_in("jax.checkpoint", "remat"):
+        out["uses"].append("remat")
+    return out
+
+
+def write_code_manifest(session_dir: Path, script: Path) -> Dict[str, Any]:
+    data = analyze_script(script)
+    data["generated_at"] = time.time()
+    atomic_write_json(Path(session_dir) / "code_manifest.json", data)
+    return data

@@ -1,0 +1,84 @@
+"""Process supervision utilities
+(reference: src/traceml_ai/launcher/process.py:30-300)."""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from traceml_tpu.utils.atomic_io import read_json
+
+
+def spawn(
+    argv: List[str],
+    env: Optional[Dict[str, str]] = None,
+    cwd: Optional[str] = None,
+    stdout=None,
+    stderr=None,
+) -> subprocess.Popen:
+    """Start a child in its own process group so we can terminate the
+    whole tree."""
+    full_env = dict(os.environ)
+    if env:
+        full_env.update(env)
+    kwargs = {}
+    if os.name == "posix":
+        kwargs["start_new_session"] = True
+    return subprocess.Popen(
+        argv,
+        env=full_env,
+        cwd=cwd,
+        stdout=stdout,
+        stderr=stderr,
+        **kwargs,
+    )
+
+
+def terminate(proc: subprocess.Popen, grace_sec: float = 10.0) -> int:
+    """SIGTERM the process group, escalate to SIGKILL after the grace
+    period; returns the exit code."""
+    if proc.poll() is not None:
+        return proc.returncode
+    try:
+        if os.name == "posix":
+            os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+        else:  # pragma: no cover
+            proc.terminate()
+    except (ProcessLookupError, PermissionError):
+        pass
+    deadline = time.monotonic() + grace_sec
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            return proc.returncode
+        time.sleep(0.1)
+    try:
+        if os.name == "posix":
+            os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        else:  # pragma: no cover
+            proc.kill()
+    except (ProcessLookupError, PermissionError):
+        pass
+    proc.wait(timeout=10)
+    return proc.returncode
+
+
+def wait_for_ready_file(path: Path, timeout: float = 30.0) -> Optional[dict]:
+    """Poll the aggregator's ready file for the bound port
+    (replaces the reference's TCP-listen poll — the file also carries
+    the ephemeral port, which a connect probe cannot discover)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        data = read_json(path)
+        if data and data.get("port"):
+            return data
+        time.sleep(0.1)
+    return None
+
+
+def python_argv(module: str) -> List[str]:
+    return [sys.executable, "-m", module]

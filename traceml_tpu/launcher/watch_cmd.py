@@ -1,0 +1,71 @@
+"""``traceml-tpu watch`` — live text view over a session's SQLite DB.
+
+The full Rich dashboard lives in the CLI display driver; watch is the
+detached flavor: it polls ``telemetry.sqlite`` read-only and redraws a
+compact status (reference: `traceml watch`, launcher/cli.py).
+"""
+
+from __future__ import annotations
+
+import time
+from pathlib import Path
+
+from traceml_tpu.utils.atomic_io import read_json
+
+
+def _snapshot(session_dir: Path) -> str:
+    from traceml_tpu.reporting import loaders
+    from traceml_tpu.diagnostics.step_time.api import diagnose_rank_rows
+    from traceml_tpu.utils.formatting import fmt_ms
+
+    db = session_dir / "telemetry.sqlite"
+    lines = [f"session: {session_dir.name}"]
+    manifest = read_json(session_dir / "manifest.json") or {}
+    lines.append(
+        f"status: {manifest.get('status', '?')}  "
+        f"telemetry: {manifest.get('telemetry_status', '?')}"
+    )
+    if not db.exists():
+        lines.append("waiting for telemetry…")
+        return "\n".join(lines)
+    try:
+        rank_rows = loaders.load_step_time_rows(db, max_steps_per_rank=120)
+    except Exception as exc:
+        lines.append(f"(db busy: {exc})")
+        return "\n".join(lines)
+    if rank_rows:
+        from traceml_tpu.utils.step_time_window import build_step_time_window
+
+        w = build_step_time_window(rank_rows, max_steps=120)
+        if w:
+            step = w.metric("step_time")
+            lines.append(
+                f"steps {w.steps[0]}–{w.steps[-1]} ({w.clock} clock)  "
+                f"median {fmt_ms(step.median_ms)}  worst {fmt_ms(step.worst_ms)} "
+                f"(rank {step.worst_rank})"
+            )
+            result = diagnose_rank_rows(rank_rows, mode="live")
+            d = result.diagnosis
+            lines.append(f"diagnosis: [{d.severity}] {d.kind} — {d.summary}")
+    else:
+        lines.append("no step telemetry yet")
+    return "\n".join(lines)
+
+
+def run_watch(session_dir: Path, interval: float = 1.0) -> int:
+    session_dir = Path(session_dir)
+    if not session_dir.exists():
+        print(f"no session at {session_dir}")
+        return 1
+    try:
+        while True:
+            print("\x1b[2J\x1b[H" + _snapshot(session_dir), flush=True)
+            manifest = read_json(session_dir / "manifest.json") or {}
+            if manifest.get("status") in ("completed", "failed"):
+                summary = session_dir / "final_summary.txt"
+                if summary.exists():
+                    print("\n" + summary.read_text())
+                return 0
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        return 0

@@ -1,0 +1,27 @@
+"""Compare significance thresholds
+(reference: src/traceml_ai/reporting/compare/policy.py:55-80 — the
+conservative significance policy: small deltas are noise, not verdicts).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+MiB = 1024 * 1024
+GiB = 1024 * MiB
+
+
+@dataclasses.dataclass(frozen=True)
+class ComparePolicy:
+    # step average: minor / major relative change
+    step_avg_minor: float = 0.03
+    step_avg_major: float = 0.08
+    # phase share shift in percentage points
+    phase_shift_minor_pp: float = 0.75
+    phase_shift_major_pp: float = 2.0
+    # memory deltas
+    memory_minor_bytes: int = 256 * MiB
+    memory_major_bytes: int = 1 * GiB
+
+
+DEFAULT_POLICY = ComparePolicy()

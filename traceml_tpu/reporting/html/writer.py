@@ -1,0 +1,155 @@
+"""Dependency-free self-contained HTML summary
+(reference: src/traceml_ai/reporting/html/ — no JS frameworks, inline
+SVG charts, one file that opens anywhere).
+"""
+
+from __future__ import annotations
+
+import html
+from pathlib import Path
+from typing import Any, Dict, List
+
+from traceml_tpu.utils.atomic_io import atomic_write_text
+from traceml_tpu.utils.formatting import fmt_bytes, fmt_ms
+
+_SEV_COLOR = {"critical": "#c0392b", "warning": "#e67e22", "info": "#2d7dd2"}
+
+_CSS = """
+body{font-family:system-ui,-apple-system,sans-serif;margin:2rem auto;
+     max-width:960px;color:#1a1a2e;background:#fafafa;padding:0 1rem}
+h1{font-size:1.4rem} h2{font-size:1.1rem;margin-top:2rem;
+   border-bottom:1px solid #ddd;padding-bottom:.3rem}
+.verdict{border-radius:8px;padding:1rem 1.25rem;color:#fff;margin:1rem 0}
+.verdict small{opacity:.85}
+table{border-collapse:collapse;width:100%;font-size:.9rem}
+th,td{text-align:left;padding:.35rem .6rem;border-bottom:1px solid #eee}
+th{background:#f0f0f5;font-weight:600}
+.bar{height:18px;border-radius:3px;display:inline-block;vertical-align:middle}
+.muted{color:#777;font-size:.85rem}
+code{background:#eee;padding:.05rem .3rem;border-radius:3px}
+"""
+
+_PHASE_COLORS = {
+    "input": "#e74c3c",
+    "h2d": "#e67e22",
+    "forward": "#2d7dd2",
+    "backward": "#2255a4",
+    "optimizer": "#7d3dd2",
+    "compute": "#2d7dd2",
+    "compile": "#f1c40f",
+    "collective": "#16a085",
+    "residual": "#95a5a6",
+}
+
+
+def _esc(x: Any) -> str:
+    return html.escape(str(x))
+
+
+def _phase_bar(phases: Dict[str, Any]) -> str:
+    """One stacked horizontal share bar (inline SVG-ish via divs)."""
+    parts: List[str] = []
+    total = 0.0
+    for key, info in phases.items():
+        if key == "step_time":
+            continue
+        share = info.get("share_of_step")
+        if not share or share <= 0:
+            continue
+        share = min(share, 1.0 - total)
+        total += share
+        color = _PHASE_COLORS.get(key, "#888")
+        parts.append(
+            f'<span class="bar" title="{_esc(key)}: {share * 100:.1f}%" '
+            f'style="width:{share * 100:.2f}%;background:{color}"></span>'
+        )
+    legend = " ".join(
+        f'<span class="muted"><span class="bar" style="width:10px;'
+        f'background:{_PHASE_COLORS.get(k, "#888")}"></span> {_esc(k)}</span>'
+        for k in phases
+        if k != "step_time"
+    )
+    return (
+        f'<div style="width:100%;background:#eee;border-radius:3px">{"".join(parts)}</div>'
+        f"<div>{legend}</div>"
+    )
+
+
+def render_html_summary(payload: Dict[str, Any]) -> str:
+    meta = payload.get("meta") or {}
+    primary = payload.get("primary_diagnosis") or {}
+    color = _SEV_COLOR.get(primary.get("severity", "info"), "#2d7dd2")
+    out = [
+        "<!doctype html><html><head><meta charset='utf-8'>",
+        f"<title>TraceML-TPU — {_esc(meta.get('session_id', 'summary'))}</title>",
+        f"<style>{_CSS}</style></head><body>",
+        "<h1>TraceML-TPU — final training summary</h1>",
+        f"<p class='muted'>session <code>{_esc(meta.get('session_id'))}</code>"
+        f" · mode {_esc((meta.get('topology') or {}).get('mode'))}"
+        f" · world size {_esc((meta.get('topology') or {}).get('world_size'))}</p>",
+        f"<div class='verdict' style='background:{color}'>"
+        f"<strong>{_esc(primary.get('kind'))}</strong>"
+        f" <small>[{_esc(primary.get('severity'))}]</small><br>"
+        f"{_esc(primary.get('summary', ''))}"
+        + (
+            f"<br><small>→ {_esc(primary.get('action'))}</small>"
+            if primary.get("action")
+            else ""
+        )
+        + "</div>",
+    ]
+
+    st = (payload.get("sections") or {}).get("step_time") or {}
+    g = st.get("global") or {}
+    phases = g.get("phases") or {}
+    if phases:
+        out.append("<h2>Step time</h2>")
+        out.append(
+            f"<p class='muted'>{_esc(g.get('n_steps'))} steps, "
+            f"{_esc(g.get('clock'))} clock</p>"
+        )
+        out.append(_phase_bar(phases))
+        out.append(
+            "<table><tr><th>phase</th><th>median</th><th>share</th>"
+            "<th>worst rank</th><th>skew</th></tr>"
+        )
+        for key, info in phases.items():
+            share = info.get("share_of_step")
+            out.append(
+                f"<tr><td>{_esc(key)}</td><td>{fmt_ms(info.get('median_ms'))}</td>"
+                f"<td>{'' if share is None else f'{share * 100:.1f}%'}</td>"
+                f"<td>{_esc(info.get('worst_rank'))}</td>"
+                f"<td>{(info.get('skew_pct') or 0) * 100:.1f}%</td></tr>"
+            )
+        out.append("</table>")
+
+    sm = (payload.get("sections") or {}).get("step_memory") or {}
+    per_rank = (sm.get("global") or {}).get("per_rank") or {}
+    if per_rank:
+        out.append("<h2>Device memory</h2><table><tr><th>rank</th>"
+                   "<th>current</th><th>peak</th><th>limit</th></tr>")
+        for rank, info in sorted(per_rank.items(), key=lambda kv: int(kv[0])):
+            out.append(
+                f"<tr><td>{_esc(rank)}</td>"
+                f"<td>{fmt_bytes(info.get('current_bytes'))}</td>"
+                f"<td>{fmt_bytes(info.get('step_peak_bytes'))}</td>"
+                f"<td>{fmt_bytes(info.get('limit_bytes'))}</td></tr>"
+            )
+        out.append("</table>")
+
+    out.append("<h2>All findings</h2><table><tr><th>domain</th><th>kind</th>"
+               "<th>severity</th><th>summary</th></tr>")
+    for key, sec in (payload.get("sections") or {}).items():
+        for issue in sec.get("issues") or []:
+            out.append(
+                f"<tr><td>{_esc(key)}</td><td>{_esc(issue.get('kind'))}</td>"
+                f"<td style='color:{_SEV_COLOR.get(issue.get('severity'), '#333')}'>"
+                f"{_esc(issue.get('severity'))}</td>"
+                f"<td>{_esc(issue.get('summary'))}</td></tr>"
+            )
+    out.append("</table></body></html>")
+    return "".join(out)
+
+
+def write_html_summary(payload: Dict[str, Any], path: Path) -> None:
+    atomic_write_text(path, render_html_summary(payload))

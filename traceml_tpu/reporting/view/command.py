@@ -1,0 +1,34 @@
+"""``traceml-tpu view`` (reference: reporting/view/command.py:41)."""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+from traceml_tpu.reporting.final import render_text_summary
+from traceml_tpu.utils.atomic_io import read_json
+
+
+def _resolve_summary_path(path: Path) -> Path:
+    path = Path(path)
+    if path.is_dir():
+        return path / "final_summary.json"
+    return path
+
+
+def run_view(path: Path, fmt: str = "text") -> int:
+    target = _resolve_summary_path(path)
+    data = read_json(target)
+    if data is None:
+        print(f"no readable summary at {target}")
+        return 1
+    if fmt == "json":
+        print(json.dumps(data, indent=2))
+        return 0
+    # prefer the stored text artifact; else re-render from JSON
+    txt = target.with_suffix(".txt")
+    if txt.exists():
+        print(txt.read_text())
+    else:
+        print(render_text_summary(data))
+    return 0
