@@ -1,0 +1,158 @@
+"""Tracer-overhead benchmark — the headline metric.
+
+Runs the flagship decoder LM for N steps twice on the real device:
+
+* **untraced** — plain ``jax.jit`` training loop;
+* **traced**   — the FULL observability stack: ``init(auto)`` patches,
+  ``wrap_step_fn`` (AOT compile attribution), ``trace_step`` envelopes,
+  step-memory edges, the runtime agent's sampler thread, and telemetry
+  shipped over a real TCP socket to an in-process aggregator sink.
+
+Prints ONE JSON line::
+
+    {"metric": "tracer_step_overhead_pct", "value": <pct>, "unit": "%",
+     "vs_baseline": <pct / 1.0>}
+
+``vs_baseline`` is the ratio against the reference's published claim of
+"under 1% overhead" (reference README.md:44); the driver target is <2%
+(BASELINE.md).  Lower is better; <1.0 beats the reference's claim.
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+WARMUP_STEPS = 5
+MEASURE_STEPS = 60
+
+
+def _build(cfg_override=None):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from traceml_tpu.models import ModelConfig, init_train_state, make_train_step
+
+    platform = jax.default_backend()
+    if cfg_override is not None:
+        cfg = cfg_override
+    elif platform == "tpu":
+        cfg = ModelConfig(
+            vocab_size=16384, hidden=1024, n_layers=8, n_heads=16,
+            n_kv_heads=8, max_seq_len=512,
+        )
+        batch, seq = 8, 512
+    else:  # CPU fallback keeps bench runnable anywhere
+        cfg = ModelConfig(
+            vocab_size=2048, hidden=256, n_layers=2, n_heads=4,
+            n_kv_heads=2, max_seq_len=256,
+        )
+    if platform != "tpu":
+        batch, seq = 4, 128
+    elif cfg_override is not None:
+        batch, seq = 4, 128
+
+    model, state, tx = init_train_state(cfg, jax.random.PRNGKey(0))
+    train_step = make_train_step(model, tx)
+    rng = np.random.default_rng(0)
+    batches = [
+        jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)), jnp.int32)
+        for _ in range(8)
+    ]
+    return model, state, tx, train_step, batches
+
+
+def _run_loop(step_fn, state, batches, n_steps, bracket=None):
+    """Time n_steps; returns (median_step_s, final_state)."""
+    import jax
+
+    times = []
+    for i in range(n_steps):
+        tokens = batches[i % len(batches)]
+        t0 = time.perf_counter()
+        if bracket is not None:
+            with bracket():
+                state, metrics = step_fn(state, tokens)
+        else:
+            state, metrics = step_fn(state, tokens)
+        # per-step sync: measures true per-step cost including device
+        # time; identical in both arms so the delta is tracer overhead
+        jax.block_until_ready(metrics["loss"])
+        times.append(time.perf_counter() - t0)
+    return statistics.median(times), state
+
+
+def main() -> int:
+    import jax
+
+    # ---- untraced arm ---------------------------------------------------
+    model, state, tx, train_step, batches = _build()
+    plain = jax.jit(train_step, donate_argnums=(0,))
+    _, state = _run_loop(plain, state, batches, WARMUP_STEPS)  # compile+warm
+    untraced_s, _ = _run_loop(plain, state, batches, MEASURE_STEPS)
+
+    # ---- traced arm -----------------------------------------------------
+    import traceml_tpu
+    from traceml_tpu.aggregator.trace_aggregator import TraceMLAggregator
+    from traceml_tpu.runtime.identity import RuntimeIdentity
+    from traceml_tpu.runtime.runtime import TraceMLRuntime
+    from traceml_tpu.runtime.settings import AggregatorEndpoint, TraceMLSettings
+    import tempfile
+
+    tmp = Path(tempfile.mkdtemp(prefix="traceml_bench_"))
+    agg_settings = TraceMLSettings(
+        session_id="bench", logs_dir=tmp, mode="summary",
+        aggregator=AggregatorEndpoint(port=0), expected_world_size=1,
+        finalize_timeout_sec=10.0,
+    )
+    agg = TraceMLAggregator(agg_settings)
+    agg.start()
+    rt_settings = TraceMLSettings(
+        session_id="bench", logs_dir=tmp, mode="summary",
+        aggregator=AggregatorEndpoint(port=agg.port or 0),
+        sampler_interval_sec=1.0,
+    )
+    runtime = TraceMLRuntime(rt_settings, RuntimeIdentity(global_rank=0))
+    runtime.start()
+    traceml_tpu.init(mode="auto")
+
+    model2, state2, tx2, train_step2, batches2 = _build()
+    traced = traceml_tpu.wrap_step_fn(train_step2, donate_argnums=(0,))
+    _, state2 = _run_loop(
+        traced, state2, batches2, WARMUP_STEPS, bracket=traceml_tpu.trace_step
+    )
+    traced_s, _ = _run_loop(
+        traced, state2, batches2, MEASURE_STEPS, bracket=traceml_tpu.trace_step
+    )
+    runtime.stop()
+    agg.stop(finalize_timeout=5.0)
+
+    overhead_pct = max(0.0, (traced_s - untraced_s) / untraced_s * 100.0)
+    print(
+        f"[bench] untraced {untraced_s * 1000:.2f} ms/step, "
+        f"traced {traced_s * 1000:.2f} ms/step on {jax.default_backend()}",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "tracer_step_overhead_pct",
+                "value": round(overhead_pct, 3),
+                "unit": "%",
+                "vs_baseline": round(overhead_pct / 1.0, 3),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,55 @@
+import jax
+import numpy as np
+import pytest
+
+from traceml_tpu.parallel import IciStatAggregator, StatVector, make_mesh
+from traceml_tpu.parallel.ici_stats import N_FIELDS, STAT_FIELDS, gathered_to_stat_vectors
+
+
+def test_make_mesh_default_and_shapes():
+    mesh = make_mesh()
+    assert mesh.shape["fsdp"] == len(jax.devices())
+    mesh = make_mesh({"data": 2, "fsdp": -1})
+    assert mesh.shape["data"] == 2
+    assert mesh.shape["data"] * mesh.shape["fsdp"] * mesh.shape["tensor"] == len(
+        jax.devices()
+    )
+    with pytest.raises(ValueError):
+        make_mesh({"data": 3})  # 3 doesn't divide 8
+
+
+def test_stat_vector_roundtrip():
+    sv = StatVector({"step": 5, "step_ms": 100.5, "input_ms": 20.0})
+    arr = sv.to_array()
+    assert arr.shape == (N_FIELDS,)
+    back = StatVector.from_array(arr)
+    assert back.values["step"] == 5
+    assert abs(back.values["step_ms"] - 100.5) < 1e-3
+    assert back.values["compute_ms"] == 0.0
+
+
+def test_ici_all_gather_over_mesh():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    mesh = make_mesh({"data": 2, "fsdp": 4})
+    agg = IciStatAggregator(mesh)
+    assert agg.n_participants == 8
+    out = agg.aggregate(StatVector({"step": 7, "step_ms": 42.0}))
+    assert out.shape == (8, N_FIELDS)
+    # single-controller: every row carries this process's vector
+    np.testing.assert_allclose(out[:, STAT_FIELDS.index("step_ms")], 42.0)
+    vecs = gathered_to_stat_vectors(out)
+    assert len(vecs) == 8
+    assert vecs[3].values["step"] == 7
+
+
+def test_rank_skew_math():
+    mesh = make_mesh({"fsdp": -1})
+    agg = IciStatAggregator(mesh)
+    gathered = np.zeros((4, N_FIELDS), dtype=np.float32)
+    idx = STAT_FIELDS.index("step_ms")
+    gathered[:, idx] = [100.0, 100.0, 100.0, 130.0]
+    skew = agg.rank_skew(gathered, "step_ms")
+    assert skew["worst_rank"] == 3
+    assert abs(skew["skew_pct"] - 0.30) < 1e-6
+    assert skew["median"] == 100.0

@@ -51,27 +51,31 @@ def test_compile_then_hit_emits_phases(fresh_state):
     assert STEP_TIME in names0
     assert COMPILE_TIME not in names1
     assert COMPUTE_TIME in names1
-    assert step.compile_count == 1
+    assert step.compile_count >= 1
     comp = next(e for e in batches[0].events if e.name == COMPILE_TIME)
-    assert comp.meta["lower_ms"] > 0
     assert comp.meta["backend_compile_ms"] > 0
+    assert "fun_name" in comp.meta
 
 
 def test_recompile_on_new_shape(fresh_state):
-    step = wrap_step_fn(lambda w, x: w + x.sum())
-    w = jnp.ones((4, 4))
+    step = wrap_step_fn(lambda w, x: (w @ w) * x.sum())
+    w = jnp.ones((64, 64))
+    xa = jnp.ones((2, 4))
+    xb = jnp.ones((3, 4))
     with trace_step():
-        step(w, jnp.ones((2, 4)))
+        step(w, xa)
     with trace_step():
-        step(w, jnp.ones((3, 4)))  # new shape → recompile
+        step(w, xb)  # new shape → recompile
     with trace_step():
-        step(w, jnp.ones((2, 4)))  # cache hit
-    assert step.compile_count == 2
+        step(w, xa)  # cache hit (and xa/xb ops already compiled)
     batches = GLOBAL_STEP_QUEUE.drain()
-    compiles = [
-        e.name for b in batches for e in b.events if e.name == COMPILE_TIME
-    ]
-    assert len(compiles) == 2
+
+    def compiles(b):
+        return [e for e in b.events if e.name == COMPILE_TIME]
+
+    assert compiles(batches[0]), "first call must emit a compile event"
+    assert compiles(batches[1]), "new input shape must emit a compile event"
+    assert not compiles(batches[2]), "cache hit must not emit compile events"
 
 
 def test_markers_resolve_and_device_times_appear(fresh_state):
@@ -92,38 +96,28 @@ def test_markers_resolve_and_device_times_appear(fresh_state):
 
 
 def test_prejitted_fn_accepted(fresh_state):
-    jitted = jax.jit(lambda x: x * 2)
+    # a fresh heavy shape so its compile clears the emission threshold
+    jitted = jax.jit(lambda x: jnp.tanh(x @ x).sum() * 2)
     step = wrap_step_fn(jitted)
     with trace_step():
-        out = step(jnp.ones((4,)))
-    assert float(out[0]) == 2.0
-    assert step.compile_count == 1
+        out = step(jnp.ones((96, 96)))
+    assert float(out) != 0.0
+    batch = GLOBAL_STEP_QUEUE.drain()[0]
+    names = [e.name for e in batch.events]
+    assert COMPUTE_TIME in names
+    # pre-jitted fns get compile attribution through the listener too
+    assert COMPILE_TIME in names
 
 
-def test_aot_failure_falls_back(fresh_state):
+def test_wrapper_survives_broken_compile_tracker(fresh_state, monkeypatch):
+    import traceml_tpu.instrumentation.compile_tracker as ct
+
+    monkeypatch.setattr(ct, "install_compile_tracker", lambda: False)
     step = wrap_step_fn(lambda x: x + 1)
     x = jnp.ones((4,))
-
-    class BrokenLower:
-        def __init__(self, jfn):
-            self._jfn = jfn
-
-        def lower(self, *a, **k):
-            raise RuntimeError("AOT unavailable on this runtime")
-
-        def __call__(self, *a, **k):
-            return self._jfn(*a, **k)
-
-    step._jfn = BrokenLower(jax.jit(lambda x: x + 1))
     with trace_step():
         out = step(x)
-    assert step._aot_ok is False
-    assert step.compile_count == 0
     assert float(out[0]) == 2.0
-    # subsequent calls go straight through the plain path
-    with trace_step():
-        out2 = step(x)
-    assert float(out2[0]) == 2.0
 
 
 def test_donate_argnums_passthrough(fresh_state):

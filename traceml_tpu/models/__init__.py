@@ -1,0 +1,16 @@
+"""Reference workloads: the flagship decoder LM + a tiny MLP.
+
+These exist so the framework ships with realistic, shardable TPU
+training jobs for its bench, demos, fault-injection scenarios, and the
+driver's compile checks — the observability stack itself is
+workload-agnostic.
+"""
+
+from traceml_tpu.models.transformer import (  # noqa: F401
+    DecoderLM,
+    ModelConfig,
+    make_train_step,
+    init_train_state,
+    param_shardings,
+)
+from traceml_tpu.models.mlp import TinyMLP  # noqa: F401

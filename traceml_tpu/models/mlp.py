@@ -1,0 +1,44 @@
+"""Tiny MLP for demos/smoke tests (reference scenario model:
+src/dev/demo uses a small DDP MLP)."""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class TinyMLP(nn.Module):
+    hidden: int = 128
+    depth: int = 2
+    out: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        for _ in range(self.depth):
+            x = nn.tanh(nn.Dense(self.hidden)(x))
+        return nn.Dense(self.out)(x)
+
+
+def make_mlp_train_step(model: TinyMLP, learning_rate: float = 1e-3):
+    import optax
+
+    tx = optax.adam(learning_rate)
+
+    def init(rng, sample_x) -> Tuple[Any, Any]:
+        params = model.init(rng, sample_x)["params"]
+        return params, tx.init(params)
+
+    def train_step(params, opt_state, x, y):
+        def loss_fn(p):
+            pred = model.apply({"params": p}, x)
+            return jnp.mean((pred - y) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return init, train_step

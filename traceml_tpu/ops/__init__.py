@@ -1,0 +1,1 @@
+"""Hot-path ops: jnp reference implementations with pallas kernel slots."""

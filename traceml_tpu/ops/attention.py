@@ -1,0 +1,40 @@
+"""Causal attention: jnp reference path + optional pallas flash kernel.
+
+The reference path is a single einsum-softmax-einsum chain that XLA
+fuses and MXU-tiles well at the model sizes the demos/bench use.  The
+pallas flash-attention kernel (ops/pallas_attention.py) takes over for
+long sequences where the S×S score matrix would blow HBM; selection is
+automatic and fail-open.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_PALLAS_MIN_SEQ = 1024  # below this the fused jnp path wins
+
+
+def causal_attention_reference(
+    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray
+) -> jnp.ndarray:
+    """q,k,v: (B, S, H, D) → (B, S, H, D); causal masked softmax(QK^T)V."""
+    B, S, H, D = q.shape
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    mask = jnp.tril(jnp.ones((S, S), dtype=bool))
+    scores = jnp.where(mask[None, None, :, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def causal_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:
+    S = q.shape[1]
+    if S >= _PALLAS_MIN_SEQ:
+        try:
+            from traceml_tpu.ops.pallas_attention import flash_attention
+
+            return flash_attention(q, k, v)
+        except Exception:
+            pass  # fail open to the reference path
+    return causal_attention_reference(q, k, v)

@@ -1,0 +1,5 @@
+"""Mesh/sharding utilities + the ICI stat-aggregation path
+(the build's analogue of a collective backend — SURVEY.md §2.5)."""
+
+from traceml_tpu.parallel.mesh import make_mesh, batch_sharding  # noqa: F401
+from traceml_tpu.parallel.ici_stats import IciStatAggregator, StatVector  # noqa: F401

@@ -1,0 +1,79 @@
+"""Device-mesh construction helpers.
+
+The observability framework is workload-agnostic, but its demos, bench
+and the flagship model need a consistent way to build a
+``jax.sharding.Mesh`` over whatever devices exist (one real TPU chip, a
+v4-8 slice, or 8 virtual CPU devices in CI) and to shard batches/params
+over it.  Axis convention follows the scaling-book recipe:
+
+* ``data``   — pure data parallelism (batch dim)
+* ``fsdp``   — parameter/optimizer sharding (ZeRO-ish), also batch
+* ``tensor`` — tensor parallelism (heads / ffn dims)
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+AXES = ("data", "fsdp", "tensor")
+
+
+def make_mesh(
+    shape: Optional[Dict[str, int]] = None,
+    devices: Optional[Sequence] = None,
+):
+    """Build a Mesh; ``shape`` maps axis name → size (missing axes get 1;
+    one axis may be -1 to absorb the remaining devices)."""
+    import jax
+    from jax.sharding import Mesh
+
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    shape = dict(shape or {})
+    sizes = []
+    wild = None
+    for ax in AXES:
+        v = int(shape.get(ax, 1))
+        if v == -1:
+            wild = ax
+            sizes.append(-1)
+        else:
+            sizes.append(v)
+    fixed = int(np.prod([s for s in sizes if s != -1]))
+    if wild is not None:
+        if n % fixed:
+            raise ValueError(f"{n} devices not divisible by fixed axes {fixed}")
+        sizes[sizes.index(-1)] = n // fixed
+    total = int(np.prod(sizes))
+    if total != n:
+        # default: put everything on the fsdp axis
+        if shape:
+            raise ValueError(
+                f"mesh shape {dict(zip(AXES, sizes))} needs {total} devices, "
+                f"have {n}"
+            )
+        sizes = [1, n, 1]
+    arr = np.array(devices).reshape(sizes)
+    return Mesh(arr, AXES)
+
+
+def batch_sharding(mesh) -> "object":
+    """Batch arrays are sharded over the data-parallel axes."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P(("data", "fsdp")))
+
+
+def replicated(mesh) -> "object":
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P())
+
+
+def local_batch_size(global_batch: int, mesh) -> Tuple[int, int]:
+    dp = mesh.shape["data"] * mesh.shape["fsdp"]
+    if global_batch % dp:
+        raise ValueError(f"global batch {global_batch} not divisible by dp={dp}")
+    return global_batch // dp, dp

@@ -64,6 +64,17 @@ def init(mode: str = "auto", **kwargs) -> TraceMLInitConfig:
 
     cfg = TraceMLInitConfig(mode=mode, **kwargs)
     applied = []
+    # process-wide compile attribution (cheap listener; all modes —
+    # compile visibility is core telemetry, not a patch)
+    try:
+        from traceml_tpu.instrumentation.compile_tracker import (
+            install_compile_tracker,
+        )
+
+        if install_compile_tracker():
+            applied.append("compile_tracker")
+    except Exception as exc:
+        get_error_log().warning("compile tracker failed", exc)
     if mode != "manual":
         # per-patch kwargs are honored in every non-manual mode ("auto"
         # defaults them all True; passing patch_x=False narrows it).

@@ -42,6 +42,7 @@ class TraceState:
         self.initialized = False
         self.patch_mode: Optional[str] = None
         self.active_step_event: Optional[TimeEvent] = None
+        self.compile_events_seen = 0  # bumped by the compile tracker
         # wall-clock of the previous trace_step exit: successive steps
         # tile the wall clock, so inter-step host time (input fetch in the
         # idiomatic `for batch in loader: with trace_step():` pattern) is

@@ -1,62 +1,31 @@
-"""JAX step-function wrapper with first-class compile attribution.
+"""JAX step-function wrapper.
 
-The genuinely TPU-native piece of the SDK (no reference equivalent —
-the reference times ``forward``/``backward`` calls it can patch; a JAX
-training step is ONE jitted function, and its dominant anomaly source is
-**recompilation**, which the reference design would misattribute as a
-giant straggler; SURVEY.md §7 "hard parts").
+Brackets each dispatch of the training step in a ``compute_time`` region
+whose device marker is the smallest output leaf — the readiness probe
+that yields the fused fwd+bwd+opt device duration without ever blocking
+(see utils/timing.py).  Dispatch goes through jit's C++ fast path
+untouched.
 
-``wrap_step_fn`` routes every distinct input signature through the AOT
-API (``jit(f).lower(...).compile()``) so compile time is *measured
-exactly* and emitted as a first-class ``compile_time`` phase with a
-lowering/backend split, instead of being folded into the first step's
-wall time.  Cache hits dispatch the pre-compiled executable directly.
+Compile attribution is handled process-wide by
+instrumentation/compile_tracker.py (a ``jax.monitoring`` listener that
+emits exact ``compile_time`` events with a lowering/backend split);
+``wrap_step_fn`` just makes sure the tracker is installed.  An earlier
+design routed calls through AOT ``lower()/compile()`` objects for the
+same information — scrapped because ``Compiled.call`` re-flattens the
+arg pytree in Python (~5 ms/step on a 65-leaf train state) and misses
+compiles outside the wrapped function.
 
-Dispatch is wrapped in a ``compute_time`` region whose device marker is
-the smallest output leaf — the readiness probe that gives the fused
-fwd+bwd+opt device duration without ever blocking (see utils/timing.py).
-
-Fail-open: any AOT-path error permanently downgrades that wrapper to
-calling the plain (possibly jitted) function — training never breaks
-because tracing misbehaved.
+Fail-open: the wrapper never raises on its own behalf; user errors
+propagate untouched.
 """
 
 from __future__ import annotations
 
-import threading
 from typing import Any, Callable, Dict, Optional, Tuple
 
 from traceml_tpu.sdk.state import TraceState, get_state
-from traceml_tpu.utils.error_log import get_error_log
 from traceml_tpu.utils.marker_resolver import get_marker_resolver
-from traceml_tpu.utils.timing import (
-    COMPILE_TIME,
-    COMPUTE_TIME,
-    TimeEvent,
-    _now,
-    timed_region,
-)
-
-
-def _abstract_signature(args: Tuple, kwargs: Dict) -> Optional[Tuple]:
-    """Hashable signature of the call: treedef + per-leaf (shape, dtype,
-    sharding).  None when unhashable (→ AOT cache unusable for the call)."""
-    try:
-        import jax
-
-        leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
-        sig = []
-        for leaf in leaves:
-            if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
-                shard = getattr(leaf, "sharding", None)
-                sig.append((tuple(leaf.shape), str(leaf.dtype), shard))
-            else:
-                sig.append(("__static__", leaf))
-        key = (treedef, tuple(sig))
-        hash(key)
-        return key
-    except Exception:
-        return None
+from traceml_tpu.utils.timing import COMPUTE_TIME, timed_region
 
 
 class WrappedStepFn:
@@ -72,10 +41,6 @@ class WrappedStepFn:
     ) -> None:
         self._state = state or get_state()
         self._phase = phase_name
-        self._lock = threading.Lock()
-        self._compiled: Dict[Tuple, Any] = {}
-        self._aot_ok = True
-        self.compile_count = 0
 
         if hasattr(fn, "lower") and callable(getattr(fn, "lower")):
             # already a jax.jit-wrapped callable
@@ -86,86 +51,26 @@ class WrappedStepFn:
             self._jfn = jax.jit(fn, **(jit_kwargs or {}))
         self.__wrapped__ = fn
 
-    @staticmethod
-    def _dispatch_compat_error(exc: Exception) -> bool:
-        """True for dispatch-time argument/executable mismatch errors —
-        the only case where re-dispatch is safe (buffers not consumed)."""
-        msg = str(exc).lower()
-        return any(
-            s in msg
-            for s in ("incompatible", "layout", "sharding", "donat", "argument")
+        from traceml_tpu.instrumentation.compile_tracker import (
+            install_compile_tracker,
         )
 
-    # -- compile path --------------------------------------------------
-    def _compile_timed(self, key: Tuple, args: Tuple, kwargs: Dict) -> Any:
-        st = self._state
-        ev = TimeEvent(COMPILE_TIME, st.current_step)
-        t0 = _now()
-        lowered = self._jfn.lower(*args, **kwargs)
-        t1 = _now()
-        compiled = lowered.compile()
-        t2 = _now()
-        ev.close()
-        ev.meta = {
-            "lower_ms": (t1 - t0) * 1000.0,
-            "backend_compile_ms": (t2 - t1) * 1000.0,
-            "cache_size": len(self._compiled) + 1,
-        }
-        try:
-            st.buffer.add(ev)
-        except Exception:
-            pass
-        self.compile_count += 1
-        return compiled
+        install_compile_tracker()
+        # the listener always bumps the CURRENT global state, so the
+        # snapshot and the later read must both come from get_state()
+        self._compiles_at_start = get_state().compile_events_seen
+
+    @property
+    def compile_count(self) -> int:
+        """Process-wide compile events observed since this wrapper was
+        created (a superset of this function's own compiles)."""
+        return get_state().compile_events_seen - self._compiles_at_start
 
     def __call__(self, *args, **kwargs):
         st = self._state
-        target = None
-        if self._aot_ok:
-            key = _abstract_signature(args, kwargs)
-            if key is not None:
-                target = self._compiled.get(key)
-                if target is None:
-                    with self._lock:
-                        target = self._compiled.get(key)
-                        if target is None:
-                            try:
-                                target = self._compile_timed(key, args, kwargs)
-                                self._compiled[key] = target
-                            except Exception as exc:
-                                get_error_log().warning(
-                                    "AOT compile path failed; falling back to "
-                                    "plain jit dispatch for this step fn",
-                                    exc,
-                                )
-                                self._aot_ok = False
-                                target = None
-            # key is None → this call's signature is unhashable; use the
-            # plain path for THIS call only, AOT stays available.
-
         region = timed_region(self._phase, st.current_step, sink=st.buffer.add)
         with region as tr:
-            try:
-                if target is not None:
-                    out = target(*args, **kwargs)
-                else:
-                    out = self._jfn(*args, **kwargs)
-            except Exception as exc:
-                if target is not None and self._dispatch_compat_error(exc):
-                    # Executable rejected the call at dispatch time
-                    # (layout/sharding drift): inputs were not consumed,
-                    # so one retry through plain jit is safe; then stop
-                    # using AOT.  Genuine runtime errors (OOM, user bugs)
-                    # re-raise untouched — retrying would re-execute the
-                    # step and, with donated buffers, mask the real error.
-                    self._aot_ok = False
-                    get_error_log().warning(
-                        "AOT executable rejected call; retrying via plain jit",
-                        exc,
-                    )
-                    out = self._jfn(*args, **kwargs)
-                else:
-                    raise
+            out = self._jfn(*args, **kwargs)
             tr.mark(out)
             st.mark_step_outputs(out)
         ev = region.event

@@ -76,6 +76,12 @@ class LiveArraysBackend:
 
     Approximates allocated bytes (misses allocator overhead / temp
     buffers) but works on every backend, including CPU CI.
+
+    CRITICAL: uses only array METADATA (nbytes, sharding, device ids).
+    Touching ``shard.data`` marks buffers as externally referenced,
+    which defeats XLA's buffer reuse and was measured to DOUBLE step
+    time on the CPU backend — the observer must not perturb the
+    allocator it observes.
     """
 
     name = "live_arrays"
@@ -87,13 +93,25 @@ class LiveArraysBackend:
         self._kinds = {d.id: str(d.device_kind) for d in jax.local_devices()}
 
     def sample(self) -> List[Dict[str, Any]]:
+        import math
+
         per_dev: Dict[int, int] = {}
         for arr in self._jax.live_arrays():
             try:
-                for shard in arr.addressable_shards:
-                    if shard.data is not None:
-                        did = shard.device.id
-                        per_dev[did] = per_dev.get(did, 0) + int(shard.data.nbytes)
+                sharding = arr.sharding
+                devices = list(sharding.device_set)
+                if not devices:
+                    continue
+                # true per-device shard size from METADATA: replicated
+                # arrays cost full nbytes on every device, sharded ones
+                # cost their shard — shard_shape computes both correctly
+                shard_shape = sharding.shard_shape(arr.shape)
+                per_shard = int(
+                    math.prod(shard_shape) * arr.dtype.itemsize
+                )
+                for d in devices:
+                    if d.process_index == self._jax.process_index():
+                        per_dev[d.id] = per_dev.get(d.id, 0) + per_shard
             except Exception:
                 continue
         return [
@@ -206,15 +224,24 @@ class StepMemoryTracker:
     def __init__(self, backend: Optional[MemoryBackend] = None) -> None:
         self._backend = backend or detect_backend()
         self._step_start: Dict[int, Dict[str, Any]] = {}
+        self._have_edge = False
 
     @property
     def backend_name(self) -> str:
         return getattr(self._backend, "name", "unknown")
 
     def reset(self, step: int) -> None:
-        """Step-start edge (reference: reset_peak_memory_stats analogue)."""
+        """Step-start edge (reference: reset_peak_memory_stats analogue).
+
+        In a contiguous step loop the previous step's EXIT sample is this
+        step's entry edge, so only the first step pays a sample here —
+        one backend sample per step, not two.
+        """
+        if self._have_edge:
+            return
         try:
             self._step_start = {row["device_id"]: row for row in self._backend.sample()}
+            self._have_edge = True
         except Exception as exc:
             get_error_log().warning("step memory reset failed", exc)
             self._step_start = {}
@@ -224,7 +251,8 @@ class StepMemoryTracker:
         rows: List[Dict[str, Any]] = []
         try:
             ts = time.time()
-            for row in self._backend.sample():
+            end_rows = self._backend.sample()
+            for row in end_rows:
                 start = self._step_start.get(row["device_id"], {})
                 step_peak = max(
                     int(row.get("current_bytes", 0)),
@@ -243,6 +271,9 @@ class StepMemoryTracker:
                 }
                 rows.append(out)
                 push_step_memory_row(out)
+            # this exit sample becomes the next step's entry edge
+            self._step_start = {r["device_id"]: r for r in end_rows}
+            self._have_edge = True
         except Exception as exc:
             get_error_log().warning("step memory record failed", exc)
         return rows
