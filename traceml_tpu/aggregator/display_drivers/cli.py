@@ -1,0 +1,59 @@
+"""Rich Live CLI display driver
+(reference: src/traceml_ai/aggregator/display_drivers/cli.py:55-295).
+
+Runs inside the aggregator process; each ``tick`` recomputes the live
+payload from the session SQLite and refreshes a Rich Live group.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from traceml_tpu.aggregator.display_drivers.base import BaseDisplayDriver
+from traceml_tpu.utils.error_log import get_error_log
+
+
+class CLIDisplayDriver(BaseDisplayDriver):
+    def __init__(self) -> None:
+        self._live = None
+        self._computer = None
+        self._session = ""
+
+    def start(self, context: Optional[Any] = None) -> None:
+        try:
+            from rich.console import Console
+            from rich.live import Live
+
+            from traceml_tpu.renderers.compute import LiveComputer
+
+            if context is not None:
+                self._computer = LiveComputer(context.db_path)
+                self._session = context.settings.session_id
+            self._live = Live(
+                console=Console(stderr=False),
+                refresh_per_second=4,
+                transient=False,
+            )
+            self._live.start()
+        except Exception as exc:
+            get_error_log().warning("cli display start failed", exc)
+            self._live = None
+
+    def tick(self, context: Optional[Any] = None) -> None:
+        if self._live is None or self._computer is None:
+            return
+        try:
+            from traceml_tpu.renderers.panels import dashboard
+
+            payload = self._computer.payload()
+            self._live.update(dashboard(payload, self._session))
+        except Exception as exc:
+            get_error_log().warning("cli display tick failed", exc)
+
+    def stop(self) -> None:
+        if self._live is not None:
+            try:
+                self._live.stop()
+            except Exception:
+                pass
+            self._live = None

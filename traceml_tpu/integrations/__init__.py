@@ -1,0 +1,1 @@
+"""Framework integrations (reference: src/traceml_ai/integrations/)."""

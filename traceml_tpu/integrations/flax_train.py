@@ -1,0 +1,77 @@
+"""Flax/Optax training-loop integration — the native JAX path
+(the BASELINE.json north star's "new Flax/Optax trace_step wrapping
+pjit training steps"; no reference equivalent since the reference is
+torch-only).
+
+Two styles:
+
+* ``traced_train_loop`` — hand the loop to us::
+
+      for state, metrics in traced_train_loop(train_step, state, batches):
+          ...
+
+* ``TraceMLFlaxHooks`` — keep your loop, call the hooks::
+
+      hooks = TraceMLFlaxHooks(train_step)
+      for batch in loader:
+          state, metrics = hooks.step(state, batch)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Iterator, Optional, Tuple
+
+from traceml_tpu.instrumentation.dataloader import wrap_dataloader
+from traceml_tpu.sdk.initial import init as traceml_init
+from traceml_tpu.sdk.instrumentation import trace_step
+from traceml_tpu.sdk.step_fn import WrappedStepFn, wrap_step_fn
+
+
+class TraceMLFlaxHooks:
+    def __init__(
+        self,
+        train_step: Callable,
+        *,
+        auto_init: bool = True,
+        donate_argnums: Tuple[int, ...] = (),
+        **jit_kwargs: Any,
+    ) -> None:
+        if auto_init:
+            traceml_init(mode="auto")
+        if isinstance(train_step, WrappedStepFn):
+            self._step = train_step
+        else:
+            self._step = wrap_step_fn(
+                train_step, donate_argnums=donate_argnums, **jit_kwargs
+            )
+
+    def step(self, *args: Any, **kwargs: Any):
+        with trace_step() as ts:
+            out = self._step(*args, **kwargs)
+            ts.mark(out)
+        return out
+
+
+def traced_train_loop(
+    train_step: Callable,
+    state: Any,
+    batches: Iterable[Any],
+    *,
+    max_steps: Optional[int] = None,
+    donate_argnums: Tuple[int, ...] = (0,),
+    to_device: bool = False,
+    **jit_kwargs: Any,
+) -> Iterator[Tuple[Any, Any]]:
+    """Drive a standard (state, batch) → (state, metrics) training loop
+    under full tracing; yields (state, metrics) per step."""
+    hooks = TraceMLFlaxHooks(
+        train_step, donate_argnums=donate_argnums, **jit_kwargs
+    )
+    loader = wrap_dataloader(batches, to_device=to_device)
+    n = 0
+    for batch in loader:
+        state, metrics = hooks.step(state, batch)
+        yield state, metrics
+        n += 1
+        if max_steps is not None and n >= max_steps:
+            return

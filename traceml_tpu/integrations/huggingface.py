@@ -1,0 +1,93 @@
+"""Hugging Face Trainer integration
+(reference: src/traceml_ai/integrations/huggingface.py:27-192).
+
+``TraceMLTrainerCallback`` is a pure bracket: ``on_step_begin`` opens a
+``trace_step``, ``on_step_end`` closes it.  Gradient-accumulation
+micro-batches fold into ONE traced step because the Trainer only fires
+step begin/end per optimizer step.  Self-healing: a leaked context
+(exception between callbacks) is closed before opening the next.
+
+Works with torch-CPU Trainers today and torch-xla TPU Trainers
+unchanged (the callback never touches device APIs — the patches and
+samplers do, through their own gated paths).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from traceml_tpu.sdk.initial import init as traceml_init
+from traceml_tpu.sdk.instrumentation import trace_step
+from traceml_tpu.utils.error_log import get_error_log
+
+try:  # transformers is optional at import time
+    from transformers import TrainerCallback  # type: ignore
+
+    _HAVE_TRANSFORMERS = True
+except Exception:  # pragma: no cover
+    TrainerCallback = object  # type: ignore
+    _HAVE_TRANSFORMERS = False
+
+
+class TraceMLTrainerCallback(TrainerCallback):  # type: ignore[misc]
+    """Attach to ``Trainer(callbacks=[TraceMLTrainerCallback()])``."""
+
+    def __init__(self, auto_init: bool = True) -> None:
+        self._ctx: Optional[trace_step] = None
+        self._auto_init = auto_init
+
+    # -- hooks ---------------------------------------------------------
+    def on_train_begin(self, args: Any = None, state: Any = None, control: Any = None, **kw: Any):
+        if self._auto_init:
+            try:
+                traceml_init(mode="auto")
+            except Exception as exc:
+                get_error_log().warning("hf callback init failed", exc)
+        return control
+
+    def on_step_begin(self, args: Any = None, state: Any = None, control: Any = None, **kw: Any):
+        try:
+            if self._ctx is not None:
+                # self-heal a leaked context (reference behavior)
+                self._ctx.__exit__(None, None, None)
+            self._ctx = trace_step()
+            self._ctx.__enter__()
+        except Exception as exc:
+            get_error_log().warning("hf on_step_begin failed", exc)
+            self._ctx = None
+        return control
+
+    def on_step_end(self, args: Any = None, state: Any = None, control: Any = None, **kw: Any):
+        try:
+            if self._ctx is not None:
+                self._ctx.__exit__(None, None, None)
+                self._ctx = None
+        except Exception as exc:
+            get_error_log().warning("hf on_step_end failed", exc)
+        return control
+
+    def on_train_end(self, args: Any = None, state: Any = None, control: Any = None, **kw: Any):
+        try:
+            if self._ctx is not None:
+                self._ctx.__exit__(None, None, None)
+                self._ctx = None
+        except Exception as exc:
+            get_error_log().warning("hf on_train_end failed", exc)
+        return control
+
+
+def TraceMLTrainer(*args: Any, **kwargs: Any):
+    """``Trainer`` subclass with the callback pre-installed
+    (reference: huggingface.py:155)."""
+    if not _HAVE_TRANSFORMERS:
+        raise ImportError("transformers is required for TraceMLTrainer")
+    from transformers import Trainer
+
+    class _TraceMLTrainer(Trainer):
+        def __init__(self, *a: Any, **kw: Any) -> None:
+            callbacks = list(kw.pop("callbacks", None) or [])
+            if not any(isinstance(c, TraceMLTrainerCallback) for c in callbacks):
+                callbacks.append(TraceMLTrainerCallback())
+            super().__init__(*a, callbacks=callbacks, **kw)
+
+    return _TraceMLTrainer(*args, **kwargs)

@@ -1,0 +1,82 @@
+"""PyTorch Lightning integration
+(reference: src/traceml_ai/integrations/lightning.py — a Callback that
+owns forward/backward timing because Lightning controls the loop).
+
+Gated: lightning / pytorch_lightning are not in this image; the callback
+is constructed dynamically against whichever base is importable
+(reference does the same dynamic multi-base dance, lightning.py:30-90).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from traceml_tpu.sdk.initial import init as traceml_init
+from traceml_tpu.sdk.instrumentation import trace_step
+from traceml_tpu.utils.error_log import get_error_log
+
+
+def _callback_bases():
+    bases = []
+    for mod in ("lightning.pytorch", "pytorch_lightning"):
+        try:
+            import importlib
+
+            m = importlib.import_module(mod)
+            bases.append(m.Callback)
+        except Exception:
+            continue
+    return tuple(dict.fromkeys(bases))
+
+
+def make_traceml_callback() -> Any:
+    """Build the callback class against the available Lightning base(s);
+    raises ImportError when no Lightning flavor is installed."""
+    bases = _callback_bases()
+    if not bases:
+        raise ImportError(
+            "neither `lightning` nor `pytorch_lightning` is installed"
+        )
+
+    class TraceMLCallback(*bases):  # type: ignore[misc]
+        def __init__(self, auto_init: bool = True) -> None:
+            super().__init__()
+            self._ctx: Optional[trace_step] = None
+            self._auto_init = auto_init
+
+        def on_fit_start(self, trainer: Any, pl_module: Any) -> None:
+            if self._auto_init:
+                try:
+                    traceml_init(mode="auto")
+                except Exception as exc:
+                    get_error_log().warning("lightning init failed", exc)
+
+        def on_train_batch_start(self, trainer: Any, pl_module: Any, batch: Any, batch_idx: int) -> None:
+            try:
+                if self._ctx is not None:
+                    self._ctx.__exit__(None, None, None)
+                self._ctx = trace_step()
+                self._ctx.__enter__()
+            except Exception as exc:
+                get_error_log().warning("lightning batch_start failed", exc)
+                self._ctx = None
+
+        def on_train_batch_end(self, trainer: Any, pl_module: Any, outputs: Any, batch: Any, batch_idx: int) -> None:
+            try:
+                if self._ctx is not None:
+                    self._ctx.__exit__(None, None, None)
+                    self._ctx = None
+            except Exception as exc:
+                get_error_log().warning("lightning batch_end failed", exc)
+
+        def on_train_end(self, trainer: Any, pl_module: Any) -> None:
+            if self._ctx is not None:
+                self._ctx.__exit__(None, None, None)
+                self._ctx = None
+
+    return TraceMLCallback
+
+
+def TraceMLCallback(*args: Any, **kwargs: Any) -> Any:
+    """Instantiate the Lightning callback (convenience factory)."""
+    return make_traceml_callback()(*args, **kwargs)

@@ -1,0 +1,1 @@
+"""Live view renderers (reference: src/traceml_ai/renderers/)."""

@@ -1,0 +1,84 @@
+"""Per-tick data computation for live views
+(reference pattern: renderers/<domain>/computer.py — SQLite → payload,
+cached per tick so multiple panels share one read).
+"""
+
+from __future__ import annotations
+
+import time
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+from traceml_tpu.diagnostics.step_time.api import diagnose_rank_rows
+from traceml_tpu.reporting import loaders
+from traceml_tpu.utils.step_time_window import build_step_time_window
+
+_CACHE_TTL = 0.4
+
+
+class LiveComputer:
+    """Reads the session SQLite and produces the per-domain payloads the
+    renderers consume; one read per tick (TTL-cached)."""
+
+    def __init__(self, db_path: Path, window_steps: int = 120) -> None:
+        self.db_path = Path(db_path)
+        self.window_steps = window_steps
+        self._cache: Dict[str, Any] = {}
+        self._cached_at = 0.0
+
+    def payload(self) -> Dict[str, Any]:
+        now = time.monotonic()
+        if now - self._cached_at < _CACHE_TTL and self._cache:
+            return self._cache
+        out: Dict[str, Any] = {"ts": time.time(), "db_exists": self.db_path.exists()}
+        if out["db_exists"]:
+            try:
+                rank_rows = loaders.load_step_time_rows(
+                    self.db_path, max_steps_per_rank=self.window_steps
+                )
+                window = build_step_time_window(rank_rows, max_steps=self.window_steps)
+                out["step_time"] = {
+                    "window": window,
+                    "diagnosis": diagnose_rank_rows(rank_rows, mode="live")
+                    if rank_rows
+                    else None,
+                }
+            except Exception as exc:
+                out["step_time"] = {"error": str(exc)}
+            try:
+                out["step_memory"] = loaders.load_step_memory_rows(
+                    self.db_path, max_rows_per_rank=self.window_steps * 4
+                )
+            except Exception as exc:
+                out["step_memory"] = {"error": str(exc)}
+            try:
+                host, devices = loaders.load_system_rows(self.db_path, max_rows=300)
+                out["system"] = {"host": host, "devices": devices}
+            except Exception as exc:
+                out["system"] = {"error": str(exc)}
+            try:
+                procs, pdevs = loaders.load_process_rows(self.db_path, max_rows=300)
+                out["process"] = {"procs": procs, "devices": pdevs}
+            except Exception as exc:
+                out["process"] = {"error": str(exc)}
+            try:
+                out["stdout"] = self._load_stdout_tail()
+            except Exception:
+                out["stdout"] = []
+        self._cache = out
+        self._cached_at = now
+        return out
+
+    def _load_stdout_tail(self, n: int = 12):
+        import sqlite3
+
+        with sqlite3.connect(f"file:{self.db_path}?mode=ro", uri=True) as conn:
+            conn.row_factory = sqlite3.Row
+            try:
+                rows = conn.execute(
+                    "SELECT stream, line FROM stdout_samples ORDER BY id DESC LIMIT ?",
+                    (n,),
+                ).fetchall()
+            except sqlite3.Error:
+                return []
+        return [(r["stream"], r["line"]) for r in reversed(rows)]
