@@ -87,10 +87,16 @@ def test_flax_hooks_step(fresh_state):
 
 
 def test_lightning_gated_import():
+    import importlib.util
+
+    if importlib.util.find_spec("lightning") or importlib.util.find_spec(
+        "pytorch_lightning"
+    ):
+        pytest.skip("lightning installed; gating not applicable")
     from traceml_tpu.integrations.lightning import TraceMLCallback
 
     with pytest.raises(ImportError):
-        TraceMLCallback()  # lightning not installed in this image
+        TraceMLCallback()
 
 
 def test_renderer_panels_smoke(tmp_path):

@@ -139,7 +139,7 @@ def test_h2d_patch_times_device_put(fresh_state):
 
     st = fresh_state
     try:
-        assert patch_jax_h2d(st)
+        assert patch_jax_h2d()
         with trace_step():
             arr = jax.device_put(np.ones((16, 16)))
             _ = arr.sum()
@@ -163,7 +163,7 @@ def test_h2d_patch_inert_under_jit(fresh_state):
 
     st = fresh_state
     try:
-        patch_jax_h2d(st)
+        patch_jax_h2d()
 
         @jax.jit
         def f(x):

@@ -1,0 +1,4 @@
+"""Fault-injection demo scenarios — the diagnosis acceptance harness
+(reference: src/dev/demo/ mlp_ddp_input_straggler.py etc.; these are the
+ground-truth precision/recall scenarios for the rule engine).
+"""

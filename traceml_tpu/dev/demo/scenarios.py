@@ -1,0 +1,137 @@
+"""Scenario library: each scenario is a small flax training run with ONE
+injected pathology, runnable standalone (``python -m
+traceml_tpu.dev.demo.scenarios <name>``) or under ``traceml-tpu run``.
+
+Scenarios and their expected verdicts:
+
+* ``healthy``           → COMPUTE_BOUND / NO_CLEAR_PERFORMANCE_BOTTLENECK
+* ``input_bound``       → INPUT_BOUND (slow dataloader on every rank)
+* ``input_straggler``   → INPUT_STRAGGLER (slow dataloader on ONE rank —
+  needs multi-rank, e.g. ``traceml-tpu run --nprocs 4``; the injected
+  rank is RANK env–gated, reference: mlp_ddp_input_straggler.py:34-38)
+* ``compute_straggler`` → COMPUTE_STRAGGLER (extra matmuls on one rank)
+* ``memory_creep``      → MEMORY_CREEP_* (a list leaks one array/step)
+* ``recompile``         → COMPILE_BOUND (shape churn every few steps)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import Iterator, Optional
+
+import numpy as np
+
+
+def _rank() -> int:
+    return int(os.environ.get("RANK", 0))
+
+
+def _make_model(hidden: int = 256):
+    import jax
+
+    from traceml_tpu.models.mlp import TinyMLP, make_mlp_train_step
+
+    model = TinyMLP(hidden=hidden, depth=3)
+    init, train_step = make_mlp_train_step(model)
+    params, opt_state = init(
+        jax.random.PRNGKey(0), np.zeros((1, 64), np.float32)
+    )
+    return params, opt_state, train_step
+
+
+def _batches(
+    n: int,
+    delay_s: float = 0.0,
+    delay_rank: Optional[int] = None,
+    batch: int = 64,
+) -> Iterator[tuple]:
+    rng = np.random.default_rng(_rank())
+    for _ in range(n):
+        if delay_s and (delay_rank is None or _rank() == delay_rank):
+            time.sleep(delay_s)
+        x = rng.normal(size=(batch, 64)).astype(np.float32)
+        y = rng.normal(size=(batch, 1)).astype(np.float32)
+        yield x, y
+
+
+def run_scenario(name: str, steps: int = 80) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    import traceml_tpu
+
+    traceml_tpu.init(mode="auto")
+    params, opt_state, train_step = _make_model()
+    step = traceml_tpu.wrap_step_fn(train_step)
+
+    if name == "healthy":
+        loader = _batches(steps)
+        for x, y in traceml_tpu.wrap_dataloader(loader):
+            with traceml_tpu.trace_step():
+                x, y = jax.device_put(x), jax.device_put(y)
+                params, opt_state, loss = step(params, opt_state, x, y)
+                # keep the device busy so compute dominates
+                for _ in range(3):
+                    params, opt_state, loss = step(params, opt_state, x, y)
+
+    elif name == "input_bound":
+        loader = _batches(steps, delay_s=0.06)
+        for x, y in traceml_tpu.wrap_dataloader(loader):
+            with traceml_tpu.trace_step():
+                x, y = jax.device_put(x), jax.device_put(y)
+                params, opt_state, loss = step(params, opt_state, x, y)
+
+    elif name == "input_straggler":
+        # rank (world_size-1) eats a 0.18 s input delay per step
+        world = int(os.environ.get("WORLD_SIZE", 1))
+        loader = _batches(steps, delay_s=0.18, delay_rank=world - 1)
+        for x, y in traceml_tpu.wrap_dataloader(loader):
+            with traceml_tpu.trace_step():
+                x, y = jax.device_put(x), jax.device_put(y)
+                params, opt_state, loss = step(params, opt_state, x, y)
+
+    elif name == "compute_straggler":
+        world = int(os.environ.get("WORLD_SIZE", 1))
+        slow_rank = world - 1
+        extra = jax.jit(lambda a: jnp.tanh(a @ a).sum())
+        pad = jnp.ones((700, 700), jnp.float32)
+        loader = _batches(steps)
+        for x, y in traceml_tpu.wrap_dataloader(loader):
+            with traceml_tpu.trace_step():
+                x, y = jax.device_put(x), jax.device_put(y)
+                params, opt_state, loss = step(params, opt_state, x, y)
+                if _rank() == slow_rank:
+                    for _ in range(6):
+                        jax.block_until_ready(extra(pad))
+
+    elif name == "memory_creep":
+        leak = []  # grows forever — the classic retained-arrays leak
+        loader = _batches(steps)
+        for i, (x, y) in enumerate(traceml_tpu.wrap_dataloader(loader)):
+            with traceml_tpu.trace_step():
+                x, y = jax.device_put(x), jax.device_put(y)
+                params, opt_state, loss = step(params, opt_state, x, y)
+                leak.append(jnp.ones((256, 1024)) * i)  # 1 MiB/step
+
+    elif name == "recompile":
+        loader = _batches(steps)
+        for i, (x, y) in enumerate(traceml_tpu.wrap_dataloader(loader)):
+            with traceml_tpu.trace_step():
+                # shape churn: ragged batch sizes defeat the jit cache
+                ragged = 17 + (i % 7)
+                x = jax.device_put(x[:ragged])
+                y = jax.device_put(y[:ragged])
+                params, opt_state, loss = step(params, opt_state, x, y)
+
+    else:
+        raise SystemExit(f"unknown scenario {name!r}; see module docstring")
+
+    print(f"scenario {name} done at step {traceml_tpu.current_step()}, "
+          f"loss={float(loss):.4f}")
+
+
+if __name__ == "__main__":
+    run_scenario(sys.argv[1] if len(sys.argv) > 1 else "healthy",
+                 steps=int(sys.argv[2]) if len(sys.argv) > 2 else 80)

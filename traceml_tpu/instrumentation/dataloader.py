@@ -106,7 +106,7 @@ class wrap_dataloader:
         return len(self._iterable)  # type: ignore[arg-type]
 
 
-def patch_torch_dataloader(state: Optional[TraceState] = None) -> bool:
+def patch_torch_dataloader() -> bool:
     """Replace ``torch.utils.data.DataLoader.__iter__`` with a timing
     generator (reference: dataloader_patch.py:8-34).  Idempotent."""
     try:
@@ -115,10 +115,10 @@ def patch_torch_dataloader(state: Optional[TraceState] = None) -> bool:
         return False
     if getattr(DataLoader, _PATCHED_FLAG, False):
         return True
-    st = state or get_state()
     original_iter = DataLoader.__iter__
 
     def patched_iter(self):  # noqa: ANN001
+        st = get_state()
         it = original_iter(self)
         while True:
             if st.tls.dataloader_depth > 0:

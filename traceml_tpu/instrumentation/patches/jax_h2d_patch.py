@@ -14,9 +14,9 @@ Gates (mirror of reference ``should_time_h2d``, h2d.py:8-67):
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Any
 
-from traceml_tpu.sdk.state import TraceState, get_state
+from traceml_tpu.sdk.state import get_state
 from traceml_tpu.utils.error_log import get_error_log
 from traceml_tpu.utils.marker_resolver import get_marker_resolver
 from traceml_tpu.utils.timing import H2D_TIME, timed_region
@@ -38,7 +38,7 @@ def _contains_tracer_or_device_array(x: Any) -> bool:
         return True  # unsure → don't time
 
 
-def patch_jax_h2d(state: Optional[TraceState] = None) -> bool:
+def patch_jax_h2d() -> bool:
     """Replace ``jax.device_put`` with a timing wrapper.  Idempotent."""
     global _original_device_put
     try:
@@ -47,10 +47,11 @@ def patch_jax_h2d(state: Optional[TraceState] = None) -> bool:
         return False
     if _original_device_put is not None:
         return True
-    st = state or get_state()
     original = jax.device_put
 
     def timed_device_put(x, device=None, *args, **kwargs):  # noqa: ANN001
+        # state resolved per call: re-inits/tests may swap the global
+        st = get_state()
         try:
             should_time = (
                 st.tls.in_step

@@ -15,9 +15,9 @@ counters, optional target-model filter with DDP/FSDP unwrap.
 from __future__ import annotations
 
 import threading
-from typing import Any, Optional
+from typing import Any
 
-from traceml_tpu.sdk.state import TraceState, get_state
+from traceml_tpu.sdk.state import get_state
 from traceml_tpu.utils.error_log import get_error_log
 from traceml_tpu.utils.timing import (
     BACKWARD_TIME,
@@ -50,7 +50,7 @@ def _is_target(module: Any) -> bool:
     return not _traced_model_ids or id(module) in _traced_model_ids
 
 
-def patch_torch_forward(state: Optional[TraceState] = None) -> bool:
+def patch_torch_forward() -> bool:
     try:
         import torch.nn as nn
     except Exception:
@@ -58,10 +58,10 @@ def patch_torch_forward(state: Optional[TraceState] = None) -> bool:
     with _lock:
         if "forward" in _originals:
             return True
-        st = state or get_state()
         original = nn.Module.__call__
 
         def patched_call(self, *args, **kwargs):  # noqa: ANN001
+            st = get_state()
             if (
                 not st.tls.in_step
                 or st.tls.forward_depth > 0
@@ -82,7 +82,7 @@ def patch_torch_forward(state: Optional[TraceState] = None) -> bool:
     return True
 
 
-def patch_torch_backward(state: Optional[TraceState] = None) -> bool:
+def patch_torch_backward() -> bool:
     try:
         import torch
     except Exception:
@@ -90,11 +90,11 @@ def patch_torch_backward(state: Optional[TraceState] = None) -> bool:
     with _lock:
         if "backward" in _originals:
             return True
-        st = state or get_state()
         orig_tensor_bwd = torch.Tensor.backward
         orig_autograd_bwd = torch.autograd.backward
 
         def _timed(fn, *args, **kwargs):  # noqa: ANN001
+            st = get_state()
             if not st.tls.in_step or st.tls.backward_depth > 0:
                 return fn(*args, **kwargs)
             st.tls.backward_depth += 1
@@ -118,7 +118,7 @@ def patch_torch_backward(state: Optional[TraceState] = None) -> bool:
     return True
 
 
-def install_torch_optimizer_hooks(state: Optional[TraceState] = None) -> bool:
+def install_torch_optimizer_hooks() -> bool:
     """Global pre/post optimizer-step hooks emitting ``optimizer_step``
     (reference: optimizer_hooks.py:17-101).  Idempotent."""
     try:
@@ -128,10 +128,10 @@ def install_torch_optimizer_hooks(state: Optional[TraceState] = None) -> bool:
     with _lock:
         if "optimizer" in _originals:
             return True
-        st = state or get_state()
         open_regions: dict = {}
 
         def pre_hook(optimizer, args, kwargs):  # noqa: ANN001
+            st = get_state()
             try:
                 if not st.tls.in_step:
                     return

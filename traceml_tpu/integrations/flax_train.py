@@ -67,6 +67,8 @@ def traced_train_loop(
     hooks = TraceMLFlaxHooks(
         train_step, donate_argnums=donate_argnums, **jit_kwargs
     )
+    if max_steps is not None and max_steps <= 0:
+        return
     loader = wrap_dataloader(batches, to_device=to_device)
     n = 0
     for batch in loader:

@@ -29,9 +29,15 @@ def _callback_bases():
     return tuple(dict.fromkeys(bases))
 
 
+_cached_callback_cls = None
+
+
 def make_traceml_callback() -> Any:
     """Build the callback class against the available Lightning base(s);
     raises ImportError when no Lightning flavor is installed."""
+    global _cached_callback_cls
+    if _cached_callback_cls is not None:
+        return _cached_callback_cls
     bases = _callback_bases()
     if not bases:
         raise ImportError(
@@ -74,6 +80,7 @@ def make_traceml_callback() -> Any:
                 self._ctx.__exit__(None, None, None)
                 self._ctx = None
 
+    _cached_callback_cls = TraceMLCallback
     return TraceMLCallback
 
 

@@ -62,23 +62,10 @@ class LiveComputer:
             except Exception as exc:
                 out["process"] = {"error": str(exc)}
             try:
-                out["stdout"] = self._load_stdout_tail()
+                out["stdout"] = loaders.load_stdout_tail(self.db_path)
             except Exception:
                 out["stdout"] = []
         self._cache = out
         self._cached_at = now
         return out
 
-    def _load_stdout_tail(self, n: int = 12):
-        import sqlite3
-
-        with sqlite3.connect(f"file:{self.db_path}?mode=ro", uri=True) as conn:
-            conn.row_factory = sqlite3.Row
-            try:
-                rows = conn.execute(
-                    "SELECT stream, line FROM stdout_samples ORDER BY id DESC LIMIT ?",
-                    (n,),
-                ).fetchall()
-            except sqlite3.Error:
-                return []
-        return [(r["stream"], r["line"]) for r in reversed(rows)]

@@ -163,3 +163,15 @@ def load_topology(db_path: Path) -> Dict[str, Any]:
         "nodes": len(nodes),
         "hostnames": sorted({str(r["hostname"]) for r in rows}),
     }
+
+
+def load_stdout_tail(db_path: Path, n: int = 12) -> List[Tuple[str, str]]:
+    """Last n (stream, line) pairs from the stdout projection."""
+    with _connect_ro(db_path) as conn:
+        if not _table_exists(conn, "stdout_samples"):
+            return []
+        rows = conn.execute(
+            "SELECT stream, line FROM stdout_samples ORDER BY id DESC LIMIT ?",
+            (int(n),),
+        ).fetchall()
+    return [(r["stream"], r["line"]) for r in reversed(rows)]

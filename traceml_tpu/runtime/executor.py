@@ -80,12 +80,25 @@ def main() -> int:
     try:
         # auto-apply SDK patches so unmodified scripts still get
         # dataloader/h2d phase timing (scripts may also call init()
-        # themselves — it is idempotent).
+        # themselves — it is idempotent).  The script's static analysis
+        # decides whether the jax side is in play (init() never drags
+        # jax into a torch-only process on its own).
         try:
             from traceml_tpu.sdk.initial import init as sdk_init
 
             if not settings.disabled:
-                sdk_init(mode="auto")
+                prefer_jax = None
+                try:
+                    from traceml_tpu.launcher.manifest import analyze_script
+
+                    fw = analyze_script(Path(script)).get("framework")
+                    if fw == "jax":
+                        prefer_jax = True
+                    elif fw == "torch":
+                        prefer_jax = False
+                except Exception:
+                    pass
+                sdk_init(mode="auto", prefer_jax=prefer_jax)
         except Exception as exc:
             get_error_log().warning("executor sdk init failed", exc)
         exit_code = run_user_script(script, args)

@@ -48,9 +48,17 @@ def _jax_loaded() -> bool:
     return "jax" in sys.modules
 
 
-def init(mode: str = "auto", **kwargs) -> TraceMLInitConfig:
+def init(
+    mode: str = "auto", prefer_jax: Optional[bool] = None, **kwargs
+) -> TraceMLInitConfig:
     """Apply the requested patch policy.  Safe to call more than once
-    with the same mode; conflicting re-init raises."""
+    with the same mode; conflicting re-init raises.
+
+    ``prefer_jax``: apply jax-side instrumentation even if jax isn't
+    imported yet (the executor sets this from the script's static
+    analysis; default = only touch jax when the process already loaded
+    it, so a torch-only job never pays the jax import).
+    """
     if mode not in VALID_MODES:
         raise TraceMLInitError(f"mode must be one of {VALID_MODES}, got {mode!r}")
     st = get_state()
@@ -64,31 +72,41 @@ def init(mode: str = "auto", **kwargs) -> TraceMLInitConfig:
 
     cfg = TraceMLInitConfig(mode=mode, **kwargs)
     applied = []
-    # process-wide compile attribution (cheap listener; all modes —
-    # compile visibility is core telemetry, not a patch)
-    try:
-        from traceml_tpu.instrumentation.compile_tracker import (
-            install_compile_tracker,
-        )
+    want_jax = _jax_loaded() if prefer_jax is None else bool(prefer_jax)
+    if want_jax:
+        # Ecosystem compat shim: chex (via optax) references
+        # jax.core.Tracer at import time, which fails UNLESS the
+        # submodule was imported first (submodule import sets the
+        # attribute, bypassing jax's deprecation __getattr__).  Our
+        # executor initializes tracing before the user script imports
+        # its stack, so do the import here to keep user imports
+        # order-independent.
+        try:
+            import jax.core  # noqa: F401
+        except Exception as exc:
+            get_error_log().warning("jax.core compat import failed", exc)
+        # process-wide compile attribution (cheap listener; compile
+        # visibility is core telemetry, not a patch)
+        try:
+            from traceml_tpu.instrumentation.compile_tracker import (
+                install_compile_tracker,
+            )
 
-        if install_compile_tracker():
-            applied.append("compile_tracker")
-    except Exception as exc:
-        get_error_log().warning("compile tracker failed", exc)
+            if install_compile_tracker():
+                applied.append("compile_tracker")
+        except Exception as exc:
+            get_error_log().warning("compile tracker failed", exc)
     if mode != "manual":
         # per-patch kwargs are honored in every non-manual mode ("auto"
         # defaults them all True; passing patch_x=False narrows it).
         want = cfg
-        # JAX-side patches: only if jax is (or will be) in play.  Importing
-        # jax here is fine — jax jobs import it anyway, and the patch is a
-        # cheap function swap.
-        if want.patch_h2d:
+        if want_jax and want.patch_h2d:
             try:
                 from traceml_tpu.instrumentation.patches.jax_h2d_patch import (
                     patch_jax_h2d,
                 )
 
-                if patch_jax_h2d(st):
+                if patch_jax_h2d():
                     applied.append("jax_h2d")
             except Exception as exc:
                 get_error_log().warning("jax h2d patch failed", exc)
@@ -105,13 +123,13 @@ def init(mode: str = "auto", **kwargs) -> TraceMLInitConfig:
                 set_traced_model,
             )
 
-            if want.patch_dataloader and patch_torch_dataloader(st):
+            if want.patch_dataloader and patch_torch_dataloader():
                 applied.append("torch_dataloader")
-            if want.patch_forward and patch_torch_forward(st):
+            if want.patch_forward and patch_torch_forward():
                 applied.append("torch_forward")
-            if want.patch_backward and patch_torch_backward(st):
+            if want.patch_backward and patch_torch_backward():
                 applied.append("torch_backward")
-            if want.patch_optimizer and install_torch_optimizer_hooks(st):
+            if want.patch_optimizer and install_torch_optimizer_hooks():
                 applied.append("torch_optimizer")
             if cfg.traced_model is not None:
                 set_traced_model(cfg.traced_model)

@@ -175,14 +175,27 @@ def jax_is_initialized() -> bool:
     backend init from a background thread before the user's own
     ``jax.distributed.initialize`` is the TPU analogue of the
     reference's touch-CUDA-before-init_process_group hazard
-    (reference: process_sampler.py CUDA-safety gate)."""
+    (reference: process_sampler.py CUDA-safety gate).
+
+    IMPORT-FREE on purpose: this runs on the sampler thread, and an
+    ``import jax...`` here can race the MAIN thread's in-progress
+    ``import jax`` (slow under CPU oversubscription), leaving jax's
+    modules partially initialized and crashing unrelated user imports —
+    observed as chex failing with "partially initialized module
+    jax._src.xla_bridge".  Only ``sys.modules`` inspection is safe.
+    """
     import sys
 
-    if "jax" not in sys.modules:
+    m = sys.modules.get("jax")
+    if m is None:
+        return False
+    spec = getattr(m, "__spec__", None)
+    if spec is not None and getattr(spec, "_initializing", False):
+        return False  # main thread is mid-import; hands off
+    xb = sys.modules.get("jax._src.xla_bridge")
+    if xb is None:
         return False
     try:
-        import jax._src.xla_bridge as xb
-
         return bool(getattr(xb, "_backends", None))
     except Exception:
         return False
