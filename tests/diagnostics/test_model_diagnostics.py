@@ -1,0 +1,44 @@
+from traceml_tpu.diagnostics.common import (
+    DiagnosticIssue,
+    DiagnosticResult,
+)
+from traceml_tpu.diagnostics.model_diagnostics import compose
+
+
+def _result(domain, issues):
+    return DiagnosticResult(domain=domain, issues=issues)
+
+
+def test_compose_all_healthy():
+    out = compose({"step_time": _result("step_time", []),
+                   "step_memory": _result("step_memory", [])})
+    assert out.headline.kind == "HEALTHY"
+    assert out.domain_health == {"step_time": True, "step_memory": True}
+    assert out.issues == []
+
+
+def test_compose_model_domain_outranks_env_at_equal_severity():
+    st = _result("step_time", [DiagnosticIssue(
+        kind="INPUT_BOUND", severity="warning", score=0.3)])
+    sysd = _result("system", [DiagnosticIssue(
+        kind="HIGH_HOST_CPU", severity="warning", score=0.9)])
+    out = compose({"step_time": st, "system": sysd})
+    assert out.headline.kind == "INPUT_BOUND"
+    assert [i.kind for i in out.issues] == ["INPUT_BOUND", "HIGH_HOST_CPU"]
+
+
+def test_compose_critical_env_beats_warning_model():
+    st = _result("step_time", [DiagnosticIssue(
+        kind="INPUT_BOUND", severity="warning", score=0.3)])
+    mem = _result("system", [DiagnosticIssue(
+        kind="HIGH_DEVICE_MEMORY", severity="critical", score=0.97)])
+    out = compose({"step_time": st, "system": mem})
+    assert out.headline.kind == "HIGH_DEVICE_MEMORY"
+
+
+def test_compose_tags_domains_in_evidence():
+    st = _result("step_memory", [DiagnosticIssue(
+        kind="MEMORY_IMBALANCE", severity="warning", score=0.25)])
+    out = compose({"step_memory": st})
+    assert out.issues[0].evidence["domain"] == "step_memory"
+    assert out.to_dict()["headline"]["kind"] == "MEMORY_IMBALANCE"

@@ -1,0 +1,72 @@
+"""Torch-path E2E: the BASELINE.json `pytorch_minimal.py` config —
+a tiny torch-CPU MLP through the full CLI with auto patches
+(dataloader / forward / backward / optimizer phase split)."""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[2]
+
+TORCH_SCRIPT = """
+import time
+import torch
+import torch.nn as nn
+from torch.utils.data import DataLoader, TensorDataset
+
+import traceml_tpu
+
+traceml_tpu.init(mode="auto")
+
+model = nn.Sequential(nn.Linear(64, 128), nn.Tanh(), nn.Linear(128, 1))
+opt = torch.optim.Adam(model.parameters(), lr=1e-3)
+loss_fn = nn.MSELoss()
+
+xs = torch.randn(640, 64)
+ys = torch.randn(640, 1)
+loader = DataLoader(TensorDataset(xs, ys), batch_size=8)
+
+for epoch in range(2):
+    for x, y in loader:
+        with traceml_tpu.trace_step():
+            opt.zero_grad()
+            loss = loss_fn(model(x), y)
+            loss.backward()
+            opt.step()
+print("torch train done", float(loss))
+"""
+
+
+def test_torch_mlp_phase_split(tmp_path):
+    script = tmp_path / "torch_train.py"
+    script.write_text(TORCH_SCRIPT)
+    logs = tmp_path / "logs"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO)
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "traceml_tpu", "run",
+            "--mode", "summary", "--logs-dir", str(logs),
+            "--sampler-interval", "0.25", "--finalize-timeout", "30",
+            str(script),
+        ],
+        env=env, capture_output=True, text=True, timeout=240, cwd=str(tmp_path),
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    session = next(iter(logs.iterdir()))
+    payload = json.loads((session / "final_summary.json").read_text())
+    st = payload["sections"]["step_time"]
+    assert st["status"] == "OK"
+    phases = st["global"]["phases"]
+    # the torch path yields the classic per-phase split
+    for phase in ("input", "forward", "backward", "optimizer"):
+        assert phase in phases, sorted(phases)
+        assert phases[phase]["median_ms"] >= 0
+    # 160 steps recorded (2 epochs x 80 batches)
+    assert st["global"]["n_steps"] >= 100
+    # code manifest detected torch + DataLoader
+    code = json.loads((session / "code_manifest.json").read_text())
+    assert code["framework"] == "torch"
+    assert "torch_dataloader" in code["input_hints"]

@@ -21,6 +21,8 @@ _API_SYMBOLS = (
     "start",
     "trace_step",
     "trace_time",
+    "summary",
+    "final_summary",
     "wrap_dataloader",
     "wrap_step_fn",
     "wrap_h2d",

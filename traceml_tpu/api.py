@@ -16,6 +16,7 @@ from traceml_tpu.sdk.wrappers import (  # noqa: F401
     wrap_optimizer,
 )
 from traceml_tpu.instrumentation.dataloader import wrap_dataloader  # noqa: F401
+from traceml_tpu.sdk.summary_client import final_summary, summary  # noqa: F401
 
 
 def current_step() -> int:

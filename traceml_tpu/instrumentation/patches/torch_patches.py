@@ -152,9 +152,16 @@ def install_torch_optimizer_hooks() -> bool:
                 get_error_log().warning("optimizer post-hook failed", exc)
 
         try:
-            h1 = optim.Optimizer.register_optimizer_step_pre_hook(pre_hook)
-            h2 = optim.Optimizer.register_optimizer_step_post_hook(post_hook)
-        except AttributeError:
+            # global hooks live as module-level functions
+            # (torch.optim.optimizer.register_optimizer_step_pre_hook)
+            from torch.optim.optimizer import (
+                register_optimizer_step_post_hook,
+                register_optimizer_step_pre_hook,
+            )
+
+            h1 = register_optimizer_step_pre_hook(pre_hook)
+            h2 = register_optimizer_step_post_hook(post_hook)
+        except (AttributeError, ImportError):
             return False
         _originals["optimizer"] = (h1, h2)
     return True

@@ -1,0 +1,98 @@
+"""Ray Train integration (gated — ray is not in this image)
+(reference: src/traceml_ai/integrations/ray.py:36-352: aggregator as a
+rank-0-node actor + per-worker in-process runtime via lifecycle).
+
+Usage::
+
+    from traceml_tpu.integrations.ray import traceml_train_loop
+
+    def my_loop(config):
+        ...  # normal Ray Train loop
+
+    trainer = TorchTrainer(traceml_train_loop(my_loop), ...)
+
+The wrapper starts an in-process runtime on every Ray worker (identity
+from Ray's world rank env), points it at an aggregator that the rank-0
+worker hosts, and stops everything when the loop returns.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Optional
+
+from traceml_tpu.runtime import lifecycle
+from traceml_tpu.runtime.settings import (
+    AggregatorEndpoint,
+    TraceMLSettings,
+    settings_from_env,
+)
+from traceml_tpu.utils.error_log import get_error_log
+
+
+def _require_ray():
+    try:
+        import ray  # noqa: F401
+
+        return ray
+    except Exception as exc:  # pragma: no cover - ray absent here
+        raise ImportError("ray is required for the Ray integration") from exc
+
+
+def traceml_train_loop(
+    user_loop: Callable[[Any], Any],
+    settings: Optional[TraceMLSettings] = None,
+) -> Callable[[Any], Any]:
+    """Wrap a Ray Train per-worker loop with TraceML runtime lifecycle."""
+
+    def wrapped(config: Any) -> Any:
+        base = settings or settings_from_env()
+        rank = int(os.environ.get("RANK", os.environ.get("WORLD_RANK", 0)))
+        agg = None
+        run_settings = base
+        try:
+            if rank == 0 and not base.aggregator.port:
+                # rank 0 hosts the aggregator; its bound port is shared
+                # through the session dir ready-file (workers on other
+                # nodes read it over the shared filesystem Ray provides)
+                agg = lifecycle.start_aggregator(base)
+                if agg is not None and agg.port:
+                    from traceml_tpu.aggregator.trace_aggregator import (
+                        write_ready_file,
+                    )
+
+                    write_ready_file(base, agg.port)
+            if not run_settings.aggregator.port:
+                from traceml_tpu.launcher.process import wait_for_ready_file
+
+                ready = wait_for_ready_file(
+                    base.session_dir / "aggregator_ready.json", timeout=30
+                )
+                if ready:
+                    import dataclasses
+
+                    run_settings = dataclasses.replace(
+                        base,
+                        aggregator=AggregatorEndpoint(
+                            connect_host=base.aggregator.connect_host,
+                            bind_host=base.aggregator.bind_host,
+                            port=int(ready["port"]),
+                        ),
+                    )
+            lifecycle.start_runtime(run_settings)
+            from traceml_tpu.sdk.initial import init as sdk_init
+
+            sdk_init(mode="auto")
+            return user_loop(config)
+        finally:
+            try:
+                lifecycle.stop_runtime()
+            except Exception as exc:
+                get_error_log().warning("ray worker runtime stop failed", exc)
+            if agg is not None:
+                try:
+                    agg.stop()
+                except Exception as exc:
+                    get_error_log().warning("ray aggregator stop failed", exc)
+
+    return wrapped

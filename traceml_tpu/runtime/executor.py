@@ -87,18 +87,22 @@ def main() -> int:
             from traceml_tpu.sdk.initial import init as sdk_init
 
             if not settings.disabled:
-                prefer_jax = None
+                prefer_jax = prefer_torch = None
                 try:
                     from traceml_tpu.launcher.manifest import analyze_script
 
                     fw = analyze_script(Path(script)).get("framework")
                     if fw == "jax":
-                        prefer_jax = True
+                        prefer_jax, prefer_torch = True, False
                     elif fw == "torch":
-                        prefer_jax = False
+                        prefer_jax, prefer_torch = False, True
                 except Exception:
                     pass
-                sdk_init(mode="auto", prefer_jax=prefer_jax)
+                sdk_init(
+                    mode="auto",
+                    prefer_jax=prefer_jax,
+                    prefer_torch=prefer_torch,
+                )
         except Exception as exc:
             get_error_log().warning("executor sdk init failed", exc)
         exit_code = run_user_script(script, args)

@@ -49,15 +49,19 @@ def _jax_loaded() -> bool:
 
 
 def init(
-    mode: str = "auto", prefer_jax: Optional[bool] = None, **kwargs
+    mode: str = "auto",
+    prefer_jax: Optional[bool] = None,
+    prefer_torch: Optional[bool] = None,
+    **kwargs,
 ) -> TraceMLInitConfig:
     """Apply the requested patch policy.  Safe to call more than once
     with the same mode; conflicting re-init raises.
 
-    ``prefer_jax``: apply jax-side instrumentation even if jax isn't
-    imported yet (the executor sets this from the script's static
-    analysis; default = only touch jax when the process already loaded
-    it, so a torch-only job never pays the jax import).
+    ``prefer_jax`` / ``prefer_torch``: apply that framework's
+    instrumentation even if it isn't imported yet (the executor sets
+    these from the script's static analysis; default = only touch a
+    framework the process already loaded, so neither job type pays the
+    other stack's import).
     """
     if mode not in VALID_MODES:
         raise TraceMLInitError(f"mode must be one of {VALID_MODES}, got {mode!r}")
@@ -110,9 +114,12 @@ def init(
                     applied.append("jax_h2d")
             except Exception as exc:
                 get_error_log().warning("jax h2d patch failed", exc)
-        # Torch-side patches: only when torch is already imported — we
-        # never pull torch into a pure-JAX process.
-        if _torch_loaded():
+        # Torch-side patches: when torch is already imported, or the
+        # executor's static analysis says this is a torch job.
+        want_torch = (
+            _torch_loaded() if prefer_torch is None else bool(prefer_torch)
+        )
+        if want_torch:
             from traceml_tpu.instrumentation.dataloader import (
                 patch_torch_dataloader,
             )
