@@ -7,13 +7,23 @@ from traceml_tpu.aggregator.display_drivers.base import (  # noqa: F401
 
 
 def resolve_display_driver(mode: str):
-    """cli → live Rich display; summary/other → no live UI
+    """cli → live Rich display; dashboard → browser server;
+    summary/other → no live UI
     (reference: trace_aggregator.py:65 _resolve_display_driver)."""
     if mode == "cli":
         try:
             from traceml_tpu.aggregator.display_drivers.cli import CLIDisplayDriver
 
             return CLIDisplayDriver()
+        except Exception:
+            return SummaryDisplayDriver()
+    if mode == "dashboard":
+        try:
+            from traceml_tpu.aggregator.display_drivers.browser import (
+                BrowserDisplayDriver,
+            )
+
+            return BrowserDisplayDriver()
         except Exception:
             return SummaryDisplayDriver()
     return SummaryDisplayDriver()

@@ -1,0 +1,207 @@
+"""Dependency-free browser dashboard
+(reference role: the NiceGUI dashboard, display_drivers/nicegui.py —
+rebuilt on the stdlib since this image ships no web framework; a single
+HTML page polls ``/api/live`` and renders with vanilla JS + inline SVG).
+
+Serves:
+
+* ``GET /``          — the dashboard page (self-contained HTML/JS/CSS)
+* ``GET /api/live``  — live JSON payload (renderers/web_payload.py)
+* ``GET /api/summary`` — final_summary.json once it exists
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+from typing import Any, Optional
+
+from traceml_tpu.aggregator.display_drivers.base import BaseDisplayDriver
+from traceml_tpu.utils.atomic_io import read_json
+from traceml_tpu.utils.error_log import get_error_log
+
+_PAGE = """<!doctype html><html><head><meta charset="utf-8">
+<title>TraceML-TPU live</title>
+<style>
+body{font-family:system-ui,sans-serif;margin:1.5rem auto;max-width:1000px;
+     background:#12121a;color:#e8e8f0;padding:0 1rem}
+h1{font-size:1.2rem} .muted{color:#9a9ab0;font-size:.85rem}
+.card{background:#1c1c28;border-radius:10px;padding:1rem;margin:.8rem 0}
+.verdict-info{border-left:5px solid #2d7dd2}
+.verdict-warning{border-left:5px solid #e67e22}
+.verdict-critical{border-left:5px solid #c0392b}
+table{border-collapse:collapse;width:100%;font-size:.88rem}
+th,td{text-align:left;padding:.3rem .55rem;border-bottom:1px solid #2c2c3c}
+.bar{height:16px;display:inline-block;vertical-align:middle;border-radius:2px}
+pre{white-space:pre-wrap;font-size:.8rem;color:#b8e0b8;margin:0}
+.err{color:#f0a0a0}
+svg{width:100%;height:70px;background:#15151f;border-radius:6px}
+</style></head><body>
+<h1>TraceML-TPU — live dashboard</h1>
+<div class="muted" id="meta">connecting…</div>
+<div id="verdict"></div>
+<div class="card"><b>Step time</b><div id="phases"></div>
+<svg id="spark" viewBox="0 0 600 70" preserveAspectRatio="none"></svg></div>
+<div class="card"><b>Device memory</b><div id="memory"></div></div>
+<div class="card"><b>System</b><div id="system"></div></div>
+<div class="card"><b>Rank 0 output</b><pre id="stdout"></pre></div>
+<script>
+const COLORS={input:"#e74c3c",h2d:"#e67e22",forward:"#2d7dd2",
+backward:"#2255a4",optimizer:"#7d3dd2",compute:"#2d7dd2",
+compile:"#f1c40f",collective:"#16a085",residual:"#95a5a6"};
+const fmtB=n=>{if(n==null)return"n/a";const u=["B","KiB","MiB","GiB","TiB"];
+let i=0;while(n>=1024&&i<u.length-1){n/=1024;i++}return n.toFixed(i?2:0)+" "+u[i]};
+const fmtMs=v=>v==null?"n/a":(v<1?(v*1000).toFixed(0)+" µs":
+v<1000?v.toFixed(1)+" ms":(v/1000).toFixed(2)+" s");
+async function tick(){
+ try{
+  const r=await fetch("/api/live");const d=await r.json();
+  document.getElementById("meta").textContent=
+    `session ${d.session} · updated ${new Date(d.ts*1000).toLocaleTimeString()}`;
+  const v=document.getElementById("verdict");
+  if(d.diagnosis){v.innerHTML=`<div class="card verdict-${d.diagnosis.severity}">
+    <b>${d.diagnosis.kind}</b> <span class="muted">[${d.diagnosis.severity}]</span><br>
+    ${d.diagnosis.summary}<br><span class="muted">→ ${d.diagnosis.action||""}</span></div>`}
+  const st=d.step_time;
+  if(st){
+   let rows=`<div class="muted">${st.n_steps} steps · ${st.clock} clock</div>
+     <div style="margin:.4rem 0">`;
+   for(const[k,p]of Object.entries(st.phases)){
+     if(k==="step_time"||!p.share)continue;
+     rows+=`<span class="bar" title="${k} ${(p.share*100).toFixed(1)}%"
+       style="width:${(p.share*100).toFixed(1)}%;background:${COLORS[k]||"#888"}"></span>`}
+   rows+=`</div><table><tr><th>phase</th><th>median</th><th>share</th>
+     <th>worst rank</th><th>skew</th></tr>`;
+   for(const[k,p]of Object.entries(st.phases)){
+     rows+=`<tr><td>${k}</td><td>${fmtMs(p.median_ms)}</td>
+       <td>${p.share==null?"—":(p.share*100).toFixed(1)+"%"}</td>
+       <td>${p.worst_rank}</td><td>${(p.skew_pct*100).toFixed(1)}%</td></tr>`}
+   document.getElementById("phases").innerHTML=rows+"</table>";
+   const svg=document.getElementById("spark");
+   let paths="";const ranks=Object.keys(st.step_series);
+   let max=1;for(const r of ranks)for(const v of st.step_series[r])max=Math.max(max,v);
+   ranks.forEach((r,ri)=>{const s=st.step_series[r];if(!s.length)return;
+     const pts=s.map((v,i)=>`${(i/(s.length-1||1))*600},${68-(v/max)*62}`).join(" ");
+     paths+=`<polyline fill="none" stroke="hsl(${(ri*67)%360},70%,60%)"
+       stroke-width="1.5" points="${pts}"><title>rank ${r}</title></polyline>`});
+   svg.innerHTML=paths;
+  }
+  let mem="<table><tr><th>rank</th><th>current</th><th>peak</th><th>limit</th></tr>";
+  for(const m of d.memory){mem+=`<tr><td>${m.rank}</td><td>${fmtB(m.current_bytes)}</td>
+    <td>${fmtB(m.step_peak_bytes)}</td><td>${fmtB(m.limit_bytes)}</td></tr>`}
+  document.getElementById("memory").innerHTML=mem+"</table>";
+  let sys="<table><tr><th>node</th><th>cpu</th><th>host mem</th></tr>";
+  for(const s of d.system){sys+=`<tr><td>${s.node}</td>
+    <td>${s.cpu_pct==null?"n/a":s.cpu_pct.toFixed(0)+"%"}</td>
+    <td>${fmtB(s.memory_used_bytes)} / ${fmtB(s.memory_total_bytes)}</td></tr>`}
+  document.getElementById("system").innerHTML=sys+"</table>";
+  document.getElementById("stdout").textContent=
+    d.stdout.map(l=>l.line).join("\\n");
+ }catch(e){document.getElementById("meta").innerHTML=
+   `<span class="err">poll failed: ${e}</span>`}
+ setTimeout(tick,1000);
+}
+tick();
+</script></body></html>"""
+
+
+class BrowserDisplayDriver(BaseDisplayDriver):
+    """Serves the dashboard from inside the aggregator process."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+        self._host = host
+        self._requested_port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self.port: Optional[int] = None
+        self._db_path: Optional[Path] = None
+        self._session = ""
+        self._session_dir: Optional[Path] = None
+
+    def start(self, context: Optional[Any] = None) -> None:
+        try:
+            if context is not None:
+                self._db_path = context.db_path
+                self._session = context.settings.session_id
+                self._session_dir = context.settings.session_dir
+            driver = self
+
+            class Handler(BaseHTTPRequestHandler):
+                def log_message(self, fmt, *args):  # silence
+                    pass
+
+                def _send(self, code: int, body: bytes, ctype: str) -> None:
+                    self.send_response(code)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+
+                def do_GET(self):  # noqa: N802
+                    try:
+                        if self.path == "/" or self.path.startswith("/index"):
+                            self._send(200, _PAGE.encode(), "text/html; charset=utf-8")
+                        elif self.path.startswith("/api/live"):
+                            from traceml_tpu.renderers.web_payload import (
+                                build_web_payload,
+                            )
+
+                            payload = build_web_payload(
+                                driver._db_path, driver._session
+                            ) if driver._db_path else {}
+                            self._send(
+                                200,
+                                json.dumps(payload).encode(),
+                                "application/json",
+                            )
+                        elif self.path.startswith("/api/summary"):
+                            data = None
+                            if driver._session_dir is not None:
+                                data = read_json(
+                                    driver._session_dir / "final_summary.json"
+                                )
+                            self._send(
+                                200 if data else 404,
+                                json.dumps(data or {"error": "not ready"}).encode(),
+                                "application/json",
+                            )
+                        else:
+                            self._send(404, b"not found", "text/plain")
+                    except BrokenPipeError:
+                        pass
+                    except Exception as exc:
+                        try:
+                            self._send(
+                                500, str(exc).encode(), "text/plain"
+                            )
+                        except Exception:
+                            pass
+
+            self._httpd = ThreadingHTTPServer(
+                (self._host, self._requested_port), Handler
+            )
+            self.port = self._httpd.server_address[1]
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                name="traceml-dashboard",
+                daemon=True,
+            )
+            self._thread.start()
+            print(f"[TraceML] dashboard: http://{self._host}:{self.port}/")
+        except Exception as exc:
+            get_error_log().warning("browser dashboard start failed", exc)
+            self._httpd = None
+
+    def tick(self, context: Optional[Any] = None) -> None:
+        pass  # pull-based: the page polls /api/live
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            try:
+                self._httpd.shutdown()
+                self._httpd.server_close()
+            except Exception:
+                pass
+            self._httpd = None

@@ -26,7 +26,9 @@ def _build_parser() -> argparse.ArgumentParser:
     run = sub.add_parser("run", help="launch a training script under tracing")
     run.add_argument("script", help="path to the training script")
     run.add_argument("script_args", nargs=argparse.REMAINDER, default=[])
-    run.add_argument("--mode", choices=("cli", "summary"), default=None)
+    run.add_argument(
+        "--mode", choices=("cli", "summary", "dashboard"), default=None
+    )
     run.add_argument("--run-name", dest="run_name", default=None)
     run.add_argument("--logs-dir", dest="logs_dir", default=None)
     run.add_argument("--nprocs", type=int, default=1, help="ranks on this node")

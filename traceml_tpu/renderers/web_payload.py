@@ -1,0 +1,111 @@
+"""JSON-able live payload for the browser dashboard."""
+
+from __future__ import annotations
+
+import time
+from pathlib import Path
+from typing import Any, Dict
+
+from traceml_tpu.diagnostics.step_time.api import diagnose_rank_rows
+from traceml_tpu.reporting import loaders
+from traceml_tpu.utils.step_time_window import (
+    RESIDUAL_KEY,
+    STEP_KEY,
+    build_step_time_window,
+)
+
+
+def build_web_payload(db_path: Path, session: str, window_steps: int = 150) -> Dict[str, Any]:
+    out: Dict[str, Any] = {
+        "session": session,
+        "ts": time.time(),
+        "step_time": None,
+        "memory": [],
+        "system": [],
+        "stdout": [],
+        "diagnosis": None,
+    }
+    db_path = Path(db_path)
+    if not db_path.exists():
+        return out
+    try:
+        rank_rows = loaders.load_step_time_rows(db_path, max_steps_per_rank=window_steps)
+        window = build_step_time_window(rank_rows, max_steps=window_steps)
+        if window is not None:
+            phases = {}
+            for key in [STEP_KEY] + window.phases_present + [RESIDUAL_KEY]:
+                m = window.metric(key)
+                if m is None:
+                    continue
+                phases[key] = {
+                    "median_ms": m.median_ms,
+                    "worst_ms": m.worst_ms,
+                    "worst_rank": m.worst_rank,
+                    "skew_pct": m.skew_pct,
+                    "share": window.share_of_step(key),
+                }
+            # per-rank step series for the sparkline
+            series = {
+                str(r): w.series[STEP_KEY][-60:]
+                for r, w in window.rank_windows.items()
+            }
+            out["step_time"] = {
+                "clock": window.clock,
+                "n_steps": window.n_steps,
+                "steps": window.steps[-60:],
+                "phases": phases,
+                "step_series": series,
+            }
+            result = diagnose_rank_rows(rank_rows, mode="live")
+            d = result.diagnosis
+            out["diagnosis"] = {
+                "kind": d.kind,
+                "severity": d.severity,
+                "summary": d.summary,
+                "action": d.action,
+            }
+    except Exception as exc:
+        out["step_time_error"] = str(exc)
+    try:
+        mem = loaders.load_step_memory_rows(db_path, max_rows_per_rank=window_steps)
+        for rank in sorted(mem):
+            rows = mem[rank]
+            if not rows:
+                continue
+            last = rows[-1]
+            out["memory"].append(
+                {
+                    "rank": rank,
+                    "current_bytes": last.get("current_bytes"),
+                    "step_peak_bytes": last.get("step_peak_bytes"),
+                    "limit_bytes": last.get("limit_bytes"),
+                    "series": [r.get("current_bytes") or 0 for r in rows[-60:]],
+                }
+            )
+    except Exception:
+        pass
+    try:
+        host, _devices = loaders.load_system_rows(db_path, max_rows=120)
+        for node in sorted(host):
+            rows = host[node]
+            if not rows:
+                continue
+            last = rows[-1]
+            out["system"].append(
+                {
+                    "node": node,
+                    "cpu_pct": last.get("cpu_pct"),
+                    "memory_used_bytes": last.get("memory_used_bytes"),
+                    "memory_total_bytes": last.get("memory_total_bytes"),
+                }
+            )
+    except Exception:
+        pass
+    try:
+        out["stdout"] = [
+            {"stream": s, "line": l}
+            for s, l in loaders.load_stdout_tail(db_path, n=14)
+        ]
+    except Exception:
+        pass
+    return out

@@ -34,7 +34,7 @@ class TraceMLRuntime:
         self.identity = identity or resolve_runtime_identity()
         self.recording = RecordingState(settings.trace_max_steps)
         self.capture: Optional[StreamCapture] = None
-        if settings.mode == "cli":
+        if settings.mode in ("cli", "dashboard"):
             self.capture = StreamCapture(capture_stderr=settings.capture_stderr)
         self.samplers: List[BaseSampler] = []
         self.client: Optional[TCPClient] = None

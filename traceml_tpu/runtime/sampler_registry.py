@@ -74,7 +74,7 @@ def build_samplers(
         out.append(sampler)
 
     # stdout capture is wired explicitly (needs the StreamCapture object)
-    if capture is not None and settings.mode == "cli":
+    if capture is not None and settings.mode in ("cli", "dashboard"):
         from traceml_tpu.samplers.stdout_stderr_sampler import StdoutStderrSampler
 
         sampler = StdoutStderrSampler(
