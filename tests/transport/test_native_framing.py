@@ -1,0 +1,71 @@
+"""Native framing extension: build, parity with the Python path, and
+fallback behavior."""
+
+import struct
+
+import pytest
+
+from traceml_tpu.native import get_framing
+
+native = get_framing()
+
+
+@pytest.mark.skipif(native is None, reason="C toolchain unavailable")
+class TestNativeFraming:
+    def test_pack_and_drain_roundtrip(self):
+        bodies = [b"hello", b"", b"x" * 10000, bytes(range(256))]
+        blob = native.pack_frames(bodies)
+        frames, consumed = native.drain_frames(blob, 0, 1 << 20)
+        assert frames == bodies
+        assert consumed == len(blob)
+
+    def test_partial_frame_stops_cleanly(self):
+        blob = native.pack_frames([b"abc", b"defg"])
+        # cut into the middle of the second frame
+        cut = blob[: len(blob) - 2]
+        frames, consumed = native.drain_frames(cut, 0, 1 << 20)
+        assert frames == [b"abc"]
+        assert consumed == 4 + 3
+
+    def test_offset_resume(self):
+        blob = native.pack_frames([b"one", b"two"])
+        frames1, consumed1 = native.drain_frames(blob[: 4 + 3], 0, 1 << 20)
+        assert frames1 == [b"one"]
+        frames2, consumed2 = native.drain_frames(blob, consumed1, 1 << 20)
+        assert frames2 == [b"two"]
+        assert consumed2 == len(blob)
+
+    def test_oversized_frame_raises(self):
+        bad = struct.pack(">I", 1 << 30) + b"xx"
+        with pytest.raises(ValueError):
+            native.drain_frames(bad, 0, 1 << 20)
+
+    def test_parity_with_python_framing(self):
+        from traceml_tpu.transport.tcp_transport import _LEN
+
+        bodies = [b"a" * n for n in (0, 1, 7, 1000)]
+        py_blob = b"".join(_LEN.pack(len(b)) + b for b in bodies)
+        assert native.pack_frames(bodies) == py_blob
+        frames, consumed = native.drain_frames(py_blob, 0, 1 << 20)
+        assert frames == bodies
+
+
+def test_transport_works_regardless_of_native():
+    """The TCP path must work with whatever get_framing() returned."""
+    from traceml_tpu.transport.tcp_transport import _ClientBuffer, encode_frame
+
+    buf = _ClientBuffer()
+    frame = encode_frame({"k": list(range(50))})
+    out = []
+    for i in range(0, len(frame), 11):
+        out.extend(buf.feed(frame[i : i + 11]))
+    assert len(out) == 1
+
+
+def test_no_native_env_disables(monkeypatch):
+    import traceml_tpu.native as nat
+
+    monkeypatch.setenv("TRACEML_NO_NATIVE", "1")
+    monkeypatch.setattr(nat, "_cached", None)
+    monkeypatch.setattr(nat, "_attempted", False)
+    assert nat.get_framing() is None

@@ -1,0 +1,85 @@
+"""Native fast paths with build-on-first-use and pure-Python fallback.
+
+``get_framing()`` returns the compiled ``_framing`` extension module or
+``None``.  The first call may invoke the C compiler (a few seconds,
+cached as a ``.so`` next to the source); any failure — no compiler, no
+headers, sandbox — silently falls back to the Python implementations in
+``transport/tcp_transport.py``.  Set ``TRACEML_NO_NATIVE=1`` to skip.
+"""
+
+from __future__ import annotations
+
+import importlib
+import importlib.util
+import os
+import subprocess
+import sys
+import threading
+from pathlib import Path
+from typing import Optional
+
+_lock = threading.Lock()
+_cached = None
+_attempted = False
+
+_HERE = Path(__file__).resolve().parent
+
+
+def _try_import() -> Optional[object]:
+    for so in _HERE.glob("_framing*.so"):
+        try:
+            # the name must match PyInit__framing
+            spec = importlib.util.spec_from_file_location("_framing", so)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)  # type: ignore[union-attr]
+            return mod
+        except Exception:
+            continue
+    return None
+
+
+def _build() -> bool:
+    """Compile framing.c into this directory; True on success."""
+    try:
+        import sysconfig
+
+        include = sysconfig.get_paths()["include"]
+        src = _HERE / "framing.c"
+        ext = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+        out = _HERE / f"_framing{ext}"
+        cmd = [
+            os.environ.get("CC", "cc"),
+            "-O2",
+            "-shared",
+            "-fPIC",
+            f"-I{include}",
+            str(src),
+            "-o",
+            str(out),
+        ]
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=120
+        )
+        return proc.returncode == 0 and out.exists()
+    except Exception:
+        return False
+
+
+def get_framing() -> Optional[object]:
+    """The compiled extension, building it on first use; None on failure."""
+    global _cached, _attempted
+    if _cached is not None:
+        return _cached
+    if _attempted:
+        return None
+    with _lock:
+        if _cached is not None or _attempted:
+            return _cached
+        _attempted = True
+        if os.environ.get("TRACEML_NO_NATIVE", "").strip() in ("1", "true"):
+            return None
+        mod = _try_import()
+        if mod is None and _build():
+            mod = _try_import()
+        _cached = mod
+        return mod

@@ -35,9 +35,18 @@ from traceml_tpu.utils.error_log import get_error_log
 _LEN = struct.Struct(">I")
 MAX_FRAME_BYTES = 256 * 1024 * 1024  # sanity bound against corrupt lengths
 
+# optional C fast path (traceml_tpu/native/framing.c); None → pure Python
+try:
+    from traceml_tpu.native import get_framing
+
+    _native = get_framing()
+except Exception:  # pragma: no cover
+    _native = None
+
 
 class _ClientBuffer:
-    """Incremental frame decoder with O(total bytes) drain."""
+    """Incremental frame decoder with O(total bytes) drain (C fast path
+    when the native extension built; identical framing either way)."""
 
     __slots__ = ("buf", "offset")
 
@@ -47,19 +56,25 @@ class _ClientBuffer:
 
     def feed(self, data: bytes) -> List[bytes]:
         self.buf.extend(data)
-        frames: List[bytes] = []
-        while True:
-            avail = len(self.buf) - self.offset
-            if avail < _LEN.size:
-                break
-            (n,) = _LEN.unpack_from(self.buf, self.offset)
-            if n > MAX_FRAME_BYTES:
-                raise ValueError(f"frame length {n} exceeds bound")
-            if avail < _LEN.size + n:
-                break
-            start = self.offset + _LEN.size
-            frames.append(bytes(self.buf[start : start + n]))
-            self.offset = start + n
+        if _native is not None:
+            frames, consumed = _native.drain_frames(
+                bytes(self.buf), self.offset, MAX_FRAME_BYTES
+            )
+            self.offset = consumed
+        else:
+            frames = []
+            while True:
+                avail = len(self.buf) - self.offset
+                if avail < _LEN.size:
+                    break
+                (n,) = _LEN.unpack_from(self.buf, self.offset)
+                if n > MAX_FRAME_BYTES:
+                    raise ValueError(f"frame length {n} exceeds bound")
+                if avail < _LEN.size + n:
+                    break
+                start = self.offset + _LEN.size
+                frames.append(bytes(self.buf[start : start + n]))
+                self.offset = start + n
         # Compact once consumed prefix dominates — amortized O(1) per byte.
         if self.offset > 65536 and self.offset * 2 > len(self.buf):
             del self.buf[: self.offset]
@@ -69,6 +84,8 @@ class _ClientBuffer:
 
 def encode_frame(payload: Any) -> bytes:
     body = msgpack_codec.encode(payload)
+    if _native is not None:
+        return _native.pack_frames([body])
     return _LEN.pack(len(body)) + body
 
 
