@@ -1,0 +1,10 @@
+"""Injected input straggler (reference demo analogue):
+
+    traceml-tpu run --nprocs 4 examples/diagnosis/input_straggler_demo.py
+
+Expected verdict: INPUT_STRAGGLER on the last rank.
+"""
+
+from traceml_tpu.dev.demo.scenarios import run_scenario
+
+run_scenario("input_straggler", steps=100)

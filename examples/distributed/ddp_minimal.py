@@ -1,0 +1,34 @@
+"""Multi-rank demo: run with  traceml-tpu run --nprocs 4 \
+    examples/distributed/ddp_minimal.py
+
+Each process is one rank (RANK/WORLD_SIZE from the launcher's env
+contract); the final summary aggregates all ranks and reports cross-rank
+skew.  On a real pod, the same script runs one process per host with
+jax.distributed.initialize().
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import traceml_tpu
+from traceml_tpu.models.mlp import TinyMLP, make_mlp_train_step
+
+traceml_tpu.init(mode="auto")
+rank = int(os.environ.get("RANK", 0))
+
+model = TinyMLP(hidden=256, depth=3)
+init, train_step = make_mlp_train_step(model)
+params, opt_state = init(jax.random.PRNGKey(rank), np.zeros((1, 64), np.float32))
+step = traceml_tpu.wrap_step_fn(train_step)
+
+rng = np.random.default_rng(rank)
+for i in range(120):
+    with traceml_tpu.trace_step():
+        x = jax.device_put(rng.normal(size=(64, 64)).astype(np.float32))
+        y = jax.device_put(rng.normal(size=(64, 1)).astype(np.float32))
+        params, opt_state, loss = step(params, opt_state, x, y)
+
+print(f"rank {rank} done, loss={float(loss):.4f}")

@@ -1,0 +1,35 @@
+"""Minimal Flax/Optax training loop under TraceML-TPU.
+
+Run:  traceml-tpu run --mode cli examples/quickstart/flax_minimal.py
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import traceml_tpu
+from traceml_tpu.models import ModelConfig, init_train_state, make_train_step
+
+traceml_tpu.init(mode="auto")
+
+cfg = ModelConfig(vocab_size=4096, hidden=256, n_layers=4, n_heads=8,
+                  n_kv_heads=4, max_seq_len=256)
+model, state, tx = init_train_state(cfg, jax.random.PRNGKey(0))
+step = traceml_tpu.wrap_step_fn(make_train_step(model, tx), donate_argnums=(0,))
+
+rng = np.random.default_rng(0)
+
+
+def batches(n=200):
+    for _ in range(n):
+        yield rng.integers(0, cfg.vocab_size, (8, 256)).astype(np.int32)
+
+
+for tokens in traceml_tpu.wrap_dataloader(batches()):
+    with traceml_tpu.trace_step():
+        tokens = jax.device_put(jnp.asarray(tokens))
+        state, metrics = step(state, tokens)
+
+print("final loss:", float(metrics["loss"]))
+print(traceml_tpu.summary())

@@ -1,0 +1,48 @@
+"""Hugging Face Trainer with the TraceML-TPU callback.
+
+Run:  traceml-tpu run --mode summary \
+          examples/quickstart/huggingface_trainer_minimal.py
+"""
+
+import numpy as np
+import torch
+
+from transformers import (
+    BertConfig,
+    BertForSequenceClassification,
+    Trainer,
+    TrainingArguments,
+)
+
+from traceml_tpu.integrations.huggingface import TraceMLTrainerCallback
+
+
+class ToyDataset(torch.utils.data.Dataset):
+    def __len__(self):
+        return 256
+
+    def __getitem__(self, i):
+        rng = np.random.default_rng(i)
+        return {
+            "input_ids": torch.tensor(rng.integers(0, 1000, 32)),
+            "attention_mask": torch.ones(32, dtype=torch.long),
+            "labels": torch.tensor(i % 2),
+        }
+
+
+config = BertConfig(
+    vocab_size=1000, hidden_size=64, num_hidden_layers=2,
+    num_attention_heads=2, intermediate_size=128,
+)
+model = BertForSequenceClassification(config)
+
+trainer = Trainer(
+    model=model,
+    args=TrainingArguments(
+        output_dir="/tmp/traceml_hf_out", num_train_epochs=1,
+        per_device_train_batch_size=8, logging_steps=50, report_to=[],
+    ),
+    train_dataset=ToyDataset(),
+    callbacks=[TraceMLTrainerCallback()],
+)
+trainer.train()

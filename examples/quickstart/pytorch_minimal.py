@@ -1,0 +1,28 @@
+"""Minimal torch training loop under TraceML-TPU (CPU or torch-xla).
+
+Run:  traceml-tpu run --mode cli examples/quickstart/pytorch_minimal.py
+"""
+
+import torch
+import torch.nn as nn
+from torch.utils.data import DataLoader, TensorDataset
+
+import traceml_tpu
+
+traceml_tpu.init(mode="auto")
+
+model = nn.Sequential(nn.Linear(64, 256), nn.Tanh(), nn.Linear(256, 1))
+opt = torch.optim.Adam(model.parameters(), lr=1e-3)
+loss_fn = nn.MSELoss()
+loader = DataLoader(
+    TensorDataset(torch.randn(2048, 64), torch.randn(2048, 1)), batch_size=16
+)
+
+for epoch in range(3):
+    for x, y in loader:
+        with traceml_tpu.trace_step():
+            opt.zero_grad()
+            loss = loss_fn(model(x), y)
+            loss.backward()
+            opt.step()
+print("final loss:", float(loss))
