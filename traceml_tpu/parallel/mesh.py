@@ -6,9 +6,10 @@ and the flagship model need a consistent way to build a
 v4-8 slice, or 8 virtual CPU devices in CI) and to shard batches/params
 over it.  Axis convention follows the scaling-book recipe:
 
-* ``data``   — pure data parallelism (batch dim)
-* ``fsdp``   — parameter/optimizer sharding (ZeRO-ish), also batch
-* ``tensor`` — tensor parallelism (heads / ffn dims)
+* ``data``    — pure data parallelism (batch dim)
+* ``fsdp``    — parameter/optimizer sharding (ZeRO-ish), also batch
+* ``tensor``  — tensor parallelism (heads / ffn dims)
+* ``context`` — sequence/context parallelism (ring attention over ICI)
 """
 
 from __future__ import annotations
@@ -17,7 +18,7 @@ from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
-AXES = ("data", "fsdp", "tensor")
+AXES = ("data", "fsdp", "tensor", "context")
 
 
 def make_mesh(
@@ -54,7 +55,7 @@ def make_mesh(
                 f"mesh shape {dict(zip(AXES, sizes))} needs {total} devices, "
                 f"have {n}"
             )
-        sizes = [1, n, 1]
+        sizes = [n if ax == "fsdp" else 1 for ax in AXES]
     arr = np.array(devices).reshape(sizes)
     return Mesh(arr, AXES)
 
