@@ -1,0 +1,114 @@
+"""torch-xla (TPU) support — gated; torch_xla is not in this image.
+
+What it adds when torch_xla IS present (BASELINE configs: BERT-base and
+Llama-3-8B FSDP via torch-xla on TPU slices):
+
+* ``patch_mark_step()`` — wraps ``torch_xla.core.xla_model.mark_step``
+  (and ``torch_xla.sync`` on newer versions) in a timed region named
+  ``collective``: under torch-xla the lazy graph executes AT the step
+  barrier, so mark_step wall time IS the device execution + collective
+  wait for the step — the torch-xla analogue of our JAX readiness edges.
+* ``XlaMemoryBackend`` — per-device memory via
+  ``torch_xla.core.xla_model.get_memory_info`` (kb fields), plugged into
+  the standard StepMemoryTracker backend protocol.
+* identity: torch-xla jobs run one process per host with torchrun-style
+  env, which ``runtime/identity.py`` already resolves.
+
+The generic torch patches (DataLoader/forward/backward/optimizer —
+instrumentation/patches/torch_patches.py) apply unchanged: they are
+host-clock dispatch timers, which is exactly what is observable under
+lazy execution; the mark_step region carries the device truth.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from traceml_tpu.sdk.state import get_state
+from traceml_tpu.utils.error_log import get_error_log
+from traceml_tpu.utils.timing import COLLECTIVE_TIME, timed_region
+
+_original_mark_step: Optional[Any] = None
+
+
+def torch_xla_available() -> bool:
+    try:
+        import torch_xla  # noqa: F401
+
+        return True
+    except Exception:
+        return False
+
+
+def patch_mark_step() -> bool:
+    """Time the lazy-execution barrier.  Idempotent; False when gated."""
+    global _original_mark_step
+    if _original_mark_step is not None:
+        return True
+    try:
+        import torch_xla.core.xla_model as xm
+    except Exception:
+        return False
+    original = xm.mark_step
+
+    def timed_mark_step(*args: Any, **kwargs: Any):
+        st = get_state()
+        if not st.tls.in_step:
+            return original(*args, **kwargs)
+        with timed_region(COLLECTIVE_TIME, st.current_step, sink=st.buffer.add):
+            return original(*args, **kwargs)
+
+    timed_mark_step._traceml_original = original  # type: ignore[attr-defined]
+    xm.mark_step = timed_mark_step
+    _original_mark_step = original
+    return True
+
+
+def unpatch_mark_step() -> None:
+    global _original_mark_step
+    if _original_mark_step is None:
+        return
+    try:
+        import torch_xla.core.xla_model as xm
+
+        xm.mark_step = _original_mark_step
+    except Exception:
+        pass
+    _original_mark_step = None
+
+
+class XlaMemoryBackend:
+    """StepMemoryTracker backend over torch-xla memory info."""
+
+    name = "torch_xla"
+
+    def __init__(self) -> None:
+        import torch_xla.core.xla_model as xm
+
+        self._xm = xm
+        devices = xm.get_xla_supported_devices()
+        if not devices:
+            raise RuntimeError("no xla devices")
+        self._devices = devices
+
+    def sample(self) -> List[dict]:
+        out = []
+        for i, dev in enumerate(self._devices):
+            try:
+                info = self._xm.get_memory_info(dev)
+            except Exception as exc:
+                get_error_log().warning(f"xla memory info failed for {dev}", exc)
+                continue
+            total = int(info.get("kb_total", 0)) * 1024
+            free = int(info.get("kb_free", 0)) * 1024
+            used = max(0, total - free)
+            out.append(
+                {
+                    "device_id": i,
+                    "device_kind": str(dev),
+                    "current_bytes": used,
+                    "peak_bytes": used,
+                    "limit_bytes": total or None,
+                }
+            )
+        return out
