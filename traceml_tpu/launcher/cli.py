@@ -80,6 +80,10 @@ def _build_parser() -> argparse.ArgumentParser:
     )
     watch.add_argument("session_dir", help="path to <logs>/<session>")
     watch.add_argument("--interval", type=float, default=1.0)
+    watch.add_argument(
+        "--browser", action="store_true",
+        help="serve the browser dashboard over this session",
+    )
 
     view = sub.add_parser("view", help="print a stored final summary")
     view.add_argument("path", help="final_summary.json (or session dir)")
@@ -145,7 +149,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.command == "watch":
         from traceml_tpu.launcher.watch_cmd import run_watch
 
-        return run_watch(Path(args.session_dir), interval=args.interval)
+        return run_watch(
+            Path(args.session_dir),
+            interval=args.interval,
+            browser=args.browser,
+        )
     return 2
 
 

@@ -52,11 +52,15 @@ def _snapshot(session_dir: Path) -> str:
     return "\n".join(lines)
 
 
-def run_watch(session_dir: Path, interval: float = 1.0) -> int:
+def run_watch(
+    session_dir: Path, interval: float = 1.0, browser: bool = False
+) -> int:
     session_dir = Path(session_dir)
     if not session_dir.exists():
         print(f"no session at {session_dir}")
         return 1
+    if browser:
+        return _run_watch_browser(session_dir)
     try:
         while True:
             print("\x1b[2J\x1b[H" + _snapshot(session_dir), flush=True)
@@ -69,3 +73,36 @@ def run_watch(session_dir: Path, interval: float = 1.0) -> int:
             time.sleep(interval)
     except KeyboardInterrupt:
         return 0
+
+
+def _run_watch_browser(session_dir: Path) -> int:
+    """Serve the browser dashboard over an existing session (live or
+    post-hoc): `traceml-tpu watch --browser <session_dir>`."""
+    import dataclasses
+
+    from traceml_tpu.aggregator.display_drivers.browser import (
+        BrowserDisplayDriver,
+    )
+    from traceml_tpu.runtime.settings import TraceMLSettings
+
+    settings = TraceMLSettings(
+        session_id=session_dir.name, logs_dir=session_dir.parent
+    )
+
+    @dataclasses.dataclass
+    class _Ctx:
+        db_path: Path
+        settings: TraceMLSettings
+
+    driver = BrowserDisplayDriver()
+    driver.start(_Ctx(session_dir / "telemetry.sqlite", settings))
+    if driver.port is None:
+        print("dashboard failed to start")
+        return 1
+    try:
+        while True:
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        driver.stop()

@@ -90,12 +90,20 @@ def _build_step_time_section(db_path: Path, mode: str):
                 "share_of_step": window.share_of_step(key),
                 "per_rank_avg_ms": {str(r): v for r, v in m.per_rank_avg_ms.items()},
             }
+        # short per-rank step series (downsampled) for charts/compare
+        tail = 120
+        series = {
+            str(r): [round(v, 3) for v in w.series[STEP_KEY][-tail:]]
+            for r, w in window.rank_windows.items()
+        }
         section["global"] = {
             "clock": window.clock,
             "n_steps": window.n_steps,
             "step_range": [window.steps[0], window.steps[-1]],
             "ranks": window.ranks,
             "phases": phases,
+            "step_series_ms": series,
+            "step_series_steps": window.steps[-tail:],
         }
     return section, result
 

@@ -75,6 +75,42 @@ def _phase_bar(phases: Dict[str, Any]) -> str:
     )
 
 
+def _step_series_svg(series: Dict[str, Any], width: int = 900, height: int = 120) -> str:
+    """Inline SVG polylines: one line per rank, shared scale."""
+    all_vals = [v for vs in series.values() for v in vs if v is not None]
+    if not all_vals:
+        return ""
+    vmax = max(all_vals) or 1.0
+    lines = []
+    hues = [210, 0, 120, 280, 30, 170, 330, 60]
+    for i, (rank, vs) in enumerate(sorted(series.items(), key=lambda kv: int(kv[0]))):
+        if not vs:
+            continue
+        n = len(vs)
+        pts = " ".join(
+            f"{(j / max(1, n - 1)) * width:.1f},"
+            f"{height - 4 - (v / vmax) * (height - 10):.1f}"
+            for j, v in enumerate(vs)
+        )
+        hue = hues[i % len(hues)]
+        lines.append(
+            f'<polyline fill="none" stroke="hsl({hue},65%,45%)" '
+            f'stroke-width="1.2" points="{pts}"><title>rank {_esc(rank)}'
+            f"</title></polyline>"
+        )
+    legend = " ".join(
+        f'<tspan fill="hsl({hues[i % len(hues)]},65%,45%)">rank {_esc(r)}</tspan>'
+        for i, r in enumerate(sorted(series, key=int))
+    )
+    return (
+        f'<svg viewBox="0 0 {width} {height}" '
+        f'style="width:100%;height:{height}px;background:#f4f4f8;'
+        f'border-radius:6px">{"".join(lines)}'
+        f'<text x="6" y="14" font-size="11">{legend} · max {vmax:.1f} ms</text>'
+        f"</svg>"
+    )
+
+
 def render_html_summary(payload: Dict[str, Any]) -> str:
     meta = payload.get("meta") or {}
     primary = payload.get("primary_diagnosis") or {}
@@ -102,6 +138,10 @@ def render_html_summary(payload: Dict[str, Any]) -> str:
     st = (payload.get("sections") or {}).get("step_time") or {}
     g = st.get("global") or {}
     phases = g.get("phases") or {}
+    series = g.get("step_series_ms") or {}
+    if series:
+        out.append("<h2>Step time per step</h2>")
+        out.append(_step_series_svg(series))
     if phases:
         out.append("<h2>Step time</h2>")
         out.append(
