@@ -136,13 +136,13 @@ def main() -> int:
         return _reexec_on_cpu()
     import jax
 
-    # ---- untraced arm ---------------------------------------------------
+    # ---- build BOTH arms, then measure in INTERLEAVED rounds ----------
+    # (sequential arms are biased by machine-load drift; per-round
+    # paired deltas with a median are robust to it)
     model, state, tx, train_step, batches = _build()
     plain = jax.jit(train_step, donate_argnums=(0,))
     _, state = _run_loop(plain, state, batches, WARMUP_STEPS)  # compile+warm
-    untraced_s, _ = _run_loop(plain, state, batches, MEASURE_STEPS)
 
-    # ---- traced arm -----------------------------------------------------
     import traceml_tpu
     from traceml_tpu.aggregator.trace_aggregator import TraceMLAggregator
     from traceml_tpu.runtime.identity import RuntimeIdentity
@@ -172,16 +172,30 @@ def main() -> int:
     _, state2 = _run_loop(
         traced, state2, batches2, WARMUP_STEPS, bracket=traceml_tpu.trace_step
     )
-    traced_s, _ = _run_loop(
-        traced, state2, batches2, MEASURE_STEPS, bracket=traceml_tpu.trace_step
-    )
+
+    rounds = 5
+    steps_per_round = max(10, MEASURE_STEPS // rounds)
+    deltas = []
+    u_all, t_all = [], []
+    for _ in range(rounds):
+        u, state = _run_loop(plain, state, batches, steps_per_round)
+        t, state2 = _run_loop(
+            traced, state2, batches2, steps_per_round,
+            bracket=traceml_tpu.trace_step,
+        )
+        u_all.append(u)
+        t_all.append(t)
+        deltas.append((t - u) / u * 100.0)
     runtime.stop()
     agg.stop(finalize_timeout=5.0)
 
-    overhead_pct = max(0.0, (traced_s - untraced_s) / untraced_s * 100.0)
+    untraced_s = statistics.median(u_all)
+    traced_s = statistics.median(t_all)
+    overhead_pct = max(0.0, statistics.median(deltas))
     print(
         f"[bench] untraced {untraced_s * 1000:.2f} ms/step, "
-        f"traced {traced_s * 1000:.2f} ms/step on {jax.default_backend()}",
+        f"traced {traced_s * 1000:.2f} ms/step on {jax.default_backend()} "
+        f"(per-round deltas: {[round(d, 1) for d in deltas]})",
         file=sys.stderr,
     )
     print(

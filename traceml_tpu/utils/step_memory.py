@@ -234,10 +234,21 @@ class StepMemoryTracker:
     """Records device memory at step edges and emits one row per
     (step, device) into the global step-memory queue."""
 
-    def __init__(self, backend: Optional[MemoryBackend] = None) -> None:
+    def __init__(
+        self,
+        backend: Optional[MemoryBackend] = None,
+        min_sample_interval_s: float = 0.2,
+    ) -> None:
         self._backend = backend or detect_backend()
         self._step_start: Dict[int, Dict[str, Any]] = {}
         self._have_edge = False
+        # Time-based throttle: sub-interval steps share one sample, so
+        # memory sampling cost stays O(1/interval) per second instead of
+        # O(1/step) — short-step jobs keep <1% overhead, and the creep/
+        # pressure diagnostics are cadence-based, not per-step.  Rows
+        # are simply sparse in `step`; every consumer iterates rows.
+        self._min_interval = float(min_sample_interval_s)
+        self._last_sample_mono = 0.0
 
     @property
     def backend_name(self) -> str:
@@ -260,7 +271,12 @@ class StepMemoryTracker:
             self._step_start = {}
 
     def record(self, step: int) -> List[Dict[str, Any]]:
-        """Step-end edge; emits rows and returns them (for tests)."""
+        """Step-end edge; emits rows and returns them (for tests).
+        Skipped (returns []) when inside the sampling throttle window."""
+        now = time.monotonic()
+        if self._min_interval > 0 and now - self._last_sample_mono < self._min_interval:
+            return []
+        self._last_sample_mono = now
         rows: List[Dict[str, Any]] = []
         try:
             ts = time.time()
