@@ -1,0 +1,62 @@
+import io
+import sys
+
+from traceml_tpu.runtime.executor import run_user_script
+from traceml_tpu.runtime.stdout_capture import StreamCapture
+from traceml_tpu.utils.error_log import ErrorLog
+
+
+def test_run_user_script_argv_and_exit(tmp_path):
+    script = tmp_path / "s.py"
+    script.write_text("import sys\nprint('args:', sys.argv[1:])\nsys.exit(3)\n")
+    code = run_user_script(str(script), ["--x", "1"])
+    assert code == 3
+    script.write_text("print('ok')\n")
+    assert run_user_script(str(script), []) == 0
+    script.write_text("import sys\nsys.exit('boom')\n")
+    assert run_user_script(str(script), []) == 1  # non-int exit normalized
+
+
+def test_stream_capture_tee_and_drain(capsys):
+    cap = StreamCapture(max_lines=5)
+    cap.start()
+    try:
+        print("hello one")
+        print("hello two")
+        sys.stderr.write("err line\n")
+        # passthrough attrs proxy to the original stream
+        assert sys.stdout.encoding
+        assert hasattr(sys.stdout, "buffer")
+    finally:
+        cap.stop()
+    lines = cap.drain()
+    streams = [s for s, _ in lines]
+    texts = [t for _, t in lines]
+    assert "hello one" in texts
+    assert "err line" in texts
+    assert "stderr" in streams
+    # passthrough reached the real stdout too
+    out = capsys.readouterr()
+    assert "hello one" in out.out
+
+
+def test_stream_capture_bounded():
+    cap = StreamCapture(max_lines=3)
+    for i in range(10):
+        cap._add("stdout", f"line{i}")
+    lines = cap.drain()
+    assert len(lines) == 3
+    assert lines[-1][1] == "line9"
+
+
+def test_error_log_never_raises(tmp_path):
+    log = ErrorLog(tmp_path / "sub" / "e.log", component="test")
+    log.error("something failed", ValueError("boom"))
+    log.warning("a warning")
+    log.info("fyi")
+    text = (tmp_path / "sub" / "e.log").read_text()
+    assert "[TraceML]" in text
+    assert "ValueError: boom" in text
+    assert "fyi" in text
+    # pathless logger swallows
+    ErrorLog(None).error("nowhere", RuntimeError("x"))

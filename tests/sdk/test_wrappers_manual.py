@@ -1,0 +1,95 @@
+"""Manual-mode wrappers (reference coverage model:
+tests/sdk/test_init_and_wrappers.py — duplicate guards, TLS gating)."""
+
+import pytest
+
+from traceml_tpu.sdk import state as state_mod
+from traceml_tpu.sdk.instrumentation import trace_step
+from traceml_tpu.sdk.wrappers import (
+    wrap_backward,
+    wrap_forward,
+    wrap_h2d,
+    wrap_optimizer,
+)
+from traceml_tpu.utils.step_memory import FakeMemoryBackend, StepMemoryTracker
+from traceml_tpu.utils.timing import (
+    BACKWARD_TIME,
+    FORWARD_TIME,
+    GLOBAL_STEP_QUEUE,
+    H2D_TIME,
+    OPTIMIZER_STEP,
+    drain_step_memory_rows,
+)
+
+
+@pytest.fixture(autouse=True)
+def fresh_state():
+    st = state_mod.reset_state_for_tests()
+    st.mem_tracker = StepMemoryTracker(FakeMemoryBackend([[]]))
+    GLOBAL_STEP_QUEUE.drain()
+    drain_step_memory_rows()
+    yield st
+    GLOBAL_STEP_QUEUE.drain()
+
+
+def _names():
+    return [e.name for b in GLOBAL_STEP_QUEUE.drain() for e in b.events]
+
+
+def test_wrap_forward_and_backward_time_phases(fresh_state):
+    fwd = wrap_forward(lambda x: x * 2)
+    bwd = wrap_backward(lambda g: g + 1)
+    with trace_step():
+        assert fwd(3) == 6
+        assert bwd(1) == 2
+    names = _names()
+    assert FORWARD_TIME in names
+    assert BACKWARD_TIME in names
+
+
+def test_nested_wrapped_forward_times_once(fresh_state):
+    inner = wrap_forward(lambda x: x + 1)
+    outer = wrap_forward(lambda x: inner(x) * 2)
+    with trace_step():
+        assert outer(1) == 4
+    names = _names()
+    assert names.count(FORWARD_TIME) == 1  # depth guard
+
+
+def test_wrap_optimizer_inplace_and_guarded(fresh_state):
+    class Opt:
+        def __init__(self):
+            self.calls = 0
+
+        def step(self):
+            self.calls += 1
+
+    opt = Opt()
+    out = wrap_optimizer(opt)
+    assert out is opt
+    wrap_optimizer(opt)  # duplicate guard: no double wrap
+    with trace_step():
+        opt.step()
+    opt.step()  # outside a step: passes through untimed
+    assert opt.calls == 2
+    names = _names()
+    assert names.count(OPTIMIZER_STEP) == 1
+
+
+def test_wrap_h2d_moves_and_times(fresh_state):
+    import numpy as np
+
+    with trace_step():
+        arr = wrap_h2d(np.ones((8, 8), np.float32))
+    assert float(arr.sum()) == 64.0
+    names = _names()
+    assert H2D_TIME in names
+
+
+def test_wrappers_propagate_user_errors(fresh_state):
+    f = wrap_forward(lambda x: 1 / 0)
+    with trace_step():
+        with pytest.raises(ZeroDivisionError):
+            f(1)
+    assert not fresh_state.tls.in_step is None  # gates released
+    assert fresh_state.tls.forward_depth == 0

@@ -1,0 +1,69 @@
+"""Identity propagation through projections into summaries
+(reference: tests/telemetry/test_system_projection_identity.py +
+test_sender_sequence.py)."""
+
+import sqlite3
+
+from traceml_tpu.aggregator.sqlite_writer import SQLiteWriter
+from traceml_tpu.database import Database, DBIncrementalSender
+from traceml_tpu.telemetry.envelope import (
+    SenderIdentity,
+    build_telemetry_envelope,
+    normalize_telemetry_envelope,
+)
+
+
+def test_sender_sequence_no_loss_no_duplication():
+    db = Database()
+    sender = DBIncrementalSender("s", db)
+    sender.set_identity(SenderIdentity(session_id="x", global_rank=5))
+    shipped = []
+    for i in range(50):
+        db.add_record("t", {"i": i})
+        if i % 7 == 0:
+            payload = sender.collect_payload()
+            if payload:
+                shipped.extend(payload["body"]["tables"]["t"])
+    payload = sender.collect_payload()
+    if payload:
+        shipped.extend(payload["body"]["tables"]["t"])
+    assert [r["i"] for r in shipped] == list(range(50))
+    assert sender.collect_payload() is None
+
+
+def test_identity_columns_survive_projection(tmp_path):
+    db_path = tmp_path / "t.sqlite"
+    w = SQLiteWriter(db_path)
+    w.start()
+    ident = SenderIdentity(
+        session_id="sess-9",
+        global_rank=6,
+        local_rank=2,
+        world_size=8,
+        local_world_size=4,
+        node_rank=1,
+        hostname="node-b",
+        pid=4242,
+        platform="tpu",
+        device_kind="TPU v5p",
+    )
+    env = build_telemetry_envelope(
+        "process",
+        {"process": [{"timestamp": 1.0, "cpu_pct": 1.0, "rss_bytes": 2,
+                      "vms_bytes": 3, "num_threads": 4}]},
+        identity=ident,
+    )
+    # wire roundtrip preserves identity meta
+    norm = normalize_telemetry_envelope(env.to_wire())
+    assert norm.meta["hostname"] == "node-b"
+    assert norm.meta["local_world_size"] == 4
+    w.ingest(norm)
+    w.force_flush()
+    w.finalize()
+    conn = sqlite3.connect(db_path)
+    row = conn.execute(
+        "SELECT session_id, global_rank, local_rank, world_size,"
+        " local_world_size, node_rank, hostname, pid FROM process_samples"
+    ).fetchone()
+    conn.close()
+    assert row == ("sess-9", 6, 2, 8, 4, 1, "node-b", 4242)
