@@ -1,0 +1,76 @@
+"""Micro-benchmark: frame-drain implementations (not asserted in CI;
+run manually: python tests/benchmarks/bench_tcp_drain.py).
+
+Counterpart of the reference's tests/benchmarks/bench_tcp_drain.py —
+illustrative numbers comparing the native C drain, the Python rolling-
+offset drain, and a naive O(N²) del-prefix drain.
+"""
+
+import struct
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+_LEN = struct.Struct(">I")
+
+
+def make_blob(n_frames: int = 100_000, size: int = 128) -> bytes:
+    body = b"x" * size
+    frame = _LEN.pack(size) + body
+    return frame * n_frames
+
+
+def python_rolling(blob: bytes):
+    frames, off = [], 0
+    while len(blob) - off >= 4:
+        (n,) = _LEN.unpack_from(blob, off)
+        if len(blob) - off - 4 < n:
+            break
+        frames.append(blob[off + 4 : off + 4 + n])
+        off += 4 + n
+    return frames
+
+
+def python_naive(blob: bytes):
+    """O(N²): re-slices the buffer per frame (the anti-pattern)."""
+    buf = bytearray(blob)
+    frames = []
+    while len(buf) >= 4:
+        (n,) = _LEN.unpack_from(buf, 0)
+        if len(buf) - 4 < n:
+            break
+        frames.append(bytes(buf[4 : 4 + n]))
+        del buf[: 4 + n]
+    return frames
+
+
+def main() -> None:
+    from traceml_tpu.native import get_framing
+
+    native = get_framing()
+    blob = make_blob()
+    n = len(python_rolling(blob))
+    print(f"{n} frames of 128 B")
+
+    t0 = time.perf_counter()
+    python_rolling(blob)
+    print(f"python rolling-offset : {(time.perf_counter() - t0) * 1000:8.1f} ms")
+
+    if native is not None:
+        t0 = time.perf_counter()
+        native.drain_frames(blob, 0, 1 << 20)
+        print(f"native C drain        : {(time.perf_counter() - t0) * 1000:8.1f} ms")
+    else:
+        print("native C drain        : (not built)")
+
+    small = make_blob(10_000)
+    t0 = time.perf_counter()
+    python_naive(small)
+    naive_ms = (time.perf_counter() - t0) * 1000 * 10  # scaled to 100k
+    print(f"naive O(N^2) (scaled) : {naive_ms:8.1f} ms")
+
+
+if __name__ == "__main__":
+    main()

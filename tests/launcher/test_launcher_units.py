@@ -158,3 +158,38 @@ def test_compare_diagnosis_change_to_healthy_is_not_regression():
     cand["primary_diagnosis"]["severity"] = "info"
     payload = build_compare_payload(base, cand)
     assert payload["verdict"] == "IMPROVEMENT"
+
+
+def test_code_manifest_deep_extraction(tmp_path):
+    script = tmp_path / "deep.py"
+    script.write_text(
+        "import torch\n"
+        "from torch.utils.data import DataLoader\n"
+        "from transformers import TrainingArguments\n"
+        "import peft\n"
+        "loader = DataLoader(ds, batch_size=32, num_workers=0, pin_memory=True)\n"
+        "args = TrainingArguments(output_dir='x', bf16=True,\n"
+        "                         gradient_accumulation_steps=4,\n"
+        "                         per_device_train_batch_size=8)\n"
+        "loss.item()\n"
+    )
+    info = analyze_script(script)
+    assert info["dataloader_args"]["num_workers"] == 0
+    assert info["dataloader_args"]["pin_memory"] is True
+    assert "single_worker_dataloader" in info["input_hints"]
+    assert info["hf_training_args"]["gradient_accumulation_steps"] == 4
+    assert "bf16" in info["precision_hints"]
+    assert "lora/qlora" in info["uses"]
+    assert "item" in info["sync_call_hints"]
+
+
+def test_code_manifest_jax_donation(tmp_path):
+    script = tmp_path / "j.py"
+    script.write_text(
+        "import jax\n"
+        "step = jax.jit(f, donate_argnums=(0,))\n"
+        "jax.block_until_ready(x)\n"
+    )
+    info = analyze_script(script)
+    assert "buffer_donation" in info["uses"]
+    assert "block_until_ready" in info["sync_call_hints"]

@@ -71,6 +71,11 @@ class _ScriptVisitor(ast.NodeVisitor):
         self.imports: set = set()
         self.calls: List[str] = []
         self.attrs: List[str] = []
+        # call name → {kwarg: literal value} for calls whose config we
+        # surface (DataLoader workers, TrainingArguments precision, …)
+        self.call_kwargs: Dict[str, Dict[str, Any]] = {}
+
+    _KWARG_TARGETS = ("DataLoader", "TrainingArguments", "jit", "pjit")
 
     def visit_Import(self, node: ast.Import) -> None:
         for a in node.names:
@@ -88,6 +93,16 @@ class _ScriptVisitor(ast.NodeVisitor):
         name = _dotted(node.func)
         if name:
             self.calls.append(name)
+            tail = name.split(".")[-1]
+            if tail in self._KWARG_TARGETS:
+                kws = self.call_kwargs.setdefault(tail, {})
+                for kw in node.keywords:
+                    if kw.arg is None:
+                        continue
+                    try:
+                        kws[kw.arg] = ast.literal_eval(kw.value)
+                    except (ValueError, SyntaxError):
+                        kws.setdefault(kw.arg, "<dynamic>")
         self.generic_visit(node)
 
     def visit_Attribute(self, node: ast.Attribute) -> None:
@@ -168,6 +183,44 @@ def analyze_script(script: Path) -> Dict[str, Any]:
         out["input_hints"].append("explicit_device_put")
     if any_in("jax.checkpoint", "remat"):
         out["uses"].append("remat")
+
+    # config extraction (reference: scanner pulls dataloader args,
+    # TrainingArguments precision, grad accumulation, QLoRA markers)
+    dl = v.call_kwargs.get("DataLoader", {})
+    if dl:
+        out["dataloader_args"] = {
+            k: dl[k]
+            for k in ("num_workers", "pin_memory", "prefetch_factor",
+                      "batch_size", "persistent_workers")
+            if k in dl
+        }
+        if dl.get("num_workers", 1) in (0, None):
+            out["input_hints"].append("single_worker_dataloader")
+    ta = v.call_kwargs.get("TrainingArguments", {})
+    if ta:
+        out["hf_training_args"] = {
+            k: ta[k]
+            for k in ("per_device_train_batch_size",
+                      "gradient_accumulation_steps", "bf16", "fp16",
+                      "gradient_checkpointing", "optim")
+            if k in ta
+        }
+        if ta.get("bf16"):
+            out["precision_hints"].append("bf16")
+        if ta.get("fp16"):
+            out["precision_hints"].append("fp16/amp")
+    jit_kw = {**v.call_kwargs.get("jit", {}), **v.call_kwargs.get("pjit", {})}
+    if "donate_argnums" in jit_kw:
+        out["uses"].append("buffer_donation")
+    if imports & {"peft", "bitsandbytes"} or any_in("lora", "Lora", "LoRA"):
+        out["uses"].append("lora/qlora")
+    # host-sync calls inside the loop are a classic TPU/GPU perf trap
+    sync_markers = [
+        n for n in ("item", "block_until_ready", "device_get", "tolist")
+        if any(name.endswith("." + n) or name == n for name in set(v.calls))
+    ]
+    if sync_markers:
+        out["sync_call_hints"] = sync_markers
     return out
 
 

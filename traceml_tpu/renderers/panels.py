@@ -119,6 +119,57 @@ def system_panel(payload: Dict[str, Any]) -> Panel:
     return Panel(table, title="system")
 
 
+def process_panel(payload: Dict[str, Any]) -> Panel:
+    proc = payload.get("process") or {}
+    procs = proc.get("procs") or {}
+    if not procs:
+        return Panel(Text("no process telemetry", style="dim"), title="processes")
+    table = Table(expand=True, box=None)
+    table.add_column("rank", justify="right")
+    table.add_column("pid", justify="right")
+    table.add_column("cpu", justify="right")
+    table.add_column("rss", justify="right")
+    table.add_column("threads", justify="right")
+    for rank in sorted(procs):
+        rows = procs[rank]
+        if not rows:
+            continue
+        last = rows[-1]
+        table.add_row(
+            str(rank),
+            str(last.get("pid", "—")),
+            f"{last.get('cpu_pct') or 0:.0f}%",
+            fmt_bytes(last.get("rss_bytes")),
+            str(last.get("num_threads", "—")),
+        )
+    return Panel(table, title="processes")
+
+
+def diagnostics_panel(payload: Dict[str, Any]) -> Panel:
+    """Composed model-diagnostics card (reference:
+    renderers/model_diagnostics/renderer.py:94)."""
+    issues = []
+    st = payload.get("step_time") or {}
+    diag = st.get("diagnosis")
+    if diag is not None:
+        for issue in diag.issues:
+            if issue.status != "ok":
+                issues.append(("step_time", issue))
+    if not issues:
+        return Panel(
+            Text("no active findings", style="dim green"),
+            title="diagnostics",
+        )
+    text = Text()
+    for domain, issue in issues[:6]:
+        text.append(
+            f"[{issue.severity:>8}] {issue.kind}: ",
+            style=_SEV_STYLE.get(issue.severity, "white"),
+        )
+        text.append(issue.summary + "\n")
+    return Panel(text, title="diagnostics")
+
+
 def stdout_panel(payload: Dict[str, Any]) -> Panel:
     lines = payload.get("stdout") or []
     if not lines:
@@ -135,7 +186,9 @@ def dashboard(payload: Dict[str, Any], session: str) -> Group:
     return Group(
         header,
         step_time_panel(payload),
+        diagnostics_panel(payload),
         step_memory_panel(payload),
         system_panel(payload),
+        process_panel(payload),
         stdout_panel(payload),
     )
