@@ -174,8 +174,8 @@ def test_code_manifest_deep_extraction(tmp_path):
         "loss.item()\n"
     )
     info = analyze_script(script)
-    assert info["dataloader_args"]["num_workers"] == 0
-    assert info["dataloader_args"]["pin_memory"] is True
+    assert info["dataloader_args"][0]["num_workers"] == 0
+    assert info["dataloader_args"][0]["pin_memory"] is True
     assert "single_worker_dataloader" in info["input_hints"]
     assert info["hf_training_args"]["gradient_accumulation_steps"] == 4
     assert "bf16" in info["precision_hints"]
@@ -193,3 +193,16 @@ def test_code_manifest_jax_donation(tmp_path):
     info = analyze_script(script)
     assert "buffer_donation" in info["uses"]
     assert "block_until_ready" in info["sync_call_hints"]
+
+
+def test_code_manifest_multiple_dataloaders_not_merged(tmp_path):
+    script = tmp_path / "two.py"
+    script.write_text(
+        "import torch\nfrom torch.utils.data import DataLoader\n"
+        "train = DataLoader(a, num_workers=8)\n"
+        "val = DataLoader(b)\n"  # torch default: 0 workers
+    )
+    info = analyze_script(script)
+    assert len(info["dataloader_args"]) == 2
+    # the val loader (default num_workers=0) still flags single-worker
+    assert "single_worker_dataloader" in info["input_hints"]

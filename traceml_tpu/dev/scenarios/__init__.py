@@ -1,0 +1,2 @@
+"""Stress scenarios on realistic model families
+(reference: src/dev/scenarios/ BERT/ViT stress variants)."""

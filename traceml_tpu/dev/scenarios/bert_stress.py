@@ -1,0 +1,62 @@
+"""BERT-base HF Trainer stress scenario (reference parity: BERT stress;
+BASELINE config: huggingface_trainer_minimal BERT-base via torch-xla).
+
+    python -m traceml_tpu.dev.scenarios.bert_stress [steps]
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+import torch
+
+from transformers import (
+    BertConfig,
+    BertForSequenceClassification,
+    Trainer,
+    TrainingArguments,
+)
+
+from traceml_tpu.integrations.huggingface import TraceMLTrainerCallback
+
+
+class SyntheticText(torch.utils.data.Dataset):
+    def __init__(self, n=512, seq=64, vocab=2000):
+        self.n, self.seq, self.vocab = n, seq, vocab
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        rng = np.random.default_rng(i)
+        return {
+            "input_ids": torch.tensor(rng.integers(0, self.vocab, self.seq)),
+            "attention_mask": torch.ones(self.seq, dtype=torch.long),
+            "labels": torch.tensor(int(i % 2)),
+        }
+
+
+def main(max_steps: int = 60) -> None:
+    config = BertConfig(
+        vocab_size=2000, hidden_size=128, num_hidden_layers=4,
+        num_attention_heads=4, intermediate_size=256,
+        max_position_embeddings=128,
+    )
+    model = BertForSequenceClassification(config)
+    trainer = Trainer(
+        model=model,
+        args=TrainingArguments(
+            output_dir="/tmp/traceml_bert_stress", max_steps=max_steps,
+            per_device_train_batch_size=8, report_to=[], logging_steps=1000,
+            disable_tqdm=True,
+        ),
+        train_dataset=SyntheticText(),
+        callbacks=[TraceMLTrainerCallback()],
+    )
+    trainer.train()
+    print("bert stress done")
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 60)

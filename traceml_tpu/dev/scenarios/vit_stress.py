@@ -1,0 +1,56 @@
+"""ViT training stress scenario (reference parity: dev/scenarios ViT).
+
+    python -m traceml_tpu.dev.scenarios.vit_stress [steps] [fault]
+
+faults: none | input_bound | memory_creep
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import traceml_tpu
+from traceml_tpu.models.vit import ViT, ViTConfig, make_vit_train_step
+
+
+def main(steps: int = 60, fault: str = "none") -> None:
+    traceml_tpu.init(mode="auto")
+    cfg = ViTConfig(image_size=32, patch_size=8, hidden=128, n_layers=3,
+                    n_heads=4, n_classes=10)
+    model = ViT(cfg)
+    init, train_step = make_vit_train_step(model)
+    rng = np.random.default_rng(0)
+    sample = jnp.zeros((2, cfg.image_size, cfg.image_size, 3))
+    state = init(jax.random.PRNGKey(0), sample)
+    step = traceml_tpu.wrap_step_fn(train_step)
+
+    def batches():
+        for _ in range(steps):
+            if fault == "input_bound":
+                time.sleep(0.05)
+            images = rng.normal(size=(16, cfg.image_size, cfg.image_size, 3))
+            labels = rng.integers(0, cfg.n_classes, (16,))
+            yield images.astype(np.float32), labels.astype(np.int32)
+
+    leak = []
+    metrics = {"loss": float("nan")}
+    for images, labels in traceml_tpu.wrap_dataloader(batches()):
+        with traceml_tpu.trace_step():
+            images = jax.device_put(jnp.asarray(images))
+            labels = jax.device_put(jnp.asarray(labels))
+            state, metrics = step(state, images, labels)
+            if fault == "memory_creep":
+                leak.append(jnp.ones((128, 1024)))
+    print(f"vit stress done ({fault}), loss={float(metrics['loss']):.4f}")
+
+
+if __name__ == "__main__":
+    main(
+        steps=int(sys.argv[1]) if len(sys.argv) > 1 else 60,
+        fault=sys.argv[2] if len(sys.argv) > 2 else "none",
+    )

@@ -71,9 +71,9 @@ class _ScriptVisitor(ast.NodeVisitor):
         self.imports: set = set()
         self.calls: List[str] = []
         self.attrs: List[str] = []
-        # call name → {kwarg: literal value} for calls whose config we
-        # surface (DataLoader workers, TrainingArguments precision, …)
-        self.call_kwargs: Dict[str, Dict[str, Any]] = {}
+        # call name → list of per-call {kwarg: literal value} (a script
+        # may build several DataLoaders with different configs)
+        self.call_kwargs: Dict[str, List[Dict[str, Any]]] = {}
 
     _KWARG_TARGETS = ("DataLoader", "TrainingArguments", "jit", "pjit")
 
@@ -95,14 +95,15 @@ class _ScriptVisitor(ast.NodeVisitor):
             self.calls.append(name)
             tail = name.split(".")[-1]
             if tail in self._KWARG_TARGETS:
-                kws = self.call_kwargs.setdefault(tail, {})
+                kws: Dict[str, Any] = {}
                 for kw in node.keywords:
                     if kw.arg is None:
                         continue
                     try:
                         kws[kw.arg] = ast.literal_eval(kw.value)
                     except (ValueError, SyntaxError):
-                        kws.setdefault(kw.arg, "<dynamic>")
+                        kws[kw.arg] = "<dynamic>"
+                self.call_kwargs.setdefault(tail, []).append(kws)
         self.generic_visit(node)
 
     def visit_Attribute(self, node: ast.Attribute) -> None:
@@ -186,17 +187,23 @@ def analyze_script(script: Path) -> Dict[str, Any]:
 
     # config extraction (reference: scanner pulls dataloader args,
     # TrainingArguments precision, grad accumulation, QLoRA markers)
-    dl = v.call_kwargs.get("DataLoader", {})
-    if dl:
-        out["dataloader_args"] = {
-            k: dl[k]
-            for k in ("num_workers", "pin_memory", "prefetch_factor",
-                      "batch_size", "persistent_workers")
-            if k in dl
-        }
-        if dl.get("num_workers", 1) in (0, None):
+    dls = v.call_kwargs.get("DataLoader", [])
+    if dls:
+        keep = ("num_workers", "pin_memory", "prefetch_factor",
+                "batch_size", "persistent_workers")
+        out["dataloader_args"] = [
+            {k: dl[k] for k in keep if k in dl} for dl in dls[:8]
+        ]
+        # torch's DataLoader default is num_workers=0 (single worker in
+        # the main process) — exactly the input-bound setup this hint
+        # exists to flag, so a missing kwarg counts
+        if any(dl.get("num_workers", 0) in (0, None) for dl in dls):
             out["input_hints"].append("single_worker_dataloader")
-    ta = v.call_kwargs.get("TrainingArguments", {})
+    ta = {
+        k: val
+        for call in v.call_kwargs.get("TrainingArguments", [])
+        for k, val in call.items()
+    }
     if ta:
         out["hf_training_args"] = {
             k: ta[k]
@@ -209,7 +216,11 @@ def analyze_script(script: Path) -> Dict[str, Any]:
             out["precision_hints"].append("bf16")
         if ta.get("fp16"):
             out["precision_hints"].append("fp16/amp")
-    jit_kw = {**v.call_kwargs.get("jit", {}), **v.call_kwargs.get("pjit", {})}
+    jit_kw = {
+        k: val
+        for call in v.call_kwargs.get("jit", []) + v.call_kwargs.get("pjit", [])
+        for k, val in call.items()
+    }
     if "donate_argnums" in jit_kw:
         out["uses"].append("buffer_donation")
     if imports & {"peft", "bitsandbytes"} or any_in("lora", "Lora", "LoRA"):

@@ -1,0 +1,118 @@
+"""Vision Transformer encoder — the second model family.
+
+Exists for the stress-scenario suite (the reference ships BERT/ViT
+stress variants under dev/scenarios) and to exercise the NON-causal
+attention path.  Same TPU-first conventions as the decoder: bf16
+compute, static shapes, einsum attention, MXU-friendly dims.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from traceml_tpu.models.transformer import RMSNorm
+from traceml_tpu.ops.attention import attention_reference
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 64
+    patch_size: int = 8
+    hidden: int = 256
+    n_layers: int = 4
+    n_heads: int = 4
+    ffn_mult: float = 4.0
+    n_classes: int = 10
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @classmethod
+    def tiny(cls) -> "ViTConfig":
+        return cls(image_size=32, patch_size=8, hidden=64, n_layers=2, n_heads=2)
+
+
+class EncoderBlock(nn.Module):
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        B, S, H = x.shape
+        hd = cfg.hidden // cfg.n_heads
+        y = RMSNorm(dtype=cfg.dtype, name="attn_norm")(x)
+        q = nn.Dense(cfg.hidden, use_bias=False, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype, name="wq")(y)
+        k = nn.Dense(cfg.hidden, use_bias=False, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype, name="wk")(y)
+        v = nn.Dense(cfg.hidden, use_bias=False, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype, name="wv")(y)
+        q, k, v = (t.reshape(B, S, cfg.n_heads, hd) for t in (q, k, v))
+        att = attention_reference(q, k, v, causal=False).reshape(B, S, cfg.hidden)
+        x = x + nn.Dense(cfg.hidden, use_bias=False, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype, name="wo")(att)
+        y = RMSNorm(dtype=cfg.dtype, name="mlp_norm")(x)
+        h = nn.Dense(int(cfg.hidden * cfg.ffn_mult), dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype, name="w_up")(y)
+        x = x + nn.Dense(cfg.hidden, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype, name="w_down")(nn.gelu(h))
+        return x
+
+
+class ViT(nn.Module):
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, images):
+        """images: (B, H, W, C) → logits (B, n_classes)."""
+        cfg = self.cfg
+        B = images.shape[0]
+        p = cfg.patch_size
+        x = nn.Conv(cfg.hidden, kernel_size=(p, p), strides=(p, p),
+                    dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                    name="patch_embed")(images.astype(cfg.dtype))
+        x = x.reshape(B, -1, cfg.hidden)  # (B, n_patches, hidden)
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02),
+            (1, cfg.n_patches, cfg.hidden), cfg.param_dtype,
+        )
+        x = x + pos.astype(cfg.dtype)
+        for i in range(cfg.n_layers):
+            x = EncoderBlock(cfg, name=f"layer_{i}")(x)
+        x = RMSNorm(dtype=cfg.dtype, name="final_norm")(x)
+        x = x.mean(axis=1)  # mean-pool patches
+        return nn.Dense(cfg.n_classes, dtype=jnp.float32,
+                        param_dtype=cfg.param_dtype, name="head")(x)
+
+
+def make_vit_train_step(model: ViT, learning_rate: float = 1e-3):
+    import optax
+
+    tx = optax.adamw(learning_rate)
+
+    def init(rng, sample_images):
+        params = model.init(rng, sample_images)["params"]
+        return {"params": params, "opt_state": tx.init(params)}
+
+    def train_step(state, images, labels):
+        def loss_fn(p):
+            logits = model.apply({"params": p}, images)
+            onehot = jax.nn.one_hot(labels, logits.shape[-1])
+            return -jnp.mean(jnp.sum(onehot * jax.nn.log_softmax(logits), -1))
+
+        loss, grads = jax.value_and_grad(loss_fn)(state["params"])
+        updates, opt_state = tx.update(grads, state["opt_state"], state["params"])
+        return {
+            "params": optax.apply_updates(state["params"], updates),
+            "opt_state": opt_state,
+        }, {"loss": loss}
+
+    return init, train_step

@@ -15,17 +15,25 @@ import jax.numpy as jnp
 _PALLAS_MIN_SEQ = 1024  # below this the fused jnp path wins
 
 
-def causal_attention_reference(
-    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray
+def attention_reference(
+    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, causal: bool = True
 ) -> jnp.ndarray:
-    """q,k,v: (B, S, H, D) → (B, S, H, D); causal masked softmax(QK^T)V."""
+    """q,k,v: (B, S, H, D) → (B, S, H, D); softmax(QK^T)V, optionally
+    causal-masked (decoders) or full (encoders/ViT)."""
     B, S, H, D = q.shape
     scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
-    mask = jnp.tril(jnp.ones((S, S), dtype=bool))
-    scores = jnp.where(mask[None, None, :, :], scores, -1e30)
+    if causal:
+        mask = jnp.tril(jnp.ones((S, S), dtype=bool))
+        scores = jnp.where(mask[None, None, :, :], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def causal_attention_reference(
+    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray
+) -> jnp.ndarray:
+    return attention_reference(q, k, v, causal=True)
 
 
 def causal_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:

@@ -46,19 +46,41 @@ class LiveComputer:
             except Exception as exc:
                 out["step_time"] = {"error": str(exc)}
             try:
-                out["step_memory"] = loaders.load_step_memory_rows(
+                mem_rows = loaders.load_step_memory_rows(
                     self.db_path, max_rows_per_rank=self.window_steps * 4
+                )
+                out["step_memory"] = mem_rows
+                from traceml_tpu.diagnostics.step_memory.api import (
+                    diagnose_rank_rows as diagnose_memory,
+                )
+
+                out["step_memory_diagnosis"] = (
+                    diagnose_memory(mem_rows) if mem_rows else None
                 )
             except Exception as exc:
                 out["step_memory"] = {"error": str(exc)}
             try:
                 host, devices = loaders.load_system_rows(self.db_path, max_rows=300)
                 out["system"] = {"host": host, "devices": devices}
+                from traceml_tpu.diagnostics.system.api import (
+                    diagnose as diagnose_system,
+                )
+
+                out["system_diagnosis"] = (
+                    diagnose_system(host, devices) if host or devices else None
+                )
             except Exception as exc:
                 out["system"] = {"error": str(exc)}
             try:
                 procs, pdevs = loaders.load_process_rows(self.db_path, max_rows=300)
                 out["process"] = {"procs": procs, "devices": pdevs}
+                from traceml_tpu.diagnostics.process.api import (
+                    diagnose as diagnose_process,
+                )
+
+                out["process_diagnosis"] = (
+                    diagnose_process(procs, pdevs) if procs or pdevs else None
+                )
             except Exception as exc:
                 out["process"] = {"error": str(exc)}
             try:

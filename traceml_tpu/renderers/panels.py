@@ -44,15 +44,6 @@ def step_time_panel(payload: Dict[str, Any]) -> Panel:
             fmt_pct(m.skew_pct),
         )
     parts = [table]
-    diag = st.get("diagnosis")
-    if diag is not None and not diag.healthy:
-        issue = diag.diagnosis
-        parts.append(
-            Text(
-                f"▸ {issue.kind}: {issue.summary}",
-                style=_SEV_STYLE.get(issue.severity, "white"),
-            )
-        )
     sub = (
         f"{window.n_steps} steps · {window.clock} clock · "
         f"ranks {window.ranks[0]}–{window.ranks[-1]}"
@@ -146,24 +137,31 @@ def process_panel(payload: Dict[str, Any]) -> Panel:
 
 
 def diagnostics_panel(payload: Dict[str, Any]) -> Panel:
-    """Composed model-diagnostics card (reference:
-    renderers/model_diagnostics/renderer.py:94)."""
-    issues = []
-    st = payload.get("step_time") or {}
-    diag = st.get("diagnosis")
-    if diag is not None:
-        for issue in diag.issues:
-            if issue.status != "ok":
-                issues.append(("step_time", issue))
-    if not issues:
+    """Composed cross-domain diagnostics card (reference:
+    renderers/model_diagnostics/renderer.py:94) — the single place the
+    live view lists findings from every domain."""
+    from traceml_tpu.diagnostics.model_diagnostics import compose
+
+    results = {
+        "step_time": (payload.get("step_time") or {}).get("diagnosis"),
+        "step_memory": payload.get("step_memory_diagnosis"),
+        "system": payload.get("system_diagnosis"),
+        "process": payload.get("process_diagnosis"),
+    }
+    try:
+        composed = compose(results)
+    except Exception:
+        return Panel(Text("—", style="dim"), title="diagnostics")
+    if not composed.issues:
         return Panel(
             Text("no active findings", style="dim green"),
             title="diagnostics",
         )
     text = Text()
-    for domain, issue in issues[:6]:
+    for issue in composed.issues[:6]:
+        domain = issue.evidence.get("domain", "?")
         text.append(
-            f"[{issue.severity:>8}] {issue.kind}: ",
+            f"[{issue.severity:>8}] {domain}/{issue.kind}: ",
             style=_SEV_STYLE.get(issue.severity, "white"),
         )
         text.append(issue.summary + "\n")
