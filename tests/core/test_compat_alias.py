@@ -1,0 +1,26 @@
+import warnings
+
+
+def test_traceml_alias_top_level():
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", DeprecationWarning)
+        import traceml
+
+    assert callable(traceml.trace_step)
+    assert callable(traceml.init)
+    assert traceml.__version__ == __import__("traceml_tpu").__version__
+
+
+def test_traceml_alias_submodules():
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", DeprecationWarning)
+        import traceml  # noqa: F401
+        from traceml.utils.timing import STEP_TIME
+        import traceml.diagnostics.common as common
+
+    from traceml_tpu.utils.timing import STEP_TIME as REAL
+
+    assert STEP_TIME == REAL
+    import traceml_tpu.diagnostics.common as real_common
+
+    assert common is real_common

@@ -1,0 +1,96 @@
+"""Two-'node' run on localhost: two launcher invocations with explicit
+port — exercises the bind/connect split, cross-node aggregation, and
+the node-0 finalize barrier over real sockets."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[2]
+
+SCRIPT = """
+import os, time
+import numpy as np
+import jax, jax.numpy as jnp
+import traceml_tpu
+
+rank = int(os.environ.get("RANK", 0))
+
+def step_fn(w, x):
+    return w - 0.01 * jax.grad(lambda w, x: jnp.sum((x @ w) ** 2))(w, x)
+
+step = traceml_tpu.wrap_step_fn(step_fn)
+w = jnp.ones((32, 32)) * 0.01
+rng = np.random.default_rng(rank)
+
+def batches():
+    for i in range(60):
+        if rank == 1:
+            time.sleep(0.03)  # node-1 rank has the slow input pipeline
+        yield rng.normal(size=(8, 32)).astype(np.float32)
+
+for x in traceml_tpu.wrap_dataloader(batches()):
+    with traceml_tpu.trace_step():
+        x = jax.device_put(x)
+        w = step(w, x)
+print("rank", rank, "done")
+"""
+
+
+def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_node_localhost(tmp_path):
+    script = tmp_path / "train.py"
+    script.write_text(SCRIPT)
+    logs = tmp_path / "logs"
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = str(REPO)
+    common = [
+        sys.executable, "-m", "traceml_tpu", "run",
+        "--mode", "summary", "--logs-dir", str(logs),
+        "--run-name", "mn",
+        "--nnodes", "2", "--nprocs", "1",
+        "--aggregator-host", "127.0.0.1",
+        "--aggregator-port", str(port),
+        "--sampler-interval", "0.25", "--finalize-timeout", "40",
+    ]
+    # both launchers must share the session id: pin it via env
+    env["TRACEML_SESSION_ID"] = "mn-shared"
+    node0 = subprocess.Popen(
+        common + ["--node-rank", "0", str(script)],
+        env=env, cwd=str(tmp_path),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    time.sleep(2.0)  # let node 0 bind the port
+    node1 = subprocess.Popen(
+        common + ["--node-rank", "1", str(script)],
+        env=env, cwd=str(tmp_path),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    out1, _ = node1.communicate(timeout=240)
+    out0, _ = node0.communicate(timeout=240)
+    assert node0.returncode == 0, out0[-3000:]
+    assert node1.returncode == 0, out1[-3000:]
+    session = next(p for p in logs.iterdir() if p.name.startswith("mn"))
+    payload = json.loads((session / "final_summary.json").read_text())
+    topo = payload["meta"]["topology"]
+    assert topo["world_size"] == 2
+    assert sorted(topo["ranks_seen"]) == [0, 1]
+    assert topo["mode"] == "multi_node"
+    primary = payload["primary_diagnosis"]
+    assert primary["kind"] == "INPUT_STRAGGLER", primary
+    assert primary["ranks"] == [1]

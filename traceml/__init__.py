@@ -1,0 +1,61 @@
+"""Compatibility alias: ``import traceml`` → ``traceml_tpu``
+(reference ships the same courtesy alias, src/traceml/__init__.py:1-69 —
+scripts written against the reference's import name keep working).
+
+A meta-path finder redirects ``traceml.*`` submodule imports to their
+``traceml_tpu.*`` counterparts; top-level attributes are re-exported
+directly.
+"""
+
+import importlib
+import importlib.abc
+import importlib.util
+import sys
+import warnings
+
+import traceml_tpu as _impl
+
+warnings.warn(
+    "`import traceml` is a compatibility alias for `traceml_tpu`; "
+    "prefer the canonical name.",
+    DeprecationWarning,
+    stacklevel=2,
+)
+
+
+class _AliasFinder(importlib.abc.MetaPathFinder):
+    _PREFIX = "traceml."
+
+    def find_spec(self, fullname, path=None, target=None):
+        if not fullname.startswith(self._PREFIX):
+            return None
+        real = "traceml_tpu." + fullname[len(self._PREFIX):]
+        try:
+            real_spec = importlib.util.find_spec(real)
+        except (ImportError, ValueError):
+            return None
+        if real_spec is None:
+            return None
+
+        class _Loader(importlib.abc.Loader):
+            def create_module(self, spec):
+                module = importlib.import_module(real)
+                sys.modules[fullname] = module
+                return module
+
+            def exec_module(self, module):
+                pass
+
+        return importlib.util.spec_from_loader(fullname, _Loader())
+
+
+if not any(isinstance(f, _AliasFinder) for f in sys.meta_path):
+    sys.meta_path.insert(0, _AliasFinder())
+
+
+def __getattr__(name):
+    return getattr(_impl, name)
+
+
+def __dir__():
+    return dir(_impl)

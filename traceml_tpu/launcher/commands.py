@@ -68,7 +68,12 @@ def resolve_settings(cli: Dict[str, Any]) -> TraceMLSettings:
     # multi-node defaults to summary mode (reference: commands.py:59-71)
     default_mode = "summary" if nnodes > 1 else "cli"
     run_name = pick("run_name")
-    session_id = cli.get("session_id") or generate_session_id(run_name)
+    # session id is env-overridable (TRACEML_SESSION_ID): multi-node runs
+    # launch one launcher per node, and every node must agree on the
+    # session identity the telemetry is keyed by
+    session_id = (
+        cli.get("session_id") or pick("session_id") or generate_session_id(run_name)
+    )
     mode = str(pick("mode", default_mode))
     max_steps = pick("trace_max_steps")
     port = int(pick("aggregator_port", 0) or 0)
