@@ -1,0 +1,54 @@
+"""Gradient accumulation under pjit-style steps (SURVEY hard part):
+microbatches folded via lax.scan inside ONE jitted step must appear as
+ONE step with ONE compute phase — no phantom steps, no misattribution."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from traceml_tpu.sdk import state as state_mod
+from traceml_tpu.sdk.instrumentation import trace_step
+from traceml_tpu.sdk.step_fn import wrap_step_fn
+from traceml_tpu.utils.step_memory import FakeMemoryBackend, StepMemoryTracker
+from traceml_tpu.utils.timing import COMPUTE_TIME, GLOBAL_STEP_QUEUE, STEP_TIME
+
+
+@pytest.fixture(autouse=True)
+def fresh_state():
+    st = state_mod.reset_state_for_tests()
+    st.mem_tracker = StepMemoryTracker(FakeMemoryBackend([[]]))
+    GLOBAL_STEP_QUEUE.drain()
+    yield st
+    GLOBAL_STEP_QUEUE.drain()
+
+
+def test_scan_microbatch_accumulation_is_one_step(fresh_state):
+    def loss_fn(w, x):
+        return jnp.sum((x @ w) ** 2)
+
+    def train_step(w, microbatches):
+        # microbatches: (K, B, D) — accumulate grads over K via scan
+        def body(g_acc, x):
+            g = jax.grad(loss_fn)(w, x)
+            return jax.tree_util.tree_map(jnp.add, g_acc, g), None
+
+        g0 = jax.tree_util.tree_map(jnp.zeros_like, w)
+        g_sum, _ = jax.lax.scan(body, g0, microbatches)
+        return w - 0.01 * g_sum / microbatches.shape[0]
+
+    step = wrap_step_fn(train_step)
+    w = jnp.ones((16, 16))
+    mb = jnp.asarray(
+        np.random.default_rng(0).normal(size=(4, 8, 16)), jnp.float32
+    )
+    for _ in range(3):
+        with trace_step():
+            w = step(w, mb)
+    batches = GLOBAL_STEP_QUEUE.drain()
+    assert len(batches) == 3  # K microbatches never inflate the step count
+    for b in batches:
+        names = [e.name for e in b.events]
+        assert names.count(STEP_TIME) == 1
+        assert names.count(COMPUTE_TIME) == 1  # ONE fused compute phase
+    assert fresh_state.current_step == 3
