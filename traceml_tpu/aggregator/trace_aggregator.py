@@ -105,6 +105,24 @@ class TraceMLAggregator:
         ok = self.writer.finalize(timeout=max(5.0, deadline - time.monotonic()))
         if not ok:
             get_error_log().warning("sqlite finalize incomplete within budget")
+        # self-metrics for the summary meta (reference parity: SQLite
+        # writer counters enqueued/dropped/written)
+        try:
+            atomic_write_json(
+                self.settings.session_dir / "ingest_stats.json",
+                {
+                    "envelopes_ingested": self.envelopes_ingested,
+                    "frames_received": self.server.frames_received,
+                    "decode_errors": self.server.decode_errors,
+                    "rows_written": self.writer.written,
+                    "rows_enqueued": self.writer.enqueued,
+                    "rows_dropped": self.writer.dropped,
+                    "finished_ranks": sorted(self._finished_ranks),
+                    "ts": time.time(),
+                },
+            )
+        except Exception:
+            pass
         try:
             if not self.generate_final_summary():
                 atomic_write_json(

@@ -180,7 +180,14 @@ def stdout_panel(payload: Dict[str, Any]) -> Panel:
 
 
 def dashboard(payload: Dict[str, Any], session: str) -> Group:
+    import time as _time
+
     header = Text(f"TraceML-TPU — live · session {session}", style="bold")
+    ts = payload.get("ts")
+    if ts:
+        age = _time.time() - ts
+        if age > 5.0:  # staleness badge (reference: display staleness)
+            header.append(f"   ⚠ data {age:.0f}s stale", style="yellow")
     return Group(
         header,
         step_time_panel(payload),

@@ -356,15 +356,32 @@ def generate_summary(
         results.get("system"),
         results.get("process"),
     )
+    meta: Dict[str, Any] = {
+        "session_id": getattr(settings, "session_id", "unknown"),
+        "run_name": getattr(settings, "run_name", None),
+        "generated_at": time.time(),
+        "mode": mode,
+        "topology": topology,
+    }
+    # telemetry self-metrics, when the aggregator recorded them
+    try:
+        from traceml_tpu.utils.atomic_io import read_json
+
+        stats = read_json(Path(session_dir) / "ingest_stats.json")
+        if stats:
+            meta["telemetry_stats"] = {
+                k: stats[k]
+                for k in (
+                    "envelopes_ingested", "frames_received", "decode_errors",
+                    "rows_written", "rows_dropped",
+                )
+                if k in stats
+            }
+    except Exception:
+        pass
     payload = {
         "schema": SCHEMA_VERSION,
-        "meta": {
-            "session_id": getattr(settings, "session_id", "unknown"),
-            "run_name": getattr(settings, "run_name", None),
-            "generated_at": time.time(),
-            "mode": mode,
-            "topology": topology,
-        },
+        "meta": meta,
         "primary_diagnosis": primary,
         "sections": sections,
     }
