@@ -61,6 +61,9 @@ def test_aggregator_end_to_end_with_summary(tmp_path):
     assert payload["sections"]["step_time"]["status"] == "OK"
     assert payload["meta"]["topology"]["world_size"] == 2
     assert not (settings.session_dir / "finalization_warning.json").exists()
+    stats = payload["meta"]["telemetry_stats"]
+    assert stats["envelopes_ingested"] >= 2
+    assert stats["rows_dropped"] == 0
 
 
 def test_aggregator_missing_rank_warning(tmp_path):

@@ -121,8 +121,8 @@ class TraceMLAggregator:
                     "ts": time.time(),
                 },
             )
-        except Exception:
-            pass
+        except Exception as exc:
+            get_error_log().warning("ingest stats write failed", exc)
         try:
             if not self.generate_final_summary():
                 atomic_write_json(

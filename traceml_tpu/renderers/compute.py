@@ -43,6 +43,15 @@ class LiveComputer:
                     if rank_rows
                     else None,
                 }
+                # newest telemetry timestamp drives the staleness badge
+                out["latest_row_ts"] = max(
+                    (
+                        row.get("timestamp") or 0.0
+                        for rows in rank_rows.values()
+                        for row in rows[-1:]
+                    ),
+                    default=None,
+                )
             except Exception as exc:
                 out["step_time"] = {"error": str(exc)}
             try:

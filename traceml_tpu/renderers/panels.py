@@ -183,11 +183,13 @@ def dashboard(payload: Dict[str, Any], session: str) -> Group:
     import time as _time
 
     header = Text(f"TraceML-TPU — live · session {session}", style="bold")
-    ts = payload.get("ts")
+    # staleness = age of the NEWEST telemetry row, not of the payload
+    # (the payload is recomputed every tick regardless)
+    ts = payload.get("latest_row_ts")
     if ts:
         age = _time.time() - ts
         if age > 5.0:  # staleness badge (reference: display staleness)
-            header.append(f"   ⚠ data {age:.0f}s stale", style="yellow")
+            header.append(f"   ⚠ telemetry {age:.0f}s stale", style="yellow")
     return Group(
         header,
         step_time_panel(payload),

@@ -31,7 +31,7 @@ from traceml_tpu.diagnostics.system.api import diagnose as diagnose_system
 from traceml_tpu.reporting import loaders
 from traceml_tpu.reporting.primary_diagnosis import build_primary_diagnosis
 from traceml_tpu.sdk import protocol
-from traceml_tpu.utils.atomic_io import atomic_write_json, atomic_write_text
+from traceml_tpu.utils.atomic_io import atomic_write_json, atomic_write_text, read_json
 from traceml_tpu.utils.error_log import get_error_log
 from traceml_tpu.utils.formatting import fmt_bytes, fmt_ms, fmt_pct
 from traceml_tpu.utils.step_time_window import (
@@ -364,21 +364,16 @@ def generate_summary(
         "topology": topology,
     }
     # telemetry self-metrics, when the aggregator recorded them
-    try:
-        from traceml_tpu.utils.atomic_io import read_json
-
-        stats = read_json(Path(session_dir) / "ingest_stats.json")
-        if stats:
-            meta["telemetry_stats"] = {
-                k: stats[k]
-                for k in (
-                    "envelopes_ingested", "frames_received", "decode_errors",
-                    "rows_written", "rows_dropped",
-                )
-                if k in stats
-            }
-    except Exception:
-        pass
+    stats = read_json(Path(session_dir) / "ingest_stats.json")
+    if stats:
+        meta["telemetry_stats"] = {
+            k: stats[k]
+            for k in (
+                "envelopes_ingested", "frames_received", "decode_errors",
+                "rows_written", "rows_dropped",
+            )
+            if k in stats
+        }
     payload = {
         "schema": SCHEMA_VERSION,
         "meta": meta,
