@@ -1,0 +1,82 @@
+"""HTML writer + window-builder edge cases."""
+
+import jax.numpy as jnp  # noqa: F401  (keeps jax platform pinned first)
+
+from traceml_tpu.reporting.html.writer import render_html_summary
+from traceml_tpu.utils import timing as T
+from traceml_tpu.utils.step_time_window import build_step_time_window
+
+
+def test_html_renders_minimal_and_odd_payloads():
+    html = render_html_summary({"meta": {}, "primary_diagnosis": {}, "sections": {}})
+    assert "<html" in html
+    payload = {
+        "meta": {"session_id": "<script>x</script>", "topology": {}},
+        "primary_diagnosis": {"kind": "INPUT_BOUND", "severity": "critical",
+                              "summary": "a & b < c"},
+        "sections": {
+            "step_time": {
+                "status": "OK",
+                "issues": [{"kind": "K", "severity": "warning", "summary": "s"}],
+                "global": {
+                    "n_steps": 3, "clock": "host",
+                    "phases": {"step_time": {"median_ms": 1.0,
+                                             "share_of_step": None,
+                                             "worst_rank": 0,
+                                             "skew_pct": 0.0}},
+                    "step_series_ms": {"0": [1.0, 2.0, 1.5]},
+                },
+            }
+        },
+    }
+    html = render_html_summary(payload)
+    assert "&lt;script&gt;" in html  # escaped, not injected
+    assert "a &amp; b &lt; c" in html
+    assert "<polyline" in html
+
+
+def _row(step, clock="device", with_device=True, step_ms=100.0):
+    ev = {"cpu_ms": step_ms, "count": 1,
+          "device_ms": step_ms if with_device else None}
+    return {"step": step, "clock": clock,
+            "events": {T.STEP_TIME: ev}}
+
+
+def test_window_mixed_device_coverage_falls_back_to_host():
+    rows = {
+        0: [_row(s) for s in range(1, 31)],
+        # rank 1 lost device timing on one step (late stamp excluded)
+        1: [_row(s, with_device=(s != 15)) for s in range(1, 31)],
+    }
+    w = build_step_time_window(rows)
+    assert w.clock == "host"
+    assert w.metric("step_time").median_ms == 100.0
+
+
+def test_window_single_step_and_disjoint_ranks():
+    # single common step
+    rows = {0: [_row(5)], 1: [_row(5)]}
+    w = build_step_time_window(rows)
+    assert w.n_steps == 1
+    assert w.steps == [5]
+    # disjoint steps → no window
+    rows = {0: [_row(1)], 1: [_row(2)]}
+    assert build_step_time_window(rows) is None
+
+
+def test_compare_accepts_session_dirs(tmp_path):
+    import json
+
+    from traceml_tpu.reporting.compare.command import compare_summaries
+
+    for name, step in (("a", 100.0), ("b", 130.0)):
+        d = tmp_path / name
+        d.mkdir()
+        (d / "final_summary.json").write_text(json.dumps({
+            "meta": {"session_id": name},
+            "primary_diagnosis": {"kind": "X", "severity": "info"},
+            "sections": {"step_time": {"global": {"phases": {
+                "step_time": {"median_ms": step}}}}},
+        }))
+    payload = compare_summaries(tmp_path / "a", tmp_path / "b")
+    assert payload["verdict"] in ("REGRESSION", "LIKELY_REGRESSION")

@@ -184,13 +184,21 @@ def render_compare_text(payload: Dict[str, Any]) -> str:
     return "\n".join(lines) + "\n"
 
 
+def _resolve_summary(path: Path) -> Optional[Dict[str, Any]]:
+    """Accept a final_summary.json OR a session directory."""
+    path = Path(path)
+    if path.is_dir():
+        path = path / "final_summary.json"
+    return read_json(path)
+
+
 def compare_summaries(
     baseline_path: Path,
     candidate_path: Path,
     policy: ComparePolicy = DEFAULT_POLICY,
 ) -> Optional[Dict[str, Any]]:
-    baseline = read_json(baseline_path)
-    candidate = read_json(candidate_path)
+    baseline = _resolve_summary(baseline_path)
+    candidate = _resolve_summary(candidate_path)
     if baseline is None or candidate is None:
         return None
     return build_compare_payload(baseline, candidate, policy)
