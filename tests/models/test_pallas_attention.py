@@ -68,3 +68,20 @@ def test_dispatcher_uses_flash_for_long_seq(monkeypatch):
     q, k, v = _qkv(B=1, S=128, H=1, D=64)
     att.causal_attention(q, k, v)
     assert not called.get("flash")  # short seq stays on the fused path
+
+
+def test_flash_block_sizes_clamped_to_seq():
+    """blk larger than S is clamped (single-block path)."""
+    q, k, v = _qkv(B=1, S=64, H=2, D=64)
+    ref = causal_attention_reference(q, k, v)
+    out = flash_attention(q, k, v, blk_q=128, blk_k=128)  # > S
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_uneven_block_pair():
+    q, k, v = _qkv(B=1, S=256, H=2, D=64)
+    ref = causal_attention_reference(q, k, v)
+    out = flash_attention(q, k, v, blk_q=128, blk_k=64)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
