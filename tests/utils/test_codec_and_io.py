@@ -34,6 +34,28 @@ def test_codec_empty_frame_raises():
         msgpack_codec.decode(b"")
 
 
+def test_codec_decodes_legacy_raw_msgpack_map():
+    # reference-style frame: raw msgpack body, no codec prefix
+    import msgpack
+
+    raw = msgpack.packb({"kind": "telemetry", "rank": 0}, use_bin_type=True)
+    assert raw[0] & 0xF0 == 0x80  # fixmap — exercises the container gate
+    assert msgpack_codec.decode(raw) == {"kind": "telemetry", "rank": 0}
+
+
+def test_codec_prefix_collision_not_misparsed_as_legacy():
+    # A raw msgpack body whose first byte is 0x01 (top-level int 1) looks
+    # like our msgpack-prefix frame.  The legacy fallback must NOT try
+    # raw-msgpack on it (envelopes are maps/arrays, never scalars); the
+    # \x01 prefix route must win and report the stripped body as bad.
+    import msgpack
+
+    raw_int = msgpack.packb(1)
+    assert raw_int == b"\x01"
+    with pytest.raises(msgpack_codec.CodecError):
+        msgpack_codec.decode(raw_int)  # body empty after prefix strip
+
+
 def test_atomic_json_roundtrip(tmp_path):
     p = tmp_path / "deep" / "x.json"
     atomic_write_json(p, {"k": [1, 2]})

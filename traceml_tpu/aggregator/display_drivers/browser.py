@@ -51,6 +51,10 @@ svg{width:100%;height:70px;background:#15151f;border-radius:6px}
 const COLORS={input:"#e74c3c",h2d:"#e67e22",forward:"#2d7dd2",
 backward:"#2255a4",optimizer:"#7d3dd2",compute:"#2d7dd2",
 compile:"#f1c40f",collective:"#16a085",residual:"#95a5a6"};
+// telemetry strings (hostnames, diagnosis text, phase/rank keys) arrive
+// from an unauthenticated ingest port — escape EVERY interpolation.
+const esc=s=>String(s).replace(/[&<>"']/g,
+  c=>({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;","'":"&#39;"}[c]));
 const fmtB=n=>{if(n==null)return"n/a";const u=["B","KiB","MiB","GiB","TiB"];
 let i=0;while(n>=1024&&i<u.length-1){n/=1024;i++}return n.toFixed(i?2:0)+" "+u[i]};
 const fmtMs=v=>v==null?"n/a":(v<1?(v*1000).toFixed(0)+" µs":
@@ -58,26 +62,28 @@ v<1000?v.toFixed(1)+" ms":(v/1000).toFixed(2)+" s");
 async function tick(){
  try{
   const r=await fetch("/api/live");const d=await r.json();
-  document.getElementById("meta").textContent=
+  const meta=document.getElementById("meta");
+  meta.textContent=
     `session ${d.session} · updated ${new Date(d.ts*1000).toLocaleTimeString()}`;
+  meta.className="muted";
   const v=document.getElementById("verdict");
-  if(d.diagnosis){v.innerHTML=`<div class="card verdict-${d.diagnosis.severity}">
-    <b>${d.diagnosis.kind}</b> <span class="muted">[${d.diagnosis.severity}]</span><br>
-    ${d.diagnosis.summary}<br><span class="muted">→ ${d.diagnosis.action||""}</span></div>`}
+  if(d.diagnosis){v.innerHTML=`<div class="card verdict-${esc(d.diagnosis.severity)}">
+    <b>${esc(d.diagnosis.kind)}</b> <span class="muted">[${esc(d.diagnosis.severity)}]</span><br>
+    ${esc(d.diagnosis.summary)}<br><span class="muted">→ ${esc(d.diagnosis.action||"")}</span></div>`}
   const st=d.step_time;
   if(st){
-   let rows=`<div class="muted">${st.n_steps} steps · ${st.clock} clock</div>
+   let rows=`<div class="muted">${esc(st.n_steps)} steps · ${esc(st.clock)} clock</div>
      <div style="margin:.4rem 0">`;
    for(const[k,p]of Object.entries(st.phases)){
      if(k==="step_time"||!p.share)continue;
-     rows+=`<span class="bar" title="${k} ${(p.share*100).toFixed(1)}%"
+     rows+=`<span class="bar" title="${esc(k)} ${(p.share*100).toFixed(1)}%"
        style="width:${(p.share*100).toFixed(1)}%;background:${COLORS[k]||"#888"}"></span>`}
    rows+=`</div><table><tr><th>phase</th><th>median</th><th>share</th>
      <th>worst rank</th><th>skew</th></tr>`;
    for(const[k,p]of Object.entries(st.phases)){
-     rows+=`<tr><td>${k}</td><td>${fmtMs(p.median_ms)}</td>
+     rows+=`<tr><td>${esc(k)}</td><td>${fmtMs(p.median_ms)}</td>
        <td>${p.share==null?"—":(p.share*100).toFixed(1)+"%"}</td>
-       <td>${p.worst_rank}</td><td>${(p.skew_pct*100).toFixed(1)}%</td></tr>`}
+       <td>${esc(p.worst_rank)}</td><td>${(p.skew_pct*100).toFixed(1)}%</td></tr>`}
    document.getElementById("phases").innerHTML=rows+"</table>";
    const svg=document.getElementById("spark");
    let paths="";const ranks=Object.keys(st.step_series);
@@ -85,22 +91,22 @@ async function tick(){
    ranks.forEach((r,ri)=>{const s=st.step_series[r];if(!s.length)return;
      const pts=s.map((v,i)=>`${(i/(s.length-1||1))*600},${68-(v/max)*62}`).join(" ");
      paths+=`<polyline fill="none" stroke="hsl(${(ri*67)%360},70%,60%)"
-       stroke-width="1.5" points="${pts}"><title>rank ${r}</title></polyline>`});
+       stroke-width="1.5" points="${pts}"><title>rank ${esc(r)}</title></polyline>`});
    svg.innerHTML=paths;
   }
   let mem="<table><tr><th>rank</th><th>current</th><th>peak</th><th>limit</th></tr>";
-  for(const m of d.memory){mem+=`<tr><td>${m.rank}</td><td>${fmtB(m.current_bytes)}</td>
+  for(const m of d.memory){mem+=`<tr><td>${esc(m.rank)}</td><td>${fmtB(m.current_bytes)}</td>
     <td>${fmtB(m.step_peak_bytes)}</td><td>${fmtB(m.limit_bytes)}</td></tr>`}
   document.getElementById("memory").innerHTML=mem+"</table>";
   let sys="<table><tr><th>node</th><th>cpu</th><th>host mem</th></tr>";
-  for(const s of d.system){sys+=`<tr><td>${s.node}</td>
+  for(const s of d.system){sys+=`<tr><td>${esc(s.node)}</td>
     <td>${s.cpu_pct==null?"n/a":s.cpu_pct.toFixed(0)+"%"}</td>
     <td>${fmtB(s.memory_used_bytes)} / ${fmtB(s.memory_total_bytes)}</td></tr>`}
   document.getElementById("system").innerHTML=sys+"</table>";
   document.getElementById("stdout").textContent=
     d.stdout.map(l=>l.line).join("\\n");
- }catch(e){document.getElementById("meta").innerHTML=
-   `<span class="err">poll failed: ${e}</span>`}
+ }catch(e){document.getElementById("meta").textContent="poll failed: "+e;
+   document.getElementById("meta").className="err"}
  setTimeout(tick,1000);
 }
 tick();

@@ -57,8 +57,11 @@ class _ClientBuffer:
     def feed(self, data: bytes) -> List[bytes]:
         self.buf.extend(data)
         if _native is not None:
+            # Pass the bytearray itself (y* accepts any buffer object) —
+            # bytes(self.buf) would copy the whole rolling buffer per recv,
+            # degrading a large multi-recv frame to O(buffered bytes/recv).
             frames, consumed = _native.drain_frames(
-                bytes(self.buf), self.offset, MAX_FRAME_BYTES
+                self.buf, self.offset, MAX_FRAME_BYTES
             )
             self.offset = consumed
         else:

@@ -80,8 +80,19 @@ def decode(data: bytes) -> Any:
             return json.loads(body.decode("utf-8"))
         except Exception as exc:
             raise CodecError(f"bad json frame: {exc}") from exc
-    # Legacy/unknown prefix: try msgpack then JSON on the whole buffer.
-    if _HAVE_MSGPACK:
+    # Legacy fallback (reference-style frames carry a RAW msgpack/JSON body
+    # with no prefix).  Interop is one-directional: we can receive
+    # reference-style frames, but a reference-style receiver cannot decode
+    # our prefixed frames.  Restrict the raw-msgpack fallback to payload
+    # shapes an envelope can actually have — a top-level map (fixmap
+    # 0x80-0x8f, map16 0xde, map32 0xdf) or array (fixarray 0x90-0x9f,
+    # array16 0xdc, array32 0xdd) — so a raw body whose first byte happens
+    # to collide with our \x01/\x02 prefixes is never misparsed here.
+    first = data[0]
+    looks_like_container = (
+        0x80 <= first <= 0x9F or first in (0xDC, 0xDD, 0xDE, 0xDF)
+    )
+    if _HAVE_MSGPACK and looks_like_container:
         try:
             return _msgpack.unpackb(data, raw=False, strict_map_key=False)
         except Exception:
