@@ -1,12 +1,27 @@
 """Tracer-overhead benchmark — the headline metric.
 
-Runs the flagship decoder LM for N steps twice on the real device:
+Measures the cost of the FULL observability stack (``init(auto)`` patches,
+``wrap_step_fn`` compile attribution, ``trace_step`` envelopes, step-memory
+edges, the runtime agent's sampler thread, telemetry over a real TCP socket
+to an in-process aggregator sink) against a plain ``jax.jit`` loop on the
+flagship decoder LM.
 
-* **untraced** — plain ``jax.jit`` training loop;
-* **traced**   — the FULL observability stack: ``init(auto)`` patches,
-  ``wrap_step_fn`` (AOT compile attribution), ``trace_step`` envelopes,
-  step-memory edges, the runtime agent's sampler thread, and telemetry
-  shipped over a real TCP socket to an in-process aggregator sink.
+Methodology (the round-1 in-process interleave was noise-dominated at
+±12%/round — the traced arm's background threads perturbed the untraced
+rounds sharing its process; on a 1-core host even an idle-polling second
+process contaminates the arm being measured):
+
+* **alternating solo child processes** — U,T,U,T,…: each phase is a
+  fresh process that runs its arm alone (warmup + a few rounds) and
+  exits.  While an arm is measured NOTHING else of the bench is running,
+  so the untraced baseline contains zero tracer work — and adjacent U/T
+  phases are ~30 s apart, so slow machine-load drift cancels in the
+  per-pair deltas (observed drift on the shared 1-core host: ~5%/3 min,
+  enough to swamp a sequential-block design);
+* a shared persistent XLA compilation cache keeps the per-spawn compile
+  cost low;
+* the reported value is the median per-pair delta with a bootstrap 95%
+  CI printed alongside.
 
 Prints ONE JSON line::
 
@@ -20,8 +35,11 @@ Prints ONE JSON line::
 
 from __future__ import annotations
 
+import argparse
 import json
+import os
 import statistics
+import subprocess
 import sys
 import time
 from pathlib import Path
@@ -30,50 +48,52 @@ REPO = Path(__file__).resolve().parent
 if str(REPO) not in sys.path:
     sys.path.insert(0, str(REPO))
 
-WARMUP_STEPS = 5
-MEASURE_STEPS = 60
+WARMUP_STEPS = 6
+ROUNDS = 10          # in-process (TPU) mode
+N_PAIRS = 6          # alternating solo (CPU) mode: U,T pairs
+ROUNDS_PER_PHASE = 2
+STEPS_PER_ROUND = 16
 _PROBE_TIMEOUT_S = 90
+_READY_TIMEOUT_S = 240  # import + first compile
+_ROUND_TIMEOUT_S = 120
 
 
-def _device_probe_ok() -> bool:
-    """Probe device availability in a SUBPROCESS with a timeout.
+# --------------------------------------------------------------------------
+# device probe / CPU fallback (the TPU tunnel can wedge inside C++ —
+# probe in a subprocess so this script always emits its JSON line)
+# --------------------------------------------------------------------------
 
-    The TPU tunnel can wedge hard enough that ``jax.devices()`` blocks
-    for minutes inside C++ (unkillable from Python threads).  Probing in
-    a child process keeps this script — and the driver calling it —
-    responsive; on probe failure the benchmark re-execs itself on the
-    CPU backend so it always emits its one JSON line.
-    """
-    import subprocess
-
+def _probe_backend() -> str:
+    """Backend platform name via a bounded subprocess probe, '' on failure."""
     try:
         proc = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
+            [
+                sys.executable, "-c",
+                "import jax; jax.devices(); print(jax.default_backend())",
+            ],
             timeout=_PROBE_TIMEOUT_S,
             capture_output=True,
+            text=True,
         )
-        return proc.returncode == 0
-    except (subprocess.TimeoutExpired, OSError):
-        return False
+        if proc.returncode == 0:
+            return proc.stdout.strip().splitlines()[-1]
+    except (subprocess.TimeoutExpired, OSError, IndexError):
+        pass
+    return ""
 
 
-def _reexec_on_cpu() -> int:
-    import os
-    import subprocess
-
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
+def _cpu_env(env: dict) -> dict:
+    env = dict(env)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # disarms the axon sitecustomize
     env["JAX_PLATFORMS"] = "cpu"
-    env["TRACEML_BENCH_NO_PROBE"] = "1"
-    print(
-        "[bench] device backend unreachable; falling back to CPU proxy",
-        file=sys.stderr,
-    )
-    proc = subprocess.run([sys.executable, __file__], env=env)
-    return proc.returncode
+    return env
 
 
-def _build(cfg_override=None):
+# --------------------------------------------------------------------------
+# model / loop (shared by both arms)
+# --------------------------------------------------------------------------
+
+def _build():
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -81,22 +101,17 @@ def _build(cfg_override=None):
     from traceml_tpu.models import ModelConfig, init_train_state, make_train_step
 
     platform = jax.default_backend()
-    if cfg_override is not None:
-        cfg = cfg_override
-    elif platform == "tpu":
+    if platform != "cpu":  # tpu (incl. tunneled backends)
         cfg = ModelConfig(
             vocab_size=16384, hidden=1024, n_layers=8, n_heads=16,
             n_kv_heads=8, max_seq_len=512,
         )
         batch, seq = 8, 512
-    else:  # CPU fallback keeps bench runnable anywhere
+    else:  # CPU proxy: big enough that steps are ≥100 ms (noise floor)
         cfg = ModelConfig(
             vocab_size=2048, hidden=256, n_layers=2, n_heads=4,
             n_kv_heads=2, max_seq_len=256,
         )
-    if platform != "tpu":
-        batch, seq = 4, 128
-    elif cfg_override is not None:
         batch, seq = 4, 128
 
     model, state, tx = init_train_state(cfg, jax.random.PRNGKey(0))
@@ -129,73 +144,138 @@ def _run_loop(step_fn, state, batches, n_steps, bracket=None):
     return statistics.median(times), state
 
 
-def main() -> int:
-    import os
+# --------------------------------------------------------------------------
+# child arms
+# --------------------------------------------------------------------------
 
-    if os.environ.get("TRACEML_BENCH_NO_PROBE") != "1" and not _device_probe_ok():
-        return _reexec_on_cpu()
+def _child(arm: str, rounds: int, steps: int, out_path: Path) -> int:
+    """Run one arm solo: warmup, then ``rounds`` rounds of ``steps`` steps;
+    writes a JSON list of per-round median step seconds."""
     import jax
 
-    # ---- build BOTH arms, then measure in INTERLEAVED rounds ----------
-    # (sequential arms are biased by machine-load drift; per-round
-    # paired deltas with a median are robust to it)
-    model, state, tx, train_step, batches = _build()
-    plain = jax.jit(train_step, donate_argnums=(0,))
-    _, state = _run_loop(plain, state, batches, WARMUP_STEPS)  # compile+warm
+    cache_dir = os.environ.get("TRACEML_BENCH_CACHE")
+    if cache_dir:
+        try:  # persistent compile cache: repeat spawns skip compilation
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        except Exception:
+            pass
 
-    import traceml_tpu
-    from traceml_tpu.aggregator.trace_aggregator import TraceMLAggregator
-    from traceml_tpu.runtime.identity import RuntimeIdentity
-    from traceml_tpu.runtime.runtime import TraceMLRuntime
-    from traceml_tpu.runtime.settings import AggregatorEndpoint, TraceMLSettings
+    model, state, tx, train_step, batches = _build()
+
+    if arm == "untraced":
+        step_fn = jax.jit(train_step, donate_argnums=(0,))
+        bracket = None
+        stop = lambda: None  # noqa: E731
+    else:
+        import tempfile
+
+        import traceml_tpu
+        from traceml_tpu.aggregator.trace_aggregator import TraceMLAggregator
+        from traceml_tpu.runtime.identity import RuntimeIdentity
+        from traceml_tpu.runtime.runtime import TraceMLRuntime
+        from traceml_tpu.runtime.settings import AggregatorEndpoint, TraceMLSettings
+
+        tmp = Path(tempfile.mkdtemp(prefix="traceml_bench_"))
+        agg = TraceMLAggregator(TraceMLSettings(
+            session_id="bench", logs_dir=tmp, mode="summary",
+            aggregator=AggregatorEndpoint(port=0), expected_world_size=1,
+            finalize_timeout_sec=10.0,
+        ))
+        agg.start()
+        runtime = TraceMLRuntime(
+            TraceMLSettings(
+                session_id="bench", logs_dir=tmp, mode="summary",
+                aggregator=AggregatorEndpoint(port=agg.port or 0),
+                sampler_interval_sec=1.0,
+            ),
+            RuntimeIdentity(global_rank=0),
+        )
+        runtime.start()
+        traceml_tpu.init(mode="auto")
+        step_fn = traceml_tpu.wrap_step_fn(train_step, donate_argnums=(0,))
+        bracket = traceml_tpu.trace_step
+
+        def stop():
+            runtime.stop()
+            agg.stop(finalize_timeout=5.0)
+
+    _, state = _run_loop(step_fn, state, batches, WARMUP_STEPS, bracket=bracket)
+
+    medians = []
+    for _ in range(rounds):
+        med, state = _run_loop(step_fn, state, batches, steps, bracket=bracket)
+        medians.append(med)
+    stop()
+    tmp = out_path.with_suffix(".tmp")
+    tmp.write_text(json.dumps(medians))
+    os.replace(tmp, out_path)
+    return 0
+
+
+# --------------------------------------------------------------------------
+# parent orchestration
+# --------------------------------------------------------------------------
+
+def _bootstrap_ci(deltas, n=2000, seed=0):
+    import random
+
+    rng = random.Random(seed)
+    meds = sorted(
+        statistics.median(rng.choices(deltas, k=len(deltas))) for _ in range(n)
+    )
+    return meds[int(0.025 * n)], meds[int(0.975 * n)]
+
+
+def _solo_phase(arm: str, rounds: int, out_path: Path, env: dict) -> list:
+    proc = subprocess.run(
+        [
+            sys.executable, __file__, "--arm", arm,
+            "--rounds", str(rounds), "--steps", str(STEPS_PER_ROUND),
+            "--out", str(out_path),
+        ],
+        env=env,
+        timeout=_READY_TIMEOUT_S + rounds * _ROUND_TIMEOUT_S,
+    )
+    if proc.returncode != 0 or not out_path.exists():
+        raise RuntimeError(f"{arm} phase failed rc={proc.returncode}")
+    return json.loads(out_path.read_text())
+
+
+def _orchestrate() -> int:
     import tempfile
 
-    tmp = Path(tempfile.mkdtemp(prefix="traceml_bench_"))
-    agg_settings = TraceMLSettings(
-        session_id="bench", logs_dir=tmp, mode="summary",
-        aggregator=AggregatorEndpoint(port=0), expected_world_size=1,
-        finalize_timeout_sec=10.0,
-    )
-    agg = TraceMLAggregator(agg_settings)
-    agg.start()
-    rt_settings = TraceMLSettings(
-        session_id="bench", logs_dir=tmp, mode="summary",
-        aggregator=AggregatorEndpoint(port=agg.port or 0),
-        sampler_interval_sec=1.0,
-    )
-    runtime = TraceMLRuntime(rt_settings, RuntimeIdentity(global_rank=0))
-    runtime.start()
-    traceml_tpu.init(mode="auto")
-
-    model2, state2, tx2, train_step2, batches2 = _build()
-    traced = traceml_tpu.wrap_step_fn(train_step2, donate_argnums=(0,))
-    _, state2 = _run_loop(
-        traced, state2, batches2, WARMUP_STEPS, bracket=traceml_tpu.trace_step
-    )
-
-    rounds = 5
-    steps_per_round = max(10, MEASURE_STEPS // rounds)
-    deltas = []
-    u_all, t_all = [], []
-    for _ in range(rounds):
-        u, state = _run_loop(plain, state, batches, steps_per_round)
-        t, state2 = _run_loop(
-            traced, state2, batches2, steps_per_round,
-            bracket=traceml_tpu.trace_step,
+    work = Path(tempfile.mkdtemp(prefix="traceml_bench_"))
+    env = dict(os.environ)
+    env["TRACEML_BENCH_CACHE"] = str(work / "xla_cache")
+    u_all, t_all, deltas = [], [], []
+    for i in range(N_PAIRS):
+        u = _solo_phase("untraced", ROUNDS_PER_PHASE, work / f"u{i}.json", env)
+        t = _solo_phase("traced", ROUNDS_PER_PHASE, work / f"t{i}.json", env)
+        u_med, t_med = statistics.median(u), statistics.median(t)
+        u_all += u
+        t_all += t
+        deltas.append((t_med - u_med) / u_med * 100.0)
+        print(
+            f"[bench] pair {i}: untraced {u_med * 1000:.2f} traced "
+            f"{t_med * 1000:.2f} ms/step ({deltas[-1]:+.2f}%)",
+            file=sys.stderr,
         )
-        u_all.append(u)
-        t_all.append(t)
-        deltas.append((t - u) / u * 100.0)
-    runtime.stop()
-    agg.stop(finalize_timeout=5.0)
+    # backend is known without importing jax here: this path only runs
+    # on the cpu backend (device backends use _run_interleaved)
+    return _report(u_all, t_all, deltas, "cpu", "paired-solo")
 
-    untraced_s = statistics.median(u_all)
-    traced_s = statistics.median(t_all)
+
+def _report(u_all, t_all, deltas, backend: str, mode: str) -> int:
+    lo, hi = _bootstrap_ci(deltas)
     overhead_pct = max(0.0, statistics.median(deltas))
     print(
-        f"[bench] untraced {untraced_s * 1000:.2f} ms/step, "
-        f"traced {traced_s * 1000:.2f} ms/step on {jax.default_backend()} "
-        f"(per-round deltas: {[round(d, 1) for d in deltas]})",
+        f"[bench] untraced {statistics.median(u_all) * 1000:.2f} ms/step, "
+        f"traced {statistics.median(t_all) * 1000:.2f} ms/step on "
+        f"{backend} ({mode}) — median delta "
+        f"{statistics.median(deltas):+.2f}% (95% CI [{lo:+.2f}, {hi:+.2f}], "
+        f"{len(deltas)} paired rounds × {STEPS_PER_ROUND} steps; per-round: "
+        f"{[round(d, 1) for d in deltas]})",
         file=sys.stderr,
     )
     print(
@@ -209,6 +289,100 @@ def main() -> int:
         )
     )
     return 0
+
+
+def _run_interleaved() -> int:
+    """Single-process paired rounds — for device-exclusive backends (TPU)
+    where two processes cannot both claim the chip.  Host-side background
+    threads overlap device compute there, so sharing the process does not
+    perturb the untraced arm the way it does on the CPU backend."""
+    import tempfile
+
+    import jax
+
+    model, state, tx, train_step, batches = _build()
+    plain = jax.jit(train_step, donate_argnums=(0,))
+    _, state = _run_loop(plain, state, batches, WARMUP_STEPS)
+
+    import traceml_tpu
+    from traceml_tpu.aggregator.trace_aggregator import TraceMLAggregator
+    from traceml_tpu.runtime.identity import RuntimeIdentity
+    from traceml_tpu.runtime.runtime import TraceMLRuntime
+    from traceml_tpu.runtime.settings import AggregatorEndpoint, TraceMLSettings
+
+    tmp = Path(tempfile.mkdtemp(prefix="traceml_bench_"))
+    agg = TraceMLAggregator(TraceMLSettings(
+        session_id="bench", logs_dir=tmp, mode="summary",
+        aggregator=AggregatorEndpoint(port=0), expected_world_size=1,
+        finalize_timeout_sec=10.0,
+    ))
+    agg.start()
+    runtime = TraceMLRuntime(
+        TraceMLSettings(
+            session_id="bench", logs_dir=tmp, mode="summary",
+            aggregator=AggregatorEndpoint(port=agg.port or 0),
+            sampler_interval_sec=1.0,
+        ),
+        RuntimeIdentity(global_rank=0),
+    )
+    runtime.start()
+    traceml_tpu.init(mode="auto")
+
+    model2, state2, tx2, train_step2, batches2 = _build()
+    traced = traceml_tpu.wrap_step_fn(train_step2, donate_argnums=(0,))
+    _, state2 = _run_loop(
+        traced, state2, batches2, WARMUP_STEPS, bracket=traceml_tpu.trace_step
+    )
+
+    u_all, t_all, deltas = [], [], []
+    for _ in range(ROUNDS):
+        u, state = _run_loop(plain, state, batches, STEPS_PER_ROUND)
+        t, state2 = _run_loop(
+            traced, state2, batches2, STEPS_PER_ROUND,
+            bracket=traceml_tpu.trace_step,
+        )
+        u_all.append(u)
+        t_all.append(t)
+        deltas.append((t - u) / u * 100.0)
+    runtime.stop()
+    agg.stop(finalize_timeout=5.0)
+    return _report(u_all, t_all, deltas, jax.default_backend(), "in-process")
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--arm", choices=["untraced", "traced"])
+    parser.add_argument("--rounds", type=int, default=ROUNDS)
+    parser.add_argument("--steps", type=int, default=STEPS_PER_ROUND)
+    parser.add_argument("--out", type=str)
+    args = parser.parse_args()
+
+    if args.arm:
+        return _child(args.arm, args.rounds, args.steps, Path(args.out))
+
+    if os.environ.get("TRACEML_BENCH_NO_PROBE") != "1":
+        backend = _probe_backend()
+        if not backend:
+            print(
+                "[bench] device backend unreachable; falling back to CPU proxy",
+                file=sys.stderr,
+            )
+            env = _cpu_env(os.environ)
+            env["TRACEML_BENCH_NO_PROBE"] = "1"
+            return subprocess.run([sys.executable, __file__], env=env).returncode
+        if backend != "cpu":
+            return _run_interleaved()
+    try:
+        return _orchestrate()
+    except Exception as exc:
+        # the one-JSON-line contract holds even if a child wedges:
+        # fall back to the in-process method rather than traceback out
+        print(
+            f"[bench] paired-solo orchestration failed ({exc}); "
+            "falling back to in-process interleave",
+            file=sys.stderr,
+        )
+        return _run_interleaved()
 
 
 if __name__ == "__main__":

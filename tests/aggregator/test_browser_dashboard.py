@@ -55,8 +55,13 @@ def test_dashboard_endpoints(tmp_path):
         assert status == 200
         payload = json.loads(body)
         assert payload["session"] == "web"
+        assert payload["version"] == 2
         assert payload["step_time"]["n_steps"] == 39
-        assert "compute" in payload["step_time"]["phases"]
+        phase_keys = [p["key"] for p in payload["step_time"]["phases"]]
+        assert "compute" in phase_keys
+        assert "compute" in payload["step_time"]["phase_stack"]
+        cov = payload["step_time"]["coverage"]
+        assert cov["ranks_present"] == 1 and not cov["incomplete"]
         # summary 404 until the artifact exists
         try:
             status, _ = _get(base + "/api/summary")

@@ -1,0 +1,163 @@
+"""Memory-creep threshold matrix + trend-engine units
+(mirrors the reference's heuristic bars: ≥row gate, 512 MiB/1 GiB delta,
+6%/4% worst/median growth, slope gates, ≤2% pullback tolerance —
+reference: diagnostics/step_memory/trend.py:31-57, policy.py:13-93)."""
+
+from traceml_tpu.analytics.trends.core import (
+    compute_trend_evidence,
+    compute_window_trend,
+    summarize_across,
+)
+from traceml_tpu.diagnostics.step_memory.api import diagnose_rank_rows as diagnose
+from traceml_tpu.diagnostics.step_memory.policy import StepMemoryPolicy
+
+GiB = 1024**3
+MiB = 1024**2
+
+POLICY = StepMemoryPolicy(creep_min_steps=90)  # row gate shrunk for speed
+
+
+def _row(step, cur, limit=16 * GiB, dev=0):
+    return {
+        "step": step,
+        "device_id": dev,
+        "current_bytes": cur,
+        "step_peak_bytes": cur,
+        "limit_bytes": limit,
+    }
+
+
+def _linear(base, delta, n=900):
+    return [_row(s, base + s * delta // n) for s in range(n)]
+
+
+def _kinds(result):
+    return {i.kind for i in result.issues}
+
+
+# --- trend engine units ----------------------------------------------------
+
+def test_window_trend_rising():
+    ev = compute_window_trend([float(i) for i in range(500)], 100, 400)
+    assert ev.trend_pct > 0
+    assert ev.slope_pct_per_100 > 0
+    assert not ev.recovered
+
+
+def test_window_trend_flat_tail_after_growth():
+    # grew early, flat for the whole long window → slope ~0 (plateau)
+    series = [float(min(i, 100)) for i in range(600)]
+    ev = compute_window_trend(series, 100, 400)
+    assert abs(ev.slope_pct_per_100) < 0.001
+    assert abs(ev.trend_pct) < 0.01
+
+
+def test_window_trend_pullback_detected():
+    series = [float(i) for i in range(400)] + [200.0] * 50
+    ev = compute_window_trend(series, 100, 400, pullback_tolerance=0.02)
+    assert ev.recovered
+    assert ev.pullback_pct > 0.4
+
+
+def test_summarize_across():
+    s = summarize_across({0: 0.10, 1: 0.02, 2: 0.05})
+    assert s.worst_key == 0 and s.worst == 0.10
+    assert s.median == 0.05
+    assert summarize_across({}) is None
+
+
+def test_banded_evidence_monotonic():
+    ev = compute_trend_evidence([float(i) for i in range(90)])
+    assert ev.monotonic_band_growth
+    assert not ev.weak_recovery
+    assert ev.delta > 0
+
+
+# --- creep threshold matrix ------------------------------------------------
+
+def test_below_delta_bar_no_creep():
+    rows = {0: _linear(4 * GiB, 300 * MiB)}  # < 512 MiB
+    assert not _kinds(diagnose(rows, policy=POLICY)) & {
+        "MEMORY_CREEP_EARLY", "MEMORY_CREEP_CONFIRMED"
+    }
+
+
+def test_below_growth_pct_no_creep():
+    # 600 MiB over a 14 GiB base ≈ 4.2% < 6% growth bar
+    rows = {0: _linear(14 * GiB, 600 * MiB, n=900)}
+    assert not _kinds(diagnose(rows, policy=POLICY)) & {
+        "MEMORY_CREEP_EARLY", "MEMORY_CREEP_CONFIRMED"
+    }
+
+
+def test_plateau_no_creep():
+    # grew 2 GiB early, flat for the rest → tail slope gate rejects
+    rows = {0: [
+        _row(s, 4 * GiB + min(s, 150) * (2 * GiB // 150)) for s in range(900)
+    ]}
+    assert not _kinds(diagnose(rows, policy=POLICY)) & {
+        "MEMORY_CREEP_EARLY", "MEMORY_CREEP_CONFIRMED"
+    }
+
+
+def test_early_creep_between_bars():
+    # 900 MiB endpoint growth → banded delta (recent band mean − baseline
+    # band mean) ≈ ⅔·900 = 600 MiB: ≥512 MiB early bar, <1 GiB confirmed
+    rows = {0: _linear(4 * GiB, 900 * MiB)}
+    result = diagnose(rows, policy=POLICY)
+    assert "MEMORY_CREEP_EARLY" in _kinds(result)
+    assert "MEMORY_CREEP_CONFIRMED" not in _kinds(result)
+    early = next(i for i in result.issues if i.kind == "MEMORY_CREEP_EARLY")
+    assert early.severity == "warning"
+
+
+def test_confirmed_creep_above_bar():
+    rows = {0: _linear(4 * GiB, 2 * GiB)}
+    result = diagnose(rows, policy=POLICY)
+    assert result.diagnosis.kind == "MEMORY_CREEP_CONFIRMED"
+    assert result.diagnosis.severity == "critical"
+    assert "MEMORY_CREEP_EARLY" not in _kinds(result)  # no double report
+    ev = result.diagnosis.evidence
+    assert "trend" in ev and "window" in ev
+
+
+def test_row_gate_blocks_short_series():
+    rows = {0: _linear(4 * GiB, 2 * GiB, n=80)}  # < 90-row gate
+    assert not _kinds(diagnose(rows, policy=POLICY)) & {
+        "MEMORY_CREEP_EARLY", "MEMORY_CREEP_CONFIRMED"
+    }
+
+
+def test_pullback_vetoes_creep():
+    rows = {0: []}
+    for s in range(900):
+        growth = min(s, 600) * (2 * GiB // 600)
+        recovery = max(0, s - 700) * (1 * GiB // 100)
+        rows[0].append(_row(s, 4 * GiB + growth - recovery))
+    assert not _kinds(diagnose(rows, policy=POLICY)) & {
+        "MEMORY_CREEP_EARLY", "MEMORY_CREEP_CONFIRMED"
+    }
+
+
+def test_cluster_wide_flag():
+    rows = {
+        0: _linear(4 * GiB, 2 * GiB),
+        1: _linear(4 * GiB, int(1.8 * GiB)),
+    }
+    result = diagnose(rows, policy=POLICY)
+    confirmed = [i for i in result.issues if i.kind == "MEMORY_CREEP_CONFIRMED"]
+    assert confirmed
+    assert all(i.evidence["cluster_wide"] for i in confirmed)
+
+
+def test_single_rank_creep_not_cluster_wide():
+    rows = {
+        0: _linear(4 * GiB, 2 * GiB),
+        1: [_row(s, 4 * GiB) for s in range(900)],
+        2: [_row(s, 4 * GiB) for s in range(900)],
+    }
+    result = diagnose(rows, policy=POLICY)
+    confirmed = [i for i in result.issues if i.kind == "MEMORY_CREEP_CONFIRMED"]
+    assert confirmed
+    assert confirmed[0].ranks == [0]
+    assert not confirmed[0].evidence["cluster_wide"]

@@ -1,0 +1,137 @@
+"""Occupancy-derived utilization + new system/process rule battery
+(VERDICT r1 items 6/8: the chip-busy signal and the counter-gated
+utilization/temperature/power and HIGH_PROCESS_CPU rules)."""
+
+from traceml_tpu.diagnostics.process.api import diagnose as diagnose_process
+from traceml_tpu.diagnostics.step_time.api import diagnose_rank_rows
+from traceml_tpu.diagnostics.system.api import diagnose as diagnose_system
+from traceml_tpu.diagnostics.system.rules import SystemPolicy
+from traceml_tpu.utils import timing as T
+from traceml_tpu.utils.step_time_window import build_step_time_window
+
+GiB = 1024**3
+
+
+def _rows(device_step_ms, host_step_ms=100.0, n=60):
+    return [
+        {
+            "step": s,
+            "timestamp": float(s),
+            "clock": "device",
+            "events": {
+                T.STEP_TIME: {
+                    "cpu_ms": host_step_ms,
+                    "device_ms": device_step_ms,
+                    "count": 1,
+                },
+                T.COMPUTE_TIME: {
+                    "cpu_ms": 1.0,
+                    "device_ms": device_step_ms * 0.9,
+                    "count": 1,
+                },
+            },
+        }
+        for s in range(1, n + 1)
+    ]
+
+
+def test_window_occupancy_computed():
+    window = build_step_time_window({0: _rows(20.0), 1: _rows(40.0)})
+    occ = window.occupancy_by_rank
+    assert abs(occ[0] - 0.2) < 1e-6
+    assert abs(occ[1] - 0.4) < 1e-6
+    assert abs(window.median_occupancy - 0.3) < 1e-6
+
+
+def test_window_occupancy_capped_and_absent():
+    # device nominally exceeding wall clips to 1.0
+    w = build_step_time_window({0: _rows(130.0)})
+    assert w.occupancy_by_rank[0] == 1.0
+    # host-only rows → no occupancy
+    rows = [
+        {"step": s, "timestamp": float(s), "clock": "host",
+         "events": {T.STEP_TIME: {"cpu_ms": 100.0, "device_ms": None, "count": 1}}}
+        for s in range(1, 30)
+    ]
+    w = build_step_time_window({0: rows})
+    assert w.occupancy_by_rank == {}
+    assert w.median_occupancy is None
+
+
+def test_low_occupancy_fires_live_and_summary():
+    rank_rows = {0: _rows(20.0)}  # 20% busy
+    for mode in ("live", "summary"):
+        result = diagnose_rank_rows(rank_rows, mode=mode)
+        kinds = {i.kind for i in result.issues}
+        assert "LOW_DEVICE_UTILIZATION" in kinds, mode
+        issue = next(i for i in result.issues if i.kind == "LOW_DEVICE_UTILIZATION")
+        assert issue.severity == "warning"
+        assert "20%" in issue.summary
+
+
+def test_very_low_occupancy_critical():
+    result = diagnose_rank_rows({0: _rows(10.0)}, mode="live")
+    issue = next(i for i in result.issues if i.kind == "LOW_DEVICE_UTILIZATION")
+    assert issue.severity == "critical"
+
+
+def test_high_occupancy_no_fire():
+    result = diagnose_rank_rows({0: _rows(90.0)}, mode="live")
+    assert "LOW_DEVICE_UTILIZATION" not in {i.kind for i in result.issues}
+
+
+# --- system counter rules (data-gated) -------------------------------------
+
+def _dev_rows(**kw):
+    base = {"memory_used_bytes": 1 * GiB, "memory_total_bytes": 16 * GiB,
+            "utilization_pct": None, "temperature_c": None, "power_w": None}
+    base.update(kw)
+    return {(0, 0): [dict(base) for _ in range(12)]}
+
+
+def test_utilization_counter_rule():
+    result = diagnose_system({}, _dev_rows(utilization_pct=15.0))
+    assert "LOW_DEVICE_UTILIZATION" in {i.kind for i in result.issues}
+    # healthy util → silent
+    result = diagnose_system({}, _dev_rows(utilization_pct=85.0))
+    assert "LOW_DEVICE_UTILIZATION" not in {i.kind for i in result.issues}
+    # null columns (current TPU runtime) → gated off, no crash
+    result = diagnose_system({}, _dev_rows())
+    assert "LOW_DEVICE_UTILIZATION" not in {i.kind for i in result.issues}
+
+
+def test_temperature_rule_tiers():
+    result = diagnose_system({}, _dev_rows(temperature_c=86.0))
+    issue = next(i for i in result.issues if i.kind == "HIGH_DEVICE_TEMPERATURE")
+    assert issue.severity == "warning"
+    result = diagnose_system({}, _dev_rows(temperature_c=96.0))
+    issue = next(i for i in result.issues if i.kind == "HIGH_DEVICE_TEMPERATURE")
+    assert issue.severity == "critical"
+
+
+def test_power_rule_needs_rated_power():
+    # default policy: rated unknown → rule silent even at high draw
+    result = diagnose_system({}, _dev_rows(power_w=500.0))
+    assert "HIGH_DEVICE_POWER" not in {i.kind for i in result.issues}
+    # with rated power configured the rule engages
+    policy = SystemPolicy(device_power_rated_w=400.0)
+    result = diagnose_system({}, _dev_rows(power_w=390.0), policy=policy)
+    assert "HIGH_DEVICE_POWER" in {i.kind for i in result.issues}
+
+
+# --- process CPU tiers ------------------------------------------------------
+
+def _proc(cpu):
+    return {0: [{"cpu_pct": cpu, "rss_bytes": 1 * GiB, "num_threads": 8}] * 30}
+
+
+def test_process_cpu_tiers():
+    assert "HIGH_PROCESS_CPU" not in {
+        i.kind for i in diagnose_process(_proc(200.0), {}).issues
+    }
+    warn = diagnose_process(_proc(400.0), {})
+    issue = next(i for i in warn.issues if i.kind == "HIGH_PROCESS_CPU")
+    assert issue.severity == "warning"
+    crit = diagnose_process(_proc(900.0), {})
+    issue = next(i for i in crit.issues if i.kind == "HIGH_PROCESS_CPU")
+    assert issue.severity == "critical"

@@ -1,0 +1,139 @@
+"""Panel snapshot battery per domain — renders the cli package's panels
+from injected views and asserts on exported text (reference: the
+per-domain renderer tests; the cluster table with ≥2 nodes is the
+multi-node view required by SURVEY §2.6)."""
+
+from rich.console import Console
+
+from traceml_tpu.renderers import views as V
+from traceml_tpu.renderers.cli import (
+    cluster_panel,
+    process_panel,
+    step_memory_panel,
+    step_time_panel,
+    system_panel,
+)
+from traceml_tpu.utils import timing as T
+from traceml_tpu.utils.step_time_window import build_step_time_window
+
+
+def _render(renderable) -> str:
+    console = Console(record=True, width=110)
+    console.print(renderable)
+    return console.export_text()
+
+
+def _step_payload(n_ranks=4, world=4):
+    rows = {
+        r: [
+            {
+                "step": s,
+                "timestamp": float(s),
+                "clock": "device",
+                "events": {
+                    T.STEP_TIME: {"cpu_ms": 100.0, "device_ms": 100.0 + 10 * r, "count": 1},
+                    T.DATALOADER_NEXT: {"cpu_ms": 15.0, "device_ms": None, "count": 1},
+                    T.COMPUTE_TIME: {"cpu_ms": 1.0, "device_ms": 70.0, "count": 1},
+                },
+            }
+            for s in range(1, 25)
+        ]
+        for r in range(n_ranks)
+    }
+    window = build_step_time_window(rows)
+    return {"views": {"step_time": V.build_step_time_view(window, world_size=world)}}
+
+
+def test_step_time_panel_with_rank_breakdown():
+    text = _render(step_time_panel(_step_payload()))
+    assert "step time" in text
+    assert "compute" in text and "input" in text and "residual" in text
+    assert "4/4 ranks" in text
+    # small world → per-rank breakdown matrix present
+    assert "per-rank avg" in text
+    # worst rank for the envelope is rank 3 (slowest)
+    assert "3" in text
+
+
+def test_step_time_panel_incomplete_coverage():
+    text = _render(step_time_panel(_step_payload(n_ranks=2, world=8)))
+    assert "2/8 ranks" in text
+    assert "INCOMPLETE" in text
+
+
+def test_step_time_panel_empty():
+    assert "waiting" in _render(step_time_panel({}))
+
+
+def test_memory_panel_pressure_and_growth():
+    rows = {
+        0: [
+            {"step": i, "timestamp": float(i), "device_id": 0,
+             "device_kind": "tpu v5e", "current_bytes": (15 << 30) + i * (1 << 20),
+             "peak_bytes": 15 << 30, "step_peak_bytes": (15 << 30) + i * (1 << 20),
+             "limit_bytes": 16 << 30}
+            for i in range(1, 6)
+        ]
+    }
+    payload = {"views": {"memory": V.build_memory_view(rows)}}
+    text = _render(step_memory_panel(payload))
+    assert "device memory" in text
+    assert "tpu v5e" in text
+    assert "%" in text  # pressure column rendered
+    assert "worst pressure rank 0" in text
+    assert "+" in text  # growth shown
+
+
+def test_cluster_panel_two_nodes():
+    now = 1000.0
+    host = {
+        0: [{"node_rank": 0, "hostname": "pod-a", "cpu_pct": 25.0,
+             "memory_used_bytes": 4 << 30, "memory_total_bytes": 8 << 30,
+             "memory_pct": 50.0, "load_1m": 0.5, "timestamp": now}],
+        1: [{"node_rank": 1, "hostname": "pod-b", "cpu_pct": 80.0,
+             "memory_used_bytes": 6 << 30, "memory_total_bytes": 8 << 30,
+             "memory_pct": 75.0, "load_1m": 2.0, "timestamp": now}],
+    }
+    payload = {"views": {"system": V.build_system_view(host, expected_nodes=2, now=now)}}
+    cluster = cluster_panel(payload)
+    assert cluster is not None
+    text = _render(cluster)
+    assert "cpu_pct" in text and "pod-b" in text
+    assert "2/2 nodes" in text
+    sys_text = _render(system_panel(payload))
+    assert "pod-a" in sys_text and "pod-b" in sys_text
+
+
+def test_cluster_panel_hidden_single_node():
+    host = {0: [{"node_rank": 0, "hostname": "solo", "cpu_pct": 10.0,
+                 "memory_used_bytes": 1, "memory_total_bytes": 2,
+                 "memory_pct": 50.0, "load_1m": 0.1, "timestamp": 1.0}]}
+    payload = {"views": {"system": V.build_system_view(host, now=2.0)}}
+    assert cluster_panel(payload) is None
+
+
+def test_system_panel_device_table_with_utilization():
+    now = 10.0
+    host = {0: [{"node_rank": 0, "hostname": "n0", "cpu_pct": 10.0,
+                 "memory_used_bytes": 1 << 30, "memory_total_bytes": 2 << 30,
+                 "memory_pct": 50.0, "load_1m": 0.1, "timestamp": now}]}
+    devices = {(0, 0): [{"device_id": 0, "device_kind": "tpu", "timestamp": now,
+                         "memory_used_bytes": 10 << 30, "memory_total_bytes": 16 << 30,
+                         "utilization_pct": 42.0, "temperature_c": 61.0,
+                         "power_w": 120.0}]}
+    payload = {"views": {"system": V.build_system_view(host, devices, now=now)}}
+    text = _render(system_panel(payload))
+    assert "42%" in text and "61°C" in text and "120W" in text
+
+
+def test_process_panel_busiest_highlight():
+    procs = {
+        0: [{"hostname": "h", "pid": 100, "cpu_pct": 20.0, "rss_bytes": 1 << 30,
+             "vms_bytes": 0, "num_threads": 4, "timestamp": 1.0}],
+        3: [{"hostname": "h", "pid": 103, "cpu_pct": 99.0, "rss_bytes": 1 << 30,
+             "vms_bytes": 0, "num_threads": 4, "timestamp": 1.0}],
+    }
+    payload = {"views": {"process": V.build_process_view(procs, now=2.0)}}
+    text = _render(process_panel(payload))
+    assert "103" in text and "99%" in text
+    assert "total rss" in text

@@ -1,0 +1,149 @@
+"""View-builder unit battery — the typed schema every surface renders
+(panels, browser payload).  Mirrors the reference's renderer compute
+tests (reference: tests/renderers/*)."""
+
+from traceml_tpu.renderers import views as V
+from traceml_tpu.utils import timing as T
+from traceml_tpu.utils.step_time_window import build_step_time_window
+
+
+def _step_rows(n=30, step_ms=100.0, input_ms=10.0, rank_offset=0.0):
+    return [
+        {
+            "step": s,
+            "timestamp": float(s),
+            "clock": "device",
+            "events": {
+                T.STEP_TIME: {"cpu_ms": step_ms, "device_ms": step_ms + rank_offset, "count": 1},
+                T.DATALOADER_NEXT: {"cpu_ms": input_ms, "device_ms": None, "count": 1},
+                T.COMPUTE_TIME: {"cpu_ms": 1.0, "device_ms": 80.0, "count": 1},
+            },
+        }
+        for s in range(1, n + 1)
+    ]
+
+
+def test_step_time_view_shapes():
+    rank_rows = {0: _step_rows(), 1: _step_rows(rank_offset=20.0)}
+    window = build_step_time_window(rank_rows)
+    view = V.build_step_time_view(window, world_size=4, latest_ts=30.0)
+    assert view.clock == "device"
+    assert view.coverage.world_size == 4
+    assert view.coverage.ranks_present == 2
+    assert view.coverage.incomplete  # 2 of 4 ranks
+    keys = [p.key for p in view.phases]
+    assert keys[0] == "step_time" and keys[-1] == "residual"
+    assert "compute" in keys and "input" in keys
+    # per-rank series + stacking series aligned to the steps tail
+    assert set(view.step_series) == {"0", "1"}
+    assert len(view.steps) == len(view.step_series["0"])
+    assert set(view.phase_stack) >= {"compute", "input", "residual"}
+    assert len(view.phase_stack["compute"]) == len(view.steps)
+    # rank 1 is slower → worst rank for the step envelope
+    step = next(p for p in view.phases if p.key == "step_time")
+    assert step.worst_rank == 1
+    # round-trips to plain JSON types
+    d = view.as_dict()
+    assert d["coverage"]["incomplete"] is True
+
+
+def test_step_time_view_none_passthrough():
+    assert V.build_step_time_view(None) is None
+
+
+def _mem_rows(cur, limit=16 << 30, n=5):
+    return [
+        {
+            "step": i,
+            "timestamp": float(i),
+            "device_id": 0,
+            "device_kind": "tpu v5e",
+            "current_bytes": cur + i * (1 << 20),
+            "peak_bytes": cur,
+            "step_peak_bytes": cur,
+            "limit_bytes": limit,
+        }
+        for i in range(1, n + 1)
+    ]
+
+
+def test_memory_view_pressure_and_growth():
+    view = V.build_memory_view({0: _mem_rows(8 << 30), 1: _mem_rows(15 << 30)})
+    assert [s.rank for s in view.ranks] == [0, 1]
+    assert view.worst_pressure_rank == 1
+    r1 = view.ranks[1]
+    assert r1.pressure > 0.9
+    assert r1.growth_bytes == 4 << 20  # 4 steps × 1 MiB
+    assert len(r1.history) == 5
+    assert view.total_current_bytes > 23 << 30
+
+
+def test_memory_view_empty():
+    assert V.build_memory_view({}) is None
+    assert V.build_memory_view({0: []}) is None
+
+
+def _host_row(node, host, cpu, ts, used=4 << 30, total=8 << 30):
+    return {
+        "node_rank": node,
+        "hostname": host,
+        "cpu_pct": cpu,
+        "memory_used_bytes": used,
+        "memory_total_bytes": total,
+        "memory_pct": used / total * 100,
+        "load_1m": 1.0,
+        "timestamp": ts,
+    }
+
+
+def test_system_view_cluster_rollups_two_nodes():
+    now = 1000.0
+    host = {
+        0: [_host_row(0, "node-a", 20.0, now - 1)],
+        1: [_host_row(1, "node-b", 90.0, now - 1)],
+    }
+    devices = {
+        (0, 0): [{"device_id": 0, "device_kind": "tpu", "memory_used_bytes": 1,
+                  "memory_total_bytes": 2, "utilization_pct": 55.0,
+                  "temperature_c": None, "power_w": None, "timestamp": now - 1}],
+    }
+    view = V.build_system_view(host, devices, expected_nodes=3, now=now)
+    assert view.is_cluster
+    assert [n.hostname for n in view.nodes] == ["node-a", "node-b"]
+    assert view.nodes[0].devices[0].utilization_pct == 55.0
+    assert view.nodes[1].devices == []
+    assert view.missing_nodes == 1
+    cpu = next(r for r in view.rollups if r.metric == "cpu_pct")
+    assert cpu.min_value == 20.0 and cpu.max_value == 90.0
+    assert cpu.max_node == "node-b"
+    assert not view.nodes[0].stale
+    d = view.as_dict()
+    assert d["is_cluster"] is True
+
+
+def test_system_view_single_node_no_rollups():
+    view = V.build_system_view({0: [_host_row(0, "solo", 10.0, 999.0)]}, now=1000.0)
+    assert not view.is_cluster
+    assert view.rollups == []
+
+
+def test_system_view_staleness():
+    view = V.build_system_view(
+        {0: [_host_row(0, "n", 10.0, 100.0)]}, now=200.0
+    )
+    assert view.nodes[0].stale
+
+
+def test_process_view_busiest_and_stale():
+    now = 50.0
+    procs = {
+        0: [{"hostname": "h", "pid": 10, "cpu_pct": 30.0, "rss_bytes": 1 << 30,
+             "vms_bytes": 2 << 30, "num_threads": 8, "timestamp": now - 1}],
+        1: [{"hostname": "h", "pid": 11, "cpu_pct": 95.0, "rss_bytes": 2 << 30,
+             "vms_bytes": 3 << 30, "num_threads": 8, "timestamp": now - 20}],
+    }
+    view = V.build_process_view(procs, now=now)
+    assert view.busiest_rank == 1
+    assert view.total_rss_bytes == 3 << 30
+    assert not view.ranks[0].stale
+    assert view.ranks[1].stale

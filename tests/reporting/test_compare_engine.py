@@ -1,0 +1,228 @@
+"""Compare-engine battery — improvement / regression / mixed /
+insufficient-data scenarios (mirrors the reference's compare scenario
+coverage; reference: reporting/compare/verdict.py:24-38 ladder)."""
+
+from traceml_tpu.reporting.compare.command import (
+    build_compare_payload,
+    render_compare_text,
+)
+from traceml_tpu.reporting.compare.policy import DEFAULT_POLICY, classify
+from traceml_tpu.reporting.compare.sections import (
+    compare_step_memory,
+    compare_step_time,
+    compare_system,
+)
+
+
+def _summary(
+    step_ms=100.0,
+    input_share=0.1,
+    per_rank=None,
+    peaks=None,
+    n_steps=40,
+    diagnosis=("HEALTHY", "info"),
+    cpu_mean=30.0,
+    rss=1 << 30,
+    proc_cpu=50.0,
+    session="s",
+):
+    per_rank = per_rank or {"0": step_ms, "1": step_ms}
+    peaks = peaks or {"0": 4 << 30, "1": 4 << 30}
+    return {
+        "meta": {"session_id": session},
+        "primary_diagnosis": {"kind": diagnosis[0], "severity": diagnosis[1]},
+        "sections": {
+            "step_time": {
+                "status": "OK",
+                "global": {
+                    "clock": "device",
+                    "n_steps": n_steps,
+                    "phases": {
+                        "step_time": {
+                            "median_ms": step_ms,
+                            "per_rank_avg_ms": per_rank,
+                        },
+                        "input": {
+                            "median_ms": step_ms * input_share,
+                            "share_of_step": input_share,
+                        },
+                    },
+                },
+            },
+            "step_memory": {
+                "status": "OK",
+                "global": {
+                    "per_rank": {
+                        r: {"step_peak_bytes": p} for r, p in peaks.items()
+                    }
+                },
+            },
+            "system": {
+                "status": "OK",
+                "global": {
+                    "nodes": {
+                        "0": {
+                            "hostname": "n0",
+                            "cpu_pct_mean": cpu_mean,
+                            "memory_used_bytes": 8 << 30,
+                        }
+                    }
+                },
+            },
+            "process": {
+                "status": "OK",
+                "global": {
+                    "per_rank": {
+                        "0": {"cpu_pct": proc_cpu, "rss_bytes": rss},
+                        "1": {"cpu_pct": proc_cpu, "rss_bytes": rss},
+                    }
+                },
+            },
+        },
+    }
+
+
+def test_equivalent_runs():
+    p = build_compare_payload(_summary(), _summary(session="t"))
+    assert p["verdict"] == "EQUIVALENT"
+    assert p["findings"] == []
+    assert p["sections"]["step_time"]["status"] == "OK"
+    assert "EQUIVALENT" in render_compare_text(p)
+
+
+def test_major_step_regression():
+    p = build_compare_payload(_summary(step_ms=100.0), _summary(step_ms=120.0))
+    assert p["verdict"] == "REGRESSION"
+    assert p["findings"][0]["kind"] == "STEP_TIME_REGRESSION"
+    assert p["findings"][0]["significance"] == "major"
+    assert abs(p["step_delta_rel"] - 0.2) < 1e-9
+
+
+def test_major_step_improvement():
+    p = build_compare_payload(_summary(step_ms=120.0), _summary(step_ms=100.0))
+    assert p["verdict"] == "IMPROVEMENT"
+    assert p["findings"][0]["kind"] == "STEP_TIME_IMPROVEMENT"
+
+
+def test_minor_regression_is_likely():
+    p = build_compare_payload(_summary(step_ms=100.0), _summary(step_ms=104.0))
+    assert p["verdict"] == "LIKELY_REGRESSION"
+
+
+def test_mixed_signals():
+    # step improves (major) but memory regresses (minor → regression class)
+    p = build_compare_payload(
+        _summary(step_ms=120.0, peaks={"0": 4 << 30, "1": 4 << 30}),
+        _summary(step_ms=100.0, peaks={"0": (4 << 30) + (300 << 20), "1": 4 << 30}),
+    )
+    assert p["verdict"] == "MIXED"
+    kinds = {f["kind"] for f in p["findings"]}
+    assert "STEP_TIME_IMPROVEMENT" in kinds
+    assert "MEMORY_REGRESSION" in kinds or "MEMORY_IMBALANCE_GREW" in kinds
+
+
+def test_insufficient_window():
+    p = build_compare_payload(_summary(n_steps=4), _summary(n_steps=40))
+    assert p["verdict"] == "INSUFFICIENT_DATA"
+    assert p["sections"]["step_time"]["status"] == "INSUFFICIENT"
+
+
+def test_missing_section_partial_data():
+    b = _summary()
+    c = _summary()
+    c["sections"]["step_memory"] = {"status": "NO_DATA"}
+    p = build_compare_payload(b, c)
+    assert p["sections"]["step_memory"]["status"] == "MISSING_CANDIDATE"
+    assert p["verdict"] == "PARTIAL_DATA"
+
+
+def test_missing_step_time_is_insufficient():
+    b = _summary()
+    del b["sections"]["step_time"]
+    c = _summary()
+    del c["sections"]["step_time"]
+    p = build_compare_payload(b, c)
+    assert p["verdict"] == "INSUFFICIENT_DATA"
+
+
+def test_rank_divergence_detected():
+    # rank 1 alone slows 30% while the run-level median stays put
+    p = build_compare_payload(
+        _summary(per_rank={"0": 100.0, "1": 100.0}),
+        _summary(per_rank={"0": 100.0, "1": 130.0}),
+    )
+    kinds = [f["kind"] for f in p["findings"]]
+    assert "RANK_DIVERGENCE" in kinds
+    rd = next(f for f in p["findings"] if f["kind"] == "RANK_DIVERGENCE")
+    assert rd["rank"] == "1"
+    assert p["verdict"] == "REGRESSION"
+
+
+def test_memory_skew_growth():
+    comp = compare_step_memory(
+        _summary(peaks={"0": 4 << 30, "1": 4 << 30}),
+        _summary(peaks={"0": 4 << 30, "1": (4 << 30) + (200 << 20)}),
+    )
+    assert "rank_skew_pp" in comp.metrics
+    kinds = [f["kind"] for f in comp.findings]
+    assert "MEMORY_IMBALANCE_GREW" in kinds
+
+
+def test_diagnosis_regression_drives_verdict():
+    p = build_compare_payload(
+        _summary(diagnosis=("HEALTHY", "info")),
+        _summary(diagnosis=("INPUT_STRAGGLER", "warning")),
+    )
+    kinds = [f["kind"] for f in p["findings"]]
+    assert "DIAGNOSIS_REGRESSION" in kinds
+    assert p["verdict"] == "REGRESSION"
+
+
+def test_diagnosis_change_to_healthy_not_regression():
+    p = build_compare_payload(
+        _summary(diagnosis=("INPUT_STRAGGLER", "warning")),
+        _summary(diagnosis=("HEALTHY", "info")),
+    )
+    changed = next(f for f in p["findings"] if f["metric"] == "primary_diagnosis")
+    assert changed["kind"] == "DIAGNOSIS_CHANGED"
+    assert changed["significance"] == "minor"
+
+
+def test_system_cpu_shift():
+    comp = compare_system(_summary(cpu_mean=20.0), _summary(cpu_mean=60.0))
+    kinds = [f["kind"] for f in comp.findings]
+    assert "HOST_CPU_SHIFT" in kinds
+    assert comp.per_rank["0"]["cpu_pp"] == 40.0
+
+
+def test_process_rss_growth():
+    p = build_compare_payload(
+        _summary(rss=1 << 30), _summary(rss=(1 << 30) + (2 << 30))
+    )
+    kinds = [f["kind"] for f in p["findings"]]
+    assert "PROCESS_RSS_GREW" in kinds
+
+
+def test_phase_share_shift_reported():
+    comp = compare_step_time(
+        _summary(input_share=0.10), _summary(input_share=0.25), DEFAULT_POLICY
+    )
+    shift = next(f for f in comp.findings if f["kind"] == "PHASE_SHIFT")
+    assert shift["phase"] == "input"
+    assert shift["direction"] == "up"
+    assert shift["significance"] == "major"
+
+
+def test_clock_change_noted():
+    b = _summary()
+    c = _summary()
+    c["sections"]["step_time"]["global"]["clock"] = "host"
+    comp = compare_step_time(b, c, DEFAULT_POLICY)
+    assert "clock changed" in comp.note
+
+
+def test_classify_tiers():
+    assert classify(None, 1, 2) == "negligible"
+    assert classify(0.5, 1, 2) == "negligible"
+    assert classify(-1.5, 1, 2) == "minor"
+    assert classify(2.5, 1, 2) == "major"

@@ -24,7 +24,10 @@ from traceml_tpu.utils.formatting import fmt_bytes
 class ProcessPolicy:
     rss_warn_bytes: int = 48 * 1024**3
     rss_critical_bytes: int = 96 * 1024**3
-    cpu_warn_pct: float = 90.0 * 4  # per-process; >4 cores busy
+    # per-process CPU tiers (psutil counts per-core: 400 == 4 cores busy)
+    # (reference: process/rules.py:35-347 High/VeryHigh CPU tiers)
+    cpu_warn_pct: float = 90.0 * 4
+    cpu_critical_pct: float = 90.0 * 8
     device_mem_skew_warn: float = 0.20
     device_mem_skew_critical: float = 0.30
     skew_pressure_gate: float = 0.5
@@ -169,8 +172,53 @@ class DeviceMemoryOverhangRule:
         return issues
 
 
+class HighProcessCPURule:
+    """HIGH_PROCESS_CPU — a training process burning many host cores
+    (reference: process/rules.py:35-347 with VeryHigh tier).  Uses a
+    recent mean so one psutil spike doesn't fire it."""
+
+    def evaluate(self, ctx: ProcessContext) -> List[DiagnosticIssue]:
+        issues = []
+        p = ctx.policy
+        for rank, rows in ctx.procs.items():
+            vals = [
+                float(r["cpu_pct"])
+                for r in rows[-30:]
+                if r.get("cpu_pct") is not None
+            ]
+            if not vals:
+                continue
+            cpu = statistics.mean(vals)
+            if cpu < p.cpu_warn_pct:
+                continue
+            severity = (
+                SEVERITY_CRITICAL if cpu >= p.cpu_critical_pct else SEVERITY_WARNING
+            )
+            issues.append(
+                DiagnosticIssue(
+                    kind="HIGH_PROCESS_CPU",
+                    severity=severity,
+                    summary=(
+                        f"Rank {rank} process burns {cpu:.0f}% CPU "
+                        f"(~{cpu / 100:.1f} cores, recent mean)."
+                    ),
+                    action=(
+                        "A compute-hungry training process starves its own "
+                        "dataloader workers and the dispatch thread: move "
+                        "preprocessing into workers, check for busy-wait "
+                        "loops, cap intra-op threads."
+                    ),
+                    metric="process_cpu_pct",
+                    score=cpu / 100.0,
+                    ranks=[rank],
+                )
+            )
+        return issues
+
+
 DEFAULT_RULES = (
     HighProcessRSSRule(),
+    HighProcessCPURule(),
     RankDeviceMemoryImbalanceRule(),
     DeviceMemoryOverhangRule(),
 )

@@ -12,7 +12,11 @@ import dataclasses
 import statistics
 from typing import Any, Dict, List, Mapping, Optional, Sequence
 
-from traceml_tpu.analytics.trends.core import compute_trend_evidence
+from traceml_tpu.analytics.trends.core import (
+    compute_trend_evidence,
+    compute_window_trend,
+    summarize_across,
+)
 from traceml_tpu.diagnostics.common import (
     SEVERITY_CRITICAL,
     SEVERITY_WARNING,
@@ -27,6 +31,8 @@ class MemoryContext:
     # (rank, device_id) → ordered step rows
     series: Dict[tuple, List[Dict[str, Any]]]
     policy: StepMemoryPolicy = DEFAULT_POLICY
+    # per-context creep-evidence cache: both creep rules share one scan
+    creep_cache: Optional[List["_CreepEvidence"]] = None
 
     @property
     def ranks(self) -> List[int]:
@@ -151,60 +157,145 @@ class ImbalanceRule:
         ]
 
 
-class CreepRule:
-    """CREEP_EARLY / CREEP_CONFIRMED
-    (reference heuristics: ≥800 steps, ≥512 MiB delta, ≥6% growth, slope
-    gate, weak-recovery check; confirmed at ≥1 GiB)."""
+@dataclasses.dataclass
+class _CreepEvidence:
+    rank: int
+    dev: int
+    banded: Any
+    windowed: Any
+    confirmed: bool
+    cluster_wide: bool
+
+
+def _collect_creep_evidence(ctx: MemoryContext) -> List[_CreepEvidence]:
+    """Shared creep screen for the Early/Confirmed rules
+    (reference heuristics: trend.py:105-200 — ≥800-row gate, banded
+    growth + windowed still-rising slope, peak-pullback recovery veto,
+    worst/median cross-rank split)."""
+    if ctx.creep_cache is not None:
+        return ctx.creep_cache
+    p = ctx.policy
+    candidates: List[_CreepEvidence] = []
+    growth_by_key: Dict[tuple, float] = {}
+    banded_by_key: Dict[tuple, Any] = {}
+    window_by_key: Dict[tuple, Any] = {}
+    for (rank, dev), rows in ctx.series.items():
+        # the row gate applies to EVERYTHING, including the cluster-wide
+        # median — a freshly restarted rank's warmup growth over 60 rows
+        # must not vote that the whole cluster is creeping
+        if len(rows) < p.creep_min_steps:
+            continue
+        series = [float(r.get("current_bytes") or 0) for r in rows]
+        banded = compute_trend_evidence(series)
+        windowed = compute_window_trend(
+            series,
+            short_n=p.creep_short_window,
+            long_n=p.creep_long_window,
+            pullback_tolerance=p.creep_pullback_max,
+        )
+        if banded is None or windowed is None:
+            continue
+        growth_by_key[(rank, dev)] = banded.growth_pct
+        banded_by_key[(rank, dev)] = banded
+        window_by_key[(rank, dev)] = windowed
+    growth_summary = summarize_across(growth_by_key)
+    median_growing = (
+        growth_summary is not None
+        and growth_summary.median >= p.creep_median_growth_pct
+    )
+    for key, banded in banded_by_key.items():
+        rank, dev = key
+        windowed = window_by_key[key]
+        if (
+            banded.delta < p.creep_min_delta_bytes
+            or banded.growth_pct < p.creep_min_growth_pct
+            or windowed.slope_pct_per_100 < p.creep_min_slope_pct_per_100
+            or windowed.recovered  # allocator pulled back — sawtooth, not leak
+        ):
+            continue
+        confirmed = (
+            banded.delta >= p.creep_confirmed_delta_bytes
+            and banded.monotonic_band_growth
+            and windowed.trend_pct > 0  # STILL rising in the tail
+        )
+        candidates.append(
+            _CreepEvidence(
+                rank=rank,
+                dev=dev,
+                banded=banded,
+                windowed=windowed,
+                confirmed=confirmed,
+                cluster_wide=median_growing,
+            )
+        )
+    ctx.creep_cache = candidates
+    return candidates
+
+
+_CREEP_ACTION = (
+    "Hunt Python-side references to device arrays (growing metric lists, "
+    "retained batches), check for per-step recompiles creating executables, "
+    "and confirm donated buffers are actually donated."
+)
+
+
+def _creep_issue(c: _CreepEvidence, kind: str, severity: str) -> DiagnosticIssue:
+    scope = "cluster-wide (median rank is growing too)" if c.cluster_wide else (
+        f"rank-local (rank {c.rank} only)"
+    )
+    return DiagnosticIssue(
+        kind=kind,
+        severity=severity,
+        summary=(
+            f"Rank {c.rank} device {c.dev} memory grew "
+            f"{fmt_bytes(c.banded.delta)} (+{c.banded.growth_pct * 100:.1f}%) "
+            f"over {c.banded.n} rows — {scope}"
+            + (
+                "; sustained and still rising, likely a leak."
+                if kind == "MEMORY_CREEP_CONFIRMED"
+                else "."
+            )
+        ),
+        action=_CREEP_ACTION,
+        metric="memory_creep",
+        score=c.banded.growth_pct,
+        ranks=[c.rank],
+        evidence={
+            "device_id": c.dev,
+            "trend": c.banded.to_dict(),
+            "window": c.windowed.to_dict(),
+            "cluster_wide": c.cluster_wide,
+        },
+    )
+
+
+class CreepEarlyRule:
+    """MEMORY_CREEP_EARLY — the screen passed but the confirmed bars
+    (≥1 GiB, monotonic, still rising) have not been met yet."""
 
     def evaluate(self, ctx: MemoryContext) -> List[DiagnosticIssue]:
-        p = ctx.policy
-        issues = []
-        for (rank, dev), rows in ctx.series.items():
-            if len(rows) < p.creep_min_steps:
-                continue
-            series = [float(r.get("current_bytes") or 0) for r in rows]
-            ev = compute_trend_evidence(series)
-            if ev is None:
-                continue
-            limit = next(
-                (r.get("limit_bytes") for r in reversed(rows) if r.get("limit_bytes")),
-                None,
-            )
-            slope_frac = (
-                (ev.slope_per_100 / float(limit)) if limit else
-                (ev.slope_per_100 / ev.baseline_mean if ev.baseline_mean else 0.0)
-            )
-            if (
-                ev.delta < p.creep_min_delta_bytes
-                or ev.growth_pct < p.creep_min_growth_pct
-                or slope_frac < p.creep_min_slope_per_100
-                or ev.weak_recovery
-            ):
-                continue
-            confirmed = ev.delta >= p.creep_confirmed_delta_bytes and ev.monotonic_band_growth
-            issues.append(
-                DiagnosticIssue(
-                    kind="MEMORY_CREEP_CONFIRMED" if confirmed else "MEMORY_CREEP_EARLY",
-                    severity=SEVERITY_CRITICAL if confirmed else SEVERITY_WARNING,
-                    summary=(
-                        f"Rank {rank} device {dev} memory grew "
-                        f"{fmt_bytes(ev.delta)} (+{ev.growth_pct * 100:.1f}%) "
-                        f"over {ev.n} steps"
-                        + (" — sustained, likely a leak." if confirmed else ".")
-                    ),
-                    action=(
-                        "Hunt Python-side references to device arrays "
-                        "(growing metric lists, retained batches), "
-                        "check for per-step recompiles creating executables, "
-                        "and confirm donated buffers are actually donated."
-                    ),
-                    metric="memory_creep",
-                    score=ev.growth_pct,
-                    ranks=[rank],
-                    evidence={"device_id": dev, "trend": ev.to_dict()},
-                )
-            )
-        return issues
+        return [
+            _creep_issue(c, "MEMORY_CREEP_EARLY", SEVERITY_WARNING)
+            for c in _collect_creep_evidence(ctx)
+            if not c.confirmed
+        ]
 
 
-DEFAULT_RULES = (HighPressureRule(), ImbalanceRule(), CreepRule())
+class CreepConfirmedRule:
+    """MEMORY_CREEP_CONFIRMED — large, monotonic, and still rising in
+    the tail window."""
+
+    def evaluate(self, ctx: MemoryContext) -> List[DiagnosticIssue]:
+        return [
+            _creep_issue(c, "MEMORY_CREEP_CONFIRMED", SEVERITY_CRITICAL)
+            for c in _collect_creep_evidence(ctx)
+            if c.confirmed
+        ]
+
+
+DEFAULT_RULES = (
+    HighPressureRule(),
+    ImbalanceRule(),
+    CreepEarlyRule(),
+    CreepConfirmedRule(),
+)

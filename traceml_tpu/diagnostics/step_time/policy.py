@@ -29,6 +29,11 @@ class StepTimePolicy:
     compile_share_warn: float = 0.10
     compile_share_critical: float = 0.25
     compile_warmup_steps: int = 3
+    # device occupancy (device-busy share of wall clock) — the TPU
+    # stand-in for the reference's GPU-utilization rule
+    # (reference: diagnostics/system/rules.py GPUUtilizationRule)
+    occupancy_warn: float = 0.30
+    occupancy_critical: float = 0.15
     min_steps: int = 20
 
 

@@ -349,10 +349,63 @@ class CompileBoundRule:
         ]
 
 
+class LowDeviceOccupancyRule:
+    """LOW_DEVICE_UTILIZATION — the chip is mostly idle.
+
+    TPU stand-in for the reference's GPUUtilizationRule
+    (reference: diagnostics/system/rules.py:22-120): libtpu exposes no
+    duty-cycle counter here, but occupancy — Σ device(step) / Σ host
+    (step) over the window — is the same signal derived from the timing
+    core.  Fires alongside whatever explains the idleness (INPUT_BOUND,
+    COMPILE_BOUND); the composer ranks them.
+    """
+
+    def evaluate(self, ctx: _Ctx) -> List[DiagnosticIssue]:
+        if not _enough_data(ctx):
+            return []
+        w = ctx.window
+        occ = w.median_occupancy
+        if occ is None or occ >= ctx.policy.occupancy_warn:
+            return []
+        severity = (
+            SEVERITY_CRITICAL
+            if occ <= ctx.policy.occupancy_critical
+            else SEVERITY_WARNING
+        )
+        worst_rank = min(w.occupancy_by_rank, key=lambda r: w.occupancy_by_rank[r])
+        return [
+            DiagnosticIssue(
+                kind="LOW_DEVICE_UTILIZATION",
+                severity=severity,
+                summary=(
+                    f"The device is busy only {occ * 100:.0f}% of wall clock "
+                    f"(median rank; worst rank {worst_rank} at "
+                    f"{w.occupancy_by_rank[worst_rank] * 100:.0f}%)."
+                ),
+                action=(
+                    "The chip is idle most of the step: overlap input with "
+                    "compute (prefetch), batch more work per dispatch, and "
+                    "check the phase table for what eats the host time."
+                ),
+                metric="device_occupancy",
+                score=1.0 - occ,
+                share_pct=occ,
+                ranks=[worst_rank],
+                evidence={
+                    "occupancy_by_rank": {
+                        str(r): round(v, 4)
+                        for r, v in w.occupancy_by_rank.items()
+                    }
+                },
+            )
+        ]
+
+
 DEFAULT_RULES = (
     CleanStragglerRule(),
     InputBoundRule(),
     CompileBoundRule(),
     ResidualHeavyRule(),
+    LowDeviceOccupancyRule(),
     ComputeBoundRule(),
 )

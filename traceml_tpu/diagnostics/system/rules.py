@@ -28,6 +28,14 @@ class SystemPolicy:
     host_mem_critical: float = 0.95
     device_mem_warn: float = 0.92
     device_mem_critical: float = 0.97
+    # data-gated device-counter rules: these columns are null on runtimes
+    # without the counters (current libtpu), populated where available
+    # (reference: system/rules.py utilization/temperature/power rules)
+    device_util_low_warn: float = 30.0  # %
+    device_temp_warn: float = 85.0  # °C
+    device_temp_critical: float = 95.0
+    device_power_warn_frac: float = 0.95  # of rated, when rated known
+    device_power_rated_w: float = 0.0  # 0 = unknown → absolute threshold off
 
 
 DEFAULT_POLICY = SystemPolicy()
@@ -168,4 +176,117 @@ class HighDeviceMemoryRule:
         return issues
 
 
-DEFAULT_RULES = (HighHostCPURule(), HighHostMemoryRule(), HighDeviceMemoryRule())
+class LowDeviceUtilizationCounterRule:
+    """Counter-based low-utilization — fires only where the runtime
+    populates ``utilization_pct`` (occupancy-derived utilization from
+    the timing core is handled by the step-time domain's
+    LOW_DEVICE_UTILIZATION rule; this one covers runtimes that DO expose
+    a duty-cycle counter)."""
+
+    def evaluate(self, ctx: SystemContext) -> List[DiagnosticIssue]:
+        issues = []
+        p = ctx.policy
+        for (node, dev), rows in ctx.devices.items():
+            util = _recent_mean(rows, "utilization_pct")
+            if util is None or util >= p.device_util_low_warn:
+                continue
+            issues.append(
+                DiagnosticIssue(
+                    kind="LOW_DEVICE_UTILIZATION",
+                    severity=SEVERITY_WARNING,
+                    summary=(
+                        f"Node {node} chip {dev} duty cycle at {util:.0f}% "
+                        "(recent mean) — the accelerator is mostly idle."
+                    ),
+                    action=(
+                        "Feed the chip: prefetch input, increase per-step "
+                        "work, check for host-side stalls in the phase table."
+                    ),
+                    metric="device_utilization_pct",
+                    score=1.0 - util / 100.0,
+                    share_pct=util / 100.0,
+                    ranks=[node],
+                    evidence={"device_id": dev},
+                )
+            )
+        return issues
+
+
+class HighDeviceTemperatureRule:
+    def evaluate(self, ctx: SystemContext) -> List[DiagnosticIssue]:
+        issues = []
+        p = ctx.policy
+        for (node, dev), rows in ctx.devices.items():
+            temp = _recent_mean(rows, "temperature_c", n=10)
+            if temp is None or temp < p.device_temp_warn:
+                continue
+            severity = (
+                SEVERITY_CRITICAL
+                if temp >= p.device_temp_critical
+                else SEVERITY_WARNING
+            )
+            issues.append(
+                DiagnosticIssue(
+                    kind="HIGH_DEVICE_TEMPERATURE",
+                    severity=severity,
+                    summary=(
+                        f"Node {node} chip {dev} at {temp:.0f}°C — thermal "
+                        "throttling territory."
+                    ),
+                    action=(
+                        "Sustained heat throttles the clock and skews this "
+                        "rank: check cooling/airflow, and expect stragglers "
+                        "attributed to this host."
+                    ),
+                    metric="device_temperature_c",
+                    score=temp / 100.0,
+                    ranks=[node],
+                    evidence={"device_id": dev},
+                )
+            )
+        return issues
+
+
+class HighDevicePowerRule:
+    def evaluate(self, ctx: SystemContext) -> List[DiagnosticIssue]:
+        issues = []
+        p = ctx.policy
+        if p.device_power_rated_w <= 0:
+            return []  # no rated power known → absolute rule disabled
+        for (node, dev), rows in ctx.devices.items():
+            power = _recent_mean(rows, "power_w", n=10)
+            if power is None:
+                continue
+            frac = power / p.device_power_rated_w
+            if frac < p.device_power_warn_frac:
+                continue
+            issues.append(
+                DiagnosticIssue(
+                    kind="HIGH_DEVICE_POWER",
+                    severity=SEVERITY_WARNING,
+                    summary=(
+                        f"Node {node} chip {dev} drawing {power:.0f}W "
+                        f"({frac * 100:.0f}% of rated) — power-limit "
+                        "throttling possible."
+                    ),
+                    action=(
+                        "Near the power cap the clock drops under sustained "
+                        "load; expect per-rank slowdowns on this host."
+                    ),
+                    metric="device_power_w",
+                    score=frac,
+                    ranks=[node],
+                    evidence={"device_id": dev},
+                )
+            )
+        return issues
+
+
+DEFAULT_RULES = (
+    HighHostCPURule(),
+    HighHostMemoryRule(),
+    HighDeviceMemoryRule(),
+    LowDeviceUtilizationCounterRule(),
+    HighDeviceTemperatureRule(),
+    HighDevicePowerRule(),
+)

@@ -1,0 +1,41 @@
+"""Live CLI dashboard composition
+(reference: aggregator/display_drivers/cli.py panel ordering — step time
+first, then findings, then resources; the cluster panel appears only in
+multi-node runs)."""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict
+
+from rich.console import Group
+from rich.text import Text
+
+from traceml_tpu.renderers.cli.diagnostics import diagnostics_panel
+from traceml_tpu.renderers.cli.memory import step_memory_panel
+from traceml_tpu.renderers.cli.output import stdout_panel
+from traceml_tpu.renderers.cli.process import process_panel
+from traceml_tpu.renderers.cli.step_time import step_time_panel
+from traceml_tpu.renderers.cli.system import cluster_panel, system_panel
+
+
+def dashboard(payload: Dict[str, Any], session: str) -> Group:
+    header = Text(f"TraceML-TPU — live · session {session}", style="bold")
+    # staleness = age of the NEWEST telemetry row, not of the payload
+    # (the payload is recomputed every tick regardless)
+    ts = payload.get("latest_row_ts")
+    if ts:
+        age = time.time() - ts
+        if age > 5.0:  # staleness badge (reference: display staleness)
+            header.append(f"   ⚠ telemetry {age:.0f}s stale", style="yellow")
+    parts = [header, step_time_panel(payload), diagnostics_panel(payload)]
+    cluster = cluster_panel(payload)
+    if cluster is not None:
+        parts.append(cluster)
+    parts += [
+        step_memory_panel(payload),
+        system_panel(payload),
+        process_panel(payload),
+        stdout_panel(payload),
+    ]
+    return Group(*parts)

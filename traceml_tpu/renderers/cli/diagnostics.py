@@ -1,0 +1,40 @@
+"""Composed cross-domain diagnostics card
+(reference: renderers/model_diagnostics/renderer.py:94 — the single place
+the live view lists findings from every domain)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from rich.panel import Panel
+from rich.text import Text
+
+_SEV_STYLE = {"critical": "bold red", "warning": "yellow", "info": "cyan"}
+
+
+def diagnostics_panel(payload: Dict[str, Any]) -> Panel:
+    from traceml_tpu.diagnostics.model_diagnostics import compose
+
+    results = {
+        "step_time": (payload.get("step_time") or {}).get("diagnosis"),
+        "step_memory": payload.get("step_memory_diagnosis"),
+        "system": payload.get("system_diagnosis"),
+        "process": payload.get("process_diagnosis"),
+    }
+    try:
+        composed = compose(results)
+    except Exception:
+        return Panel(Text("—", style="dim"), title="diagnostics")
+    if not composed.issues:
+        return Panel(
+            Text("no active findings", style="dim green"), title="diagnostics"
+        )
+    text = Text()
+    for issue in composed.issues[:6]:
+        domain = issue.evidence.get("domain", "?")
+        text.append(
+            f"[{issue.severity:>8}] {domain}/{issue.kind}: ",
+            style=_SEV_STYLE.get(issue.severity, "white"),
+        )
+        text.append(issue.summary + "\n")
+    return Panel(text, title="diagnostics")

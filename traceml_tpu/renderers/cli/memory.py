@@ -1,0 +1,54 @@
+"""Device-memory CLI panel
+(reference: renderers/step_memory/renderer.py — per-rank rows with
+pressure highlighting and window growth)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from rich.panel import Panel
+from rich.table import Table
+from rich.text import Text
+
+from traceml_tpu.renderers.views import MemoryView
+from traceml_tpu.utils.formatting import fmt_bytes, fmt_pct
+
+_PRESSURE_WARN = 0.92
+_PRESSURE_CRIT = 0.97
+
+
+def step_memory_panel(payload: Dict[str, Any]) -> Panel:
+    view: Optional[MemoryView] = (payload.get("views") or {}).get("memory")
+    if view is None:
+        return Panel(Text("no memory telemetry", style="dim"), title="device memory")
+    table = Table(expand=True, box=None)
+    table.add_column("rank", justify="right")
+    table.add_column("device")
+    table.add_column("current", justify="right")
+    table.add_column("step peak", justify="right")
+    table.add_column("limit", justify="right")
+    table.add_column("pressure", justify="right")
+    table.add_column("growth", justify="right")
+    for s in view.ranks:
+        style = ""
+        if s.pressure is not None and s.pressure >= _PRESSURE_WARN:
+            style = "bold red" if s.pressure >= _PRESSURE_CRIT else "yellow"
+        growth = ""
+        if s.growth_bytes:
+            sign = "+" if s.growth_bytes > 0 else ""
+            growth = f"{sign}{fmt_bytes(abs(s.growth_bytes))}"
+            if s.growth_bytes < 0:
+                growth = "-" + fmt_bytes(abs(s.growth_bytes))
+        table.add_row(
+            str(s.rank),
+            s.device_kind,
+            fmt_bytes(s.current_bytes),
+            fmt_bytes(s.step_peak_bytes),
+            fmt_bytes(s.limit_bytes),
+            Text(fmt_pct(s.pressure) if s.pressure else "—", style=style),
+            growth or "—",
+        )
+    sub = f"total {fmt_bytes(view.total_current_bytes)}"
+    if view.worst_pressure_rank is not None:
+        sub += f" · worst pressure rank {view.worst_pressure_rank}"
+    return Panel(table, title="device memory", subtitle=sub)

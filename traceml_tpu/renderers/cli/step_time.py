@@ -1,0 +1,78 @@
+"""Step-time CLI panel
+(reference: renderers/step_time/renderer.py — phase table, coverage
+subtitle, per-rank phase breakdown for small worlds)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from rich.console import Group
+from rich.panel import Panel
+from rich.table import Table
+from rich.text import Text
+
+from traceml_tpu.renderers.views import StepTimeView
+from traceml_tpu.utils.formatting import fmt_ms, fmt_pct
+from traceml_tpu.utils.step_time_window import RESIDUAL_KEY, STEP_KEY
+
+_MAX_RANK_COLUMNS = 8
+_SKEW_WARN = 0.10
+
+
+def _phase_table(view: StepTimeView) -> Table:
+    table = Table(expand=True, box=None, pad_edge=False)
+    table.add_column("phase")
+    table.add_column("median", justify="right")
+    table.add_column("share", justify="right")
+    table.add_column("worst rank", justify="right")
+    table.add_column("skew", justify="right")
+    for p in view.phases:
+        skew_style = "yellow" if p.skew_pct >= _SKEW_WARN and p.key != RESIDUAL_KEY else ""
+        table.add_row(
+            p.key,
+            fmt_ms(p.median_ms),
+            fmt_pct(p.share) if p.share is not None else "—",
+            str(p.worst_rank),
+            Text(fmt_pct(p.skew_pct), style=skew_style),
+        )
+    return table
+
+
+def _rank_breakdown(view: StepTimeView) -> Optional[Table]:
+    """rank × phase window-average matrix — only for small worlds where
+    the table is readable; large worlds rely on worst/skew columns."""
+    ranks = sorted(view.per_rank_avg_ms)
+    if not 1 < len(ranks) <= _MAX_RANK_COLUMNS:
+        return None
+    phase_keys = [p.key for p in view.phases if p.key != STEP_KEY]
+    table = Table(expand=True, box=None, pad_edge=False, title="per-rank avg (ms)")
+    table.add_column("rank", justify="right")
+    for k in [STEP_KEY] + phase_keys:
+        table.add_column(k.replace("_time", ""), justify="right")
+    for r in ranks:
+        avgs = view.per_rank_avg_ms[r]
+        cells = [f"{avgs.get(k, 0.0):.1f}" for k in [STEP_KEY] + phase_keys]
+        table.add_row(str(r), *cells)
+    return table
+
+
+def step_time_panel(payload: Dict[str, Any]) -> Panel:
+    view: Optional[StepTimeView] = (payload.get("views") or {}).get("step_time")
+    if view is None:
+        return Panel(
+            Text("waiting for step telemetry…", style="dim"), title="step time"
+        )
+    parts = [_phase_table(view)]
+    breakdown = _rank_breakdown(view)
+    if breakdown is not None:
+        parts.append(breakdown)
+    cov = view.coverage
+    sub = (
+        f"{view.n_steps} steps · {view.clock} clock · "
+        f"{cov.ranks_present}/{cov.world_size} ranks"
+    )
+    if view.median_occupancy is not None:
+        sub += f" · chip busy {view.median_occupancy * 100:.0f}%"
+    if cov.incomplete:
+        sub += " · INCOMPLETE"
+    return Panel(Group(*parts), title="step time", subtitle=sub)

@@ -1,6 +1,11 @@
 """Per-tick data computation for live views
-(reference pattern: renderers/<domain>/computer.py — SQLite → payload,
+(reference pattern: renderers/<domain>/computer.py — SQLite → typed view,
 cached per tick so multiple panels share one read).
+
+``LiveComputer.payload()`` returns a dict holding BOTH the typed views
+(``views.*``, the schema every surface renders from — see views.py) and
+the per-domain diagnosis results.  Raw loader output is only kept where a
+diagnostic consumes it directly.
 """
 
 from __future__ import annotations
@@ -10,6 +15,7 @@ from pathlib import Path
 from typing import Any, Dict, Optional
 
 from traceml_tpu.diagnostics.step_time.api import diagnose_rank_rows
+from traceml_tpu.renderers import views as V
 from traceml_tpu.reporting import loaders
 from traceml_tpu.utils.step_time_window import build_step_time_window
 
@@ -31,20 +37,21 @@ class LiveComputer:
         if now - self._cached_at < _CACHE_TTL and self._cache:
             return self._cache
         out: Dict[str, Any] = {"ts": time.time(), "db_exists": self.db_path.exists()}
+        out["views"] = {}
         if out["db_exists"]:
+            try:
+                out["topology"] = loaders.load_topology(self.db_path)
+            except Exception:
+                out["topology"] = {}
+            world = int((out.get("topology") or {}).get("world_size") or 0)
+            nodes = int((out.get("topology") or {}).get("nodes") or 0)
             try:
                 rank_rows = loaders.load_step_time_rows(
                     self.db_path, max_steps_per_rank=self.window_steps
                 )
                 window = build_step_time_window(rank_rows, max_steps=self.window_steps)
-                out["step_time"] = {
-                    "window": window,
-                    "diagnosis": diagnose_rank_rows(rank_rows, mode="live")
-                    if rank_rows
-                    else None,
-                }
                 # newest telemetry timestamp drives the staleness badge
-                out["latest_row_ts"] = max(
+                latest = max(
                     (
                         row.get("timestamp") or 0.0
                         for rows in rank_rows.values()
@@ -52,17 +59,28 @@ class LiveComputer:
                     ),
                     default=None,
                 )
+                out["latest_row_ts"] = latest
+                out["views"]["step_time"] = V.build_step_time_view(
+                    window, world_size=world, latest_ts=latest
+                )
+                out["step_time"] = {
+                    "window": window,
+                    "diagnosis": diagnose_rank_rows(rank_rows, mode="live")
+                    if rank_rows
+                    else None,
+                }
             except Exception as exc:
                 out["step_time"] = {"error": str(exc)}
             try:
                 mem_rows = loaders.load_step_memory_rows(
                     self.db_path, max_rows_per_rank=self.window_steps * 4
                 )
-                out["step_memory"] = mem_rows
+                out["views"]["memory"] = V.build_memory_view(mem_rows)
                 from traceml_tpu.diagnostics.step_memory.api import (
                     diagnose_rank_rows as diagnose_memory,
                 )
 
+                out["step_memory"] = mem_rows
                 out["step_memory_diagnosis"] = (
                     diagnose_memory(mem_rows) if mem_rows else None
                 )
@@ -70,11 +88,14 @@ class LiveComputer:
                 out["step_memory"] = {"error": str(exc)}
             try:
                 host, devices = loaders.load_system_rows(self.db_path, max_rows=300)
-                out["system"] = {"host": host, "devices": devices}
+                out["views"]["system"] = V.build_system_view(
+                    host, devices, expected_nodes=nodes
+                )
                 from traceml_tpu.diagnostics.system.api import (
                     diagnose as diagnose_system,
                 )
 
+                out["system"] = {"host": host, "devices": devices}
                 out["system_diagnosis"] = (
                     diagnose_system(host, devices) if host or devices else None
                 )
@@ -82,11 +103,12 @@ class LiveComputer:
                 out["system"] = {"error": str(exc)}
             try:
                 procs, pdevs = loaders.load_process_rows(self.db_path, max_rows=300)
-                out["process"] = {"procs": procs, "devices": pdevs}
+                out["views"]["process"] = V.build_process_view(procs)
                 from traceml_tpu.diagnostics.process.api import (
                     diagnose as diagnose_process,
                 )
 
+                out["process"] = {"procs": procs, "devices": pdevs}
                 out["process_diagnosis"] = (
                     diagnose_process(procs, pdevs) if procs or pdevs else None
                 )
@@ -99,4 +121,3 @@ class LiveComputer:
         self._cache = out
         self._cached_at = now
         return out
-

@@ -1,201 +1,14 @@
-"""Rich panel renderers per domain
-(reference pattern: renderers/<domain>/renderer.py get_panel_renderable).
-"""
+"""Compatibility shim — the panel renderers moved to the per-domain
+package ``traceml_tpu.renderers.cli`` (reference layout:
+renderers/<domain>/renderer.py).  Import from there."""
 
-from __future__ import annotations
-
-from typing import Any, Dict
-
-from rich.console import Group
-from rich.panel import Panel
-from rich.table import Table
-from rich.text import Text
-
-from traceml_tpu.utils.formatting import fmt_bytes, fmt_ms, fmt_pct
-from traceml_tpu.utils.step_time_window import RESIDUAL_KEY, STEP_KEY
-
-_SEV_STYLE = {"critical": "bold red", "warning": "yellow", "info": "cyan"}
-
-
-def step_time_panel(payload: Dict[str, Any]) -> Panel:
-    st = payload.get("step_time") or {}
-    window = st.get("window")
-    if window is None:
-        return Panel(
-            Text("waiting for step telemetry…", style="dim"),
-            title="step time",
-        )
-    table = Table(expand=True, box=None, pad_edge=False)
-    table.add_column("phase")
-    table.add_column("median", justify="right")
-    table.add_column("share", justify="right")
-    table.add_column("worst rank", justify="right")
-    table.add_column("skew", justify="right")
-    for key in [STEP_KEY] + window.phases_present + [RESIDUAL_KEY]:
-        m = window.metric(key)
-        if m is None:
-            continue
-        share = window.share_of_step(key) if key != STEP_KEY else None
-        table.add_row(
-            key,
-            fmt_ms(m.median_ms),
-            fmt_pct(share) if share is not None else "—",
-            str(m.worst_rank),
-            fmt_pct(m.skew_pct),
-        )
-    parts = [table]
-    sub = (
-        f"{window.n_steps} steps · {window.clock} clock · "
-        f"ranks {window.ranks[0]}–{window.ranks[-1]}"
-        if window.ranks
-        else ""
-    )
-    return Panel(Group(*parts), title="step time", subtitle=sub)
-
-
-def step_memory_panel(payload: Dict[str, Any]) -> Panel:
-    rows_by_rank = payload.get("step_memory") or {}
-    if not isinstance(rows_by_rank, dict) or not rows_by_rank or "error" in rows_by_rank:
-        return Panel(Text("no memory telemetry", style="dim"), title="device memory")
-    table = Table(expand=True, box=None)
-    table.add_column("rank", justify="right")
-    table.add_column("current", justify="right")
-    table.add_column("step peak", justify="right")
-    table.add_column("limit", justify="right")
-    table.add_column("pressure", justify="right")
-    for rank in sorted(rows_by_rank):
-        rows = rows_by_rank[rank]
-        if not rows:
-            continue
-        last = rows[-1]
-        cur = last.get("current_bytes")
-        peak = last.get("step_peak_bytes")
-        limit = last.get("limit_bytes")
-        pressure = (peak or cur or 0) / limit if limit else None
-        style = ""
-        if pressure is not None and pressure >= 0.92:
-            style = "bold red" if pressure >= 0.97 else "yellow"
-        table.add_row(
-            str(rank),
-            fmt_bytes(cur),
-            fmt_bytes(peak),
-            fmt_bytes(limit),
-            Text(fmt_pct(pressure) if pressure else "—", style=style),
-        )
-    return Panel(table, title="device memory")
-
-
-def system_panel(payload: Dict[str, Any]) -> Panel:
-    sysd = payload.get("system") or {}
-    host = sysd.get("host") or {}
-    if not host:
-        return Panel(Text("no system telemetry", style="dim"), title="system")
-    table = Table(expand=True, box=None)
-    table.add_column("node", justify="right")
-    table.add_column("cpu", justify="right")
-    table.add_column("host mem", justify="right")
-    for node in sorted(host):
-        rows = host[node]
-        if not rows:
-            continue
-        last = rows[-1]
-        used, total = last.get("memory_used_bytes"), last.get("memory_total_bytes")
-        frac = used / total if used and total else None
-        table.add_row(
-            str(node),
-            f"{last.get('cpu_pct', 0):.0f}%",
-            f"{fmt_bytes(used)} / {fmt_bytes(total)}"
-            + (f" ({fmt_pct(frac)})" if frac else ""),
-        )
-    return Panel(table, title="system")
-
-
-def process_panel(payload: Dict[str, Any]) -> Panel:
-    proc = payload.get("process") or {}
-    procs = proc.get("procs") or {}
-    if not procs:
-        return Panel(Text("no process telemetry", style="dim"), title="processes")
-    table = Table(expand=True, box=None)
-    table.add_column("rank", justify="right")
-    table.add_column("pid", justify="right")
-    table.add_column("cpu", justify="right")
-    table.add_column("rss", justify="right")
-    table.add_column("threads", justify="right")
-    for rank in sorted(procs):
-        rows = procs[rank]
-        if not rows:
-            continue
-        last = rows[-1]
-        table.add_row(
-            str(rank),
-            str(last.get("pid", "—")),
-            f"{last.get('cpu_pct') or 0:.0f}%",
-            fmt_bytes(last.get("rss_bytes")),
-            str(last.get("num_threads", "—")),
-        )
-    return Panel(table, title="processes")
-
-
-def diagnostics_panel(payload: Dict[str, Any]) -> Panel:
-    """Composed cross-domain diagnostics card (reference:
-    renderers/model_diagnostics/renderer.py:94) — the single place the
-    live view lists findings from every domain."""
-    from traceml_tpu.diagnostics.model_diagnostics import compose
-
-    results = {
-        "step_time": (payload.get("step_time") or {}).get("diagnosis"),
-        "step_memory": payload.get("step_memory_diagnosis"),
-        "system": payload.get("system_diagnosis"),
-        "process": payload.get("process_diagnosis"),
-    }
-    try:
-        composed = compose(results)
-    except Exception:
-        return Panel(Text("—", style="dim"), title="diagnostics")
-    if not composed.issues:
-        return Panel(
-            Text("no active findings", style="dim green"),
-            title="diagnostics",
-        )
-    text = Text()
-    for issue in composed.issues[:6]:
-        domain = issue.evidence.get("domain", "?")
-        text.append(
-            f"[{issue.severity:>8}] {domain}/{issue.kind}: ",
-            style=_SEV_STYLE.get(issue.severity, "white"),
-        )
-        text.append(issue.summary + "\n")
-    return Panel(text, title="diagnostics")
-
-
-def stdout_panel(payload: Dict[str, Any]) -> Panel:
-    lines = payload.get("stdout") or []
-    if not lines:
-        return Panel(Text("—", style="dim"), title="rank 0 output")
-    text = Text()
-    for stream, line in lines[-10:]:
-        style = "red" if stream == "stderr" else ""
-        text.append(line[:160] + "\n", style=style)
-    return Panel(text, title="rank 0 output")
-
-
-def dashboard(payload: Dict[str, Any], session: str) -> Group:
-    import time as _time
-
-    header = Text(f"TraceML-TPU — live · session {session}", style="bold")
-    # staleness = age of the NEWEST telemetry row, not of the payload
-    # (the payload is recomputed every tick regardless)
-    ts = payload.get("latest_row_ts")
-    if ts:
-        age = _time.time() - ts
-        if age > 5.0:  # staleness badge (reference: display staleness)
-            header.append(f"   ⚠ telemetry {age:.0f}s stale", style="yellow")
-    return Group(
-        header,
-        step_time_panel(payload),
-        diagnostics_panel(payload),
-        step_memory_panel(payload),
-        system_panel(payload),
-        process_panel(payload),
-        stdout_panel(payload),
-    )
+from traceml_tpu.renderers.cli import (  # noqa: F401
+    cluster_panel,
+    dashboard,
+    diagnostics_panel,
+    process_panel,
+    stdout_panel,
+    step_memory_panel,
+    step_time_panel,
+    system_panel,
+)

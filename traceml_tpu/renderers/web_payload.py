@@ -1,4 +1,8 @@
-"""JSON-able live payload for the browser dashboard."""
+"""JSON-able live payload for the browser dashboard
+(reference pattern: renderers/<domain>/dashboard_compute.py — here the
+payload is literally the typed views from renderers/views.py serialized,
+plus the composed diagnosis list; the page renders, it never computes).
+"""
 
 from __future__ import annotations
 
@@ -7,56 +11,76 @@ from pathlib import Path
 from typing import Any, Dict
 
 from traceml_tpu.diagnostics.step_time.api import diagnose_rank_rows
+from traceml_tpu.renderers import views as V
 from traceml_tpu.reporting import loaders
-from traceml_tpu.utils.step_time_window import (
-    RESIDUAL_KEY,
-    STEP_KEY,
-    build_step_time_window,
-)
+from traceml_tpu.utils.step_time_window import build_step_time_window
+
+PAYLOAD_VERSION = 2
+_CACHE_TTL_S = 0.4
+_cache: Dict[tuple, tuple] = {}  # (db_path, session) → (monotonic, payload)
 
 
-def build_web_payload(db_path: Path, session: str, window_steps: int = 150) -> Dict[str, Any]:
+def build_web_payload(
+    db_path: Path, session: str, window_steps: int = 150
+) -> Dict[str, Any]:
+    """TTL-cached: N dashboard tabs polling at 1 Hz cost one pipeline
+    per TTL, not one per request (mirrors LiveComputer's cache)."""
+    key = (str(db_path), session)
+    hit = _cache.get(key)
+    now = time.monotonic()
+    if hit is not None and now - hit[0] < _CACHE_TTL_S:
+        return hit[1]
+    payload = _build_web_payload(db_path, session, window_steps)
+    _cache.clear()  # one session per aggregator; don't grow unbounded
+    _cache[key] = (now, payload)
+    return payload
+
+
+def _build_web_payload(
+    db_path: Path, session: str, window_steps: int = 150
+) -> Dict[str, Any]:
     out: Dict[str, Any] = {
+        "version": PAYLOAD_VERSION,
         "session": session,
         "ts": time.time(),
         "step_time": None,
-        "memory": [],
-        "system": [],
+        "memory": None,
+        "system": None,
+        "process": None,
         "stdout": [],
         "diagnosis": None,
+        "findings": [],
     }
     db_path = Path(db_path)
     if not db_path.exists():
         return out
     try:
-        rank_rows = loaders.load_step_time_rows(db_path, max_steps_per_rank=window_steps)
+        topology = loaders.load_topology(db_path)
+    except Exception:
+        topology = {}
+    world = int(topology.get("world_size") or 0)
+    nodes = int(topology.get("nodes") or 0)
+
+    domain_results: Dict[str, Any] = {}
+    try:
+        rank_rows = loaders.load_step_time_rows(
+            db_path, max_steps_per_rank=window_steps
+        )
         window = build_step_time_window(rank_rows, max_steps=window_steps)
-        if window is not None:
-            phases = {}
-            for key in [STEP_KEY] + window.phases_present + [RESIDUAL_KEY]:
-                m = window.metric(key)
-                if m is None:
-                    continue
-                phases[key] = {
-                    "median_ms": m.median_ms,
-                    "worst_ms": m.worst_ms,
-                    "worst_rank": m.worst_rank,
-                    "skew_pct": m.skew_pct,
-                    "share": window.share_of_step(key),
-                }
-            # per-rank step series for the sparkline
-            series = {
-                str(r): w.series[STEP_KEY][-60:]
-                for r, w in window.rank_windows.items()
-            }
-            out["step_time"] = {
-                "clock": window.clock,
-                "n_steps": window.n_steps,
-                "steps": window.steps[-60:],
-                "phases": phases,
-                "step_series": series,
-            }
+        latest = max(
+            (
+                row.get("timestamp") or 0.0
+                for rows in rank_rows.values()
+                for row in rows[-1:]
+            ),
+            default=None,
+        )
+        view = V.build_step_time_view(window, world_size=world, latest_ts=latest)
+        if view is not None:
+            out["step_time"] = view.as_dict()
+        if rank_rows:
             result = diagnose_rank_rows(rank_rows, mode="live")
+            domain_results["step_time"] = result
             d = result.diagnosis
             out["diagnosis"] = {
                 "kind": d.kind,
@@ -67,38 +91,56 @@ def build_web_payload(db_path: Path, session: str, window_steps: int = 150) -> D
     except Exception as exc:
         out["step_time_error"] = str(exc)
     try:
-        mem = loaders.load_step_memory_rows(db_path, max_rows_per_rank=window_steps)
-        for rank in sorted(mem):
-            rows = mem[rank]
-            if not rows:
-                continue
-            last = rows[-1]
-            out["memory"].append(
-                {
-                    "rank": rank,
-                    "current_bytes": last.get("current_bytes"),
-                    "step_peak_bytes": last.get("step_peak_bytes"),
-                    "limit_bytes": last.get("limit_bytes"),
-                    "series": [r.get("current_bytes") or 0 for r in rows[-60:]],
-                }
+        mem_rows = loaders.load_step_memory_rows(
+            db_path, max_rows_per_rank=window_steps
+        )
+        view = V.build_memory_view(mem_rows)
+        if view is not None:
+            out["memory"] = view.as_dict()
+        if mem_rows:
+            from traceml_tpu.diagnostics.step_memory.api import (
+                diagnose_rank_rows as diagnose_memory,
             )
+
+            domain_results["step_memory"] = diagnose_memory(mem_rows)
     except Exception:
         pass
     try:
-        host, _devices = loaders.load_system_rows(db_path, max_rows=120)
-        for node in sorted(host):
-            rows = host[node]
-            if not rows:
-                continue
-            last = rows[-1]
-            out["system"].append(
-                {
-                    "node": node,
-                    "cpu_pct": last.get("cpu_pct"),
-                    "memory_used_bytes": last.get("memory_used_bytes"),
-                    "memory_total_bytes": last.get("memory_total_bytes"),
-                }
-            )
+        host, devices = loaders.load_system_rows(db_path, max_rows=300)
+        view = V.build_system_view(host, devices, expected_nodes=nodes)
+        if view is not None:
+            out["system"] = view.as_dict()
+        if host or devices:
+            from traceml_tpu.diagnostics.system.api import diagnose as diagnose_system
+
+            domain_results["system"] = diagnose_system(host, devices)
+    except Exception:
+        pass
+    try:
+        procs, pdevs = loaders.load_process_rows(db_path, max_rows=300)
+        view = V.build_process_view(procs)
+        if view is not None:
+            out["process"] = view.as_dict()
+        if procs or pdevs:
+            from traceml_tpu.diagnostics.process.api import diagnose as diagnose_process
+
+            domain_results["process"] = diagnose_process(procs, pdevs)
+    except Exception:
+        pass
+    try:
+        from traceml_tpu.diagnostics.model_diagnostics import compose
+
+        composed = compose(domain_results)
+        out["findings"] = [
+            {
+                "domain": i.evidence.get("domain", "?"),
+                "kind": i.kind,
+                "severity": i.severity,
+                "summary": i.summary,
+                "action": i.action,
+            }
+            for i in composed.issues[:8]
+        ]
     except Exception:
         pass
     try:

@@ -1,41 +1,24 @@
 """``traceml-tpu compare a.json b.json``
 (reference: src/traceml_ai/reporting/compare/ — command.py:19,
-verdict.py:24-38 priority ladder, core.py:71 payload builder).
+verdict.py:24-38 priority ladder, policy.py:55-80 significance tiers).
 
-Compares two final summaries section by section and renders a
-priority-ordered verdict: regressions first (step time ↑, new
-diagnosis, memory ↑), then improvements, then "equivalent".
+Pipeline: per-section comparers (sections.py) → diagnosis transitions →
+priority verdict ladder (verdict.py) → payload + text render.  The
+payload schema is ``traceml-tpu-compare/2``: per-section blocks with
+named metric rows, per-rank deltas, and ranked findings.
 """
 
 from __future__ import annotations
 
 import json
 from pathlib import Path
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, Optional
 
 from traceml_tpu.reporting.compare.policy import DEFAULT_POLICY, ComparePolicy
+from traceml_tpu.reporting.compare.sections import ALL_COMPARERS, compare_diagnoses
+from traceml_tpu.reporting.compare.verdict import decide_verdict
 from traceml_tpu.utils.atomic_io import atomic_write_json, atomic_write_text, read_json
-from traceml_tpu.utils.formatting import fmt_bytes, fmt_ms
-
-
-def _step_phase_stats(summary: Dict[str, Any]) -> Tuple[Optional[float], Dict[str, float]]:
-    st = (summary.get("sections") or {}).get("step_time") or {}
-    phases = (st.get("global") or {}).get("phases") or {}
-    step = phases.get("step_time") or {}
-    step_ms = step.get("median_ms")
-    shares = {
-        k: (v.get("share_of_step") or 0.0)
-        for k, v in phases.items()
-        if k != "step_time" and v.get("share_of_step") is not None
-    }
-    return step_ms, shares
-
-
-def _peak_memory(summary: Dict[str, Any]) -> Optional[int]:
-    sm = (summary.get("sections") or {}).get("step_memory") or {}
-    per_rank = (sm.get("global") or {}).get("per_rank") or {}
-    peaks = [v.get("step_peak_bytes") or 0 for v in per_rank.values()]
-    return max(peaks) if peaks else None
+from traceml_tpu.utils.formatting import fmt_ms
 
 
 def build_compare_payload(
@@ -43,128 +26,29 @@ def build_compare_payload(
     candidate: Dict[str, Any],
     policy: ComparePolicy = DEFAULT_POLICY,
 ) -> Dict[str, Any]:
-    findings: List[Dict[str, Any]] = []
+    sections = {
+        name: comparer(baseline, candidate, policy)
+        for name, comparer in ALL_COMPARERS.items()
+    }
+    diag_findings = compare_diagnoses(baseline, candidate)
+    verdict, findings = decide_verdict(sections, diag_findings)
 
-    # 1. step time delta
-    b_step, b_shares = _step_phase_stats(baseline)
-    c_step, c_shares = _step_phase_stats(candidate)
-    step_delta_rel = None
-    if b_step and c_step and b_step > 0:
-        step_delta_rel = (c_step - b_step) / b_step
-        if abs(step_delta_rel) >= policy.step_avg_minor:
-            sev = "major" if abs(step_delta_rel) >= policy.step_avg_major else "minor"
-            direction = "slower" if step_delta_rel > 0 else "faster"
-            findings.append(
-                {
-                    "kind": "STEP_TIME_" + ("REGRESSION" if step_delta_rel > 0 else "IMPROVEMENT"),
-                    "significance": sev,
-                    "priority": 0 if step_delta_rel > 0 else 2,
-                    "summary": (
-                        f"Median step is {abs(step_delta_rel) * 100:.1f}% {direction} "
-                        f"({fmt_ms(b_step)} → {fmt_ms(c_step)})."
-                    ),
-                    "metric": "step_median_ms",
-                    "baseline": b_step,
-                    "candidate": c_step,
-                }
-            )
-
-    # 2. phase share shifts
-    for key in sorted(set(b_shares) | set(c_shares)):
-        b_v, c_v = b_shares.get(key, 0.0), c_shares.get(key, 0.0)
-        shift_pp = (c_v - b_v) * 100.0
-        if abs(shift_pp) < policy.phase_shift_minor_pp:
-            continue
-        sev = "major" if abs(shift_pp) >= policy.phase_shift_major_pp else "minor"
-        findings.append(
-            {
-                "kind": "PHASE_SHIFT",
-                "significance": sev,
-                "priority": 1,
-                "summary": (
-                    f"Phase '{key}' share moved {shift_pp:+.1f} pp "
-                    f"({b_v * 100:.1f}% → {c_v * 100:.1f}%)."
-                ),
-                "metric": f"share.{key}",
-                "baseline": b_v,
-                "candidate": c_v,
-            }
-        )
-
-    # 3. memory delta
-    b_mem, c_mem = _peak_memory(baseline), _peak_memory(candidate)
-    if b_mem is not None and c_mem is not None:
-        delta = c_mem - b_mem
-        if abs(delta) >= policy.memory_minor_bytes:
-            sev = "major" if abs(delta) >= policy.memory_major_bytes else "minor"
-            findings.append(
-                {
-                    "kind": "MEMORY_" + ("REGRESSION" if delta > 0 else "IMPROVEMENT"),
-                    "significance": sev,
-                    "priority": 1 if delta > 0 else 2,
-                    "summary": (
-                        f"Peak device memory {'grew' if delta > 0 else 'shrank'} "
-                        f"{fmt_bytes(abs(delta))} ({fmt_bytes(b_mem)} → {fmt_bytes(c_mem)})."
-                    ),
-                    "metric": "peak_memory_bytes",
-                    "baseline": b_mem,
-                    "candidate": c_mem,
-                }
-            )
-
-    # 4. diagnosis change — a regression signal only when the CANDIDATE
-    # lands on a pathological diagnosis; moving to a healthy state is
-    # informational (it supports, not overrides, the step/memory deltas).
-    b_diag = (baseline.get("primary_diagnosis") or {}).get("kind")
-    c_primary = candidate.get("primary_diagnosis") or {}
-    c_diag = c_primary.get("kind")
-    if b_diag != c_diag:
-        candidate_pathological = c_primary.get("severity") in (
-            "warning",
-            "critical",
-        )
-        findings.append(
-            {
-                "kind": "DIAGNOSIS_CHANGED",
-                "significance": "major" if candidate_pathological else "minor",
-                "priority": 0 if candidate_pathological else 2,
-                "summary": f"Primary diagnosis changed: {b_diag} → {c_diag}.",
-                "metric": "primary_diagnosis",
-                "baseline": b_diag,
-                "candidate": c_diag,
-            }
-        )
-
-    findings.sort(key=lambda f: (f["priority"], f["significance"] != "major"))
-
-    # verdict ladder (reference: verdict.py:24-38)
-    if any(f["priority"] == 0 and f["significance"] == "major" for f in findings):
-        verdict = "REGRESSION"
-    elif any(f["priority"] == 0 for f in findings):
-        verdict = "LIKELY_REGRESSION"
-    elif any(
-        f["kind"].endswith("IMPROVEMENT") and f["significance"] == "major"
-        for f in findings
-    ):
-        verdict = "IMPROVEMENT"
-    elif findings:
-        verdict = "MIXED"
-    else:
-        verdict = "EQUIVALENT"
-
+    step = sections.get("step_time")
+    step_metric = (step.metrics.get("step_median_ms") or {}) if step else {}
     return {
-        "schema": "traceml-tpu-compare/1",
+        "schema": "traceml-tpu-compare/2",
         "verdict": verdict,
         "baseline": {
             "session_id": (baseline.get("meta") or {}).get("session_id"),
-            "step_median_ms": b_step,
+            "step_median_ms": step_metric.get("baseline"),
         },
         "candidate": {
             "session_id": (candidate.get("meta") or {}).get("session_id"),
-            "step_median_ms": c_step,
+            "step_median_ms": step_metric.get("candidate"),
         },
-        "step_delta_rel": step_delta_rel,
+        "step_delta_rel": step_metric.get("delta_rel"),
         "findings": findings,
+        "sections": {name: comp.as_dict() for name, comp in sections.items()},
     }
 
 
@@ -178,9 +62,15 @@ def render_compare_text(payload: Dict[str, Any]) -> str:
         "",
     ]
     for f in payload["findings"]:
-        lines.append(f"[{f['significance']}] {f['summary']}")
+        lines.append(f"[{f['significance']}] {f['section']}: {f['summary']}")
     if not payload["findings"]:
         lines.append("No significant differences.")
+    # section status footer — says which domains actually compared
+    lines.append("")
+    for name, sec in (payload.get("sections") or {}).items():
+        status = sec.get("status")
+        note = f" — {sec['note']}" if sec.get("note") else ""
+        lines.append(f"  {name}: {status}{note}")
     return "\n".join(lines) + "\n"
 
 

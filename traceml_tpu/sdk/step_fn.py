@@ -25,7 +25,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 from traceml_tpu.sdk.state import TraceState, get_state
 from traceml_tpu.utils.marker_resolver import get_marker_resolver
-from traceml_tpu.utils.timing import COMPUTE_TIME, timed_region
+from traceml_tpu.utils.timing import COMPUTE_TIME, DeviceMarker, timed_region
 
 
 class WrappedStepFn:
@@ -59,6 +59,10 @@ class WrappedStepFn:
         # the listener always bumps the CURRENT global state, so the
         # snapshot and the later read must both come from get_state()
         self._compiles_at_start = get_state().compile_events_seen
+        # smallest-leaf index per output treedef: the structure of a
+        # jitted fn's output is stable, so the min-size scan runs once
+        # and later dispatches index straight into the flat leaves
+        self._leaf_idx: Dict[Any, int] = {}
 
     @property
     def compile_count(self) -> int:
@@ -66,13 +70,47 @@ class WrappedStepFn:
         created (a superset of this function's own compiles)."""
         return get_state().compile_events_seen - self._compiles_at_start
 
+    def _pick_handles(self, out):
+        """Smallest ready-able output leaf, with the selection cached per
+        treedef (one tree_flatten per dispatch, no min-scan rescan); the
+        selection policy itself lives in timing.smallest_ready_index."""
+        try:
+            import jax
+
+            from traceml_tpu.utils.timing import smallest_ready_index
+
+            leaves, treedef = jax.tree_util.tree_flatten(out)
+            idx = self._leaf_idx.get(treedef)
+            if (
+                idx is None
+                or idx >= len(leaves)
+                or not hasattr(leaves[idx], "is_ready")
+            ):
+                idx = smallest_ready_index(leaves)
+                if idx is None:
+                    return []
+                if len(self._leaf_idx) > 64:
+                    self._leaf_idx.clear()
+                self._leaf_idx[treedef] = idx
+            return [leaves[idx]]
+        except Exception:
+            return []
+
     def __call__(self, *args, **kwargs):
         st = self._state
         region = timed_region(self._phase, st.current_step, sink=st.buffer.add)
         with region as tr:
             out = self._jfn(*args, **kwargs)
-            tr.mark(out)
-            st.mark_step_outputs(out)
+            # ONE marker shared by the compute event and the open step
+            # envelope (same handles, same dispatch instant) — a single
+            # pytree flatten and a single resolver poll per step.
+            handles = self._pick_handles(out)
+            if handles:
+                marker = DeviceMarker(handles)
+                tr.event.marker = marker
+                env = st.active_step_event
+                if env is not None:
+                    env.marker = marker
         ev = region.event
         if ev.marker is not None and not ev.marker.resolved:
             get_marker_resolver().submit(ev.marker)

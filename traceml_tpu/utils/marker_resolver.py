@@ -21,8 +21,10 @@ from typing import List, Optional
 from traceml_tpu.utils.error_log import get_error_log
 from traceml_tpu.utils.timing import DeviceMarker
 
-_DEFAULT_INTERVAL = 0.002  # 2 ms poll while markers are pending
+_DEFAULT_INTERVAL = 0.002  # 2 ms poll while young markers are pending
 _IDLE_TIMEOUT = 0.25  # park after this long with nothing pending
+_FINE_WINDOW_S = 0.020  # markers younger than this get the fine cadence
+_MAX_BACKOFF_S = 0.025  # cadence ceiling for long-running markers
 
 
 class MarkerResolver:
@@ -92,7 +94,25 @@ class MarkerResolver:
                 self._pending = [m for m in self._pending if not m.resolved]
         return resolved
 
+    def _delay_for(self, age_s: float) -> float:
+        """Age-proportional poll backoff.
+
+        Young markers (short phases) are polled at the fine cadence so
+        their stamps stay ~2 ms accurate.  A marker that has been in
+        flight for a while is a long device phase; polling it every 2 ms
+        buys nothing but wakeups — on a 1-core host those wakeups alone
+        cost ~2% of a 150 ms step.  Back off to 10% of the marker's age,
+        capped: relative stamp error stays ≤10% (absolute ≤25 ms), and in
+        bracketed loops sweep_inline() at the next step boundary usually
+        stamps first anyway, at inter-step precision.
+        """
+        if age_s < _FINE_WINDOW_S:
+            return self._interval
+        return min(_MAX_BACKOFF_S, max(self._interval, 0.1 * age_s))
+
     def _run(self) -> None:
+        import time as _time
+
         try:
             while not self._stop.is_set():
                 with self._lock:
@@ -107,12 +127,24 @@ class MarkerResolver:
                         m.poll()
                     except Exception:
                         pass  # poll() itself fails open, but belt+braces
+                now = _time.perf_counter()
                 with self._lock:
                     # Identity-based prune: concurrent submits and
                     # sweep_inline() prunes both mutate _pending, so a
                     # slice-by-stale-length merge would drop markers.
                     self._pending = [m for m in self._pending if not m.resolved]
-                self._stop.wait(self._interval)
+                    unresolved = list(self._pending)
+                if unresolved:
+                    delay = min(
+                        self._delay_for(now - m.dispatched_at) for m in unresolved
+                    )
+                else:
+                    delay = self._interval
+                # waiting on _wake (not _stop) lets a fresh submit
+                # re-tighten the cadence mid-backoff
+                fired = self._wake.wait(timeout=delay)
+                if fired:
+                    self._wake.clear()
         except Exception as exc:  # pragma: no cover
             get_error_log().error("marker resolver crashed", exc)
 

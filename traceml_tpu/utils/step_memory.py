@@ -91,27 +91,43 @@ class LiveArraysBackend:
 
         self._jax = jax
         self._kinds = {d.id: str(d.device_kind) for d in jax.local_devices()}
+        self._pid = jax.process_index()
+        # (sharding, shape, itemsize) → (local device ids, bytes/shard).
+        # Training loops re-create arrays with identical layout every
+        # step; memoizing turns the per-array device_set/shard_shape work
+        # into one dict hit (holding the sharding key keeps it alive, so
+        # ids can't be recycled under us).
+        self._layout_cache: Dict[Any, Any] = {}
 
     def sample(self) -> List[Dict[str, Any]]:
         import math
 
         per_dev: Dict[int, int] = {}
+        cache = self._layout_cache
         for arr in self._jax.live_arrays():
             try:
-                sharding = arr.sharding
-                devices = list(sharding.device_set)
-                if not devices:
-                    continue
-                # true per-device shard size from METADATA: replicated
-                # arrays cost full nbytes on every device, sharded ones
-                # cost their shard — shard_shape computes both correctly
-                shard_shape = sharding.shard_shape(arr.shape)
-                per_shard = int(
-                    math.prod(shard_shape) * arr.dtype.itemsize
-                )
-                for d in devices:
-                    if d.process_index == self._jax.process_index():
-                        per_dev[d.id] = per_dev.get(d.id, 0) + per_shard
+                key = (arr.sharding, arr.shape, arr.dtype.itemsize)
+                hit = cache.get(key)
+                if hit is None:
+                    sharding = arr.sharding
+                    # true per-device shard size from METADATA: replicated
+                    # arrays cost full nbytes on every device, sharded
+                    # ones cost their shard — shard_shape computes both
+                    dev_ids = [
+                        d.id
+                        for d in sharding.device_set
+                        if d.process_index == self._pid
+                    ]
+                    per_shard = int(
+                        math.prod(sharding.shard_shape(arr.shape))
+                        * arr.dtype.itemsize
+                    )
+                    if len(cache) > 4096:
+                        cache.clear()
+                    cache[key] = hit = (dev_ids, per_shard)
+                dev_ids, per_shard = hit
+                for did in dev_ids:
+                    per_dev[did] = per_dev.get(did, 0) + per_shard
             except Exception:
                 continue
         return [

@@ -57,6 +57,10 @@ class RankWindow:
     # per phase key → window average ms
     averages: Dict[str, float]
     clock: str
+    # device-busy share of the wall clock: Σ device(step) / Σ host(step)
+    # over the window — the TPU stand-in for a chip-utilization counter
+    # (device envelopes tile chip occupancy; host envelopes tile wall).
+    occupancy: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -89,6 +93,19 @@ class StepTimeWindow:
 
     def metric(self, key: str) -> Optional[StepCombinedTimeMetric]:
         return self.metrics.get(key)
+
+    @property
+    def occupancy_by_rank(self) -> Dict[int, float]:
+        return {
+            r: w.occupancy
+            for r, w in self.rank_windows.items()
+            if w.occupancy is not None
+        }
+
+    @property
+    def median_occupancy(self) -> Optional[float]:
+        vals = list(self.occupancy_by_rank.values())
+        return statistics.median(vals) if vals else None
 
     def share_of_step(self, key: str) -> Optional[float]:
         """median(phase) / median(step) — the phase-share statistic."""
@@ -150,12 +167,17 @@ def build_rank_window(
     """Phase extraction + residual clamp (reference: _build_rank_timing)."""
     by_step = {int(r["step"]): r for r in rows if r.get("step") is not None}
     series: Dict[str, List[float]] = {k: [] for k in ALL_KEYS}
+    dev_sum = host_sum = 0.0
     for step in steps:
         row = by_step.get(step)
         if row is None:
             for k in ALL_KEYS:
                 series[k].append(0.0)
             continue
+        env = (row.get("events") or {}).get(T.STEP_TIME) or {}
+        if env.get("device_ms") and env.get("cpu_ms"):
+            dev_sum += float(env["device_ms"])
+            host_sum += float(env["cpu_ms"])
         step_ms = _row_value(row, T.STEP_TIME, clock) or 0.0
         accounted = 0.0
         for key, event_name in PHASES.items():
@@ -171,7 +193,15 @@ def build_rank_window(
     averages = {
         k: (sum(vs) / len(vs) if vs else 0.0) for k, vs in series.items()
     }
-    return RankWindow(rank=rank, steps=list(steps), series=series, averages=averages, clock=clock)
+    return RankWindow(
+        rank=rank,
+        steps=list(steps),
+        series=series,
+        averages=averages,
+        clock=clock,
+        # cap: device readiness quantization can nominally exceed wall
+        occupancy=min(dev_sum / host_sum, 1.0) if host_sum > 0 and dev_sum > 0 else None,
+    )
 
 
 def build_step_time_metrics(rank_windows: Mapping[int, RankWindow]) -> Dict[str, StepCombinedTimeMetric]:

@@ -127,6 +127,27 @@ class DeviceMarker:
         return True
 
 
+def smallest_ready_index(leaves: Sequence[Any]) -> Optional[int]:
+    """Index of the smallest ``is_ready``-capable leaf, or None.
+
+    THE leaf-selection policy — every caller (pytree path below, the
+    treedef-cached hot path in sdk/step_fn.py) routes through this so
+    the policy can't silently fork.
+    """
+    best_i: Optional[int] = None
+    best_size = 1 << 62
+    for i, x in enumerate(leaves):
+        if not hasattr(x, "is_ready"):
+            continue
+        try:
+            size = int(x.size)
+        except Exception:
+            size = 1 << 60
+        if best_i is None or size < best_size:
+            best_i, best_size = i, size
+    return best_i
+
+
 def smallest_leaf(tree: Any) -> List[Any]:
     """Pick the smallest array leaf of a pytree as the readiness handle.
 
@@ -137,19 +158,11 @@ def smallest_leaf(tree: Any) -> List[Any]:
     try:
         import jax
 
-        leaves = [x for x in jax.tree_util.tree_leaves(tree) if hasattr(x, "is_ready")]
+        leaves = jax.tree_util.tree_leaves(tree)
     except Exception:
-        leaves = [tree] if hasattr(tree, "is_ready") else []
-    if not leaves:
-        return []
-
-    def _size(x: Any) -> int:
-        try:
-            return int(x.size)
-        except Exception:
-            return 1 << 60
-
-    return [min(leaves, key=_size)]
+        leaves = [tree]
+    idx = smallest_ready_index(leaves)
+    return [leaves[idx]] if idx is not None else []
 
 
 class TimeEvent:
