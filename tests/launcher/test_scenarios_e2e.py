@@ -62,6 +62,18 @@ def test_recompile_storm_detected(tmp_path):
     assert "COMPILE_BOUND" in kinds, kinds
 
 
+def test_collective_straggler_four_ranks(tmp_path):
+    payload = _run(tmp_path, "collective_straggler", steps=60, nprocs=4)
+    st = payload["sections"]["step_time"]
+    # the collective phase is measured for real (nonzero in the window)
+    coll = (st["global"]["phases"] or {}).get("collective")
+    assert coll and coll["median_ms"] > 5.0, st["global"]["phases"].keys()
+    kinds = {i["kind"] for i in st["issues"]}
+    assert "COLLECTIVE_STRAGGLER" in kinds, (st["diagnosis"], kinds)
+    issue = next(i for i in st["issues"] if i["kind"] == "COLLECTIVE_STRAGGLER")
+    assert issue["ranks"] == [3]
+
+
 def test_healthy_not_misdiagnosed(tmp_path):
     payload = _run(tmp_path, "healthy", steps=60)
     primary = payload["primary_diagnosis"]

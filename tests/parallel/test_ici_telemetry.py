@@ -1,0 +1,144 @@
+"""ICI telemetry hook: straggler attribution from the ICI-gathered
+matrix ALONE (no TCP anywhere in the path) — the SURVEY §2.5 wiring
+VERDICT r1 flagged as missing."""
+
+import jax
+import numpy as np
+import pytest
+
+from traceml_tpu.parallel.ici_stats import IciStatAggregator, StatVector
+from traceml_tpu.parallel.ici_telemetry import (
+    IciTelemetryHook,
+    batch_to_stat_vector,
+    matrix_to_rank_rows,
+)
+from traceml_tpu.parallel.mesh import make_mesh
+from traceml_tpu.utils import timing as T
+
+
+def _mesh8():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return make_mesh({"data": 8})
+
+
+def _vec(step, step_ms=100.0, input_ms=5.0, compute_ms=80.0):
+    return StatVector(
+        {
+            "step": step,
+            "step_ms": step_ms,
+            "input_ms": input_ms,
+            "compute_ms": compute_ms,
+            "residual_ms": max(0.0, step_ms - input_ms - compute_ms),
+        }
+    )
+
+
+def test_aggregate_many_distinct_vectors():
+    mesh = _mesh8()
+    agg = IciStatAggregator(mesh)
+    vectors = [_vec(1, input_ms=float(r)) for r in range(8)]
+    matrix = agg.aggregate_many(vectors)
+    assert matrix.shape == (8, len(matrix[0]))
+    # gathered order preserves participant order
+    input_col = [StatVector.from_array(row).values["input_ms"] for row in matrix]
+    assert input_col == [float(r) for r in range(8)]
+    with pytest.raises(ValueError):
+        agg.aggregate_many(vectors[:3])
+
+
+def test_input_straggler_from_ici_matrix_alone():
+    mesh = _mesh8()
+    agg = IciStatAggregator(mesh)
+    hook = IciTelemetryHook(aggregator=agg, every_n_steps=1)
+    # physically consistent synchronous-training shape: every rank's step
+    # envelope is gated by the slowest rank; fast ranks spend the
+    # difference WAITING inside the sync (compute) phase, the straggler
+    # spends it in input — exactly what the clean-straggler math untangles
+    for step in range(1, 31):
+        vectors = [
+            _vec(
+                step,
+                step_ms=160.0,
+                input_ms=60.0 if r == 3 else 5.0,
+                compute_ms=95.0 if r == 3 else 150.0,
+            )
+            for r in range(8)
+        ]
+        hook.ingest_matrix(agg.aggregate_many(vectors))
+    assert hook.gather_count == 30
+    rows = hook.rank_rows()
+    assert sorted(rows) == list(range(8))
+    assert len(rows[0]) == 30
+    result = hook.diagnose(mode="live")
+    assert result.diagnosis.kind == "INPUT_STRAGGLER", result.diagnosis
+    assert result.diagnosis.ranks == [3]
+
+
+def test_aggregate_many_order_on_multi_axis_mesh():
+    # chained all_gathers must preserve mesh-linear participant order —
+    # a 2×2×2 mesh regressed this (rows came back axis-reversed)
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    mesh = make_mesh({"data": 2, "fsdp": 2, "tensor": 2})
+    agg = IciStatAggregator(mesh)
+    vectors = [_vec(1, input_ms=float(r)) for r in range(8)]
+    matrix = agg.aggregate_many(vectors)
+    input_col = [StatVector.from_array(row).values["input_ms"] for row in matrix]
+    assert input_col == [float(r) for r in range(8)]
+
+
+def test_matrix_to_rank_rows_shape():
+    matrix = np.stack([_vec(7, input_ms=float(r + 1)).to_array() for r in range(4)])
+    rows = matrix_to_rank_rows(matrix, timestamp=123.0)
+    assert sorted(rows) == [0, 1, 2, 3]
+    row = rows[2]
+    assert row["step"] == 7
+    assert row["clock"] == "device"
+    assert row["events"][T.DATALOADER_NEXT]["cpu_ms"] == 3.0
+    assert row["events"][T.STEP_TIME]["device_ms"] == 100.0
+
+
+def test_batch_to_stat_vector_folds_forward_backward():
+    events = []
+    for name, cpu_ms in (
+        (T.STEP_TIME, 100.0),
+        (T.DATALOADER_NEXT, 20.0),
+        (T.FORWARD_TIME, 30.0),
+        (T.BACKWARD_TIME, 25.0),
+        (T.OPTIMIZER_STEP, 5.0),
+    ):
+        ev = T.TimeEvent(name, step=4)
+        ev.cpu_start = 0.0
+        ev.cpu_end = cpu_ms / 1000.0
+        events.append(ev)
+    vec = batch_to_stat_vector(T.StepTimeBatch(4, events)).values
+    assert vec["step"] == 4.0
+    assert vec["step_ms"] == pytest.approx(100.0)
+    assert vec["input_ms"] == pytest.approx(20.0)
+    assert vec["compute_ms"] == pytest.approx(55.0)  # fwd+bwd folded
+    assert vec["optimizer_ms"] == pytest.approx(5.0)
+    assert vec["residual_ms"] == pytest.approx(20.0)
+
+
+def test_hook_installs_on_batch_flush():
+    mesh = _mesh8()
+    from traceml_tpu.sdk.state import TraceState
+
+    st = TraceState()
+    hook = IciTelemetryHook(
+        aggregator=IciStatAggregator(mesh), every_n_steps=2
+    ).install(st)
+    try:
+        for step in (1, 2, 3, 4):
+            ev = T.TimeEvent(T.STEP_TIME, step=step)
+            ev.cpu_start, ev.cpu_end = 0.0, 0.1
+            st.buffer.add(ev)
+            st.flush_step(step)
+        # every_n=2 → steps 2 and 4 gathered
+        assert hook.gather_count == 2
+        # single-controller broadcast: all 8 participants report
+        assert sorted(hook.rank_rows()) == list(range(8))
+    finally:
+        hook.uninstall()
+    st.flush_step(5)  # no crash after uninstall

@@ -1,0 +1,161 @@
+"""The ``collective`` phase, measured for real
+(VERDICT r1 item 6: the phase existed only as vocabulary — no code path
+emitted it on JAX and the torch-xla emitter was never exercised).
+
+Covers: wrap_collective emission → window → COLLECTIVE_STRAGGLER rule,
+and the torch-xla mark_step emitter + memory backend via a stub module.
+"""
+
+import sys
+import time
+import types
+
+import pytest
+
+from traceml_tpu.diagnostics.step_time.api import diagnose_rank_rows
+from traceml_tpu.utils import timing as T
+from traceml_tpu.utils.step_time_window import build_step_time_window
+
+
+def test_wrap_collective_emits_phase():
+    import traceml_tpu
+    from traceml_tpu.sdk.state import get_state
+
+    st = get_state()
+    captured = []
+    st.on_batch_flushed.append(captured.append)
+    try:
+        sync = traceml_tpu.wrap_collective(lambda v: v * 2)
+        with traceml_tpu.trace_step():
+            out = sync(21)
+        assert out == 42
+    finally:
+        st.on_batch_flushed.remove(captured.append)
+    names = [e.name for e in captured[-1].events]
+    assert T.COLLECTIVE_TIME in names
+
+
+def test_wrap_collective_duplicate_guard():
+    import traceml_tpu
+    from traceml_tpu.sdk.state import get_state
+
+    st = get_state()
+    captured = []
+    st.on_batch_flushed.append(captured.append)
+    try:
+        inner = traceml_tpu.wrap_collective(lambda v: v + 1)
+        outer = traceml_tpu.wrap_collective(lambda v: inner(v))
+        with traceml_tpu.trace_step():
+            assert outer(1) == 2
+    finally:
+        st.on_batch_flushed.remove(captured.append)
+    collectives = [
+        e for e in captured[-1].events if e.name == T.COLLECTIVE_TIME
+    ]
+    assert len(collectives) == 1  # nested wrapper timed exactly once
+
+
+def _rows_with_collective(collective_ms, n=30, step_ms=100.0):
+    return [
+        {
+            "step": s,
+            "timestamp": float(s),
+            "clock": "device",
+            "events": {
+                T.STEP_TIME: {"cpu_ms": step_ms, "device_ms": step_ms, "count": 1},
+                T.COMPUTE_TIME: {"cpu_ms": 1.0, "device_ms": 60.0, "count": 1},
+                T.COLLECTIVE_TIME: {
+                    "cpu_ms": collective_ms,
+                    "device_ms": collective_ms,
+                    "count": 1,
+                },
+            },
+        }
+        for s in range(1, n + 1)
+    ]
+
+
+def test_window_carries_collective_phase():
+    window = build_step_time_window({0: _rows_with_collective(20.0)})
+    assert "collective" in window.phases_present
+    m = window.metric("collective")
+    assert m.median_ms == pytest.approx(20.0)
+    assert window.share_of_step("collective") == pytest.approx(0.2)
+
+
+def test_collective_straggler_rule_fires():
+    # subgroup collectives (pipeline stages / sharded groups, NOT one
+    # globally-gating allreduce): rank 3's group hop is genuinely slow,
+    # so its step stretches while other ranks run free — the clean-sync
+    # discount finds no cross-rank wait to subtract and the collective
+    # delta dominates
+    slow = _rows_with_collective(55.0, step_ms=120.0)      # 60+55+5
+    normal = _rows_with_collective(15.0, step_ms=80.0)     # 60+15+5
+    rank_rows = {0: normal, 1: normal, 2: normal, 3: slow}
+    result = diagnose_rank_rows(rank_rows, mode="live")
+    kinds = {i.kind for i in result.issues}
+    assert "COLLECTIVE_STRAGGLER" in kinds or result.diagnosis.kind == "COLLECTIVE_STRAGGLER", (
+        result.diagnosis,
+        kinds,
+    )
+
+
+# --- torch-xla emitter via stub --------------------------------------------
+
+@pytest.fixture()
+def stub_torch_xla(monkeypatch):
+    torch_xla = types.ModuleType("torch_xla")
+    core = types.ModuleType("torch_xla.core")
+    xm = types.ModuleType("torch_xla.core.xla_model")
+
+    def mark_step(*a, **k):
+        time.sleep(0.003)  # the lazy-execution barrier "runs the graph"
+
+    xm.mark_step = mark_step
+    xm.get_xla_supported_devices = lambda: ["xla:0", "xla:1"]
+    xm.get_memory_info = lambda dev: {"kb_total": 16 << 20, "kb_free": 12 << 20}
+    torch_xla.core = core
+    core.xla_model = xm
+    monkeypatch.setitem(sys.modules, "torch_xla", torch_xla)
+    monkeypatch.setitem(sys.modules, "torch_xla.core", core)
+    monkeypatch.setitem(sys.modules, "torch_xla.core.xla_model", xm)
+    yield xm
+
+
+def test_torch_xla_mark_step_emits_collective(stub_torch_xla):
+    import traceml_tpu
+    from traceml_tpu.instrumentation.torch_xla_support import (
+        patch_mark_step,
+        torch_xla_available,
+        unpatch_mark_step,
+    )
+    from traceml_tpu.sdk.state import get_state
+
+    assert torch_xla_available()
+    assert patch_mark_step() is True
+    st = get_state()
+    captured = []
+    st.on_batch_flushed.append(captured.append)
+    try:
+        with traceml_tpu.trace_step():
+            stub_torch_xla.mark_step()
+        names = [e.name for e in captured[-1].events]
+        assert T.COLLECTIVE_TIME in names
+        ev = next(e for e in captured[-1].events if e.name == T.COLLECTIVE_TIME)
+        assert ev.cpu_ms >= 2.0  # the barrier's wall time was captured
+        # outside a step: passthrough, no event
+        before = len(captured)
+        stub_torch_xla.mark_step()
+        assert len(captured) == before
+    finally:
+        st.on_batch_flushed.remove(captured.append)
+        unpatch_mark_step()
+
+
+def test_torch_xla_memory_backend(stub_torch_xla):
+    from traceml_tpu.instrumentation.torch_xla_support import XlaMemoryBackend
+
+    rows = XlaMemoryBackend().sample()
+    assert len(rows) == 2
+    assert rows[0]["limit_bytes"] == (16 << 20) * 1024
+    assert rows[0]["current_bytes"] == (4 << 20) * 1024

@@ -29,7 +29,9 @@ _API_SYMBOLS = (
     "wrap_forward",
     "wrap_backward",
     "wrap_optimizer",
+    "wrap_collective",
     "current_step",
+    "enable_ici_stats",
 )
 
 __all__ = list(_API_SYMBOLS) + ["__version__"]

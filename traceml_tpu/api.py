@@ -11,6 +11,7 @@ from traceml_tpu.sdk.instrumentation import trace_step, trace_time  # noqa: F401
 from traceml_tpu.sdk.step_fn import wrap_step_fn  # noqa: F401
 from traceml_tpu.sdk.wrappers import (  # noqa: F401
     wrap_backward,
+    wrap_collective,
     wrap_forward,
     wrap_h2d,
     wrap_optimizer,
@@ -24,3 +25,19 @@ def current_step() -> int:
     from traceml_tpu.sdk.state import get_state
 
     return get_state().current_step
+
+
+def enable_ici_stats(mesh=None, *, every_n_steps: int = 10, window_steps: int = 120):
+    """Opt-in: all-gather per-chip stat vectors over the mesh every N
+    steps and keep a local cross-rank window for diagnosis — the
+    ICI-path rank source that bypasses TCP (SURVEY §2.5).
+
+    Returns the installed :class:`~traceml_tpu.parallel.ici_telemetry.
+    IciTelemetryHook`; call ``hook.diagnose()`` for a straggler verdict
+    from the gathered matrices, ``hook.uninstall()`` to detach.
+    """
+    from traceml_tpu.parallel.ici_telemetry import IciTelemetryHook
+
+    return IciTelemetryHook(
+        mesh, every_n_steps=every_n_steps, window_steps=window_steps
+    ).install()

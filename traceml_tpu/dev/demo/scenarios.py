@@ -10,6 +10,10 @@ Scenarios and their expected verdicts:
   needs multi-rank, e.g. ``traceml-tpu run --nprocs 4``; the injected
   rank is RANK env–gated, reference: mlp_ddp_input_straggler.py:34-38)
 * ``compute_straggler`` → COMPUTE_STRAGGLER (extra matmuls on one rank)
+* ``collective_straggler`` → COLLECTIVE_STRAGGLER (one rank's explicit
+  gradient-sync collective is slow — degraded ICI link analogue; uses
+  ``wrap_collective`` so the time lands in the first-class ``collective``
+  phase)
 * ``memory_creep``      → MEMORY_CREEP_* (a list leaks one array/step)
 * ``recompile``         → COMPILE_BOUND (shape churn every few steps)
 """
@@ -105,6 +109,27 @@ def run_scenario(name: str, steps: int = 80) -> None:
                 if _rank() == slow_rank:
                     for _ in range(6):
                         jax.block_until_ready(extra(pad))
+
+    elif name == "collective_straggler":
+        # each rank dispatches an explicit "gradient sync" outside the
+        # fused step; the last rank's link is slow (ICI degradation
+        # analogue).  trace via wrap_collective → collective phase.
+        world = int(os.environ.get("WORLD_SIZE", 1))
+        slow_rank = world - 1
+
+        sync_op = jax.jit(lambda t: t * (1.0 / max(1, world)))
+
+        def gradient_sync(tree):
+            time.sleep(0.12 if _rank() == slow_rank else 0.02)
+            return jax.tree_util.tree_map(sync_op, tree)
+
+        timed_sync = traceml_tpu.wrap_collective(gradient_sync)
+        loader = _batches(steps)
+        for x, y in traceml_tpu.wrap_dataloader(loader):
+            with traceml_tpu.trace_step():
+                x, y = jax.device_put(x), jax.device_put(y)
+                params, opt_state, loss = step(params, opt_state, x, y)
+                params = timed_sync(params)
 
     elif name == "memory_creep":
         leak = []  # grows forever — the classic retained-arrays leak

@@ -118,6 +118,11 @@ class InputBoundRule:
 
 class CleanStragglerRule:
     def _sync_phase(self, ctx: _Ctx) -> Optional[str]:
+        # a first-class collective phase IS where sync waits concentrate
+        # (explicit wrap_collective / torch-xla mark_step); otherwise
+        # backward (torch DDP overlap) else the fused compute (JAX pjit)
+        if "collective" in ctx.window.phases_present:
+            return "collective"
         if "backward" in ctx.window.phases_present:
             return "backward"
         if "compute" in ctx.window.phases_present:

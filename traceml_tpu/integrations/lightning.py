@@ -1,10 +1,26 @@
 """PyTorch Lightning integration
-(reference: src/traceml_ai/integrations/lightning.py — a Callback that
-owns forward/backward timing because Lightning controls the loop).
+(reference: src/traceml_ai/integrations/lightning.py:161-419 — a
+Callback that OWNS forward/backward/optimizer timing because Lightning
+controls the loop; the generic auto-patches are suppressed while it
+runs so every phase is timed exactly once).
 
 Gated: lightning / pytorch_lightning are not in this image; the callback
 is constructed dynamically against whichever base is importable
 (reference does the same dynamic multi-base dance, lightning.py:30-90).
+
+Phase mapping (Lightning hooks → TraceML regions):
+
+* ``on_train_batch_start``      → close previous step, open ``trace_step``
+  and the ``forward`` region (Lightning gives no pre-forward hook, so
+  forward runs from batch start to just before backward — the reference
+  uses the same bracketing)
+* ``on_before_backward``        → close ``forward`` (mark the loss as the
+  device probe), open ``backward``
+* ``on_after_backward``         → close ``backward``
+* ``on_before_optimizer_step``  → open ``optimizer``
+* ``on_before_zero_grad``       → close ``optimizer``
+* ``on_train_batch_end``        → close any open region + the step
+* sanity-check / validation batches are never timed.
 """
 
 from __future__ import annotations
@@ -13,7 +29,15 @@ from typing import Any, Optional
 
 from traceml_tpu.sdk.initial import init as traceml_init
 from traceml_tpu.sdk.instrumentation import trace_step
+from traceml_tpu.sdk.state import get_state
 from traceml_tpu.utils.error_log import get_error_log
+from traceml_tpu.utils.marker_resolver import get_marker_resolver
+from traceml_tpu.utils.timing import (
+    BACKWARD_TIME,
+    FORWARD_TIME,
+    OPTIMIZER_STEP,
+    timed_region,
+)
 
 
 def _callback_bases():
@@ -45,40 +69,137 @@ def make_traceml_callback() -> Any:
         )
 
     class TraceMLCallback(*bases):  # type: ignore[misc]
+        """Owns the per-phase timing of the Lightning training loop."""
+
         def __init__(self, auto_init: bool = True) -> None:
             super().__init__()
-            self._ctx: Optional[trace_step] = None
+            self._step_ctx: Optional[trace_step] = None
+            self._region: Optional[timed_region] = None
             self._auto_init = auto_init
+            self._own_depth = False
 
-        def on_fit_start(self, trainer: Any, pl_module: Any) -> None:
+        # -- lifecycle --------------------------------------------------
+        def setup(self, trainer: Any, pl_module: Any, stage: Optional[str] = None) -> None:
             if self._auto_init:
                 try:
-                    traceml_init(mode="auto")
+                    # manual mode: this callback owns fwd/bwd/optimizer;
+                    # the torch auto-patches would double-time them
+                    traceml_init(mode="manual", prefer_torch=True)
                 except Exception as exc:
                     get_error_log().warning("lightning init failed", exc)
 
-        def on_train_batch_start(self, trainer: Any, pl_module: Any, batch: Any, batch_idx: int) -> None:
+        def teardown(self, trainer: Any, pl_module: Any, stage: Optional[str] = None) -> None:
+            self._close_all()
+
+        # -- region plumbing (never raises into the loop) ----------------
+        def _timing_active(self, trainer: Any) -> bool:
+            return not bool(getattr(trainer, "sanity_checking", False))
+
+        def _open(self, phase: str) -> None:
             try:
-                if self._ctx is not None:
-                    self._ctx.__exit__(None, None, None)
-                self._ctx = trace_step()
-                self._ctx.__enter__()
+                self._close_region()
+                st = get_state()
+                self._region = timed_region(
+                    phase, st.current_step, sink=st.buffer.add
+                )
+                self._region.__enter__()
+            except Exception as exc:
+                get_error_log().warning("lightning region open failed", exc)
+                self._region = None
+
+        def _close_region(self, mark: Any = None) -> None:
+            region = self._region
+            self._region = None
+            if region is None:
+                return
+            try:
+                if mark is not None:
+                    region.mark(mark)
+                region.__exit__(None, None, None)
+                ev = region.event
+                if ev.marker is not None:
+                    env = get_state().active_step_event
+                    if env is not None:  # last dispatch wins (envelope end)
+                        env.marker = ev.marker
+                    if not ev.marker.resolved:
+                        get_marker_resolver().submit(ev.marker)
+            except Exception as exc:
+                get_error_log().warning("lightning region close failed", exc)
+
+        def _close_all(self) -> None:
+            self._close_region()
+            if self._step_ctx is not None:
+                try:
+                    self._step_ctx.__exit__(None, None, None)
+                except Exception as exc:
+                    get_error_log().warning("lightning step close failed", exc)
+                self._step_ctx = None
+            if self._own_depth:
+                tls = get_state().tls
+                tls.forward_depth = max(0, tls.forward_depth - 1)
+                tls.backward_depth = max(0, tls.backward_depth - 1)
+                self._own_depth = False
+
+        # -- training hooks ----------------------------------------------
+        def on_train_batch_start(
+            self, trainer: Any, pl_module: Any, batch: Any, batch_idx: int
+        ) -> None:
+            if not self._timing_active(trainer):
+                return
+            try:
+                self._close_all()
+                self._step_ctx = trace_step()
+                self._step_ctx.__enter__()
+                # raise the duplicate-guard depths: any stray auto-patch
+                # or manual wrapper inside the module defers to us
+                tls = get_state().tls
+                tls.forward_depth += 1
+                tls.backward_depth += 1
+                self._own_depth = True
+                self._open(FORWARD_TIME)
             except Exception as exc:
                 get_error_log().warning("lightning batch_start failed", exc)
-                self._ctx = None
+                self._step_ctx = None
 
-        def on_train_batch_end(self, trainer: Any, pl_module: Any, outputs: Any, batch: Any, batch_idx: int) -> None:
+        def on_before_backward(self, trainer: Any, pl_module: Any, loss: Any) -> None:
+            if self._step_ctx is None:
+                return
+            self._close_region(mark=loss)  # forward ends; loss = device probe
+            self._open(BACKWARD_TIME)
+
+        def on_after_backward(self, trainer: Any, pl_module: Any) -> None:
+            if self._step_ctx is None:
+                return
+            self._close_region()
+
+        def on_before_optimizer_step(
+            self, trainer: Any, pl_module: Any, optimizer: Any
+        ) -> None:
+            if self._step_ctx is None:
+                return
+            self._open(OPTIMIZER_STEP)
+
+        def on_before_zero_grad(
+            self, trainer: Any, pl_module: Any, optimizer: Any
+        ) -> None:
+            if self._step_ctx is None:
+                return
+            self._close_region()
+
+        def on_train_batch_end(
+            self, trainer: Any, pl_module: Any, outputs: Any, batch: Any, batch_idx: int
+        ) -> None:
+            if self._step_ctx is None:
+                return
             try:
-                if self._ctx is not None:
-                    self._ctx.__exit__(None, None, None)
-                    self._ctx = None
-            except Exception as exc:
-                get_error_log().warning("lightning batch_end failed", exc)
+                if self._step_ctx is not None and outputs is not None:
+                    self._step_ctx.mark(outputs)
+            except Exception:
+                pass
+            self._close_all()
 
         def on_train_end(self, trainer: Any, pl_module: Any) -> None:
-            if self._ctx is not None:
-                self._ctx.__exit__(None, None, None)
-                self._ctx = None
+            self._close_all()
 
     _cached_callback_cls = TraceMLCallback
     return TraceMLCallback

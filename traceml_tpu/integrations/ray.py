@@ -1,6 +1,8 @@
 """Ray Train integration (gated — ray is not in this image)
-(reference: src/traceml_ai/integrations/ray.py:36-352: aggregator as a
-rank-0-node actor + per-worker in-process runtime via lifecycle).
+(reference: src/traceml_ai/integrations/ray.py:36-352: the aggregator
+runs inside a NAMED RAY ACTOR so every worker — any node — can resolve
+its endpoint through Ray instead of a shared filesystem; workers run the
+in-process runtime via lifecycle).
 
 Usage::
 
@@ -11,15 +13,16 @@ Usage::
 
     trainer = TorchTrainer(traceml_train_loop(my_loop), ...)
 
-The wrapper starts an in-process runtime on every Ray worker (identity
-from Ray's world rank env), points it at an aggregator that the rank-0
-worker hosts, and stops everything when the loop returns.
+The wrapper: rank 0 creates (or reuses) the aggregator actor; every
+worker asks the actor for the endpoint, starts an in-process runtime
+pointed at it, runs the loop, and stops everything when the loop
+returns; rank 0 finally asks the actor to finalize.
 """
 
 from __future__ import annotations
 
 import os
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Dict, Optional
 
 from traceml_tpu.runtime import lifecycle
 from traceml_tpu.runtime.settings import (
@@ -28,6 +31,8 @@ from traceml_tpu.runtime.settings import (
     settings_from_env,
 )
 from traceml_tpu.utils.error_log import get_error_log
+
+ACTOR_NAME = "traceml_aggregator"
 
 
 def _require_ray():
@@ -39,6 +44,105 @@ def _require_ray():
         raise ImportError("ray is required for the Ray integration") from exc
 
 
+class AggregatorActorImpl:
+    """The aggregator, hosted inside a Ray actor.
+
+    Plain class on purpose: ``ray.remote`` is applied at runtime (ray is
+    an optional dependency), and tests drive the class directly through
+    a stub ray module.
+    """
+
+    def __init__(self, settings_dict: Dict[str, Any]) -> None:
+        import dataclasses
+
+        from traceml_tpu.aggregator.trace_aggregator import TraceMLAggregator
+
+        settings = TraceMLSettings.from_dict(settings_dict)
+        if settings.aggregator.bind_host in ("127.0.0.1", "localhost"):
+            # workers on OTHER nodes dial the advertised node IP — a
+            # loopback bind would refuse every one of them
+            settings = dataclasses.replace(
+                settings,
+                aggregator=dataclasses.replace(
+                    settings.aggregator, bind_host="0.0.0.0"
+                ),
+            )
+        self._settings = settings
+        self._agg = TraceMLAggregator(self._settings)
+        self._agg.start()
+
+    def endpoint(self) -> Dict[str, Any]:
+        """Connectable endpoint for workers (host = this node's IP)."""
+        host = self._settings.aggregator.connect_host or "127.0.0.1"
+        try:
+            import ray
+
+            host = ray.util.get_node_ip_address()
+        except Exception:
+            pass
+        return {"host": host, "port": self._agg.port or 0}
+
+    def finalize(self, timeout: float = 30.0) -> bool:
+        try:
+            self._agg.stop(finalize_timeout=timeout)
+            return True
+        except Exception as exc:
+            get_error_log().warning("ray aggregator finalize failed", exc)
+            return False
+
+
+def actor_name_for(settings: TraceMLSettings) -> str:
+    """Session-scoped actor name: concurrent jobs on one cluster must
+    not cross-wire into each other's aggregator, and a finished job's
+    stale actor must never be mistaken for a fresh one."""
+    return f"{ACTOR_NAME}_{settings.session_id}"
+
+
+def start_actor_aggregator(
+    settings: TraceMLSettings, *, name: Optional[str] = None
+) -> Any:
+    """Create (or fetch) the named aggregator actor; returns the handle."""
+    ray = _require_ray()
+    name = name or actor_name_for(settings)
+    try:
+        return ray.get_actor(name)
+    except Exception:
+        pass
+    actor_cls = ray.remote(AggregatorActorImpl)
+    options = getattr(actor_cls, "options", None)
+    if options is not None:
+        actor_cls = actor_cls.options(name=name, lifetime="detached")
+    return actor_cls.remote(settings.to_dict())
+
+
+def resolve_actor_endpoint(
+    ray: Any, *, name: str = ACTOR_NAME, timeout: float = 30.0
+) -> Optional[Dict[str, Any]]:
+    """Resolve the aggregator endpoint, WAITING for the actor to appear —
+    Ray Train starts all workers concurrently, so non-rank-0 workers
+    race rank 0's actor creation."""
+    import time
+
+    deadline = time.monotonic() + timeout
+    actor = None
+    while time.monotonic() < deadline:
+        try:
+            actor = ray.get_actor(name)
+            break
+        except Exception:
+            time.sleep(0.25)
+    if actor is None:
+        get_error_log().warning(
+            f"ray aggregator actor {name!r} never appeared", None
+        )
+        return None
+    try:
+        return ray.get(actor.endpoint.remote(), timeout=timeout)
+    except Exception as exc:
+        get_error_log().warning("ray aggregator endpoint resolve failed", exc)
+        return None
+
+
 def traceml_train_loop(
     user_loop: Callable[[Any], Any],
     settings: Optional[TraceMLSettings] = None,
@@ -46,37 +150,27 @@ def traceml_train_loop(
     """Wrap a Ray Train per-worker loop with TraceML runtime lifecycle."""
 
     def wrapped(config: Any) -> Any:
+        ray = _require_ray()
         base = settings or settings_from_env()
         rank = int(os.environ.get("RANK", os.environ.get("WORLD_RANK", 0)))
-        agg = None
+        actor = None
         run_settings = base
+        name = actor_name_for(base)
         try:
             if rank == 0 and not base.aggregator.port:
-                # rank 0 hosts the aggregator; its bound port is shared
-                # through the session dir ready-file (workers on other
-                # nodes read it over the shared filesystem Ray provides)
-                agg = lifecycle.start_aggregator(base)
-                if agg is not None and agg.port:
-                    from traceml_tpu.aggregator.trace_aggregator import (
-                        write_ready_file,
-                    )
-
-                    write_ready_file(base, agg.port)
+                actor = start_actor_aggregator(base, name=name)
             if not run_settings.aggregator.port:
-                from traceml_tpu.launcher.process import wait_for_ready_file
-
-                ready = wait_for_ready_file(
-                    base.session_dir / "aggregator_ready.json", timeout=30
-                )
-                if ready:
+                endpoint = resolve_actor_endpoint(ray, name=name)
+                if endpoint and endpoint.get("port"):
                     import dataclasses
 
                     run_settings = dataclasses.replace(
                         base,
                         aggregator=AggregatorEndpoint(
-                            connect_host=base.aggregator.connect_host,
+                            connect_host=endpoint.get("host")
+                            or base.aggregator.connect_host,
                             bind_host=base.aggregator.bind_host,
-                            port=int(ready["port"]),
+                            port=int(endpoint["port"]),
                         ),
                     )
             lifecycle.start_runtime(run_settings)
@@ -89,10 +183,16 @@ def traceml_train_loop(
                 lifecycle.stop_runtime()
             except Exception as exc:
                 get_error_log().warning("ray worker runtime stop failed", exc)
-            if agg is not None:
+            if actor is not None:
                 try:
-                    agg.stop()
+                    ray.get(actor.finalize.remote(), timeout=60)
                 except Exception as exc:
                     get_error_log().warning("ray aggregator stop failed", exc)
+                try:
+                    # the detached actor must not outlive the job — a
+                    # later run would resolve a dead aggregator
+                    ray.kill(actor)
+                except Exception:
+                    pass
 
     return wrapped

@@ -1,0 +1,337 @@
+"""Project-level static code scan
+(reference: src/traceml_ai/utils/ast_analysis/scanner.py:59-369 — the
+reference walks local imports from the entry script and extracts
+framework/strategy/precision/QLoRA signals; rebuilt here around one
+visitor shared by the single-file and project-level paths, tuned for
+JAX/TPU signals first).
+
+``analyze_script``  — one file (the round-1 scanner, extended).
+``analyze_project`` — entry file + bounded BFS over its LOCAL imports
+(modules resolvable to files under the script's directory), merged into
+one manifest with per-module provenance.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Set
+
+_MAX_MODULES = 24
+_MAX_FILE_BYTES = 512 * 1024
+
+
+class _ScriptVisitor(ast.NodeVisitor):
+    def __init__(self) -> None:
+        self.imports: Set[str] = set()        # top-level names
+        self.import_modules: Set[str] = set()  # full dotted module names
+        self.calls: List[str] = []
+        self.attrs: List[str] = []
+        # call name → list of per-call {kwarg: literal value} (a script
+        # may build several DataLoaders with different configs)
+        self.call_kwargs: Dict[str, List[Dict[str, Any]]] = {}
+
+    _KWARG_TARGETS = (
+        "DataLoader",
+        "TrainingArguments",
+        "jit",
+        "pjit",
+        "Trainer",
+        "BitsAndBytesConfig",
+        "LoraConfig",
+        "from_pretrained",
+    )
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for a in node.names:
+            self.imports.add(a.name.split(".")[0])
+            self.import_modules.add(a.name)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module:
+            self.imports.add(node.module.split(".")[0])
+            self.import_modules.add(node.module)
+        for a in node.names:
+            # imported symbol names carry parallelism signals
+            # (Mesh, PartitionSpec, shard_map, …)
+            self.attrs.append(a.name)
+            if node.module:
+                self.import_modules.add(f"{node.module}.{a.name}")
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = _dotted(node.func)
+        if name:
+            self.calls.append(name)
+            tail = name.split(".")[-1]
+            if tail in self._KWARG_TARGETS:
+                kws: Dict[str, Any] = {}
+                for kw in node.keywords:
+                    if kw.arg is None:
+                        continue
+                    try:
+                        kws[kw.arg] = ast.literal_eval(kw.value)
+                    except (ValueError, SyntaxError):
+                        kws[kw.arg] = "<dynamic>"
+                self.call_kwargs.setdefault(tail, []).append(kws)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        name = _dotted(node)
+        if name:
+            self.attrs.append(name)
+        self.generic_visit(node)
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _visit_file(path: Path, enforce_size: bool = True) -> Optional[_ScriptVisitor]:
+    """Parse + visit one file; None on parse failure (or oversize, when
+    ``enforce_size`` — the traversal bound; the ENTRY script is always
+    scanned in full)."""
+    try:
+        if enforce_size and path.stat().st_size > _MAX_FILE_BYTES:
+            return None
+        tree = ast.parse(path.read_text(encoding="utf-8"))
+    except Exception:
+        return None
+    v = _ScriptVisitor()
+    v.visit(tree)
+    return v
+
+
+def _extract(v: _ScriptVisitor, out: Dict[str, Any]) -> None:
+    """Fold one visitor's signals into the manifest dict."""
+    names = set(v.calls) | set(v.attrs)
+    imports = v.imports
+
+    # jax/flax anywhere in the project wins (torch often appears as a
+    # data-utility import in jax projects); order-independent
+    if "jax" in imports or "flax" in imports:
+        out["framework"] = "jax"
+    elif out["framework"] == "unknown" and imports & {
+        "torch", "lightning", "pytorch_lightning"
+    }:
+        out["framework"] = "torch"
+    out["uses"] = sorted(
+        set(out["uses"])
+        | (
+            imports
+            & {
+                "jax", "flax", "optax", "orbax", "torch", "transformers",
+                "numpy", "tensorflow", "grain", "lightning",
+                "pytorch_lightning", "deepspeed", "accelerate", "peft",
+                "bitsandbytes", "ray",
+            }
+        )
+    )
+
+    def any_in(*subs: str) -> bool:
+        return any(any(s in n for n in names) for s in subs)
+
+    def add(field: str, value: str) -> None:
+        if value not in out[field]:
+            out[field].append(value)
+
+    if any_in("pjit", "shard_map", "NamedSharding", "PartitionSpec", "Mesh"):
+        add("parallelism_hints", "gspmd")
+    if any_in("pmap"):
+        add("parallelism_hints", "pmap")
+    if any_in("distributed.initialize"):
+        add("parallelism_hints", "multi_host")
+    if any_in("DistributedDataParallel", "DDPStrategy"):
+        add("parallelism_hints", "ddp")
+    if any_in("FSDP", "fully_shard", "FSDPStrategy"):
+        add("parallelism_hints", "fsdp")
+    if "deepspeed" in imports or any_in("DeepSpeedStrategy", "deepspeed"):
+        add("parallelism_hints", "deepspeed")
+    # lightning Trainer(strategy="...") literal
+    for call in v.call_kwargs.get("Trainer", []):
+        strategy = call.get("strategy")
+        if isinstance(strategy, str):
+            out["trainer_strategy"] = strategy
+            for tag in ("ddp", "fsdp", "deepspeed"):
+                if tag in strategy:
+                    add("parallelism_hints", tag)
+        for k in ("devices", "num_nodes", "precision", "accumulate_grad_batches"):
+            if k in call:
+                out.setdefault("trainer_args", {})[k] = call[k]
+    if any_in("bfloat16", "bf16"):
+        add("precision_hints", "bf16")
+    if any_in("float16", "fp16", "autocast"):
+        add("precision_hints", "fp16/amp")
+    for opt in ("adamw", "adam", "sgd", "adafactor", "lion", "lamb"):
+        if any_in(opt):
+            add("optimizer_hints", opt)
+    if any_in("DataLoader"):
+        add("input_hints", "torch_dataloader")
+    if any_in("device_put"):
+        add("input_hints", "explicit_device_put")
+    if any_in("jax.checkpoint", "remat") and "remat" not in out["uses"]:
+        out["uses"].append("remat")
+
+    # config extraction (reference: scanner pulls dataloader args,
+    # TrainingArguments precision, grad accumulation, QLoRA markers)
+    dls = v.call_kwargs.get("DataLoader", [])
+    if dls:
+        keep = ("num_workers", "pin_memory", "prefetch_factor",
+                "batch_size", "persistent_workers")
+        out.setdefault("dataloader_args", []).extend(
+            {k: dl[k] for k in keep if k in dl} for dl in dls[:8]
+        )
+        # torch's DataLoader default is num_workers=0 (single worker in
+        # the main process) — exactly the input-bound setup this hint
+        # exists to flag, so a missing kwarg counts
+        if any(dl.get("num_workers", 0) in (0, None) for dl in dls):
+            add("input_hints", "single_worker_dataloader")
+    ta = {
+        k: val
+        for call in v.call_kwargs.get("TrainingArguments", [])
+        for k, val in call.items()
+    }
+    if ta:
+        out.setdefault("hf_training_args", {}).update(
+            {
+                k: ta[k]
+                for k in ("per_device_train_batch_size",
+                          "gradient_accumulation_steps", "bf16", "fp16",
+                          "gradient_checkpointing", "optim",
+                          "deepspeed", "fsdp")
+                if k in ta
+            }
+        )
+        if ta.get("bf16"):
+            add("precision_hints", "bf16")
+        if ta.get("fp16"):
+            add("precision_hints", "fp16/amp")
+        if ta.get("fsdp"):
+            add("parallelism_hints", "fsdp")
+        if ta.get("deepspeed"):
+            add("parallelism_hints", "deepspeed")
+    jit_kw = {
+        k: val
+        for call in v.call_kwargs.get("jit", []) + v.call_kwargs.get("pjit", [])
+        for k, val in call.items()
+    }
+    if "donate_argnums" in jit_kw and "buffer_donation" not in out["uses"]:
+        out["uses"].append("buffer_donation")
+
+    # QLoRA / quantization (reference: scanner QLoRA detection)
+    quant: Dict[str, Any] = dict(out.get("quantization") or {})
+    for call in v.call_kwargs.get("BitsAndBytesConfig", []):
+        for k in ("load_in_4bit", "load_in_8bit", "bnb_4bit_quant_type",
+                  "bnb_4bit_compute_dtype"):
+            if k in call:
+                quant[k] = call[k]
+    for call in v.call_kwargs.get("from_pretrained", []):
+        for k in ("load_in_4bit", "load_in_8bit"):
+            if call.get(k):
+                quant[k] = call[k]
+    lora = {
+        k: val
+        for call in v.call_kwargs.get("LoraConfig", [])
+        for k, val in call.items()
+        if k in ("r", "lora_alpha", "target_modules", "lora_dropout")
+    }
+    if lora:
+        quant["lora"] = lora
+    if quant:
+        out["quantization"] = quant
+    if (
+        imports & {"peft", "bitsandbytes"}
+        or any_in("lora", "Lora", "LoRA")
+    ) and "lora/qlora" not in out["uses"]:
+        out["uses"].append("lora/qlora")
+    # host-sync calls inside the loop are a classic TPU/GPU perf trap
+    sync_markers = [
+        n for n in ("item", "block_until_ready", "device_get", "tolist")
+        if any(name.endswith("." + n) or name == n for name in set(v.calls))
+    ]
+    for m in sync_markers:
+        if m not in out.setdefault("sync_call_hints", []):
+            out["sync_call_hints"].append(m)
+
+
+def _empty_manifest(script: Path) -> Dict[str, Any]:
+    return {
+        "script": str(script),
+        "framework": "unknown",
+        "uses": [],
+        "parallelism_hints": [],
+        "precision_hints": [],
+        "optimizer_hints": [],
+        "input_hints": [],
+    }
+
+
+def analyze_script(script: Path) -> Dict[str, Any]:
+    """Best-effort static scan of ONE file (reference: scanner.py:59)."""
+    out = _empty_manifest(script)
+    v = _visit_file(Path(script), enforce_size=False)
+    if v is None:
+        try:
+            ast.parse(Path(script).read_text(encoding="utf-8"))
+        except Exception as exc:
+            out["error"] = str(exc)
+        return out
+    _extract(v, out)
+    return out
+
+
+def _resolve_local(module: str, roots: List[Path]) -> Optional[Path]:
+    """Dotted module name → local file under one of ``roots``, or None."""
+    rel = module.replace(".", "/")
+    for root in roots:
+        for candidate in (root / f"{rel}.py", root / rel / "__init__.py"):
+            try:
+                if candidate.is_file():
+                    return candidate.resolve()
+            except OSError:
+                continue
+    return None
+
+
+def analyze_project(script: Path, max_modules: int = _MAX_MODULES) -> Dict[str, Any]:
+    """Entry script + bounded BFS over its LOCAL imports
+    (reference: ast_analysis local-import traversal).
+
+    Only modules that resolve to files under the entry script's directory
+    (the project) are followed; site-packages never are.  Bounded by
+    ``max_modules`` and per-file size, tolerant of cycles and syntax
+    errors (a broken module is recorded, not fatal).
+    """
+    entry = Path(script).resolve()
+    out = _empty_manifest(entry)
+    roots = [entry.parent]
+    queue: List[Path] = [entry]
+    seen: Set[Path] = set()
+    scanned: List[str] = []
+    failed: List[str] = []
+    while queue and len(seen) < max_modules:
+        path = queue.pop(0)
+        if path in seen:
+            continue
+        seen.add(path)
+        v = _visit_file(path, enforce_size=path != entry)
+        if v is None:
+            failed.append(str(path))
+            continue
+        scanned.append(str(path))
+        _extract(v, out)
+        for module in sorted(v.import_modules):
+            local = _resolve_local(module, roots)
+            if local is not None and local not in seen:
+                queue.append(local)
+    out["modules_scanned"] = len(scanned)
+    out["local_modules"] = [str(p) for p in scanned if Path(p) != entry]
+    if failed:
+        out["modules_failed"] = failed
+    return out

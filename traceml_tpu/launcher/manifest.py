@@ -1,16 +1,19 @@
 """Run + code manifests
-(reference: src/traceml_ai/launcher/manifest.py:58-228 and the AST code
-manifest utils/ast_analysis/ — here a single-pass static scan of the
-entry script tuned to JAX/TPU signals).
+(reference: src/traceml_ai/launcher/manifest.py:58-228; the AST code
+scan lives in launcher/ast_scan.py — project-level traversal over local
+imports, reference utils/ast_analysis/).
 """
 
 from __future__ import annotations
 
-import ast
 import time
 from pathlib import Path
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Optional
 
+from traceml_tpu.launcher.ast_scan import (  # noqa: F401  (compat re-export)
+    analyze_project,
+    analyze_script,
+)
 from traceml_tpu.utils.atomic_io import atomic_write_json, read_json
 
 STATUS_STARTING = "starting"
@@ -63,180 +66,8 @@ def update_run_manifest(session_dir: Path, **fields: Any) -> None:
     atomic_write_json(manifest_path(session_dir), data)
 
 
-# -- code manifest (static analysis) --------------------------------------
-
-
-class _ScriptVisitor(ast.NodeVisitor):
-    def __init__(self) -> None:
-        self.imports: set = set()
-        self.calls: List[str] = []
-        self.attrs: List[str] = []
-        # call name → list of per-call {kwarg: literal value} (a script
-        # may build several DataLoaders with different configs)
-        self.call_kwargs: Dict[str, List[Dict[str, Any]]] = {}
-
-    _KWARG_TARGETS = ("DataLoader", "TrainingArguments", "jit", "pjit")
-
-    def visit_Import(self, node: ast.Import) -> None:
-        for a in node.names:
-            self.imports.add(a.name.split(".")[0])
-
-    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
-        if node.module:
-            self.imports.add(node.module.split(".")[0])
-        for a in node.names:
-            # imported symbol names carry parallelism signals
-            # (Mesh, PartitionSpec, shard_map, …)
-            self.attrs.append(a.name)
-
-    def visit_Call(self, node: ast.Call) -> None:
-        name = _dotted(node.func)
-        if name:
-            self.calls.append(name)
-            tail = name.split(".")[-1]
-            if tail in self._KWARG_TARGETS:
-                kws: Dict[str, Any] = {}
-                for kw in node.keywords:
-                    if kw.arg is None:
-                        continue
-                    try:
-                        kws[kw.arg] = ast.literal_eval(kw.value)
-                    except (ValueError, SyntaxError):
-                        kws[kw.arg] = "<dynamic>"
-                self.call_kwargs.setdefault(tail, []).append(kws)
-        self.generic_visit(node)
-
-    def visit_Attribute(self, node: ast.Attribute) -> None:
-        name = _dotted(node)
-        if name:
-            self.attrs.append(name)
-        self.generic_visit(node)
-
-
-def _dotted(node: ast.AST) -> Optional[str]:
-    parts: List[str] = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return None
-
-
-def analyze_script(script: Path) -> Dict[str, Any]:
-    """Best-effort static scan: framework, parallelism hints, precision,
-    optimizer, input-pipeline hints (reference: ast_analysis/scanner.py:59)."""
-    out: Dict[str, Any] = {
-        "script": str(script),
-        "framework": "unknown",
-        "uses": [],
-        "parallelism_hints": [],
-        "precision_hints": [],
-        "optimizer_hints": [],
-        "input_hints": [],
-    }
-    try:
-        tree = ast.parse(Path(script).read_text(encoding="utf-8"))
-    except Exception as exc:
-        out["error"] = str(exc)
-        return out
-    v = _ScriptVisitor()
-    v.visit(tree)
-    names = set(v.calls) | set(v.attrs)
-    imports = v.imports
-
-    if "jax" in imports or "flax" in imports:
-        out["framework"] = "jax"
-    elif "torch" in imports:
-        out["framework"] = "torch"
-    out["uses"] = sorted(
-        imports
-        & {
-            "jax", "flax", "optax", "orbax", "torch", "transformers",
-            "numpy", "tensorflow", "grain",
-        }
-    )
-
-    def any_in(*subs: str) -> bool:
-        return any(any(s in n for n in names) for s in subs)
-
-    if any_in("pjit", "shard_map", "NamedSharding", "PartitionSpec", "Mesh"):
-        out["parallelism_hints"].append("gspmd")
-    if any_in("pmap"):
-        out["parallelism_hints"].append("pmap")
-    if any_in("distributed.initialize"):
-        out["parallelism_hints"].append("multi_host")
-    if any_in("DistributedDataParallel"):
-        out["parallelism_hints"].append("ddp")
-    if any_in("FSDP", "fully_shard"):
-        out["parallelism_hints"].append("fsdp")
-    if any_in("bfloat16", "bf16"):
-        out["precision_hints"].append("bf16")
-    if any_in("float16", "fp16", "autocast"):
-        out["precision_hints"].append("fp16/amp")
-    for opt in ("adamw", "adam", "sgd", "adafactor", "lion", "lamb"):
-        if any_in(opt):
-            out["optimizer_hints"].append(opt)
-    if any_in("DataLoader"):
-        out["input_hints"].append("torch_dataloader")
-    if any_in("device_put"):
-        out["input_hints"].append("explicit_device_put")
-    if any_in("jax.checkpoint", "remat"):
-        out["uses"].append("remat")
-
-    # config extraction (reference: scanner pulls dataloader args,
-    # TrainingArguments precision, grad accumulation, QLoRA markers)
-    dls = v.call_kwargs.get("DataLoader", [])
-    if dls:
-        keep = ("num_workers", "pin_memory", "prefetch_factor",
-                "batch_size", "persistent_workers")
-        out["dataloader_args"] = [
-            {k: dl[k] for k in keep if k in dl} for dl in dls[:8]
-        ]
-        # torch's DataLoader default is num_workers=0 (single worker in
-        # the main process) — exactly the input-bound setup this hint
-        # exists to flag, so a missing kwarg counts
-        if any(dl.get("num_workers", 0) in (0, None) for dl in dls):
-            out["input_hints"].append("single_worker_dataloader")
-    ta = {
-        k: val
-        for call in v.call_kwargs.get("TrainingArguments", [])
-        for k, val in call.items()
-    }
-    if ta:
-        out["hf_training_args"] = {
-            k: ta[k]
-            for k in ("per_device_train_batch_size",
-                      "gradient_accumulation_steps", "bf16", "fp16",
-                      "gradient_checkpointing", "optim")
-            if k in ta
-        }
-        if ta.get("bf16"):
-            out["precision_hints"].append("bf16")
-        if ta.get("fp16"):
-            out["precision_hints"].append("fp16/amp")
-    jit_kw = {
-        k: val
-        for call in v.call_kwargs.get("jit", []) + v.call_kwargs.get("pjit", [])
-        for k, val in call.items()
-    }
-    if "donate_argnums" in jit_kw:
-        out["uses"].append("buffer_donation")
-    if imports & {"peft", "bitsandbytes"} or any_in("lora", "Lora", "LoRA"):
-        out["uses"].append("lora/qlora")
-    # host-sync calls inside the loop are a classic TPU/GPU perf trap
-    sync_markers = [
-        n for n in ("item", "block_until_ready", "device_get", "tolist")
-        if any(name.endswith("." + n) or name == n for name in set(v.calls))
-    ]
-    if sync_markers:
-        out["sync_call_hints"] = sync_markers
-    return out
-
-
 def write_code_manifest(session_dir: Path, script: Path) -> Dict[str, Any]:
-    data = analyze_script(script)
+    data = analyze_project(script)
     data["generated_at"] = time.time()
     atomic_write_json(Path(session_dir) / "code_manifest.json", data)
     return data

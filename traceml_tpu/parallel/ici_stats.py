@@ -77,9 +77,14 @@ class IciStatAggregator:
         axes = self.axes
 
         def gather(local: jnp.ndarray) -> jnp.ndarray:
-            # local: (1, N_FIELDS) shard per device → (n_devices, N_FIELDS)
+            # local: (1, N_FIELDS) shard per device → (n_devices, N_FIELDS).
+            # Gather over the LAST axis first: each all_gather makes the
+            # gathered axis major in dim 0, so reversing the chain leaves
+            # the result in mesh-linear (first-axis-major) order — row i
+            # IS participant i of the P(axes) input placement.  Rank
+            # attribution downstream depends on this.
             out = local
-            for ax in axes:
+            for ax in reversed(axes):
                 out = jax.lax.all_gather(out, ax, axis=0, tiled=True)
             return out
 
@@ -118,6 +123,27 @@ class IciStatAggregator:
         n = self.n_participants
         local = jnp.broadcast_to(
             jnp.asarray(stats.to_array())[None, :], (n, N_FIELDS)
+        )
+        sharding = NamedSharding(self.mesh, P(self.axes))
+        local = jax.device_put(local, sharding)
+        with self.mesh:
+            out = self._gather(local)
+        return np.asarray(jax.device_get(out))
+
+    def aggregate_many(self, stats: Sequence[StatVector]) -> np.ndarray:
+        """Single-controller variant: place DISTINCT per-device vectors
+        (len must equal n_participants) and gather.  Tests and
+        single-host jobs use this to exercise the real collective with
+        heterogeneous per-chip stats."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        n = self.n_participants
+        if len(stats) != n:
+            raise ValueError(f"need {n} vectors, got {len(stats)}")
+        local = jnp.asarray(
+            np.stack([s.to_array() for s in stats]), dtype=jnp.float32
         )
         sharding = NamedSharding(self.mesh, P(self.axes))
         local = jax.device_put(local, sharding)

@@ -1,0 +1,219 @@
+"""ICI-path telemetry hook — the on-device alternative rank source
+(SURVEY.md §2.5 mandate: per-chip stat vectors all-gathered over ICI so
+cross-rank skew diagnostics can run WITHOUT a TCP round trip).
+
+Wiring (opt-in via :func:`traceml_tpu.enable_ici_stats`):
+
+1. the hook registers an ``on_batch_flushed`` observer on the trace
+   state — every ``trace_step`` exit hands it the step's event batch;
+2. every ``every_n_steps`` it folds the batch into one fixed-layout
+   :class:`~traceml_tpu.parallel.ici_stats.StatVector` and all-gathers
+   it over the mesh (one small ICI collective, not world_size TCP
+   messages over DCN);
+3. every participant's host sees the full ``(n, N_FIELDS)`` matrix; the
+   hook converts the rows back into the step-row shape the window
+   builder consumes and accumulates them as a per-rank history;
+4. :meth:`diagnose` runs the SAME straggler/bound rules the aggregator
+   runs — but on the ICI-gathered matrix alone.
+
+Multi-controller: each process contributes its own vector (all_gather
+is global).  Single-controller meshes (tests, single-host) can inject
+distinct per-device vectors through
+:meth:`IciStatAggregator.aggregate_many`.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Any, Deque, Dict, List, Optional
+
+import numpy as np
+
+from traceml_tpu.parallel.ici_stats import (
+    N_FIELDS,
+    STAT_FIELDS,
+    IciStatAggregator,
+    StatVector,
+)
+from traceml_tpu.utils import timing as T
+from traceml_tpu.utils.error_log import get_error_log
+
+# StatVector field ↔ internal event name (forward/backward fold into
+# compute: the fixed ICI layout carries the fused phase)
+_FIELD_TO_EVENT = {
+    "input_ms": T.DATALOADER_NEXT,
+    "h2d_ms": T.H2D_TIME,
+    "compute_ms": T.COMPUTE_TIME,
+    "optimizer_ms": T.OPTIMIZER_STEP,
+    "compile_ms": T.COMPILE_TIME,
+    "collective_ms": T.COLLECTIVE_TIME,
+}
+_FOLD_INTO_COMPUTE = (T.FORWARD_TIME, T.BACKWARD_TIME)
+
+
+def batch_to_stat_vector(batch: Any) -> StatVector:
+    """One step's event batch → fixed-layout stat vector.
+
+    Uses the sampler's aggregation (device readiness edges where
+    resolved, host times otherwise) so the ICI path and the TCP path
+    report the same numbers for the same step.
+    """
+    from traceml_tpu.samplers.step_time_sampler import _aggregate_step
+
+    row, _ = _aggregate_step(batch.events, None)
+    events = row.get("events") or {}
+
+    def _ms(name: str) -> float:
+        ev = events.get(name) or {}
+        v = ev.get("device_ms")
+        if v is None:
+            v = ev.get("cpu_ms")
+        return float(v or 0.0)
+
+    values: Dict[str, float] = {"step": float(batch.step)}
+    step_ms = _ms(T.STEP_TIME)
+    values["step_ms"] = step_ms
+    accounted = 0.0
+    for field, event_name in _FIELD_TO_EVENT.items():
+        v = _ms(event_name)
+        if field == "compute_ms":
+            v += sum(_ms(n) for n in _FOLD_INTO_COMPUTE)
+        values[field] = v
+        accounted += v
+    values["residual_ms"] = max(0.0, step_ms - accounted)
+    return StatVector(values)
+
+
+def matrix_to_rank_rows(
+    matrix: np.ndarray, timestamp: Optional[float] = None
+) -> Dict[int, Dict[str, Any]]:
+    """One gathered matrix → {participant → step row} in the window
+    builder's shape (participant index IS the rank over the gather
+    order — mesh-major, the same order jax.devices() enumerates)."""
+    ts = time.time() if timestamp is None else timestamp
+    out: Dict[int, Dict[str, Any]] = {}
+    for rank, arr in enumerate(np.asarray(matrix)):
+        vec = StatVector.from_array(arr).values
+        events: Dict[str, Dict[str, Any]] = {
+            T.STEP_TIME: {
+                "cpu_ms": vec["step_ms"],
+                "device_ms": vec["step_ms"],
+                "count": 1,
+            }
+        }
+        for field, event_name in _FIELD_TO_EVENT.items():
+            v = vec.get(field) or 0.0
+            if v > 0:
+                events[event_name] = {"cpu_ms": v, "device_ms": v, "count": 1}
+        out[rank] = {
+            "step": int(vec["step"]),
+            "timestamp": ts,
+            "clock": "device",
+            "events": events,
+        }
+    return out
+
+
+class IciTelemetryHook:
+    """Accumulates ICI-gathered stat matrices into a per-rank window and
+    diagnoses from it — no TCP involved."""
+
+    def __init__(
+        self,
+        mesh=None,
+        *,
+        every_n_steps: int = 10,
+        window_steps: int = 120,
+        aggregator: Optional[IciStatAggregator] = None,
+    ) -> None:
+        self._agg = aggregator or IciStatAggregator(mesh)
+        self.every_n_steps = max(1, int(every_n_steps))
+        self._rows: Dict[int, Deque[Dict[str, Any]]] = {}
+        self._window = int(window_steps)
+        self._installed_on: Optional[Any] = None
+        self._last_batch: Optional[Any] = None
+        self.gather_count = 0
+        self.last_matrix: Optional[np.ndarray] = None
+
+    # -- wiring ---------------------------------------------------------
+    def install(self, state=None) -> "IciTelemetryHook":
+        from traceml_tpu.sdk.state import get_state
+
+        st = state or get_state()
+        # gathers are driven by on_step_flushed — it fires on EVERY
+        # trace_step exit, batch or not.  Driving them from
+        # on_batch_flushed would deadlock the collective when one rank's
+        # flush came up empty (its peers would block in all_gather with
+        # nobody arriving); an empty-batch rank contributes zeros instead.
+        st.on_batch_flushed.append(self._on_batch)
+        st.on_step_flushed.append(self._on_step)
+        self._installed_on = st
+        return self
+
+    def uninstall(self) -> None:
+        st = self._installed_on
+        if st is not None:
+            for lst, cb in (
+                (st.on_batch_flushed, self._on_batch),
+                (st.on_step_flushed, self._on_step),
+            ):
+                try:
+                    lst.remove(cb)
+                except ValueError:
+                    pass
+            self._installed_on = None
+
+    def _on_batch(self, batch: Any) -> None:
+        self._last_batch = batch
+
+    def _on_step(self, step: int) -> None:
+        if step % self.every_n_steps != 0:
+            return
+        try:
+            from traceml_tpu.utils.marker_resolver import get_marker_resolver
+
+            get_marker_resolver().sweep_inline()
+            batch = self._last_batch
+            if batch is not None and batch.step == step:
+                vec = batch_to_stat_vector(batch)
+            else:  # empty flush on this rank: contribute zeros, keep
+                vec = StatVector({"step": float(step)})  # the collective aligned
+            matrix = self._agg.aggregate(vec)
+            self.ingest_matrix(matrix)
+        except Exception as exc:  # never raises into training
+            get_error_log().warning("ici telemetry gather failed", exc)
+
+    # -- matrix accounting ----------------------------------------------
+    def ingest_matrix(self, matrix: np.ndarray, timestamp: Optional[float] = None) -> None:
+        self.gather_count += 1
+        self.last_matrix = np.asarray(matrix)
+        for rank, row in matrix_to_rank_rows(matrix, timestamp).items():
+            dq = self._rows.setdefault(
+                rank, collections.deque(maxlen=self._window)
+            )
+            dq.append(row)
+
+    def rank_rows(self) -> Dict[int, List[Dict[str, Any]]]:
+        return {r: list(dq) for r, dq in self._rows.items()}
+
+    # -- consumers -------------------------------------------------------
+    def diagnose(self, mode: str = "live"):
+        """Straggler/bound diagnosis from the ICI matrices alone."""
+        from traceml_tpu.diagnostics.step_time.api import diagnose_rank_rows
+
+        return diagnose_rank_rows(self.rank_rows(), mode=mode)
+
+    def rank_skew(self, field: str) -> Optional[Dict[str, float]]:
+        if self.last_matrix is None:
+            return None
+        return self._agg.rank_skew(self.last_matrix, field)
+
+
+__all__ = [
+    "IciTelemetryHook",
+    "batch_to_stat_vector",
+    "matrix_to_rank_rows",
+    "STAT_FIELDS",
+    "N_FIELDS",
+]

@@ -1,7 +1,10 @@
 """JSON-able live payload for the browser dashboard
-(reference pattern: renderers/<domain>/dashboard_compute.py — here the
-payload is literally the typed views from renderers/views.py serialized,
-plus the composed diagnosis list; the page renders, it never computes).
+(reference pattern: renderers/<domain>/dashboard_compute.py).
+
+One pipeline, N surfaces: the payload is derived from the SAME
+``LiveComputer`` the CLI renders from (one load→views→diagnose pass per
+TTL regardless of how many dashboard tabs poll), with the typed views
+serialized verbatim via ``as_dict()``.
 """
 
 from __future__ import annotations
@@ -10,33 +13,32 @@ import time
 from pathlib import Path
 from typing import Any, Dict
 
-from traceml_tpu.diagnostics.step_time.api import diagnose_rank_rows
-from traceml_tpu.renderers import views as V
-from traceml_tpu.reporting import loaders
-from traceml_tpu.utils.step_time_window import build_step_time_window
+from traceml_tpu.renderers.compute import LiveComputer
 
 PAYLOAD_VERSION = 2
-_CACHE_TTL_S = 0.4
-_cache: Dict[tuple, tuple] = {}  # (db_path, session) → (monotonic, payload)
+
+_computers: Dict[str, LiveComputer] = {}
+
+
+def _computer_for(db_path: Path, window_steps: int) -> LiveComputer:
+    key = str(db_path)
+    comp = _computers.get(key)
+    if comp is None or comp.window_steps != window_steps:
+        _computers.clear()  # one session per aggregator process
+        comp = _computers[key] = LiveComputer(db_path, window_steps=window_steps)
+    return comp
+
+
+def _issue_dict(issue: Any) -> Dict[str, Any]:
+    return {
+        "kind": issue.kind,
+        "severity": issue.severity,
+        "summary": issue.summary,
+        "action": issue.action,
+    }
 
 
 def build_web_payload(
-    db_path: Path, session: str, window_steps: int = 150
-) -> Dict[str, Any]:
-    """TTL-cached: N dashboard tabs polling at 1 Hz cost one pipeline
-    per TTL, not one per request (mirrors LiveComputer's cache)."""
-    key = (str(db_path), session)
-    hit = _cache.get(key)
-    now = time.monotonic()
-    if hit is not None and now - hit[0] < _CACHE_TTL_S:
-        return hit[1]
-    payload = _build_web_payload(db_path, session, window_steps)
-    _cache.clear()  # one session per aggregator; don't grow unbounded
-    _cache[key] = (now, payload)
-    return payload
-
-
-def _build_web_payload(
     db_path: Path, session: str, window_steps: int = 150
 ) -> Dict[str, Any]:
     out: Dict[str, Any] = {
@@ -51,103 +53,42 @@ def _build_web_payload(
         "diagnosis": None,
         "findings": [],
     }
-    db_path = Path(db_path)
-    if not db_path.exists():
+    payload = _computer_for(Path(db_path), window_steps).payload()
+    if not payload.get("db_exists"):
         return out
-    try:
-        topology = loaders.load_topology(db_path)
-    except Exception:
-        topology = {}
-    world = int(topology.get("world_size") or 0)
-    nodes = int(topology.get("nodes") or 0)
 
-    domain_results: Dict[str, Any] = {}
-    try:
-        rank_rows = loaders.load_step_time_rows(
-            db_path, max_steps_per_rank=window_steps
-        )
-        window = build_step_time_window(rank_rows, max_steps=window_steps)
-        latest = max(
-            (
-                row.get("timestamp") or 0.0
-                for rows in rank_rows.values()
-                for row in rows[-1:]
-            ),
-            default=None,
-        )
-        view = V.build_step_time_view(window, world_size=world, latest_ts=latest)
+    views = payload.get("views") or {}
+    for key, payload_key in (
+        ("step_time", "step_time"),
+        ("memory", "memory"),
+        ("system", "system"),
+        ("process", "process"),
+    ):
+        view = views.get(key)
         if view is not None:
-            out["step_time"] = view.as_dict()
-        if rank_rows:
-            result = diagnose_rank_rows(rank_rows, mode="live")
-            domain_results["step_time"] = result
-            d = result.diagnosis
-            out["diagnosis"] = {
-                "kind": d.kind,
-                "severity": d.severity,
-                "summary": d.summary,
-                "action": d.action,
-            }
-    except Exception as exc:
-        out["step_time_error"] = str(exc)
-    try:
-        mem_rows = loaders.load_step_memory_rows(
-            db_path, max_rows_per_rank=window_steps
-        )
-        view = V.build_memory_view(mem_rows)
-        if view is not None:
-            out["memory"] = view.as_dict()
-        if mem_rows:
-            from traceml_tpu.diagnostics.step_memory.api import (
-                diagnose_rank_rows as diagnose_memory,
-            )
+            out[payload_key] = view.as_dict()
 
-            domain_results["step_memory"] = diagnose_memory(mem_rows)
-    except Exception:
-        pass
-    try:
-        host, devices = loaders.load_system_rows(db_path, max_rows=300)
-        view = V.build_system_view(host, devices, expected_nodes=nodes)
-        if view is not None:
-            out["system"] = view.as_dict()
-        if host or devices:
-            from traceml_tpu.diagnostics.system.api import diagnose as diagnose_system
+    st_result = (payload.get("step_time") or {}).get("diagnosis")
+    if st_result is not None:
+        out["diagnosis"] = _issue_dict(st_result.diagnosis)
 
-            domain_results["system"] = diagnose_system(host, devices)
-    except Exception:
-        pass
-    try:
-        procs, pdevs = loaders.load_process_rows(db_path, max_rows=300)
-        view = V.build_process_view(procs)
-        if view is not None:
-            out["process"] = view.as_dict()
-        if procs or pdevs:
-            from traceml_tpu.diagnostics.process.api import diagnose as diagnose_process
-
-            domain_results["process"] = diagnose_process(procs, pdevs)
-    except Exception:
-        pass
+    domain_results = {
+        "step_time": st_result,
+        "step_memory": payload.get("step_memory_diagnosis"),
+        "system": payload.get("system_diagnosis"),
+        "process": payload.get("process_diagnosis"),
+    }
     try:
         from traceml_tpu.diagnostics.model_diagnostics import compose
 
         composed = compose(domain_results)
         out["findings"] = [
-            {
-                "domain": i.evidence.get("domain", "?"),
-                "kind": i.kind,
-                "severity": i.severity,
-                "summary": i.summary,
-                "action": i.action,
-            }
+            dict(_issue_dict(i), domain=i.evidence.get("domain", "?"))
             for i in composed.issues[:8]
         ]
     except Exception:
         pass
-    try:
-        out["stdout"] = [
-            {"stream": s, "line": l}
-            for s, l in loaders.load_stdout_tail(db_path, n=14)
-        ]
-    except Exception:
-        pass
+    out["stdout"] = [
+        {"stream": s, "line": l} for s, l in (payload.get("stdout") or [])
+    ]
     return out

@@ -28,6 +28,7 @@ class _TLS(threading.local):
         self.backward_depth = 0
         self.h2d_depth = 0
         self.dataloader_depth = 0
+        self.collective_depth = 0
 
 
 class TraceState:
@@ -50,6 +51,9 @@ class TraceState:
         self.last_step_exit: Optional[float] = None
         # called with the step number after each flush (max-steps lifecycle)
         self.on_step_flushed: List[Callable[[int], None]] = []
+        # called with the StepTimeBatch after each non-empty flush
+        # (ICI telemetry hook and other batch observers)
+        self.on_batch_flushed: List[Callable[[StepTimeBatch], None]] = []
 
     # -- step lifecycle ------------------------------------------------
     def begin_step(self) -> int:
@@ -83,6 +87,11 @@ class TraceState:
         batch = self.buffer.flush(step)
         if batch is not None:
             GLOBAL_STEP_QUEUE.put(batch)
+            for cb in list(self.on_batch_flushed):
+                try:
+                    cb(batch)
+                except Exception:
+                    pass
         for cb in list(self.on_step_flushed):
             try:
                 cb(step)

@@ -15,6 +15,7 @@ from traceml_tpu.sdk.state import TraceState, get_state
 from traceml_tpu.utils.marker_resolver import get_marker_resolver
 from traceml_tpu.utils.timing import (
     BACKWARD_TIME,
+    COLLECTIVE_TIME,
     FORWARD_TIME,
     H2D_TIME,
     OPTIMIZER_STEP,
@@ -43,8 +44,16 @@ def _timed_call(
             if mark_output:
                 tr.mark(out)
         ev = region.event
-        if ev.marker is not None and not ev.marker.resolved:
-            get_marker_resolver().submit(ev.marker)
+        if ev.marker is not None:
+            # last dispatch wins: the step envelope's device end must be
+            # the readiness of the LAST dispatched phase, or a post-
+            # compute collective/h2d would fall outside the envelope and
+            # get clamped away by the window builder
+            env = st.active_step_event
+            if tls.in_step and env is not None:
+                env.marker = ev.marker
+            if not ev.marker.resolved:
+                get_marker_resolver().submit(ev.marker)
         return out
     finally:
         setattr(tls, depth_attr, depth)
@@ -96,6 +105,32 @@ def wrap_optimizer(optimizer: Any, state: Optional[TraceState] = None) -> Any:
     optimizer.step = step
     optimizer._traceml_wrapped = True
     return optimizer
+
+
+def wrap_collective(fn: Callable, state: Optional[TraceState] = None) -> Callable:
+    """Time an explicit collective (gradient sync, all-gather, psum
+    dispatched OUTSIDE the fused step) as the first-class ``collective``
+    phase.
+
+    Inside one fused ``wrap_step_fn`` program the collectives are part of
+    ``compute`` — XLA schedules them and there is no host-visible
+    boundary.  This wrapper is for the loops that DO dispatch them
+    separately: manual pipeline schedules, ring-attention hops driven
+    from the host, parameter syncs between microbatch groups, or the
+    torch-xla path (where ``patch_mark_step`` emits this phase
+    automatically).  Feeds COLLECTIVE_STRAGGLER attribution
+    (diagnostics/step_time/rules.py).
+    """
+    st = state or get_state()
+
+    @functools.wraps(fn)
+    def wrapped(*args: Any, **kwargs: Any):
+        return _timed_call(
+            COLLECTIVE_TIME, "collective_depth", fn, st, True, *args, **kwargs
+        )
+
+    wrapped._traceml_wrapped = True  # type: ignore[attr-defined]
+    return wrapped
 
 
 def wrap_h2d(value: Any, device: Any = None, state: Optional[TraceState] = None) -> Any:
