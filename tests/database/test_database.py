@@ -49,6 +49,62 @@ def test_incremental_sender_ships_only_new():
     assert p2["body"]["tables"]["other"] == [{"x": 1}]
 
 
+def test_incremental_sender_cursor_sequence_with_eviction():
+    """Cursor sequence battery (reference: sender-cursor sequence tests):
+    interleaved appends, eviction between collections, and cursor
+    monotonicity — the sender must never re-ship or skip silently except
+    when rows were evicted before collection."""
+    db = Database(max_rows_per_table=4)
+    sender = DBIncrementalSender("system", db)
+    sender.set_identity(SenderIdentity(session_id="s", global_rank=0))
+
+    db.add_records("t", [{"i": 0}, {"i": 1}])
+    assert [r["i"] for r in sender.collect_payload()["body"]["tables"]["t"]] == [0, 1]
+
+    # burst past the retention bound between ticks: rows 2..8 appended,
+    # only the newest 4 retained — the sender ships what survived
+    db.add_records("t", [{"i": i} for i in range(2, 9)])
+    got = [r["i"] for r in sender.collect_payload()["body"]["tables"]["t"]]
+    assert got == [5, 6, 7, 8]
+
+    # cursor is at the append head now: silence means None, repeatedly
+    assert sender.collect_payload() is None
+    assert sender.collect_payload() is None
+
+    # resumes cleanly after silence
+    db.add_record("t", {"i": 9})
+    assert [r["i"] for r in sender.collect_payload()["body"]["tables"]["t"]] == [9]
+
+
+def test_incremental_sender_multi_table_independent_cursors():
+    db = Database()
+    sender = DBIncrementalSender("s", db)
+    sender.set_identity(SenderIdentity(session_id="s", global_rank=0))
+    db.add_record("a", {"i": 0})
+    p = sender.collect_payload()
+    assert set(p["body"]["tables"]) == {"a"}
+    db.add_record("b", {"j": 0})
+    p = sender.collect_payload()
+    assert set(p["body"]["tables"]) == {"b"}  # table a's cursor untouched
+    db.add_record("a", {"i": 1})
+    db.add_record("b", {"j": 1})
+    p = sender.collect_payload()
+    assert [r["i"] for r in p["body"]["tables"]["a"]] == [1]
+    assert [r["j"] for r in p["body"]["tables"]["b"]] == [1]
+
+
+def test_incremental_sender_reset_reships_retained_rows():
+    db = Database(max_rows_per_table=4)
+    sender = DBIncrementalSender("s", db)
+    sender.set_identity(SenderIdentity(session_id="s", global_rank=0))
+    db.add_records("t", [{"i": i} for i in range(6)])
+    sender.collect_payload()
+    assert sender.collect_payload() is None
+    sender.reset()  # reconnect semantics: replay what's still retained
+    got = [r["i"] for r in sender.collect_payload()["body"]["tables"]["t"]]
+    assert got == [2, 3, 4, 5]
+
+
 def test_disk_writer_roundtrip(tmp_path):
     db = Database()
     w = DatabaseWriter("s", db, tmp_path, flush_every=1)

@@ -33,12 +33,25 @@ class _Trainer:
     sanity_checking = False
 
 
+class _Loss:
+    """Loss stand-in with a readiness probe (device-marker carrier)."""
+
+    size = 1
+
+    def is_ready(self):
+        return True
+
+
 def _drive_one_batch(cb, trainer):
+    """REAL Lightning automatic-optimization hook order:
+    batch_start → training_step → before_zero_grad → zero_grad →
+    before_backward → backward → after_backward →
+    before_optimizer_step → step → batch_end."""
     cb.on_train_batch_start(trainer, None, batch=None, batch_idx=0)
-    cb.on_before_backward(trainer, None, loss=object())
+    cb.on_before_zero_grad(trainer, None, optimizer=None)  # BEFORE backward!
+    cb.on_before_backward(trainer, None, loss=_Loss())
     cb.on_after_backward(trainer, None)
     cb.on_before_optimizer_step(trainer, None, optimizer=None)
-    cb.on_before_zero_grad(trainer, None, optimizer=None)
     cb.on_train_batch_end(trainer, None, outputs=None, batch=None, batch_idx=0)
 
 
@@ -52,18 +65,45 @@ def test_lightning_callback_owns_phase_timing(stub_lightning):
     try:
         trainer = _Trainer()
         _drive_one_batch(cb, trainer)
-        names = [e.name for e in captured[-1].events]
+        events = captured[-1].events
+        names = [e.name for e in events]
         assert T.FORWARD_TIME in names
         assert T.BACKWARD_TIME in names
         assert T.OPTIMIZER_STEP in names
         assert T.STEP_TIME in names
-        # phases are ordered: forward closed before backward opened
-        fwd = next(e for e in captured[-1].events if e.name == T.FORWARD_TIME)
-        bwd = next(e for e in captured[-1].events if e.name == T.BACKWARD_TIME)
+        # the early zero_grad must NOT have closed forward — forward ends
+        # at before_backward and carries the loss device probe
+        fwd = next(e for e in events if e.name == T.FORWARD_TIME)
+        bwd = next(e for e in events if e.name == T.BACKWARD_TIME)
+        assert fwd.marker is not None  # loss probe attached
         assert fwd.cpu_end <= bwd.cpu_start
         # duplicate-guard depths restored after the batch
         assert st.tls.forward_depth == 0
         assert st.tls.backward_depth == 0
+    finally:
+        st.on_batch_flushed.remove(captured.append)
+        cb.teardown(trainer, None)
+
+
+def test_lightning_manual_optimization_order(stub_lightning):
+    """Manual-optimization order (zero_grad AFTER step) also maps cleanly."""
+    from traceml_tpu.sdk.state import get_state
+
+    cb = stub_lightning.TraceMLCallback(auto_init=False)
+    st = get_state()
+    captured = []
+    st.on_batch_flushed.append(captured.append)
+    try:
+        trainer = _Trainer()
+        cb.on_train_batch_start(trainer, None, batch=None, batch_idx=0)
+        cb.on_before_backward(trainer, None, loss=_Loss())
+        cb.on_after_backward(trainer, None)
+        cb.on_before_optimizer_step(trainer, None, optimizer=None)
+        cb.on_before_zero_grad(trainer, None, optimizer=None)  # closes optimizer
+        cb.on_train_batch_end(trainer, None, outputs=None, batch=None, batch_idx=0)
+        events = captured[-1].events
+        opt = next(e for e in events if e.name == T.OPTIMIZER_STEP)
+        assert opt.cpu_end is not None
     finally:
         st.on_batch_flushed.remove(captured.append)
         cb.teardown(trainer, None)
@@ -237,6 +277,31 @@ import optax
     assert "buffer_donation" in info["uses"]
     assert "single_worker_dataloader" in info["input_hints"]
     assert len(info["local_modules"]) == 3
+
+
+def test_analyze_project_relative_imports(tmp_path):
+    from traceml_tpu.launcher.ast_scan import analyze_project
+
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    _write(pkg / "__init__.py", "")
+    _write(pkg / "model.py", """
+from . import layers
+from .sharding import mesh_rules
+""")
+    _write(pkg / "layers.py", """
+from torch.nn.parallel import DistributedDataParallel
+""")
+    _write(pkg / "sharding.py", """
+from jax.sharding import Mesh, PartitionSpec
+def mesh_rules(): ...
+""")
+    entry = _write(tmp_path / "train.py", "from pkg.model import build\n")
+    info = analyze_project(entry)
+    scanned = {p.rsplit("/", 1)[-1] for p in info["local_modules"]}
+    assert {"model.py", "layers.py", "sharding.py"} <= scanned
+    assert "gspmd" in info["parallelism_hints"]  # from pkg/sharding.py
+    assert "ddp" in info["parallelism_hints"]    # from pkg/layers.py
 
 
 def test_analyze_project_bounded(tmp_path):

@@ -78,6 +78,43 @@ def test_summary_input_straggler_detected(tmp_path):
     assert "rank 2" in primary["summary"].lower()
 
 
+def test_summary_section_depth_fields(tmp_path):
+    """The per-rank cards, occupancy, steady-state, and rollups the
+    round-2 section build-out added (SCHEMA.md)."""
+    db = tmp_path / "telemetry.sqlite"
+    _inject(db, n_ranks=2)
+    settings = TraceMLSettings(session_id="s1", logs_dir=tmp_path, mode="summary")
+    assert generate_summary(db, tmp_path, settings)
+    payload = json.loads((tmp_path / "final_summary.json").read_text())
+
+    g = payload["sections"]["step_time"]["global"]
+    # occupancy: device step == host step in the fixture → ~1.0
+    assert g["median_occupancy"] == 1.0
+    assert g["occupancy_by_rank"]["0"] == 1.0
+    # steady-state split present for a 60-step window
+    steady = g["steady_state"]
+    assert steady["warmup_steps_excluded"] == 15
+    assert steady["median_ms"] == 100.0
+    # per-rank cards carry phase averages + occupancy
+    card = g["per_rank"]["1"]
+    assert card["steps_seen"] == 60
+    assert card["occupancy"] == 1.0
+    assert card["avg_ms"]["step_time"] == 100.0
+
+    sm = payload["sections"]["step_memory"]["global"]
+    rank0 = sm["per_rank"]["0"]
+    assert rank0["pressure"] == (5 << 30) / (16 << 30)
+    assert rank0["growth_bytes"] == 0
+    assert sm["rollup"]["max_peak_bytes"] == 5 << 30
+    assert sm["rollup"]["total_current_bytes"] == 2 * (4 << 30)
+
+    # text render surfaces the new aggregates
+    text = (tmp_path / "final_summary.txt").read_text()
+    assert "chip busy 100.0%" in text
+    assert "steady-state median" in text
+    assert "pressure" in text
+
+
 def test_summary_no_db(tmp_path):
     settings = TraceMLSettings(session_id="s1", logs_dir=tmp_path, mode="summary")
     assert generate_summary(tmp_path / "missing.sqlite", tmp_path, settings)

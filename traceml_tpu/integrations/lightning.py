@@ -8,17 +8,24 @@ Gated: lightning / pytorch_lightning are not in this image; the callback
 is constructed dynamically against whichever base is importable
 (reference does the same dynamic multi-base dance, lightning.py:30-90).
 
-Phase mapping (Lightning hooks → TraceML regions):
+Phase mapping (Lightning hooks → TraceML regions).  Region transitions
+are PHASE-AWARE, not positional, because Lightning's automatic-
+optimization hook order interleaves zero_grad BEFORE backward
+(batch_start → training_step → before_zero_grad → before_backward →
+backward → after_backward → before_optimizer_step → step → batch_end),
+while manual optimization fires them in other orders:
 
 * ``on_train_batch_start``      → close previous step, open ``trace_step``
   and the ``forward`` region (Lightning gives no pre-forward hook, so
   forward runs from batch start to just before backward — the reference
   uses the same bracketing)
-* ``on_before_backward``        → close ``forward`` (mark the loss as the
-  device probe), open ``backward``
-* ``on_after_backward``         → close ``backward``
+* ``on_before_backward``        → close ``forward`` if open (mark the
+  loss as the device probe), open ``backward``
+* ``on_after_backward``         → close ``backward`` if open
 * ``on_before_optimizer_step``  → open ``optimizer``
-* ``on_before_zero_grad``       → close ``optimizer``
+* ``on_before_zero_grad``       → close ``optimizer`` ONLY if the
+  optimizer region is the open one (under automatic optimization this
+  hook fires while ``forward`` is still open — it must not close it)
 * ``on_train_batch_end``        → close any open region + the step
 * sanity-check / validation batches are never timed.
 """
@@ -30,8 +37,8 @@ from typing import Any, Optional
 from traceml_tpu.sdk.initial import init as traceml_init
 from traceml_tpu.sdk.instrumentation import trace_step
 from traceml_tpu.sdk.state import get_state
+from traceml_tpu.sdk.wrappers import publish_region_marker
 from traceml_tpu.utils.error_log import get_error_log
-from traceml_tpu.utils.marker_resolver import get_marker_resolver
 from traceml_tpu.utils.timing import (
     BACKWARD_TIME,
     FORWARD_TIME,
@@ -75,6 +82,7 @@ def make_traceml_callback() -> Any:
             super().__init__()
             self._step_ctx: Optional[trace_step] = None
             self._region: Optional[timed_region] = None
+            self._region_phase: Optional[str] = None
             self._auto_init = auto_init
             self._own_depth = False
 
@@ -103,26 +111,25 @@ def make_traceml_callback() -> Any:
                     phase, st.current_step, sink=st.buffer.add
                 )
                 self._region.__enter__()
+                self._region_phase = phase
             except Exception as exc:
                 get_error_log().warning("lightning region open failed", exc)
                 self._region = None
+                self._region_phase = None
 
-        def _close_region(self, mark: Any = None) -> None:
+        def _close_region(self, mark: Any = None, only_phase: Optional[str] = None) -> None:
             region = self._region
-            self._region = None
             if region is None:
                 return
+            if only_phase is not None and self._region_phase != only_phase:
+                return  # a different phase is open — not ours to close
+            self._region = None
+            self._region_phase = None
             try:
                 if mark is not None:
                     region.mark(mark)
                 region.__exit__(None, None, None)
-                ev = region.event
-                if ev.marker is not None:
-                    env = get_state().active_step_event
-                    if env is not None:  # last dispatch wins (envelope end)
-                        env.marker = ev.marker
-                    if not ev.marker.resolved:
-                        get_marker_resolver().submit(ev.marker)
+                publish_region_marker(region.event, get_state())
             except Exception as exc:
                 get_error_log().warning("lightning region close failed", exc)
 
@@ -164,13 +171,16 @@ def make_traceml_callback() -> Any:
         def on_before_backward(self, trainer: Any, pl_module: Any, loss: Any) -> None:
             if self._step_ctx is None:
                 return
-            self._close_region(mark=loss)  # forward ends; loss = device probe
+            # forward ends here (whatever hooks fired in between);
+            # the loss is the device probe
+            self._close_region(mark=loss, only_phase=FORWARD_TIME)
+            self._close_region()  # any other leftover region
             self._open(BACKWARD_TIME)
 
         def on_after_backward(self, trainer: Any, pl_module: Any) -> None:
             if self._step_ctx is None:
                 return
-            self._close_region()
+            self._close_region(only_phase=BACKWARD_TIME)
 
         def on_before_optimizer_step(
             self, trainer: Any, pl_module: Any, optimizer: Any
@@ -184,7 +194,10 @@ def make_traceml_callback() -> Any:
         ) -> None:
             if self._step_ctx is None:
                 return
-            self._close_region()
+            # under automatic optimization this fires BEFORE backward,
+            # while the forward region is still open — only close the
+            # optimizer region (manual/legacy orders), never forward
+            self._close_region(only_phase=OPTIMIZER_STEP)
 
         def on_train_batch_end(
             self, trainer: Any, pl_module: Any, outputs: Any, batch: Any, batch_idx: int

@@ -94,8 +94,23 @@ class AggregatorActorImpl:
 def actor_name_for(settings: TraceMLSettings) -> str:
     """Session-scoped actor name: concurrent jobs on one cluster must
     not cross-wire into each other's aggregator, and a finished job's
-    stale actor must never be mistaken for a fresh one."""
-    return f"{ACTOR_NAME}_{settings.session_id}"
+    stale actor must never be mistaken for a fresh one.
+
+    When the session id is the unconfigured default ('local'), scope by
+    the Ray job id instead — all workers of one Ray job share it and
+    distinct jobs never do, so two default-config jobs on one cluster
+    stay isolated."""
+    session = settings.session_id
+    if session == "local":
+        try:
+            import ray
+
+            job = ray.get_runtime_context().get_job_id()
+            if job:
+                session = f"local_{job}"
+        except Exception:
+            pass
+    return f"{ACTOR_NAME}_{session}"
 
 
 def start_actor_aggregator(
@@ -158,7 +173,15 @@ def traceml_train_loop(
         name = actor_name_for(base)
         try:
             if rank == 0 and not base.aggregator.port:
-                actor = start_actor_aggregator(base, name=name)
+                try:
+                    # telemetry must NEVER abort training: actor-creation
+                    # failure degrades to a no-telemetry run
+                    actor = start_actor_aggregator(base, name=name)
+                except Exception as exc:
+                    get_error_log().warning(
+                        "ray aggregator actor creation failed", exc
+                    )
+                    actor = None
             if not run_settings.aggregator.port:
                 endpoint = resolve_actor_endpoint(ray, name=name)
                 if endpoint and endpoint.get("port"):

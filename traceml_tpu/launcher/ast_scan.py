@@ -25,6 +25,9 @@ class _ScriptVisitor(ast.NodeVisitor):
     def __init__(self) -> None:
         self.imports: Set[str] = set()        # top-level names
         self.import_modules: Set[str] = set()  # full dotted module names
+        # (level, module-or-"", names) for `from . import x` forms —
+        # resolved against the IMPORTING file's package, not the entry
+        self.relative_imports: List[tuple] = []
         self.calls: List[str] = []
         self.attrs: List[str] = []
         # call name → list of per-call {kwarg: literal value} (a script
@@ -48,14 +51,18 @@ class _ScriptVisitor(ast.NodeVisitor):
             self.import_modules.add(a.name)
 
     def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
-        if node.module:
+        if node.level and node.level > 0:
+            self.relative_imports.append(
+                (node.level, node.module or "", [a.name for a in node.names])
+            )
+        elif node.module:
             self.imports.add(node.module.split(".")[0])
             self.import_modules.add(node.module)
         for a in node.names:
             # imported symbol names carry parallelism signals
             # (Mesh, PartitionSpec, shard_map, …)
             self.attrs.append(a.name)
-            if node.module:
+            if node.module and not node.level:
                 self.import_modules.add(f"{node.module}.{a.name}")
 
     def visit_Call(self, node: ast.Call) -> None:
@@ -330,6 +337,20 @@ def analyze_project(script: Path, max_modules: int = _MAX_MODULES) -> Dict[str, 
             local = _resolve_local(module, roots)
             if local is not None and local not in seen:
                 queue.append(local)
+        # relative imports resolve against THIS file's package, walking
+        # one directory up per extra leading dot
+        for level, module, names in v.relative_imports:
+            base = path.parent
+            for _ in range(level - 1):
+                base = base.parent
+            candidates = [module] if module else []
+            candidates += (
+                [f"{module}.{n}" for n in names] if module else list(names)
+            )
+            for mod in candidates:
+                local = _resolve_local(mod, [base])
+                if local is not None and local not in seen:
+                    queue.append(local)
     out["modules_scanned"] = len(scanned)
     out["local_modules"] = [str(p) for p in scanned if Path(p) != entry]
     if failed:

@@ -170,6 +170,9 @@ class IciTelemetryHook:
     def _on_step(self, step: int) -> None:
         if step % self.every_n_steps != 0:
             return
+        # vector construction failures degrade to zeros so aggregate()
+        # ALWAYS runs — a rank skipping the collective while its peers
+        # block inside all_gather would hang the whole job
         try:
             from traceml_tpu.utils.marker_resolver import get_marker_resolver
 
@@ -179,6 +182,10 @@ class IciTelemetryHook:
                 vec = batch_to_stat_vector(batch)
             else:  # empty flush on this rank: contribute zeros, keep
                 vec = StatVector({"step": float(step)})  # the collective aligned
+        except Exception as exc:
+            get_error_log().warning("ici stat vector build failed", exc)
+            vec = StatVector({"step": float(step)})
+        try:
             matrix = self._agg.aggregate(vec)
             self.ingest_matrix(matrix)
         except Exception as exc:  # never raises into training

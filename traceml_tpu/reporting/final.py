@@ -96,12 +96,54 @@ def _build_step_time_section(db_path: Path, mode: str):
             str(r): [round(v, 3) for v in w.series[STEP_KEY][-tail:]]
             for r, w in window.rank_windows.items()
         }
+        # warmup vs steady-state split: the first quarter of the window
+        # carries compile/cache-warm effects; steady-state medians are
+        # the number a capacity plan should use (reference concept: the
+        # report's warmup-excluded aggregates)
+        steady: Dict[str, Any] = {}
+        if window.n_steps >= 12:
+            cut = max(3, window.n_steps // 4)
+            per_rank_steady = {}
+            for r, w in window.rank_windows.items():
+                vals = w.series[STEP_KEY][cut:]
+                if vals:
+                    per_rank_steady[str(r)] = statistics.median(vals)
+            if per_rank_steady:
+                overall = statistics.median(per_rank_steady.values())
+                step_m = window.metric(STEP_KEY)
+                steady = {
+                    "warmup_steps_excluded": cut,
+                    "median_ms": overall,
+                    "per_rank_median_ms": per_rank_steady,
+                    "warmup_inflation_pct": (
+                        (step_m.median_ms - overall) / overall
+                        if overall > 0
+                        else None
+                    ),
+                }
+        # per-rank cards: the per-rank group view the renderers and
+        # compare consume (reference: per-rank groups in sections)
+        rank_cards = {
+            str(r): {
+                "avg_ms": {k: round(v, 4) for k, v in w.averages.items()},
+                "occupancy": w.occupancy,
+                "steps_seen": len(w.steps),
+            }
+            for r, w in window.rank_windows.items()
+        }
         section["global"] = {
             "clock": window.clock,
             "n_steps": window.n_steps,
             "step_range": [window.steps[0], window.steps[-1]],
             "ranks": window.ranks,
             "phases": phases,
+            "occupancy_by_rank": {
+                str(r): round(v, 4)
+                for r, v in window.occupancy_by_rank.items()
+            },
+            "median_occupancy": window.median_occupancy,
+            "steady_state": steady or None,
+            "per_rank": rank_cards,
             "step_series_ms": series,
             "step_series_steps": window.steps[-tail:],
         }
@@ -113,27 +155,56 @@ def _build_step_memory_section(db_path: Path):
     if not rank_rows:
         return _no_data_section("step_memory"), None
     result = diagnose_memory(rank_rows)
+    from traceml_tpu.analytics.trends.core import compute_window_trend
+
     per_rank = {}
     for rank, rows in rank_rows.items():
         if not rows:
             continue
         last = rows[-1]
         series = [r.get("current_bytes") or 0 for r in rows]
+        peak = max((r.get("step_peak_bytes") or 0 for r in rows), default=0)
+        limit = last.get("limit_bytes")
+        first_cur = next((v for v in series if v), None)
+        trend = compute_window_trend(series) if len(series) >= 8 else None
         per_rank[str(rank)] = {
             "devices": sorted({int(r.get("device_id") or 0) for r in rows}),
             "current_bytes": last.get("current_bytes"),
-            "step_peak_bytes": max(
-                (r.get("step_peak_bytes") or 0 for r in rows), default=0
-            ),
-            "limit_bytes": last.get("limit_bytes"),
+            "step_peak_bytes": peak,
+            "limit_bytes": limit,
+            "pressure": (peak / limit) if peak and limit else None,
             "mean_bytes": int(statistics.mean(series)) if series else 0,
+            "growth_bytes": (
+                (last.get("current_bytes") or 0) - first_cur
+                if first_cur is not None
+                else None
+            ),
+            "trend": {
+                "trend_pct": trend.trend_pct,
+                "slope_pct_per_100": trend.slope_pct_per_100,
+                "recovered": trend.recovered,
+            }
+            if trend
+            else None,
             "n_rows": len(rows),
         }
+    peaks = [v["step_peak_bytes"] for v in per_rank.values() if v["step_peak_bytes"]]
+    rollup = {
+        "total_current_bytes": sum(
+            v["current_bytes"] or 0 for v in per_rank.values()
+        ),
+        "max_peak_bytes": max(peaks, default=0),
+        "peak_skew_pct": (
+            (max(peaks) - statistics.median(peaks)) / statistics.median(peaks)
+            if len(peaks) > 1 and statistics.median(peaks) > 0
+            else None
+        ),
+    }
     section = {
         "status": "OK",
         "diagnosis": result.diagnosis.to_dict(),
         "issues": [i.to_dict() for i in result.issues],
-        "global": {"per_rank": per_rank},
+        "global": {"per_rank": per_rank, "rollup": rollup},
         "units": {"memory": "bytes"},
     }
     return section, result
@@ -150,12 +221,15 @@ def _build_system_section(db_path: Path):
             continue
         last = rows[-1]
         cpu_vals = [r["cpu_pct"] for r in rows if r.get("cpu_pct") is not None]
+        used, total = last.get("memory_used_bytes"), last.get("memory_total_bytes")
         nodes[str(node)] = {
             "hostname": last.get("hostname"),
             "cpu_pct_mean": statistics.mean(cpu_vals) if cpu_vals else None,
             "cpu_pct_max": max(cpu_vals) if cpu_vals else None,
-            "memory_used_bytes": last.get("memory_used_bytes"),
-            "memory_total_bytes": last.get("memory_total_bytes"),
+            "memory_used_bytes": used,
+            "memory_total_bytes": total,
+            "memory_pct": (used / total * 100.0) if used and total else None,
+            "load_1m": last.get("load_1m"),
             "n_samples": len(rows),
         }
     chips = {}
@@ -163,17 +237,39 @@ def _build_system_section(db_path: Path):
         if not rows:
             continue
         last = rows[-1]
+        util_vals = [
+            r["utilization_pct"] for r in rows if r.get("utilization_pct") is not None
+        ]
         chips[f"{node}:{dev}"] = {
             "device_kind": last.get("device_kind"),
             "memory_used_bytes": last.get("memory_used_bytes"),
             "memory_peak_bytes": last.get("memory_peak_bytes"),
             "memory_total_bytes": last.get("memory_total_bytes"),
+            "utilization_pct_mean": statistics.mean(util_vals) if util_vals else None,
+            "temperature_c": last.get("temperature_c"),
+            "power_w": last.get("power_w"),
         }
+    global_block: Dict[str, Any] = {"nodes": nodes, "devices": chips}
+    if len(nodes) > 1:
+        cpu_means = {
+            n: v["cpu_pct_mean"]
+            for n, v in nodes.items()
+            if v["cpu_pct_mean"] is not None
+        }
+        if cpu_means:
+            worst = max(cpu_means, key=lambda n: cpu_means[n])
+            global_block["cluster"] = {
+                "n_nodes": len(nodes),
+                "cpu_pct_min": min(cpu_means.values()),
+                "cpu_pct_median": statistics.median(cpu_means.values()),
+                "cpu_pct_max": cpu_means[worst],
+                "busiest_node": nodes[worst].get("hostname"),
+            }
     section = {
         "status": "OK",
         "diagnosis": result.diagnosis.to_dict(),
         "issues": [i.to_dict() for i in result.issues],
-        "global": {"nodes": nodes, "devices": chips},
+        "global": global_block,
         "units": {"memory": "bytes", "cpu": "%"},
     }
     return section, result
@@ -189,17 +285,33 @@ def _build_process_section(db_path: Path):
         if not rows:
             continue
         last = rows[-1]
+        cpu_vals = [r["cpu_pct"] for r in rows if r.get("cpu_pct") is not None]
+        rss_vals = [r["rss_bytes"] for r in rows if r.get("rss_bytes") is not None]
         per_rank[str(rank)] = {
             "pid": last.get("pid"),
+            "hostname": last.get("hostname"),
             "rss_bytes": last.get("rss_bytes"),
+            "rss_peak_bytes": max(rss_vals) if rss_vals else None,
             "cpu_pct": last.get("cpu_pct"),
+            "cpu_pct_mean": statistics.mean(cpu_vals) if cpu_vals else None,
+            "cpu_pct_max": max(cpu_vals) if cpu_vals else None,
             "num_threads": last.get("num_threads"),
+            "n_samples": len(rows),
         }
+    with_cpu = {
+        r: v["cpu_pct_mean"] for r, v in per_rank.items() if v["cpu_pct_mean"]
+    }
+    rollup = {
+        "total_rss_bytes": sum(v["rss_bytes"] or 0 for v in per_rank.values()),
+        "busiest_rank": max(with_cpu, key=lambda r: with_cpu[r])
+        if with_cpu
+        else None,
+    }
     section = {
         "status": "OK",
         "diagnosis": result.diagnosis.to_dict(),
         "issues": [i.to_dict() for i in result.issues],
-        "global": {"per_rank": per_rank},
+        "global": {"per_rank": per_rank, "rollup": rollup},
         "units": {"memory": "bytes", "cpu": "%"},
     }
     return section, result
@@ -238,16 +350,27 @@ def render_text_summary(payload: Dict[str, Any]) -> str:
     g = st.get("global") or {}
     phases = g.get("phases") or {}
     if phases:
-        out.append(
+        header = (
             f"Step time ({g.get('clock')} clock, {g.get('n_steps')} steps, "
-            f"steps {g.get('step_range', ['?', '?'])[0]}–{g.get('step_range', ['?', '?'])[1]}):"
+            f"steps {g.get('step_range', ['?', '?'])[0]}–{g.get('step_range', ['?', '?'])[1]}"
         )
+        occ = g.get("median_occupancy")
+        if occ is not None:
+            header += f", chip busy {fmt_pct(occ)}"
+        out.append(header + "):")
         step = phases.get(STEP_KEY, {})
         out.append(
             f"  step: median {fmt_ms(step.get('median_ms'))}  "
             f"worst {fmt_ms(step.get('worst_ms'))} (rank {step.get('worst_rank')})  "
             f"skew {fmt_pct(step.get('skew_pct'))}"
         )
+        steady = g.get("steady_state") or {}
+        if steady.get("median_ms") is not None:
+            line = f"  steady-state median {fmt_ms(steady['median_ms'])}"
+            infl = steady.get("warmup_inflation_pct")
+            if infl is not None and infl > 0.02:
+                line += f"  (warmup inflated the overall median {fmt_pct(infl)})"
+            out.append(line)
         for key, p in phases.items():
             if key == STEP_KEY:
                 continue
@@ -264,11 +387,27 @@ def render_text_summary(payload: Dict[str, Any]) -> str:
     if per_rank:
         out.append("Device memory (per rank):")
         for rank, info in sorted(per_rank.items(), key=lambda kv: int(kv[0])):
-            out.append(
+            line = (
                 f"  rank {rank}: current {fmt_bytes(info.get('current_bytes'))}  "
                 f"peak {fmt_bytes(info.get('step_peak_bytes'))}  "
                 f"limit {fmt_bytes(info.get('limit_bytes'))}"
             )
+            pressure = info.get("pressure")
+            if pressure is not None:
+                line += f"  pressure {fmt_pct(pressure)}"
+            out.append(line)
+        out.append("")
+
+    cluster = ((payload.get("sections") or {}).get("system") or {}).get(
+        "global", {}
+    ).get("cluster")
+    if cluster:
+        out.append(
+            f"Cluster: {cluster['n_nodes']} nodes · host CPU "
+            f"{cluster['cpu_pct_min']:.0f}/{cluster['cpu_pct_median']:.0f}/"
+            f"{cluster['cpu_pct_max']:.0f}% (min/median/max, busiest "
+            f"{cluster.get('busiest_node')})"
+        )
         out.append("")
 
     for key in ("system", "process", "step_memory", "step_time"):

@@ -43,20 +43,25 @@ def _timed_call(
             out = fn(*args, **kwargs)
             if mark_output:
                 tr.mark(out)
-        ev = region.event
-        if ev.marker is not None:
-            # last dispatch wins: the step envelope's device end must be
-            # the readiness of the LAST dispatched phase, or a post-
-            # compute collective/h2d would fall outside the envelope and
-            # get clamped away by the window builder
-            env = st.active_step_event
-            if tls.in_step and env is not None:
-                env.marker = ev.marker
-            if not ev.marker.resolved:
-                get_marker_resolver().submit(ev.marker)
+        publish_region_marker(region.event, st)
         return out
     finally:
         setattr(tls, depth_attr, depth)
+
+
+def publish_region_marker(ev, st: TraceState) -> None:
+    """Post-close marker publication, shared by every phase owner
+    (manual wrappers here, the Lightning callback): hand the marker to
+    the open step envelope — last dispatch wins, or a post-compute
+    collective/h2d would fall outside the envelope and get clamped away
+    by the window builder — and submit it for background resolution."""
+    if ev.marker is None:
+        return
+    env = st.active_step_event
+    if st.tls.in_step and env is not None:
+        env.marker = ev.marker
+    if not ev.marker.resolved:
+        get_marker_resolver().submit(ev.marker)
 
 
 def wrap_forward(fn: Callable, state: Optional[TraceState] = None) -> Callable:
