@@ -1,0 +1,133 @@
+"""Expert parallelism (MoE) + pipeline parallelism over the virtual
+8-device mesh — the ep/pp axes of the tp/pp/dp/sp/ep mandate."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from traceml_tpu.models.moe import (
+    MoEBlock,
+    init_expert_parallel,
+    make_moe_train_step,
+    moe_param_shardings,
+)
+from traceml_tpu.parallel.mesh import make_mesh
+from traceml_tpu.parallel.pipeline import (
+    init_linear_stages,
+    linear_stage_apply,
+    make_pipeline_fn,
+    make_pipeline_train_step,
+    stack_stage_params,
+    stage_param_shardings,
+)
+
+
+def _need(n):
+    if len(jax.devices()) < n:
+        pytest.skip(f"needs {n} virtual devices")
+
+
+# --------------------------------------------------------------------------
+# MoE / expert parallelism
+# --------------------------------------------------------------------------
+
+def test_moe_forward_and_aux():
+    model = MoEBlock(n_experts=4, hidden=16, ffn_hidden=32)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 16))
+    params = model.init(jax.random.PRNGKey(1), x)["params"]
+    out, aux = model.apply({"params": params}, x)
+    assert out.shape == x.shape
+    # aux ≥ 1 with equality iff routing is perfectly uniform
+    assert float(aux) >= 1.0 - 1e-5
+
+
+def test_moe_expert_sharding_specs():
+    _need(8)
+    mesh = make_mesh({"expert": 4, "fsdp": 2})
+    model = MoEBlock(n_experts=4, hidden=16, ffn_hidden=32)
+    placed = init_expert_parallel(model, mesh)
+    ffn = placed["params"]["MoEFFN_0"]
+    spec = placed["shardings"]["MoEFFN_0"]["w_in"].spec
+    assert spec[0] == "expert"  # expert dim sharded over the expert axis
+    # each leaf is actually placed with its sharding
+    w_in = ffn["w_in"]
+    assert w_in.sharding.spec[0] == "expert"
+    # local shard holds n_experts / |expert| experts
+    shard = w_in.addressable_shards[0]
+    assert shard.data.shape[0] == 1  # 4 experts / 4-way expert axis
+
+
+def test_moe_expert_parallel_training_step():
+    _need(8)
+    mesh = make_mesh({"expert": 4, "fsdp": 2})
+    model = MoEBlock(n_experts=4, hidden=16, ffn_hidden=32)
+    init, train_step = make_moe_train_step(model)
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(rng, (4, 8, 16))
+    y = jnp.roll(x, 1, axis=-1)
+    params, opt_state = init(rng, x)
+    shardings = moe_param_shardings(params, mesh)
+    params = jax.tree_util.tree_map(jax.device_put, params, shardings)
+    step = jax.jit(train_step)
+    losses = []
+    with mesh:
+        for _ in range(8):
+            params, opt_state, metrics = step(params, opt_state, x, y)
+            losses.append(float(metrics["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]  # it learns
+    # params stay expert-sharded through the jitted update
+    assert params["MoEFFN_0"]["w_in"].sharding.spec[0] == "expert"
+
+
+# --------------------------------------------------------------------------
+# pipeline parallelism
+# --------------------------------------------------------------------------
+
+def test_pipeline_matches_sequential():
+    _need(8)
+    mesh = make_mesh({"stage": 4}, devices=jax.devices()[:4])
+    stages = init_linear_stages(4, width=8, rng=jax.random.PRNGKey(0))
+    stacked = stack_stage_params(stages)
+    stacked = jax.tree_util.tree_map(
+        jax.device_put, stacked, stage_param_shardings(stacked, mesh)
+    )
+    n_micro = 6
+    x = jax.random.normal(jax.random.PRNGKey(1), (n_micro, 4, 8))
+    pipeline_fn = make_pipeline_fn(linear_stage_apply, mesh, n_micro)
+    with mesh:
+        out = jax.jit(pipeline_fn)(stacked, x)
+    # sequential reference: stage0 → stage1 → stage2 → stage3
+    ref = x
+    for p in stages:
+        ref = jnp.tanh(ref @ p["w"] + p["b"])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+
+def test_pipeline_train_step_learns():
+    _need(8)
+    mesh = make_mesh({"stage": 4}, devices=jax.devices()[:4])
+    stages = init_linear_stages(4, width=8, rng=jax.random.PRNGKey(0))
+    stacked = stack_stage_params(stages)
+    stacked = jax.tree_util.tree_map(
+        jax.device_put, stacked, stage_param_shardings(stacked, mesh)
+    )
+    n_micro = 4
+    init, train_step = make_pipeline_train_step(
+        linear_stage_apply, mesh, n_micro, learning_rate=0.1
+    )
+    opt_state = init(stacked)
+    rng = jax.random.PRNGKey(2)
+    x = jax.random.normal(rng, (n_micro, 4, 8))
+    y = 0.5 * x  # learnable linear-ish target
+    step = jax.jit(train_step)
+    losses = []
+    with mesh:
+        for _ in range(20):
+            stacked, opt_state, metrics = step(stacked, opt_state, x, y)
+            losses.append(float(metrics["loss"]))
+    assert np.isfinite(losses).all()
+    # backward flows through ppermute's transpose: strictly decreasing
+    assert all(b < a for a, b in zip(losses, losses[1:])), losses
+    assert losses[-1] < losses[0] * 0.92

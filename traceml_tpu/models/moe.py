@@ -1,0 +1,162 @@
+"""Mixture-of-Experts FFN with expert parallelism
+(SURVEY §2.13 / driver mandate: the ``ep`` axis of tp/pp/dp/sp/ep —
+the reference has no MoE; this is the TPU-native design for one).
+
+Design — dense-dispatch MoE, XLA-first:
+
+* routing and dispatch are ONE pair of einsums over a static expert
+  dimension — no top-k scatter, no capacity overflow, no dynamic
+  shapes.  Every expert sees every token, weighted by its gate
+  probability (soft-MoE style).  For the small expert counts the test
+  meshes carry this is FLOP-comparable to capacity-based dispatch and
+  maps straight onto the MXU; the point here is the SHARDING pattern,
+  which is identical to a capacity-based implementation's:
+* the expert dimension of ``w_in (E, H, F)`` / ``w_out (E, F, H)`` is
+  sharded over the mesh's ``expert`` axis.  Under GSPMD the dispatch
+  einsum partitions by expert and the combine einsum inserts the
+  reduce over the expert axis automatically — each chip computes only
+  its local experts' contributions and the partial sums ride ICI.
+* an auxiliary load-balance loss (squared-importance, the
+  switch-transformer shape: Σ_e mean_gate_e² · E, minimized by uniform
+  routing) keeps the router from collapsing onto one expert.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class MoEFFN(nn.Module):
+    """Expert-parallel feed-forward block."""
+
+    n_experts: int
+    hidden: int
+    ffn_hidden: int
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """x: (batch, seq, hidden) → (out, aux_loss)."""
+        e, h, f = self.n_experts, self.hidden, self.ffn_hidden
+        router = self.param(
+            "router", nn.initializers.normal(0.02), (h, e), jnp.float32
+        )
+        w_in = self.param(
+            "w_in", nn.initializers.normal(0.02), (e, h, f), jnp.float32
+        )
+        w_out = self.param(
+            "w_out", nn.initializers.normal(0.02), (e, f, h), jnp.float32
+        )
+        # router probabilities per token
+        gates = jax.nn.softmax(
+            jnp.einsum("bsh,he->bse", x, router), axis=-1
+        )  # (B, S, E)
+        # dense dispatch: every expert computes, gated combine reduces
+        # over the expert dim (GSPMD turns this into a psum over the
+        # 'expert' mesh axis when w_in/w_out are expert-sharded)
+        inner = jax.nn.silu(jnp.einsum("bsh,ehf->ebsf", x, w_in))
+        expert_out = jnp.einsum("ebsf,efh->ebsh", inner, w_out)
+        out = jnp.einsum("bse,ebsh->bsh", gates, expert_out)
+        # load-balance aux: squared mean gate per expert (switch-style
+        # importance loss; uniform routing minimizes it)
+        importance = gates.mean(axis=(0, 1))  # (E,)
+        aux = (importance**2).sum() * e
+        return out, aux
+
+
+class MoEBlock(nn.Module):
+    """Pre-norm residual block around the expert FFN."""
+
+    n_experts: int
+    hidden: int
+    ffn_hidden: int
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        scale = self.param("norm_scale", nn.initializers.ones, (self.hidden,))
+        normed = x * jax.lax.rsqrt(
+            jnp.mean(x * x, axis=-1, keepdims=True) + 1e-6
+        ) * scale
+        out, aux = MoEFFN(
+            n_experts=self.n_experts,
+            hidden=self.hidden,
+            ffn_hidden=self.ffn_hidden,
+        )(normed)
+        return x + out, aux
+
+
+def moe_param_shardings(params, mesh, expert_axis: str = "expert") -> Any:
+    """Expert-parallel sharding specs: expert dim over the expert axis,
+    inner dims over fsdp/tensor where they exist."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    have_fsdp = "fsdp" in mesh.axis_names
+    fsdp = "fsdp" if have_fsdp else None
+
+    def spec_for(path: Tuple[str, ...], leaf) -> Any:
+        if "w_in" in path or "w_out" in path:
+            return NamedSharding(mesh, P(expert_axis, fsdp, None))
+        if "router" in path:
+            return NamedSharding(mesh, P(fsdp, None))
+        return NamedSharding(mesh, P())
+
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    treedef = jax.tree_util.tree_structure(params)
+    specs = []
+    for path, leaf in flat:
+        keys = tuple(
+            getattr(k, "key", getattr(k, "name", str(k))) for k in path
+        )
+        specs.append(spec_for(keys, leaf))
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def make_moe_train_step(
+    model: MoEBlock, learning_rate: float = 1e-3, aux_weight: float = 0.01
+):
+    """(params, opt_state, x, y) → (params, opt_state, metrics) — simple
+    regression objective over the block, aux-loss regularized."""
+    import optax
+
+    tx = optax.adam(learning_rate)
+
+    def loss_fn(params, x, y):
+        out, aux = model.apply({"params": params}, x)
+        mse = jnp.mean((out - y) ** 2)
+        return mse + aux_weight * aux, (mse, aux)
+
+    def train_step(params, opt_state, x, y):
+        (loss, (mse, aux)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, x, y
+        )
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, {"loss": loss, "mse": mse, "aux": aux}
+
+    def init(rng, x):
+        params = model.init(rng, x)["params"]
+        return params, tx.init(params)
+
+    return init, train_step
+
+
+def init_expert_parallel(
+    model: MoEBlock,
+    mesh,
+    rng: Optional[jax.Array] = None,
+    sample: Optional[jnp.ndarray] = None,
+    expert_axis: str = "expert",
+) -> Dict[str, Any]:
+    """Initialize params and place them expert-sharded over the mesh."""
+    rng = jax.random.PRNGKey(0) if rng is None else rng
+    if sample is None:
+        sample = jnp.zeros((2, 8, model.hidden), jnp.float32)
+    params = model.init(rng, sample)["params"]
+    shardings = moe_param_shardings(params, mesh, expert_axis)
+    params = jax.tree_util.tree_map(
+        lambda leaf, s: jax.device_put(leaf, s), params, shardings
+    )
+    return {"params": params, "shardings": shardings}

@@ -10,6 +10,11 @@ over it.  Axis convention follows the scaling-book recipe:
 * ``fsdp``    — parameter/optimizer sharding (ZeRO-ish), also batch
 * ``tensor``  — tensor parallelism (heads / ffn dims)
 * ``context`` — sequence/context parallelism (ring attention over ICI)
+
+Non-canonical axes (``expert`` for MoE expert parallelism, ``stage``
+for pipeline parallelism — models/moe.py, parallel/pipeline.py) are
+supported too: pass them in ``shape`` and the mesh uses exactly the
+axes given, in order.
 """
 
 from __future__ import annotations
@@ -19,6 +24,7 @@ from typing import Dict, Optional, Sequence, Tuple
 import numpy as np
 
 AXES = ("data", "fsdp", "tensor", "context")
+EXTRA_AXES = ("expert", "stage")  # MoE ep / pipeline pp (see docstring)
 
 
 def make_mesh(
@@ -33,9 +39,22 @@ def make_mesh(
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
     shape = dict(shape or {})
+    # extra axes (expert/stage): the mesh is exactly the axes given.
+    # Anything else is rejected so a typo'd canonical axis ('fdsp')
+    # fails HERE, not as a confusing missing-axis error downstream.
+    unknown = [ax for ax in shape if ax not in AXES + EXTRA_AXES]
+    if unknown:
+        raise ValueError(
+            f"unknown mesh axes {unknown}; known: {AXES + EXTRA_AXES} "
+            "(build jax.sharding.Mesh directly for fully custom layouts)"
+        )
+    if shape and any(ax in EXTRA_AXES for ax in shape):
+        axes = tuple(shape.keys())
+    else:
+        axes = AXES
     sizes = []
     wild = None
-    for ax in AXES:
+    for ax in axes:
         v = int(shape.get(ax, 1))
         if v == -1:
             wild = ax
@@ -52,12 +71,12 @@ def make_mesh(
         # default: put everything on the fsdp axis
         if shape:
             raise ValueError(
-                f"mesh shape {dict(zip(AXES, sizes))} needs {total} devices, "
+                f"mesh shape {dict(zip(axes, sizes))} needs {total} devices, "
                 f"have {n}"
             )
-        sizes = [n if ax == "fsdp" else 1 for ax in AXES]
+        sizes = [n if ax == "fsdp" else 1 for ax in axes]
     arr = np.array(devices).reshape(sizes)
-    return Mesh(arr, AXES)
+    return Mesh(arr, axes)
 
 
 def batch_sharding(mesh) -> "object":
