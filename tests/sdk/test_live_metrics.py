@@ -1,0 +1,54 @@
+"""live_metrics(): the per-step, in-process tracker projection
+(vs summary(), which is final-summary file IPC)."""
+
+import tempfile
+import time
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+
+
+def test_live_metrics_without_runtime():
+    import traceml_tpu
+
+    out = traceml_tpu.live_metrics()
+    # fail-open: just the step counter, never raises
+    assert set(out) <= {"traceml/live/step"}
+
+
+def test_live_metrics_with_runtime(tmp_path):
+    import traceml_tpu
+    from traceml_tpu.runtime import lifecycle
+    from traceml_tpu.runtime.settings import AggregatorEndpoint, TraceMLSettings
+
+    settings = TraceMLSettings(
+        session_id="live", logs_dir=tmp_path, mode="summary",
+        aggregator=AggregatorEndpoint(port=1),  # nowhere; client fails open
+        sampler_interval_sec=0.1,
+    )
+    rt = lifecycle.start_runtime(settings)
+    assert rt is not None
+    try:
+        traceml_tpu.init(mode="auto")
+        fn = traceml_tpu.wrap_step_fn(lambda x: (x * 2).sum())
+        x = jnp.ones((64, 64))
+        for _ in range(6):
+            with traceml_tpu.trace_step():
+                out = fn(x)
+            jax.block_until_ready(out)
+            time.sleep(0.02)
+        deadline = time.monotonic() + 5
+        metrics = {}
+        while time.monotonic() < deadline:
+            metrics = traceml_tpu.live_metrics()
+            if "traceml/live/step_time_ms" in metrics:
+                break
+            time.sleep(0.1)
+        assert metrics["traceml/live/step"] >= 6
+        assert metrics["traceml/live/step_time_ms"] > 0
+        assert "traceml/live/compute_time_ms" in metrics
+        # every value is a plain scalar (logger-safe)
+        assert all(isinstance(v, (int, float)) for v in metrics.values())
+    finally:
+        lifecycle.stop_runtime()

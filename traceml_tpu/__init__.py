@@ -23,6 +23,7 @@ _API_SYMBOLS = (
     "trace_time",
     "summary",
     "final_summary",
+    "live_metrics",
     "wrap_dataloader",
     "wrap_step_fn",
     "wrap_h2d",

@@ -17,7 +17,11 @@ from traceml_tpu.sdk.wrappers import (  # noqa: F401
     wrap_optimizer,
 )
 from traceml_tpu.instrumentation.dataloader import wrap_dataloader  # noqa: F401
-from traceml_tpu.sdk.summary_client import final_summary, summary  # noqa: F401
+from traceml_tpu.sdk.summary_client import (  # noqa: F401
+    final_summary,
+    live_metrics,
+    summary,
+)
 
 
 def current_step() -> int:

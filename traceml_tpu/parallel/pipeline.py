@@ -123,6 +123,12 @@ def make_pipeline_fn(
 
     def pipeline_fn(stacked_params, microbatches):
         _check_stage_dim(stacked_params)
+        if microbatches.shape[0] != n_microbatches:
+            raise ValueError(
+                f"got {microbatches.shape[0]} microbatches, schedule was "
+                f"built for {n_microbatches} — the clamp in the injection "
+                "gather would silently duplicate the last microbatch"
+            )
 
         def wrapped(stacked_local, micro_local):
             stage_index = jax.lax.axis_index(axis)

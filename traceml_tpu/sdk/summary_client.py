@@ -67,10 +67,81 @@ def _flatten(prefix: str, obj: Any, out: Dict[str, Any]) -> None:
 def summary(
     timeout: float = 120.0, session_dir: Optional[Path] = None
 ) -> Dict[str, Any]:
-    """Flat ``{"traceml/...": scalar}`` dict for W&B/MLflow-style loggers."""
+    """Flat ``{"traceml/...": scalar}`` dict for W&B/MLflow-style loggers.
+
+    Backed by the FINAL summary (file IPC with the aggregator, may
+    block up to ``timeout``) — call it at run end.  For per-step
+    logging use :func:`live_metrics`, which reads this rank's own
+    sampler window with no IPC at all.
+    """
     data = final_summary(timeout=timeout, session_dir=session_dir)
     if not data:
         return {}
     out: Dict[str, Any] = {}
     _flatten("traceml", data, out)
+    return out
+
+
+def live_metrics(window: int = 30) -> Dict[str, Any]:
+    """Flat ``{"traceml/live/...": scalar}`` snapshot of THIS rank's
+    recent telemetry — safe to call every step (in-process reads only,
+    no aggregator round-trip).
+
+    Emits per-phase host/device medians over the last ``window`` step
+    rows of the runtime's step-time sampler, the latest device-memory
+    row, and the step counter.  Empty dict when the runtime isn't
+    running (fail-open).
+    """
+    import statistics
+
+    out: Dict[str, Any] = {}
+    try:
+        from traceml_tpu.runtime.lifecycle import get_active_runtime
+        from traceml_tpu.sdk.state import get_state
+
+        out["traceml/live/step"] = get_state().current_step
+        rt = get_active_runtime()
+        if rt is None:
+            return out
+        for sampler in getattr(rt, "samplers", []):
+            if sampler.name == "step_time":
+                rows = sampler.db.tail("step_time", window)
+                per_phase: Dict[str, list] = {}
+                for row in rows:
+                    for name, ev in (row.get("events") or {}).items():
+                        key = name.rsplit(":", 1)[-1]
+                        v = ev.get("device_ms")
+                        if v is None:
+                            v = ev.get("cpu_ms")
+                        if v is not None:
+                            per_phase.setdefault(key, []).append(float(v))
+                for key, vals in per_phase.items():
+                    out[f"traceml/live/{key}_ms"] = statistics.median(vals)
+                # occupancy only where the envelope has BOTH clocks
+                # (0.0 is a legitimate device duration — `is not None`,
+                # or idle steps would be dropped and occupancy overstated)
+                dev_sum = host_sum = 0.0
+                for row in rows:
+                    env = (row.get("events") or {}).get(
+                        "_traceml_internal:step_time"
+                    ) or {}
+                    if env.get("device_ms") is not None and env.get("cpu_ms") is not None:
+                        dev_sum += float(env["device_ms"])
+                        host_sum += float(env["cpu_ms"])
+                if host_sum > 0:
+                    out["traceml/live/occupancy"] = min(1.0, dev_sum / host_sum)
+            elif sampler.name == "step_memory":
+                # rows are per (step, device): aggregate the NEWEST
+                # step's rows with max, so a near-OOM device can't hide
+                # behind whichever device happened to be written last
+                rows = sampler.db.tail("step_memory", 16)
+                if rows:
+                    latest_step = rows[-1].get("step")
+                    newest = [r for r in rows if r.get("step") == latest_step]
+                    for k in ("current_bytes", "step_peak_bytes", "limit_bytes"):
+                        vals = [r[k] for r in newest if r.get(k) is not None]
+                        if vals:
+                            out[f"traceml/live/memory_{k}"] = max(vals)
+    except Exception as exc:  # never raises into training
+        get_error_log().warning("live_metrics failed", exc)
     return out
