@@ -1,0 +1,229 @@
+"""Summary fixture battery — end-state verdict coverage across many
+injected data shapes (reference: tests/reporting/summary/
+test_fixtures.py — schema stability under empty/partial/misaligned
+inputs, single- and multi-rank coverage, per-section contracts).
+
+Multi-rank is a DATA shape here (rows injected per rank through the
+real SQLiteWriter), so the battery runs in milliseconds."""
+
+import json
+
+import pytest
+
+from traceml_tpu.aggregator.sqlite_writer import SQLiteWriter
+from traceml_tpu.reporting.final import generate_summary
+from traceml_tpu.runtime.settings import TraceMLSettings
+from traceml_tpu.telemetry.envelope import SenderIdentity, build_telemetry_envelope
+from traceml_tpu.utils import timing as T
+
+GiB = 1024**3
+
+SECTIONS = ("system", "process", "step_time", "step_memory")
+
+
+def _step_row(step, step_ms=100.0, input_ms=5.0, compute_ms=90.0,
+              collective_ms=None, clock="device"):
+    events = {
+        T.STEP_TIME: {"cpu_ms": step_ms,
+                      "device_ms": step_ms if clock == "device" else None,
+                      "count": 1},
+        T.DATALOADER_NEXT: {"cpu_ms": input_ms, "device_ms": None, "count": 1},
+        T.COMPUTE_TIME: {"cpu_ms": 0.5,
+                         "device_ms": compute_ms if clock == "device" else None,
+                         "count": 1},
+    }
+    if collective_ms is not None:
+        events[T.COLLECTIVE_TIME] = {
+            "cpu_ms": collective_ms, "device_ms": collective_ms, "count": 1
+        }
+    return {"step": step, "timestamp": float(step), "clock": clock,
+            "events": events}
+
+
+class _Session:
+    """One injected session: write envelopes, generate, read payload."""
+
+    def __init__(self, tmp_path, session="fx"):
+        self.dir = tmp_path
+        self.session = session
+        self.writer = SQLiteWriter(tmp_path / "telemetry.sqlite")
+        self.writer.start()
+
+    def ident(self, rank, world=1, node=0):
+        return SenderIdentity(
+            session_id=self.session, global_rank=rank, world_size=world,
+            node_rank=node, hostname=f"host{node}", pid=100 + rank,
+        )
+
+    def inject(self, sampler, tables, ident):
+        self.writer.ingest(build_telemetry_envelope(sampler, tables, ident))
+
+    def payload(self):
+        self.writer.force_flush()
+        self.writer.finalize()
+        settings = TraceMLSettings(session_id=self.session, logs_dir=self.dir)
+        assert generate_summary(
+            self.dir / "telemetry.sqlite", self.dir, settings, mode="summary"
+        )
+        return json.loads((self.dir / "final_summary.json").read_text())
+
+
+def _assert_schema_stable(payload):
+    """Every section exists with the status/diagnosis/issues contract
+    regardless of what data arrived."""
+    assert payload["schema"].startswith("traceml-tpu/")
+    for key in SECTIONS:
+        sec = payload["sections"][key]
+        assert sec["status"] in ("OK", "NO_DATA")
+        assert "issues" in sec
+        if sec["status"] == "OK":
+            assert sec["diagnosis"] is not None
+            assert sec["issues"][0] == sec["diagnosis"]  # documented invariant
+            assert "global" in sec
+
+
+def test_empty_db_stable_schema(tmp_path):
+    s = _Session(tmp_path)
+    payload = s.payload()
+    _assert_schema_stable(payload)
+    assert all(
+        payload["sections"][k]["status"] == "NO_DATA" for k in SECTIONS
+    )
+    assert payload["primary_diagnosis"]["kind"] == "INSUFFICIENT_STEP_TIME_DATA"
+
+
+def test_step_time_only_other_sections_degrade(tmp_path):
+    s = _Session(tmp_path)
+    s.inject("step_time",
+             {"step_time": [_step_row(i) for i in range(1, 61)]}, s.ident(0))
+    payload = s.payload()
+    _assert_schema_stable(payload)
+    assert payload["sections"]["step_time"]["status"] == "OK"
+    assert payload["sections"]["system"]["status"] == "NO_DATA"
+    assert payload["sections"]["process"]["status"] == "NO_DATA"
+
+
+def test_host_clock_run(tmp_path):
+    """No device timing anywhere → host clock selected, still diagnosable."""
+    s = _Session(tmp_path)
+    rows = [_step_row(i, input_ms=60.0, clock="host") for i in range(1, 61)]
+    s.inject("step_time", {"step_time": rows}, s.ident(0))
+    payload = s.payload()
+    g = payload["sections"]["step_time"]["global"]
+    assert g["clock"] == "host"
+    assert g["median_occupancy"] is None  # no device data → no occupancy
+    assert payload["sections"]["step_time"]["diagnosis"]["kind"] == "INPUT_BOUND"
+
+
+def test_misaligned_ranks_use_common_suffix(tmp_path):
+    """Rank 1 joined late: the window is the common suffix only."""
+    s = _Session(tmp_path)
+    s.inject("step_time",
+             {"step_time": [_step_row(i) for i in range(1, 81)]},
+             s.ident(0, world=2))
+    s.inject("step_time",
+             {"step_time": [_step_row(i) for i in range(41, 81)]},
+             s.ident(1, world=2))
+    payload = s.payload()
+    g = payload["sections"]["step_time"]["global"]
+    assert g["step_range"][0] >= 41
+    assert g["ranks"] == [0, 1]
+
+
+def test_missing_rank_reported_in_topology(tmp_path):
+    s = _Session(tmp_path)
+    for rank in (0, 1, 3):  # rank 2 never reports
+        s.inject("step_time",
+                 {"step_time": [_step_row(i) for i in range(1, 41)]},
+                 s.ident(rank, world=4))
+    payload = s.payload()
+    topo = payload["meta"]["topology"]
+    assert topo["world_size"] == 4
+    assert sorted(topo["ranks_seen"]) == [0, 1, 3]
+
+
+def test_collective_phase_in_summary(tmp_path):
+    s = _Session(tmp_path)
+    rows = [_step_row(i, step_ms=120.0, compute_ms=60.0, collective_ms=50.0)
+            for i in range(1, 61)]
+    s.inject("step_time", {"step_time": rows}, s.ident(0))
+    payload = s.payload()
+    phases = payload["sections"]["step_time"]["global"]["phases"]
+    assert phases["collective"]["median_ms"] == pytest.approx(50.0)
+    assert phases["collective"]["share_of_step"] == pytest.approx(50.0 / 120.0)
+
+
+def test_memory_without_limits(tmp_path):
+    """CPU/tunneled runtimes have no bytes_limit — pressure is None, no
+    pressure verdicts, schema intact."""
+    s = _Session(tmp_path)
+    mem = [{"step": i, "timestamp": float(i), "device_id": 0,
+            "device_kind": "cpu", "current_bytes": 1 * GiB,
+            "peak_bytes": 1 * GiB, "step_peak_bytes": 1 * GiB,
+            "limit_bytes": None, "backend": "live_arrays"}
+           for i in range(1, 61)]
+    s.inject("step_memory", {"step_memory": mem}, s.ident(0))
+    payload = s.payload()
+    rank0 = payload["sections"]["step_memory"]["global"]["per_rank"]["0"]
+    assert rank0["pressure"] is None
+    kinds = {i["kind"] for i in payload["sections"]["step_memory"]["issues"]}
+    assert "HIGH_MEMORY_PRESSURE" not in kinds
+
+
+def test_multi_node_cluster_rollup_in_summary(tmp_path):
+    s = _Session(tmp_path)
+    for node, cpu in ((0, 20.0), (1, 80.0)):
+        sysrows = [{"timestamp": float(i), "cpu_pct": cpu,
+                    "memory_used_bytes": 4 * GiB, "memory_total_bytes": 16 * GiB,
+                    "memory_pct": 25.0, "load_1m": 1.0}
+                   for i in range(30)]
+        s.inject("system", {"system": sysrows}, s.ident(node * 4, world=8, node=node))
+    payload = s.payload()
+    cluster = payload["sections"]["system"]["global"]["cluster"]
+    assert cluster["n_nodes"] == 2
+    assert cluster["cpu_pct_max"] == pytest.approx(80.0)
+    assert cluster["busiest_node"] == "host1"
+
+
+def test_garbage_rows_do_not_break_summary(tmp_path):
+    """Rows with missing/None fields degrade gracefully, never throw."""
+    s = _Session(tmp_path)
+    rows = [
+        {"step": 1, "timestamp": 1.0, "clock": "device", "events": {}},
+        {"step": None, "timestamp": None, "clock": None, "events": None},
+        _step_row(2),
+    ]
+    s.inject("step_time", {"step_time": rows}, s.ident(0))
+    s.inject("step_memory", {"step_memory": [{"step": 1}]}, s.ident(0))
+    payload = s.payload()
+    _assert_schema_stable(payload)
+
+
+def test_single_step_run(tmp_path):
+    """One step: below every diagnosis gate, still schema-valid."""
+    s = _Session(tmp_path)
+    s.inject("step_time", {"step_time": [_step_row(1)]}, s.ident(0))
+    payload = s.payload()
+    _assert_schema_stable(payload)
+    st = payload["sections"]["step_time"]
+    assert st["global"]["n_steps"] == 1
+    assert st["global"]["steady_state"] is None  # needs ≥12 steps
+    assert payload["primary_diagnosis"]["kind"] in (
+        "INSUFFICIENT_STEP_TIME_DATA", "NO_CLEAR_PERFORMANCE_BOTTLENECK",
+        "HEALTHY", "COMPUTE_BOUND",
+    )
+
+
+def test_occupancy_low_run_yields_low_util_verdict(tmp_path):
+    s = _Session(tmp_path)
+    rows = []
+    for i in range(1, 61):
+        row = _step_row(i, step_ms=100.0, compute_ms=18.0)
+        row["events"][T.STEP_TIME]["device_ms"] = 20.0  # chip busy 20%
+        rows.append(row)
+    s.inject("step_time", {"step_time": rows}, s.ident(0))
+    payload = s.payload()
+    g = payload["sections"]["step_time"]["global"]
+    assert g["median_occupancy"] == pytest.approx(0.2)
+    kinds = {i["kind"] for i in payload["sections"]["step_time"]["issues"]}
+    assert "LOW_DEVICE_UTILIZATION" in kinds

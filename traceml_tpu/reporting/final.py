@@ -494,6 +494,7 @@ def generate_summary(
         results.get("step_memory"),
         results.get("system"),
         results.get("process"),
+        step_time_error=sections["step_time"].get("error"),
     )
     meta: Dict[str, Any] = {
         "session_id": getattr(settings, "session_id", "unknown"),

@@ -25,6 +25,7 @@ def build_primary_diagnosis(
     step_memory: Optional[DiagnosticResult] = None,
     system: Optional[DiagnosticResult] = None,
     process: Optional[DiagnosticResult] = None,
+    step_time_error: Optional[str] = None,
 ) -> Dict[str, Any]:
     candidates = []
     if step_time is not None:
@@ -47,6 +48,31 @@ def build_primary_diagnosis(
             candidates.append((_SEV_ORDER.get(issue.severity, 0), domain, issue))
 
     if not candidates:
+        if step_time is None and step_time_error:
+            # the section BUILDER failed — telemetry may exist; send the
+            # user to the reported error, not to their instrumentation
+            return {
+                "kind": "INSUFFICIENT_STEP_TIME_DATA",
+                "domain": "run",
+                "severity": "info",
+                "summary": (
+                    f"Step-time analysis failed: {step_time_error}"
+                ),
+                "action": "See sections.step_time.error in the summary.",
+            }
+        if step_time is None:
+            # nothing was even measured — "no bottleneck" would imply a
+            # healthy run when there is simply no step data at all
+            return {
+                "kind": "INSUFFICIENT_STEP_TIME_DATA",
+                "domain": "run",
+                "severity": "info",
+                "summary": "No step telemetry was recorded.",
+                "action": (
+                    "Check that trace_step() brackets the loop and the "
+                    "runtime started (TRACEML_DISABLE unset)."
+                ),
+            }
         return {
             "kind": "NO_CLEAR_PERFORMANCE_BOTTLENECK",
             "domain": "run",

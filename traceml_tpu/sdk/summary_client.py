@@ -106,11 +106,21 @@ def live_metrics(window: int = 30) -> Dict[str, Any]:
         for sampler in getattr(rt, "samplers", []):
             if sampler.name == "step_time":
                 rows = sampler.db.tail("step_time", window)
+                # one clock for the whole window (same policy as the
+                # shared window builder): device only when EVERY row
+                # resolved device timing, else host — mixing clocks
+                # would bounce a phase median between dispatch (~ms)
+                # and device (~100ms) values with the mix parity
+                clock = (
+                    "device"
+                    if rows and all(r.get("clock") == "device" for r in rows)
+                    else "host"
+                )
                 per_phase: Dict[str, list] = {}
                 for row in rows:
                     for name, ev in (row.get("events") or {}).items():
                         key = name.rsplit(":", 1)[-1]
-                        v = ev.get("device_ms")
+                        v = ev.get("device_ms") if clock == "device" else None
                         if v is None:
                             v = ev.get("cpu_ms")
                         if v is not None:

@@ -175,7 +175,9 @@ def build_rank_window(
                 series[k].append(0.0)
             continue
         env = (row.get("events") or {}).get(T.STEP_TIME) or {}
-        if env.get("device_ms") and env.get("cpu_ms"):
+        # 0.0 is a legitimate device duration (fully idle step) —
+        # truthiness would drop idle steps and overstate occupancy
+        if env.get("device_ms") is not None and env.get("cpu_ms") is not None:
             dev_sum += float(env["device_ms"])
             host_sum += float(env["cpu_ms"])
         step_ms = _row_value(row, T.STEP_TIME, clock) or 0.0
