@@ -1,0 +1,94 @@
+"""Expert-parallel MoE + pipeline-parallel training under tracing.
+
+Runs on any mesh — including 8 virtual CPU devices:
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/distributed/moe_pipeline.py
+"""
+
+import jax
+import jax.numpy as jnp
+
+import traceml_tpu
+from traceml_tpu.models.moe import (
+    MoEBlock,
+    make_moe_train_step,
+    moe_param_shardings,
+)
+from traceml_tpu.parallel.mesh import make_mesh
+from traceml_tpu.parallel.pipeline import (
+    init_linear_stages,
+    linear_stage_apply,
+    make_pipeline_train_step,
+    stack_stage_params,
+    stage_param_shardings,
+)
+
+
+def run_moe(n_devices: int, steps: int = 10) -> None:
+    mesh = make_mesh({"expert": n_devices})
+    model = MoEBlock(n_experts=n_devices, hidden=32, ffn_hidden=64)
+    init, train_step = make_moe_train_step(model)
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(rng, (8, 16, 32))
+    y = jnp.roll(x, 1, axis=-1)
+    params, opt_state = init(rng, x)
+    params = jax.tree_util.tree_map(
+        jax.device_put, params, moe_param_shardings(params, mesh)
+    )
+    step = traceml_tpu.wrap_step_fn(train_step)
+    with mesh:
+        for _ in range(steps):
+            with traceml_tpu.trace_step():
+                params, opt_state, metrics = step(params, opt_state, x, y)
+    print(f"MoE (ep={n_devices}): loss {float(metrics['loss']):.4f} "
+          f"aux {float(metrics['aux']):.4f}")
+
+
+def run_pipeline(n_stages: int, steps: int = 10) -> None:
+    mesh = make_mesh({"stage": n_stages}, devices=jax.devices()[:n_stages])
+    stages = init_linear_stages(n_stages, width=16, rng=jax.random.PRNGKey(0))
+    stacked = stack_stage_params(stages)
+    stacked = jax.tree_util.tree_map(
+        jax.device_put, stacked, stage_param_shardings(stacked, mesh)
+    )
+    init, train_step = make_pipeline_train_step(
+        linear_stage_apply, mesh, n_microbatches=4, learning_rate=0.05
+    )
+    opt_state = init(stacked)
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 8, 16))
+    y = 0.5 * x
+    step = traceml_tpu.wrap_step_fn(train_step)
+    with mesh:
+        for _ in range(steps):
+            with traceml_tpu.trace_step():
+                stacked, opt_state, metrics = step(stacked, opt_state, x, y)
+    print(f"pipeline (pp={n_stages}): loss {float(metrics['loss']):.4f}")
+
+
+if __name__ == "__main__":
+    import tempfile
+    from pathlib import Path
+
+    from traceml_tpu.runtime import lifecycle
+    from traceml_tpu.runtime.settings import AggregatorEndpoint, TraceMLSettings
+
+    # an in-process runtime makes live_metrics() carry phase timings
+    # (under `traceml-tpu run` the launcher does this for you)
+    lifecycle.start_runtime(TraceMLSettings(
+        session_id="moe_pipeline",
+        logs_dir=Path(tempfile.mkdtemp()),
+        mode="summary",
+        aggregator=AggregatorEndpoint(port=1),  # no aggregator: fail-open
+        sampler_interval_sec=0.2,
+    ))
+    traceml_tpu.init(mode="auto")
+    n = len(jax.devices())
+    run_moe(n)
+    run_pipeline(min(4, n))
+    import time
+
+    time.sleep(0.5)  # let the sampler drain the last steps
+    print("live:", {k: round(v, 2) for k, v in
+                    sorted(traceml_tpu.live_metrics().items())})
+    lifecycle.stop_runtime()

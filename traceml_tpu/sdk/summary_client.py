@@ -105,17 +105,15 @@ def live_metrics(window: int = 30) -> Dict[str, Any]:
             return out
         for sampler in getattr(rt, "samplers", []):
             if sampler.name == "step_time":
+                from traceml_tpu.utils.step_time_window import select_clock
+                from traceml_tpu.utils.timing import STEP_TIME
+
                 rows = sampler.db.tail("step_time", window)
-                # one clock for the whole window (same policy as the
-                # shared window builder): device only when EVERY row
-                # resolved device timing, else host — mixing clocks
-                # would bounce a phase median between dispatch (~ms)
-                # and device (~100ms) values with the mix parity
-                clock = (
-                    "device"
-                    if rows and all(r.get("clock") == "device" for r in rows)
-                    else "host"
-                )
+                # ONE clock for the whole window, via the SAME policy as
+                # the shared window builder — mixing clocks would bounce
+                # a phase median between dispatch (~ms) and device
+                # (~100ms) values with the mix parity
+                clock = select_clock({0: rows}) if rows else "host"
                 per_phase: Dict[str, list] = {}
                 for row in rows:
                     for name, ev in (row.get("events") or {}).items():
@@ -132,9 +130,7 @@ def live_metrics(window: int = 30) -> Dict[str, Any]:
                 # or idle steps would be dropped and occupancy overstated)
                 dev_sum = host_sum = 0.0
                 for row in rows:
-                    env = (row.get("events") or {}).get(
-                        "_traceml_internal:step_time"
-                    ) or {}
+                    env = (row.get("events") or {}).get(STEP_TIME) or {}
                     if env.get("device_ms") is not None and env.get("cpu_ms") is not None:
                         dev_sum += float(env["device_ms"])
                         host_sum += float(env["cpu_ms"])

@@ -201,8 +201,11 @@ def build_rank_window(
         series=series,
         averages=averages,
         clock=clock,
-        # cap: device readiness quantization can nominally exceed wall
-        occupancy=min(dev_sum / host_sum, 1.0) if host_sum > 0 and dev_sum > 0 else None,
+        # cap: device readiness quantization can nominally exceed wall.
+        # host_sum>0 alone gates (dual-clock rows existed): a fully idle
+        # window must read 0.0, not None — None would silence the
+        # LOW_DEVICE_UTILIZATION rule exactly when it matters most
+        occupancy=min(dev_sum / host_sum, 1.0) if host_sum > 0 else None,
     )
 
 
