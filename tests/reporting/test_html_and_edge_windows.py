@@ -35,6 +35,86 @@ def test_html_renders_minimal_and_odd_payloads():
     assert "<polyline" in html
 
 
+def test_html_renders_round2_sections():
+    """Occupancy, per-rank matrix, memory pressure/growth, system nodes
+    + cluster, process table, telemetry footer."""
+    payload = {
+        "meta": {"session_id": "s", "topology": {"world_size": 2},
+                 "telemetry_stats": {"envelopes_ingested": 10}},
+        "primary_diagnosis": {"kind": "HEALTHY", "severity": "info",
+                              "summary": "ok"},
+        "sections": {
+            "step_time": {
+                "status": "OK", "issues": [],
+                "global": {
+                    "n_steps": 40, "clock": "device",
+                    "median_occupancy": 0.83,
+                    "steady_state": {"median_ms": 90.0,
+                                     "warmup_inflation_pct": 0.1,
+                                     "warmup_steps_excluded": 10},
+                    "phases": {
+                        "step_time": {"median_ms": 100.0, "share_of_step": None,
+                                      "worst_rank": 1, "skew_pct": 0.0},
+                        "compute": {"median_ms": 80.0, "share_of_step": 0.8,
+                                    "worst_rank": 1, "skew_pct": 0.0},
+                    },
+                    "per_rank": {
+                        "0": {"avg_ms": {"step_time": 100.0, "compute": 80.0},
+                              "occupancy": 0.85, "steps_seen": 40},
+                        "1": {"avg_ms": {"step_time": 101.0, "compute": 81.0},
+                              "occupancy": 0.81, "steps_seen": 40},
+                    },
+                },
+            },
+            "step_memory": {
+                "status": "OK", "issues": [],
+                "global": {
+                    "per_rank": {"0": {"current_bytes": 4 << 30,
+                                       "step_peak_bytes": 5 << 30,
+                                       "limit_bytes": 16 << 30,
+                                       "pressure": 0.31,
+                                       "growth_bytes": 1 << 20}},
+                    "rollup": {"total_current_bytes": 4 << 30,
+                               "max_peak_bytes": 5 << 30},
+                },
+            },
+            "system": {
+                "status": "OK", "issues": [],
+                "global": {
+                    "nodes": {"0": {"hostname": "a", "cpu_pct_mean": 20.0,
+                                    "cpu_pct_max": 40.0,
+                                    "memory_used_bytes": 1, "memory_total_bytes": 2,
+                                    "load_1m": 0.5},
+                              "1": {"hostname": "b", "cpu_pct_mean": 80.0,
+                                    "cpu_pct_max": 95.0,
+                                    "memory_used_bytes": 1, "memory_total_bytes": 2,
+                                    "load_1m": 2.0}},
+                    "cluster": {"n_nodes": 2, "cpu_pct_min": 20.0,
+                                "cpu_pct_median": 50.0, "cpu_pct_max": 80.0,
+                                "busiest_node": "b"},
+                },
+            },
+            "process": {
+                "status": "OK", "issues": [],
+                "global": {"per_rank": {"0": {"pid": 7, "cpu_pct_mean": 50.0,
+                                              "cpu_pct_max": 90.0,
+                                              "rss_bytes": 1 << 30,
+                                              "rss_peak_bytes": 2 << 30,
+                                              "num_threads": 8}}},
+            },
+        },
+    }
+    html = render_html_summary(payload)
+    assert "chip busy 83%" in html
+    assert "steady-state median" in html
+    assert "Per-rank breakdown" in html
+    assert "31%" in html  # memory pressure
+    assert "cluster: 2 nodes" in html
+    assert "busiest b" in html
+    assert "Processes" in html
+    assert "envelopes_ingested 10" in html
+
+
 def _row(step, clock="device", with_device=True, step_ms=100.0):
     ev = {"cpu_ms": step_ms, "count": 1,
           "device_ms": step_ms if with_device else None}

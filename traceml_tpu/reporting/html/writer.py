@@ -144,10 +144,19 @@ def render_html_summary(payload: Dict[str, Any]) -> str:
         out.append(_step_series_svg(series))
     if phases:
         out.append("<h2>Step time</h2>")
-        out.append(
-            f"<p class='muted'>{_esc(g.get('n_steps'))} steps, "
-            f"{_esc(g.get('clock'))} clock</p>"
+        sub = (
+            f"{_esc(g.get('n_steps'))} steps, {_esc(g.get('clock'))} clock"
         )
+        occ = g.get("median_occupancy")
+        if occ is not None:
+            sub += f", chip busy {occ * 100:.0f}%"
+        steady = g.get("steady_state") or {}
+        if steady.get("median_ms") is not None:
+            sub += f" · steady-state median {fmt_ms(steady['median_ms'])}"
+            infl = steady.get("warmup_inflation_pct")
+            if infl is not None and infl > 0.02:
+                sub += f" (warmup inflated {infl * 100:.0f}%)"
+        out.append(f"<p class='muted'>{sub}</p>")
         out.append(_phase_bar(phases))
         out.append(
             "<table><tr><th>phase</th><th>median</th><th>share</th>"
@@ -163,17 +172,97 @@ def render_html_summary(payload: Dict[str, Any]) -> str:
             )
         out.append("</table>")
 
+    # per-rank phase matrix (small worlds)
+    rank_cards = g.get("per_rank") or {}
+    if 1 < len(rank_cards) <= 8 and phases:
+        phase_keys = [k for k in phases if k != "step_time"]
+        out.append("<h2>Per-rank breakdown (window avg, ms)</h2><table><tr>"
+                   "<th>rank</th><th>step</th>"
+                   + "".join(f"<th>{_esc(k)}</th>" for k in phase_keys)
+                   + "<th>busy</th></tr>")
+        for rank, card in sorted(rank_cards.items(), key=lambda kv: int(kv[0])):
+            avgs = card.get("avg_ms") or {}
+            occ_r = card.get("occupancy")
+            out.append(
+                f"<tr><td>{_esc(rank)}</td>"
+                f"<td>{avgs.get('step_time', 0):.1f}</td>"
+                + "".join(f"<td>{avgs.get(k, 0):.1f}</td>" for k in phase_keys)
+                + f"<td>{'' if occ_r is None else f'{occ_r * 100:.0f}%'}</td></tr>"
+            )
+        out.append("</table>")
+
     sm = (payload.get("sections") or {}).get("step_memory") or {}
     per_rank = (sm.get("global") or {}).get("per_rank") or {}
     if per_rank:
         out.append("<h2>Device memory</h2><table><tr><th>rank</th>"
-                   "<th>current</th><th>peak</th><th>limit</th></tr>")
+                   "<th>current</th><th>peak</th><th>limit</th>"
+                   "<th>pressure</th><th>growth</th></tr>")
         for rank, info in sorted(per_rank.items(), key=lambda kv: int(kv[0])):
+            pressure = info.get("pressure")
+            growth = info.get("growth_bytes")
             out.append(
                 f"<tr><td>{_esc(rank)}</td>"
                 f"<td>{fmt_bytes(info.get('current_bytes'))}</td>"
                 f"<td>{fmt_bytes(info.get('step_peak_bytes'))}</td>"
-                f"<td>{fmt_bytes(info.get('limit_bytes'))}</td></tr>"
+                f"<td>{fmt_bytes(info.get('limit_bytes'))}</td>"
+                f"<td>{'' if pressure is None else f'{pressure * 100:.0f}%'}</td>"
+                f"<td>{'' if not growth else ('+' if growth > 0 else '-') + fmt_bytes(abs(growth))}</td>"
+                f"</tr>"
+            )
+        out.append("</table>")
+        rollup = (sm.get("global") or {}).get("rollup") or {}
+        if rollup:
+            out.append(
+                f"<p class='muted'>total {fmt_bytes(rollup.get('total_current_bytes'))}"
+                f" · max peak {fmt_bytes(rollup.get('max_peak_bytes'))}</p>"
+            )
+
+    sysg = ((payload.get("sections") or {}).get("system") or {}).get("global") or {}
+    nodes = sysg.get("nodes") or {}
+    if nodes:
+        out.append("<h2>System</h2><table><tr><th>node</th><th>cpu mean/max</th>"
+                   "<th>host mem</th><th>load</th></tr>")
+        def _node_key(kv):
+            try:
+                return (0, int(kv[0]))
+            except (TypeError, ValueError):
+                return (1, kv[0])
+
+        for node, info in sorted(nodes.items(), key=_node_key):
+            cpu_m, cpu_x = info.get("cpu_pct_mean"), info.get("cpu_pct_max")
+            load = info.get("load_1m")
+            out.append(
+                f"<tr><td>{_esc(info.get('hostname'))} (#{_esc(node)})</td>"
+                f"<td>{'' if cpu_m is None else f'{cpu_m:.0f}%'}/"
+                f"{'' if cpu_x is None else f'{cpu_x:.0f}%'}</td>"
+                f"<td>{fmt_bytes(info.get('memory_used_bytes'))} / "
+                f"{fmt_bytes(info.get('memory_total_bytes'))}</td>"
+                f"<td>{'—' if load is None else _esc(load)}</td></tr>"
+            )
+        out.append("</table>")
+        cluster = sysg.get("cluster")
+        if cluster:
+            out.append(
+                f"<p class='muted'>cluster: {cluster['n_nodes']} nodes · host "
+                f"CPU {cluster['cpu_pct_min']:.0f}/"
+                f"{cluster['cpu_pct_median']:.0f}/{cluster['cpu_pct_max']:.0f}% "
+                f"(min/median/max, busiest {_esc(cluster.get('busiest_node'))})</p>"
+            )
+
+    procg = ((payload.get("sections") or {}).get("process") or {}).get("global") or {}
+    pranks = procg.get("per_rank") or {}
+    if pranks:
+        out.append("<h2>Processes</h2><table><tr><th>rank</th><th>pid</th>"
+                   "<th>cpu mean/max</th><th>rss / peak</th><th>threads</th></tr>")
+        for rank, info in sorted(pranks.items(), key=lambda kv: int(kv[0])):
+            cpu_m, cpu_x = info.get("cpu_pct_mean"), info.get("cpu_pct_max")
+            out.append(
+                f"<tr><td>{_esc(rank)}</td><td>{_esc(info.get('pid') or '—')}</td>"
+                f"<td>{'' if cpu_m is None else f'{cpu_m:.0f}%'}/"
+                f"{'' if cpu_x is None else f'{cpu_x:.0f}%'}</td>"
+                f"<td>{fmt_bytes(info.get('rss_bytes'))} / "
+                f"{fmt_bytes(info.get('rss_peak_bytes'))}</td>"
+                f"<td>{_esc(info.get('num_threads') or '—')}</td></tr>"
             )
         out.append("</table>")
 
@@ -187,7 +276,15 @@ def render_html_summary(payload: Dict[str, Any]) -> str:
                 f"{_esc(issue.get('severity'))}</td>"
                 f"<td>{_esc(issue.get('summary'))}</td></tr>"
             )
-    out.append("</table></body></html>")
+    out.append("</table>")
+    stats = meta.get("telemetry_stats") or {}
+    if stats:
+        out.append(
+            "<p class='muted'>telemetry: "
+            + " · ".join(f"{_esc(k)} {_esc(v)}" for k, v in stats.items())
+            + "</p>"
+        )
+    out.append("</body></html>")
     return "".join(out)
 
 
