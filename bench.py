@@ -336,7 +336,12 @@ def _run_interleaved() -> int:
 
     u_all, t_all, deltas = [], [], []
     for _ in range(ROUNDS):
+        # quiesce the traced stack's background threads while timing the
+        # untraced arm — the arms share one process on device-exclusive
+        # backends, and the sampler must not perturb the baseline
+        runtime.pause()
         u, state = _run_loop(plain, state, batches, STEPS_PER_ROUND)
+        runtime.resume()
         t, state2 = _run_loop(
             traced, state2, batches2, STEPS_PER_ROUND,
             bracket=traceml_tpu.trace_step,

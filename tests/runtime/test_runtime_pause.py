@@ -1,0 +1,31 @@
+import time
+import pytest
+
+
+def test_runtime_pause_resume(tmp_path):
+    from traceml_tpu.runtime.runtime import TraceMLRuntime
+    from traceml_tpu.runtime.identity import RuntimeIdentity
+    from traceml_tpu.runtime.settings import AggregatorEndpoint, TraceMLSettings
+
+    rt = TraceMLRuntime(
+        TraceMLSettings(session_id="p", logs_dir=tmp_path, mode="summary",
+                        aggregator=AggregatorEndpoint(port=1),
+                        sampler_interval_sec=0.05),
+        RuntimeIdentity(global_rank=0),
+    )
+    rt.start()
+    try:
+        time.sleep(0.3)
+        step_sampler = next(s for s in rt.samplers if s.name == "system")
+        # pause FIRST (it blocks on any in-flight tick), then read the
+        # baseline — reading before pausing races the 50ms tick thread
+        rt.pause()
+        before = step_sampler.db.append_count("system")
+        time.sleep(0.4)
+        paused_count = step_sampler.db.append_count("system")
+        assert paused_count == before  # no sampling while paused
+        rt.resume()
+        time.sleep(0.4)
+        assert step_sampler.db.append_count("system") > paused_count
+    finally:
+        rt.stop()

@@ -43,6 +43,8 @@ class TraceMLRuntime:
         self._stop_evt = threading.Event()
         self._started = False
         self._finished_sent = False
+        self._paused = threading.Event()
+        self._tick_lock = threading.Lock()  # pause() waits on in-flight ticks
         self._lock = threading.Lock()
 
     # -- lifecycle -----------------------------------------------------
@@ -113,6 +115,21 @@ class TraceMLRuntime:
             )
         ]
 
+    # -- pause (measurement quiescence) --------------------------------
+    def pause(self) -> None:
+        """Suspend tick work (sampling + publishing) without tearing the
+        runtime down.  For measurement windows that must exclude the
+        tracer's own background activity (bench.py quiesces the traced
+        stack while timing the UNTRACED arm in-process on
+        device-exclusive backends).  Blocks until any in-flight tick
+        completes — the window starts truly quiet."""
+        self._paused.set()
+        with self._tick_lock:
+            pass
+
+    def resume(self) -> None:
+        self._paused.clear()
+
     # -- tick loop -----------------------------------------------------
     def _tick(self) -> None:
         phase = self.recording.phase
@@ -138,8 +155,11 @@ class TraceMLRuntime:
     def _sampler_loop(self) -> None:
         interval = max(0.05, self.settings.sampler_interval_sec)
         while not self._stop_evt.wait(interval):
+            if self._paused.is_set():
+                continue
             try:
-                self._tick()
+                with self._tick_lock:
+                    self._tick()
             except Exception as exc:  # belt+braces; samplers fail-open anyway
                 get_error_log().warning("runtime tick failed", exc)
 
@@ -161,3 +181,7 @@ class NoOpRuntime:
     def start(self) -> None: ...
 
     def stop(self) -> None: ...
+
+    def pause(self) -> None: ...
+
+    def resume(self) -> None: ...
