@@ -266,7 +266,8 @@ def _orchestrate() -> int:
     return _report(u_all, t_all, deltas, "cpu", "paired-solo")
 
 
-def _report(u_all, t_all, deltas, backend: str, mode: str) -> int:
+def _report(u_all, t_all, deltas, backend: str, mode: str,
+            steps: int = STEPS_PER_ROUND) -> int:
     lo, hi = _bootstrap_ci(deltas)
     overhead_pct = max(0.0, statistics.median(deltas))
     print(
@@ -274,7 +275,7 @@ def _report(u_all, t_all, deltas, backend: str, mode: str) -> int:
         f"traced {statistics.median(t_all) * 1000:.2f} ms/step on "
         f"{backend} ({mode}) — median delta "
         f"{statistics.median(deltas):+.2f}% (95% CI [{lo:+.2f}, {hi:+.2f}], "
-        f"{len(deltas)} paired rounds × {STEPS_PER_ROUND} steps; per-round: "
+        f"{len(deltas)} paired rounds × {steps} steps; per-round: "
         f"{[round(d, 1) for d in deltas]})",
         file=sys.stderr,
     )
@@ -291,7 +292,7 @@ def _report(u_all, t_all, deltas, backend: str, mode: str) -> int:
     return 0
 
 
-def _run_interleaved() -> int:
+def _run_interleaved(rounds: int = ROUNDS, steps: int = STEPS_PER_ROUND) -> int:
     """Single-process paired rounds — for device-exclusive backends (TPU)
     where two processes cannot both claim the chip.  Host-side background
     threads overlap device compute there, so sharing the process does not
@@ -335,15 +336,15 @@ def _run_interleaved() -> int:
     )
 
     u_all, t_all, deltas = [], [], []
-    for _ in range(ROUNDS):
+    for _ in range(rounds):
         # quiesce the traced stack's background threads while timing the
         # untraced arm — the arms share one process on device-exclusive
         # backends, and the sampler must not perturb the baseline
         runtime.pause()
-        u, state = _run_loop(plain, state, batches, STEPS_PER_ROUND)
+        u, state = _run_loop(plain, state, batches, steps)
         runtime.resume()
         t, state2 = _run_loop(
-            traced, state2, batches2, STEPS_PER_ROUND,
+            traced, state2, batches2, steps,
             bracket=traceml_tpu.trace_step,
         )
         u_all.append(u)
@@ -351,12 +352,52 @@ def _run_interleaved() -> int:
         deltas.append((t - u) / u * 100.0)
     runtime.stop()
     agg.stop(finalize_timeout=5.0)
-    return _report(u_all, t_all, deltas, jax.default_backend(), "in-process")
+    return _report(u_all, t_all, deltas, jax.default_backend(), "in-process", steps)
+
+
+def _cpu_proxy_fallback() -> int:
+    env = _cpu_env(os.environ)
+    env["TRACEML_BENCH_NO_PROBE"] = "1"
+    return subprocess.run([sys.executable, __file__], env=env).returncode
+
+
+def _run_device_child() -> bool:
+    """Run the device interleaved bench in a bounded child; True when it
+    emitted its result (rc 0).  Uses Popen + bounded waits: a child
+    wedged in uninterruptible sleep survives SIGKILL's reap, and an
+    unbounded ``subprocess.run`` timeout path would hang the parent on
+    exactly the failure this bound exists for (the zombie is abandoned).
+    """
+    # generous budget derived from the module constants, not a magic
+    # number: startup/compile + both arms' rounds
+    budget = _READY_TIMEOUT_S + 2 * ROUNDS * _ROUND_TIMEOUT_S
+    proc = subprocess.Popen([sys.executable, __file__, "--interleaved"])
+    try:
+        rc = proc.wait(timeout=budget)
+        if rc != 0:
+            print(
+                f"[bench] device bench failed rc={rc}; "
+                "falling back to CPU proxy",
+                file=sys.stderr,
+            )
+        return rc == 0
+    except subprocess.TimeoutExpired:
+        print(
+            "[bench] device bench timed out; falling back to CPU proxy",
+            file=sys.stderr,
+        )
+        proc.kill()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass  # D-state zombie: abandon it, the contract matters more
+        return False
 
 
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--arm", choices=["untraced", "traced"])
+    parser.add_argument("--interleaved", action="store_true")
     parser.add_argument("--rounds", type=int, default=ROUNDS)
     parser.add_argument("--steps", type=int, default=STEPS_PER_ROUND)
     parser.add_argument("--out", type=str)
@@ -364,6 +405,8 @@ def main() -> int:
 
     if args.arm:
         return _child(args.arm, args.rounds, args.steps, Path(args.out))
+    if args.interleaved:
+        return _run_interleaved(args.rounds, args.steps)
 
     if os.environ.get("TRACEML_BENCH_NO_PROBE") != "1":
         backend = _probe_backend()
@@ -372,11 +415,14 @@ def main() -> int:
                 "[bench] device backend unreachable; falling back to CPU proxy",
                 file=sys.stderr,
             )
-            env = _cpu_env(os.environ)
-            env["TRACEML_BENCH_NO_PROBE"] = "1"
-            return subprocess.run([sys.executable, __file__], env=env).returncode
+            return _cpu_proxy_fallback()
         if backend != "cpu":
-            return _run_interleaved()
+            # device path runs in a BOUNDED child: a tunnel that probes
+            # healthy can still wedge mid-run inside C++ (unkillable from
+            # threads), and the one-JSON-line contract must survive that
+            if _run_device_child():
+                return 0
+            return _cpu_proxy_fallback()
     try:
         return _orchestrate()
     except Exception as exc:
