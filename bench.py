@@ -361,26 +361,39 @@ def _cpu_proxy_fallback() -> int:
     return subprocess.run([sys.executable, __file__], env=env).returncode
 
 
-def _run_device_child() -> bool:
+def _run_device_child(rounds: int, steps: int) -> bool:
     """Run the device interleaved bench in a bounded child; True when it
     emitted its result (rc 0).  Uses Popen + bounded waits: a child
     wedged in uninterruptible sleep survives SIGKILL's reap, and an
     unbounded ``subprocess.run`` timeout path would hang the parent on
     exactly the failure this bound exists for (the zombie is abandoned).
+
+    The child's stdout is captured and forwarded ONLY on success — a
+    child that printed its JSON and then wedged in teardown must not
+    leave a first JSON line for the fallback to contradict.
     """
-    # generous budget derived from the module constants, not a magic
+    # generous budget derived from the requested schedule, not a magic
     # number: startup/compile + both arms' rounds
-    budget = _READY_TIMEOUT_S + 2 * ROUNDS * _ROUND_TIMEOUT_S
-    proc = subprocess.Popen([sys.executable, __file__, "--interleaved"])
+    budget = _READY_TIMEOUT_S + 2 * rounds * _ROUND_TIMEOUT_S
+    proc = subprocess.Popen(
+        [
+            sys.executable, __file__, "--interleaved",
+            "--rounds", str(rounds), "--steps", str(steps),
+        ],
+        stdout=subprocess.PIPE,
+        text=True,
+    )
     try:
-        rc = proc.wait(timeout=budget)
-        if rc != 0:
+        out, _ = proc.communicate(timeout=budget)
+        if proc.returncode != 0:
             print(
-                f"[bench] device bench failed rc={rc}; "
+                f"[bench] device bench failed rc={proc.returncode}; "
                 "falling back to CPU proxy",
                 file=sys.stderr,
             )
-        return rc == 0
+            return False
+        sys.stdout.write(out)
+        return True
     except subprocess.TimeoutExpired:
         print(
             "[bench] device bench timed out; falling back to CPU proxy",
@@ -388,7 +401,7 @@ def _run_device_child() -> bool:
         )
         proc.kill()
         try:
-            proc.wait(timeout=10)
+            proc.communicate(timeout=10)
         except subprocess.TimeoutExpired:
             pass  # D-state zombie: abandon it, the contract matters more
         return False
@@ -420,7 +433,7 @@ def main() -> int:
             # device path runs in a BOUNDED child: a tunnel that probes
             # healthy can still wedge mid-run inside C++ (unkillable from
             # threads), and the one-JSON-line contract must survive that
-            if _run_device_child():
+            if _run_device_child(args.rounds, args.steps):
                 return 0
             return _cpu_proxy_fallback()
     try:

@@ -1,0 +1,144 @@
+"""Transport robustness battery: garbage frames, boundary fuzz,
+trickled bytes, oversized-frame client eviction, reconnect after server
+restart — the ingest port is unauthenticated, so the server must treat
+every byte as hostile (reference: the drain/ingest edge tests)."""
+
+import random
+import socket
+import struct
+import time
+
+from traceml_tpu.transport.tcp_transport import (
+    MAX_FRAME_BYTES,
+    TCPClient,
+    TCPServer,
+    encode_frame,
+)
+from traceml_tpu.utils import msgpack_codec
+
+_LEN = struct.Struct(">I")
+
+
+def _collect(server, n, timeout=5.0):
+    got = []
+    deadline = time.monotonic() + timeout
+    while len(got) < n and time.monotonic() < deadline:
+        server.wait_for_data(0.1)
+        got.extend(server.drain())
+    return got
+
+
+def test_garbage_bytes_bump_decode_errors_not_crash():
+    server = TCPServer()
+    server.start()
+    try:
+        sock = socket.create_connection(("127.0.0.1", server.port))
+        # three "frames" of undecodable junk with valid length prefixes
+        for junk in (b"\x00\xff\x13\x37", b"\x7f" * 64, b"\x01"):
+            sock.sendall(_LEN.pack(len(junk)) + junk)
+        # then a real one: the server must still be serving
+        sock.sendall(encode_frame({"ok": True}))
+        got = _collect(server, 1)
+        assert got == [{"ok": True}]
+        assert server.decode_errors == 3
+    finally:
+        server.stop()
+
+
+def test_frame_boundary_fuzz():
+    """100 frames sent with random split points across send() calls —
+    reassembly must be exact and ordered."""
+    server = TCPServer()
+    server.start()
+    try:
+        payloads = [{"i": i, "blob": "x" * (i % 97)} for i in range(100)]
+        stream = b"".join(encode_frame(p) for p in payloads)
+        rng = random.Random(7)
+        sock = socket.create_connection(("127.0.0.1", server.port))
+        pos = 0
+        while pos < len(stream):
+            cut = min(len(stream), pos + rng.randint(1, 211))
+            sock.sendall(stream[pos:cut])
+            pos = cut
+        got = _collect(server, 100)
+        assert got == payloads
+        assert server.frames_received == 100
+    finally:
+        server.stop()
+
+
+def test_oversized_frame_evicts_only_that_client():
+    server = TCPServer()
+    server.start()
+    try:
+        bad = socket.create_connection(("127.0.0.1", server.port))
+        good = socket.create_connection(("127.0.0.1", server.port))
+        bad.sendall(_LEN.pack(MAX_FRAME_BYTES + 1))
+        good.sendall(encode_frame({"fine": 1}))
+        got = _collect(server, 1)
+        assert got == [{"fine": 1}]
+        # evicted client sees a closed connection eventually
+        bad.settimeout(3)
+        assert bad.recv(1) == b""
+        # the good client keeps working
+        good.sendall(encode_frame({"fine": 2}))
+        assert _collect(server, 1) == [{"fine": 2}]
+    finally:
+        server.stop()
+
+
+def test_client_survives_server_restart():
+    server = TCPServer()
+    server.start()
+    port = server.port
+    client = TCPClient("127.0.0.1", port, reconnect_backoff=0.05)
+    try:
+        assert client.send_batch([{"n": 1}])
+        _collect(server, 1)
+        server.stop()
+        # sends while down eventually fail (the FIRST may land in the
+        # kernel buffer before the RST arrives — normal TCP); they must
+        # return False, never raise
+        deadline = time.monotonic() + 5
+        failed = False
+        while time.monotonic() < deadline and not failed:
+            failed = client.send_batch([{"n": 2}]) is False
+            time.sleep(0.05)
+        assert failed, "send never failed with the server down"
+        # new server on the SAME port
+        server2 = TCPServer(port=port)
+        server2.start()
+        try:
+            deadline = time.monotonic() + 5
+            sent = False
+            while time.monotonic() < deadline and not sent:
+                sent = client.send_batch([{"n": 3}])
+                time.sleep(0.05)
+            assert sent, "client never reconnected"
+            got = _collect(server2, 1)
+            assert got and got[0]["n"] == 3
+        finally:
+            server2.stop()
+    finally:
+        client.close()
+
+
+def test_legacy_raw_msgpack_frame_accepted():
+    """Reference-style frames (raw msgpack body, no codec prefix) decode
+    through the legacy fallback at the transport level too."""
+    import msgpack
+
+    server = TCPServer()
+    server.start()
+    try:
+        body = msgpack.packb({"legacy": True}, use_bin_type=True)
+        sock = socket.create_connection(("127.0.0.1", server.port))
+        sock.sendall(_LEN.pack(len(body)) + body)
+        got = _collect(server, 1)
+        assert got == [{"legacy": True}]
+    finally:
+        server.stop()
+
+
+def test_codec_name_reported():
+    assert msgpack_codec.codec_name() in ("msgpack", "json")
