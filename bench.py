@@ -50,8 +50,11 @@ if str(REPO) not in sys.path:
 
 WARMUP_STEPS = 6
 ROUNDS = 10          # in-process (TPU) mode
-N_PAIRS = 6          # alternating solo (CPU) mode: U,T pairs
-ROUNDS_PER_PHASE = 2
+# alternating solo (CPU) mode: MANY SHORT pairs — the shared host has
+# bursty neighbor load on ~10s scales, so short phases localize a burst
+# to one pair (the median absorbs it) instead of poisoning a long block
+N_PAIRS = 10
+ROUNDS_PER_PHASE = 1
 STEPS_PER_ROUND = 16
 _PROBE_TIMEOUT_S = 90
 _READY_TIMEOUT_S = 240  # import + first compile
@@ -250,14 +253,22 @@ def _orchestrate() -> int:
     env["TRACEML_BENCH_CACHE"] = str(work / "xla_cache")
     u_all, t_all, deltas = [], [], []
     for i in range(N_PAIRS):
-        u = _solo_phase("untraced", ROUNDS_PER_PHASE, work / f"u{i}.json", env)
-        t = _solo_phase("traced", ROUNDS_PER_PHASE, work / f"t{i}.json", env)
+        # alternate the order within pairs so slow machine drift biases
+        # half the pairs each way and cancels in the median
+        order = ("untraced", "traced") if i % 2 == 0 else ("traced", "untraced")
+        results = {}
+        for arm in order:
+            results[arm] = _solo_phase(
+                arm, ROUNDS_PER_PHASE, work / f"{arm[0]}{i}.json", env
+            )
+        u, t = results["untraced"], results["traced"]
         u_med, t_med = statistics.median(u), statistics.median(t)
         u_all += u
         t_all += t
         deltas.append((t_med - u_med) / u_med * 100.0)
         print(
-            f"[bench] pair {i}: untraced {u_med * 1000:.2f} traced "
+            f"[bench] pair {i} ({order[0][0]}{order[1][0]}): "
+            f"untraced {u_med * 1000:.2f} traced "
             f"{t_med * 1000:.2f} ms/step ({deltas[-1]:+.2f}%)",
             file=sys.stderr,
         )
