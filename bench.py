@@ -11,13 +11,15 @@ Methodology (the round-1 in-process interleave was noise-dominated at
 rounds sharing its process; on a 1-core host even an idle-polling second
 process contaminates the arm being measured):
 
-* **alternating solo child processes** — U,T,U,T,…: each phase is a
-  fresh process that runs its arm alone (warmup + a few rounds) and
-  exits.  While an arm is measured NOTHING else of the bench is running,
-  so the untraced baseline contains zero tracer work — and adjacent U/T
-  phases are ~30 s apart, so slow machine-load drift cancels in the
-  per-pair deltas (observed drift on the shared 1-core host: ~5%/3 min,
-  enough to swamp a sequential-block design);
+* **many short alternating solo child processes** — each phase is a
+  fresh process that runs its arm alone (warmup + ONE round) and exits,
+  so while an arm is measured NOTHING else of the bench is running and
+  the untraced baseline contains zero tracer work.  Ten pairs with the
+  arm order flipped between pairs (UT, TU, UT, …): slow machine-load
+  drift biases half the pairs each way and cancels in the median, and a
+  neighbor-load burst (observed on the shared 1-core host at ~10 s
+  scales) lands in one short pair that the median absorbs instead of
+  poisoning a long block;
 * a shared persistent XLA compilation cache keeps the per-spawn compile
   cost low;
 * the reported value is the median per-pair delta with a bootstrap 95%

@@ -1,0 +1,81 @@
+"""Direct unit coverage for two load-bearing-but-indirectly-tested
+modules: the declarative sampler registry (profiles/rank gating) and
+the final-summary request service (request → settle → generate →
+response → clear)."""
+
+from traceml_tpu.aggregator.summary_service import FinalSummaryService
+from traceml_tpu.runtime.identity import RuntimeIdentity
+from traceml_tpu.runtime.sampler_registry import (
+    SAMPLER_REGISTRY,
+    build_samplers,
+    register_default_samplers,
+)
+from traceml_tpu.runtime.settings import TraceMLSettings
+from traceml_tpu.sdk import protocol
+
+
+def _settings(tmp_path, mode="summary"):
+    return TraceMLSettings(session_id="s", logs_dir=tmp_path, mode=mode)
+
+
+def test_default_registry_contents():
+    register_default_samplers()
+    for key in ("system", "process", "step_time", "step_memory"):
+        assert key in SAMPLER_REGISTRY
+    assert SAMPLER_REGISTRY.get("system").node_primary_only
+    assert SAMPLER_REGISTRY.get("step_time").drain_on_recording_stop
+
+
+def test_node_primary_only_gating(tmp_path):
+    primary = build_samplers(
+        _settings(tmp_path), RuntimeIdentity(global_rank=0, local_rank=0)
+    )
+    secondary = build_samplers(
+        _settings(tmp_path), RuntimeIdentity(global_rank=1, local_rank=1)
+    )
+    names_primary = {s.name for s in primary}
+    names_secondary = {s.name for s in secondary}
+    assert "system" in names_primary      # node-primary samples the host
+    assert "system" not in names_secondary  # other local ranks don't
+    for key in ("process", "step_time", "step_memory"):
+        assert key in names_primary and key in names_secondary
+    for s in primary + secondary:
+        s.stop()
+
+
+def test_summary_service_serves_request(tmp_path):
+    settings = _settings(tmp_path)
+    settings.session_dir.mkdir(parents=True, exist_ok=True)
+    settled, generated = [], []
+    svc = FinalSummaryService(
+        settings,
+        generate=lambda: generated.append(1) or True,
+        settle=lambda: settled.append(1),
+        poll_interval=0.0,
+    )
+    svc.poll()  # no request yet
+    assert not generated
+    protocol.write_summary_request(settings.session_dir, requester_rank=0)
+    svc.poll()
+    assert settled and generated
+    assert svc.requests_served == 1
+    resp = protocol.read_summary_response(settings.session_dir)
+    assert resp and resp["ok"] is True
+    # request cleared → no double-serve
+    svc.poll()
+    assert svc.requests_served == 1
+
+
+def test_summary_service_failure_writes_error(tmp_path):
+    settings = _settings(tmp_path)
+    settings.session_dir.mkdir(parents=True, exist_ok=True)
+
+    def boom():
+        raise RuntimeError("db corrupt")
+
+    svc = FinalSummaryService(settings, generate=boom, poll_interval=0.0)
+    protocol.write_summary_request(settings.session_dir, requester_rank=0)
+    svc.poll()  # must not raise
+    resp = protocol.read_summary_response(settings.session_dir)
+    assert resp and resp["ok"] is False
+    assert "db corrupt" in resp.get("error", "")
