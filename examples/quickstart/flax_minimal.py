@@ -21,7 +21,7 @@ step = traceml_tpu.wrap_step_fn(make_train_step(model, tx), donate_argnums=(0,))
 rng = np.random.default_rng(0)
 
 
-def batches(n=200):
+def batches(n=60):
     for _ in range(n):
         yield rng.integers(0, cfg.vocab_size, (8, 256)).astype(np.int32)
 
@@ -32,4 +32,10 @@ for tokens in traceml_tpu.wrap_dataloader(batches()):
         state, metrics = step(state, tokens)
 
 print("final loss:", float(metrics["loss"]))
-print(traceml_tpu.summary())
+# per-step/live projection works standalone (in-process); the full
+# summary() projection needs the aggregator `traceml-tpu run` provides
+print(traceml_tpu.live_metrics())
+import os
+
+if os.environ.get("TRACEML_SESSION_ID"):  # under the launcher
+    print(traceml_tpu.summary())
