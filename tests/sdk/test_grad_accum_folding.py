@@ -1,0 +1,65 @@
+"""Grad-accumulation / multi-dispatch folding under one trace_step
+(SURVEY §7 hard-parts list: microbatch folding semantics under pjit).
+
+N microbatch dispatches inside one step must fold into ONE step row
+with the compute slot counting N occurrences and the step envelope's
+device end tracking the LAST dispatch."""
+
+import jax
+import jax.numpy as jnp
+
+import traceml_tpu
+from traceml_tpu.samplers.step_time_sampler import _aggregate_step
+from traceml_tpu.sdk.state import get_state
+from traceml_tpu.utils import timing as T
+
+
+def test_microbatches_fold_into_one_step():
+    st = get_state()
+    captured = []
+    st.on_batch_flushed.append(captured.append)
+    try:
+        fn = traceml_tpu.wrap_step_fn(lambda x: (x * 2).sum())
+        x = jnp.ones((32, 32))
+        with traceml_tpu.trace_step():
+            for _ in range(4):  # grad-accum microbatches
+                out = fn(x)
+        jax.block_until_ready(out)
+        batch = captured[-1]
+        computes = [e for e in batch.events if e.name == T.COMPUTE_TIME]
+        assert len(computes) == 4
+        # envelope marker is the LAST dispatch's marker (shared object)
+        env = next(e for e in batch.events if e.name == T.STEP_TIME)
+        assert env.marker is computes[-1].marker
+        # the sampler folds them into one row: compute count == 4,
+        # cpu_ms summed over the microbatches
+        batch.force_resolve()
+        row, _ = _aggregate_step(batch.events, None)
+        slot = row["events"][T.COMPUTE_TIME]
+        assert slot["count"] == 4
+        assert slot["cpu_ms"] >= sum(e.cpu_ms for e in computes) * 0.99
+    finally:
+        st.on_batch_flushed.remove(captured.append)
+
+
+def test_two_wrapped_fns_in_one_step():
+    """Multi-model steps: each wrapped fn contributes compute events to
+    the same step; the last dispatched one owns the envelope end."""
+    st = get_state()
+    captured = []
+    st.on_batch_flushed.append(captured.append)
+    try:
+        f1 = traceml_tpu.wrap_step_fn(lambda x: x.sum())
+        f2 = traceml_tpu.wrap_step_fn(lambda x: (x + 1).mean())
+        x = jnp.ones((16, 16))
+        with traceml_tpu.trace_step():
+            f1(x)
+            out = f2(x)
+        jax.block_until_ready(out)
+        batch = captured[-1]
+        computes = [e for e in batch.events if e.name == T.COMPUTE_TIME]
+        assert len(computes) == 2
+        env = next(e for e in batch.events if e.name == T.STEP_TIME)
+        assert env.marker is computes[-1].marker
+    finally:
+        st.on_batch_flushed.remove(captured.append)
