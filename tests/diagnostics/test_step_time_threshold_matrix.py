@@ -1,0 +1,201 @@
+"""Step-time policy threshold matrix — exact boundary behavior for
+every numeric gate, live vs summary (reference style: the rule-threshold
+matrices VERDICT r1 flagged as thin).
+
+The window is built from hand-rows where the target share is exact, so
+each case sits just under / at / above a policy constant."""
+
+import pytest
+
+from traceml_tpu.diagnostics.step_time.api import diagnose_rank_rows
+from traceml_tpu.diagnostics.step_time.policy import LIVE_POLICY, SUMMARY_POLICY
+from traceml_tpu.utils import timing as T
+
+
+def _row(step, step_ms, input_ms=0.0, compute_ms=0.0, residual_share=None,
+         compile_ms=0.0):
+    events = {
+        T.STEP_TIME: {"cpu_ms": step_ms, "device_ms": step_ms, "count": 1},
+    }
+    if input_ms:
+        events[T.DATALOADER_NEXT] = {
+            "cpu_ms": input_ms, "device_ms": None, "count": 1
+        }
+    if compute_ms:
+        events[T.COMPUTE_TIME] = {
+            "cpu_ms": 0.5, "device_ms": compute_ms, "count": 1
+        }
+    if compile_ms:
+        events[T.COMPILE_TIME] = {
+            "cpu_ms": compile_ms, "device_ms": None, "count": 1
+        }
+    return {"step": step, "clock": "device", "events": events}
+
+
+def _world(n_steps=60, **kw):
+    return {0: [_row(s, **kw) for s in range(1, n_steps + 1)]}
+
+
+def _kinds(rows, mode):
+    return {i.kind for i in diagnose_rank_rows(rows, mode=mode).issues}
+
+
+# --- INPUT_BOUND boundaries -------------------------------------------------
+
+@pytest.mark.parametrize("mode,policy", [
+    ("live", LIVE_POLICY), ("summary", SUMMARY_POLICY),
+])
+def test_input_bound_boundaries(mode, policy):
+    step = 100.0
+    just_under = _world(step_ms=step,
+                        input_ms=step * (policy.input_share_warn - 0.02),
+                        compute_ms=50.0)
+    assert "INPUT_BOUND" not in _kinds(just_under, mode)
+
+    at_warn = _world(step_ms=step,
+                     input_ms=step * (policy.input_share_warn + 0.01),
+                     compute_ms=50.0)
+    result = diagnose_rank_rows(at_warn, mode=mode)
+    issue = next(i for i in result.issues if i.kind == "INPUT_BOUND")
+    assert issue.severity == "warning"
+
+    at_crit = _world(step_ms=step,
+                     input_ms=step * (policy.input_share_critical + 0.01),
+                     compute_ms=40.0)
+    result = diagnose_rank_rows(at_crit, mode=mode)
+    issue = next(i for i in result.issues if i.kind == "INPUT_BOUND")
+    assert issue.severity == "critical"
+
+
+# --- RESIDUAL_HEAVY boundaries ----------------------------------------------
+
+@pytest.mark.parametrize("mode,policy", [
+    ("live", LIVE_POLICY), ("summary", SUMMARY_POLICY),
+])
+def test_residual_boundaries(mode, policy):
+    step = 100.0
+    # residual = step − compute (no other phases)
+    ok = _world(step_ms=step,
+                compute_ms=step * (1 - policy.residual_share_warn + 0.02))
+    assert "RESIDUAL_HEAVY" not in _kinds(ok, mode)
+
+    warn = _world(step_ms=step,
+                  compute_ms=step * (1 - policy.residual_share_warn - 0.01))
+    result = diagnose_rank_rows(warn, mode=mode)
+    issue = next(i for i in result.issues if i.kind == "RESIDUAL_HEAVY")
+    assert issue.severity == "warning"
+
+    crit = _world(step_ms=step,
+                  compute_ms=step * (1 - policy.residual_share_critical - 0.01))
+    result = diagnose_rank_rows(crit, mode=mode)
+    issue = next(i for i in result.issues if i.kind == "RESIDUAL_HEAVY")
+    assert issue.severity == "critical"
+
+
+# --- straggler score + dominance boundaries ---------------------------------
+
+def _straggler_world(slow_extra_input, n_ranks=4, step=100.0):
+    """Sync-consistent shape: every rank's step is gated at step+e; the
+    slow rank spends the extra in input, fast ranks wait in the sync
+    (compute) phase.  Clean-straggler score ≈ e / (step+e), and the
+    input delta is the ONLY clean component → INPUT attribution."""
+    e = slow_extra_input
+    rows = {}
+    for r in range(n_ranks):
+        slow = r == n_ranks - 1
+        rows[r] = [
+            _row(s, step_ms=step + e,
+                 input_ms=(5.0 + e) if slow else 5.0,
+                 compute_ms=90.0 if slow else 90.0 + e)
+            for s in range(1, 41)
+        ]
+    return rows
+
+
+def test_straggler_score_boundary():
+    below = _straggler_world(slow_extra_input=8.0)   # score ≈ 0.074 < 0.10
+    kinds = _kinds(below, "live")
+    assert not kinds & {"INPUT_STRAGGLER", "STRAGGLER"}
+
+    above = _straggler_world(slow_extra_input=13.0)  # score ≈ 0.115
+    result = diagnose_rank_rows(above, mode="live")
+    issue = next(
+        i for i in result.issues if i.kind in ("INPUT_STRAGGLER", "STRAGGLER")
+    )
+    assert issue.kind == "INPUT_STRAGGLER"  # input delta dominates
+    assert issue.severity == "warning"
+
+    critical = _straggler_world(slow_extra_input=36.0)  # score ≈ 0.26
+    result = diagnose_rank_rows(critical, mode="live")
+    issue = next(i for i in result.issues if i.kind == "INPUT_STRAGGLER")
+    assert issue.severity == "critical"
+
+
+def test_straggler_mixed_when_no_dominant_component():
+    # sync-consistent world (every rank's step gated at 130): the slow
+    # rank lags equally in input and residual (+15/+15), fast ranks park
+    # the wait in the sync (compute) phase — dominance 1.0 < 1.25 →
+    # mixed STRAGGLER
+    rows = {}
+    for r in range(4):
+        slow = r == 3
+        rows[r] = [
+            _row(s, step_ms=130.0,
+                 input_ms=20.0 if slow else 5.0,      # +15 input
+                 compute_ms=80.0 if slow else 110.0)  # fast: 80 + 30 wait
+            # residual: slow 30, fast 15 → +15
+            for s in range(1, 41)
+        ]
+    result = diagnose_rank_rows(rows, mode="live")
+    issue = next(
+        i for i in result.issues
+        if i.kind in ("STRAGGLER", "INPUT_STRAGGLER", "RESIDUAL_STRAGGLER")
+    )
+    assert issue.kind == "STRAGGLER"
+    assert issue.ranks == [3]
+
+
+# --- compile warmup boundary ------------------------------------------------
+
+def test_compile_warmup_steps_not_counted():
+    policy_warmup = LIVE_POLICY.compile_warmup_steps
+
+    def world(recompile_pred):
+        # a recompiling step really TAKES the compile time (the window
+        # clamps any phase to its step envelope, so an un-stretched step
+        # would swallow the compile)
+        rows = {0: []}
+        for s in range(1, 61):
+            compiling = recompile_pred(s)
+            rows[0].append(_row(
+                s,
+                step_ms=600.0 if compiling else 100.0,
+                compute_ms=90.0,
+                compile_ms=500.0 if compiling else 0.0,
+            ))
+        return rows
+
+    # big compiles ONLY within the warmup steps → not pathological
+    warmup_only = world(lambda s: s <= policy_warmup)
+    assert "COMPILE_BOUND" not in _kinds(warmup_only, "live")
+
+    # the same compile mass AFTER warmup fires
+    recompiles = world(lambda s: policy_warmup < s <= policy_warmup + 3)
+    assert "COMPILE_BOUND" in _kinds(recompiles, "live")
+
+
+# --- min-steps gates --------------------------------------------------------
+
+@pytest.mark.parametrize("mode,policy", [
+    ("live", LIVE_POLICY), ("summary", SUMMARY_POLICY),
+])
+def test_min_steps_gate(mode, policy):
+    under = _world(n_steps=policy.min_steps - 1, step_ms=100.0,
+                   input_ms=60.0, compute_ms=30.0)
+    result = diagnose_rank_rows(under, mode=mode)
+    assert result.diagnosis.kind == "INSUFFICIENT_STEP_TIME_DATA"
+
+    at = _world(n_steps=policy.min_steps, step_ms=100.0,
+                input_ms=60.0, compute_ms=30.0)
+    result = diagnose_rank_rows(at, mode=mode)
+    assert result.diagnosis.kind == "INPUT_BOUND"
