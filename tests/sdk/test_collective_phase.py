@@ -100,6 +100,46 @@ def test_collective_straggler_rule_fires():
     )
 
 
+def test_intra_step_device_edges_are_timely():
+    """Markers must be submitted AT DISPATCH so the resolver stamps each
+    phase's readiness while the step runs — deferring submission to step
+    exit collapses the edges and zeroes phase durations (regression:
+    the collective scenario once read 0.05 ms instead of ~30 ms)."""
+    import jax
+    import jax.numpy as jnp
+
+    import traceml_tpu
+    from traceml_tpu.samplers.step_time_sampler import _aggregate_step
+    from traceml_tpu.sdk.state import get_state
+
+    st = get_state()
+    captured = []
+    st.on_batch_flushed.append(captured.append)
+    try:
+        fn = traceml_tpu.wrap_step_fn(lambda x: (x * 2).sum())
+        sync_op = jax.jit(lambda t: t * 0.5)
+
+        def gradient_sync(t):
+            time.sleep(0.06)  # the "slow link"
+            return sync_op(t)
+
+        timed_sync = traceml_tpu.wrap_collective(gradient_sync)
+        x = jnp.ones((16, 16))
+        with traceml_tpu.trace_step():
+            out = fn(x)
+            out = timed_sync(out)
+        jax.block_until_ready(out)
+        time.sleep(0.05)  # let the resolver stamp
+        batch = captured[-1]
+        batch.force_resolve()
+        row, _ = _aggregate_step(batch.events, None)
+        coll = row["events"][T.COLLECTIVE_TIME]
+        assert coll["device_ms"] is not None
+        assert coll["device_ms"] >= 45.0, coll  # ≈ the 60 ms sleep window
+    finally:
+        st.on_batch_flushed.remove(captured.append)
+
+
 # --- torch-xla emitter via stub --------------------------------------------
 
 @pytest.fixture()

@@ -128,9 +128,13 @@ class trace_time:
         try:
             if self._region is not None:
                 self._region.__exit__(exc_type, exc, tb)
-                ev: TimeEvent = self._region.event
-                if ev.marker is not None and not ev.marker.resolved:
-                    get_marker_resolver().submit(ev.marker)
+                from traceml_tpu.sdk.wrappers import publish_region_marker
+
+                # a marked user region behaves like every other phase
+                # owner: envelope hand-off (a last-dispatch user region
+                # must extend the step's device end) + dispatch-time
+                # resolver submission
+                publish_region_marker(self._region.event, self._state)
         except Exception as err:
             get_error_log().warning("trace_time exit failed", err)
         return False

@@ -24,7 +24,7 @@ from __future__ import annotations
 from typing import Any, Callable, Dict, Optional, Tuple
 
 from traceml_tpu.sdk.state import TraceState, get_state
-from traceml_tpu.utils.marker_resolver import get_marker_resolver
+from traceml_tpu.sdk.wrappers import publish_region_marker
 from traceml_tpu.utils.timing import COMPUTE_TIME, DeviceMarker, timed_region
 
 
@@ -106,14 +106,11 @@ class WrappedStepFn:
             # pytree flatten and a single resolver poll per step.
             handles = self._pick_handles(out)
             if handles:
-                marker = DeviceMarker(handles)
-                tr.event.marker = marker
-                env = st.active_step_event
-                if env is not None:
-                    env.marker = marker
-        ev = region.event
-        if ev.marker is not None and not ev.marker.resolved:
-            get_marker_resolver().submit(ev.marker)
+                tr.event.marker = DeviceMarker(handles)
+        # envelope hand-off + dispatch-time resolver submission (the
+        # fine-cadence stamping that intra-step device edges need) —
+        # see publish_region_marker's docstring
+        publish_region_marker(region.event, st)
         return out
 
 

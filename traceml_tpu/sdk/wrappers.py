@@ -51,15 +51,25 @@ def _timed_call(
 
 def publish_region_marker(ev, st: TraceState) -> None:
     """Post-close marker publication, shared by every phase owner
-    (manual wrappers here, the Lightning callback): hand the marker to
-    the open step envelope — last dispatch wins, or a post-compute
-    collective/h2d would fall outside the envelope and get clamped away
-    by the window builder — and submit it for background resolution."""
+    (manual wrappers here, the Lightning callback, wrap_step_fn): hand
+    the marker to the open step envelope — last dispatch wins, or a
+    post-compute collective/h2d would fall outside the envelope and get
+    clamped away by the window builder — and route it to the resolver.
+
+    Submission happens AT DISPATCH on purpose: the resolver's fine
+    cadence stamps each phase's readiness WHILE the step runs, which is
+    what gives intra-step device edges (compute → collective → …) their
+    timeliness.  Deferring submission to step exit collapses every
+    edge onto the exit sweep's observation instant and zeroes the
+    phase durations (regression caught by the collective-straggler
+    scenario E2E) — the per-dispatch wake is the price of observation.
+    """
     if ev.marker is None:
         return
-    env = st.active_step_event
-    if st.tls.in_step and env is not None:
-        env.marker = ev.marker
+    if st.tls.in_step:
+        env = st.active_step_event
+        if env is not None:
+            env.marker = ev.marker
     if not ev.marker.resolved:
         get_marker_resolver().submit(ev.marker)
 
