@@ -129,8 +129,9 @@ def _build():
     return model, state, tx, train_step, batches
 
 
-def _run_loop(step_fn, state, batches, n_steps, bracket=None):
-    """Time n_steps; returns (median_step_s, final_state)."""
+def _run_loop(step_fn, state, batches, n_steps, bracket=None, stat=None):
+    """Time n_steps; returns (stat(step_s), final_state).
+    ``stat`` defaults to the median; the solo child arms pass ``min``."""
     import jax
 
     times = []
@@ -146,7 +147,7 @@ def _run_loop(step_fn, state, batches, n_steps, bracket=None):
         # time; identical in both arms so the delta is tracer overhead
         jax.block_until_ready(metrics["loss"])
         times.append(time.perf_counter() - t0)
-    return statistics.median(times), state
+    return (stat or statistics.median)(times), state
 
 
 # --------------------------------------------------------------------------
@@ -155,7 +156,8 @@ def _run_loop(step_fn, state, batches, n_steps, bracket=None):
 
 def _child(arm: str, rounds: int, steps: int, out_path: Path) -> int:
     """Run one arm solo: warmup, then ``rounds`` rounds of ``steps`` steps;
-    writes a JSON list of per-round median step seconds."""
+    writes a JSON list of per-round MINIMUM step seconds (see the
+    statistic note below)."""
     import jax
 
     cache_dir = os.environ.get("TRACEML_BENCH_CACHE")
@@ -207,13 +209,26 @@ def _child(arm: str, rounds: int, steps: int, out_path: Path) -> int:
 
     _, state = _run_loop(step_fn, state, batches, WARMUP_STEPS, bracket=bracket)
 
-    medians = []
+    # per-phase statistic: MIN of the step times (pyperf-style).  The
+    # tracer's EVERY-step costs (envelope bookkeeping, marker flatten,
+    # resolver wakes — they fire each step) shift the minimum exactly as
+    # much as the mean, so they stay fully measured; transient scheduler
+    # steals from co-tenants (observed: minutes-long bursts inflating
+    # whole phases) do not survive a min over 16 steps.  What the min
+    # DOES exclude is the tracer's intermittent work — the 1 Hz sampler
+    # tick, measured at ~0.25 ms per tick ⇒ ~0.02% amortized at 150 ms
+    # steps — two orders below this host's noise floor; stated here so
+    # the metric's scope is exact.  Cross-pair aggregation stays a
+    # median over 10 alternating pairs.
+    mins = []
     for _ in range(rounds):
-        med, state = _run_loop(step_fn, state, batches, steps, bracket=bracket)
-        medians.append(med)
+        best, state = _run_loop(
+            step_fn, state, batches, steps, bracket=bracket, stat=min
+        )
+        mins.append(best)
     stop()
     tmp = out_path.with_suffix(".tmp")
-    tmp.write_text(json.dumps(medians))
+    tmp.write_text(json.dumps(mins))
     os.replace(tmp, out_path)
     return 0
 

@@ -19,6 +19,7 @@ from __future__ import annotations
 from typing import Any, Optional
 
 from traceml_tpu.sdk.state import TraceState, get_state
+from traceml_tpu.sdk.wrappers import publish_region_marker
 from traceml_tpu.utils.error_log import get_error_log
 from traceml_tpu.utils.marker_resolver import get_marker_resolver
 from traceml_tpu.utils.timing import STEP_TIME, TimeEvent, timed_region
@@ -128,8 +129,6 @@ class trace_time:
         try:
             if self._region is not None:
                 self._region.__exit__(exc_type, exc, tb)
-                from traceml_tpu.sdk.wrappers import publish_region_marker
-
                 # a marked user region behaves like every other phase
                 # owner: envelope hand-off (a last-dispatch user region
                 # must extend the step's device end) + dispatch-time
