@@ -11,15 +11,15 @@ Methodology (the round-1 in-process interleave was noise-dominated at
 rounds sharing its process; on a 1-core host even an idle-polling second
 process contaminates the arm being measured):
 
-* **many short alternating solo child processes** — each phase is a
-  fresh process that runs its arm alone (warmup + ONE round) and exits,
-  so while an arm is measured NOTHING else of the bench is running and
-  the untraced baseline contains zero tracer work.  Ten pairs with the
-  arm order flipped between pairs (UT, TU, UT, …): slow machine-load
-  drift biases half the pairs each way and cancels in the median, and a
-  neighbor-load burst (observed on the shared 1-core host at ~10 s
-  scales) lands in one short pair that the median absorbs instead of
-  poisoning a long block;
+* **one child process per pair, untraced arm first** — the baseline
+  runs before any tracer component is INITIALIZED (no runtime, no
+  aggregator, no resolver thread — only the model library is imported),
+  so isolation holds; then the same process starts the full traced
+  stack and measures the traced arm ~2 s later on a warm jit cache.  Tight
+  in-pair adjacency makes each pair robust to SUSTAINED co-tenant
+  bursts (observed on the shared 1-core host at minutes scales): a
+  burst covers both arms and cancels in the ratio.  Ten pairs; the
+  cross-pair median absorbs any pair where a burst edge split the arms;
 * a shared persistent XLA compilation cache keeps the per-spawn compile
   cost low;
 * the reported value is the median per-pair delta with a bootstrap 95%
@@ -52,11 +52,7 @@ if str(REPO) not in sys.path:
 
 WARMUP_STEPS = 6
 ROUNDS = 10          # in-process (TPU) mode
-# alternating solo (CPU) mode: MANY SHORT pairs — the shared host has
-# bursty neighbor load on ~10s scales, so short phases localize a burst
-# to one pair (the median absorbs it) instead of poisoning a long block
-N_PAIRS = 10
-ROUNDS_PER_PHASE = 1
+N_PAIRS = 10         # CPU mode: pair children (see module docstring)
 STEPS_PER_ROUND = 16
 _PROBE_TIMEOUT_S = 90
 _READY_TIMEOUT_S = 240  # import + first compile
@@ -154,88 +150,92 @@ def _run_loop(step_fn, state, batches, n_steps, bracket=None, stat=None):
 # child arms
 # --------------------------------------------------------------------------
 
-def _child(arm: str, rounds: int, steps: int, out_path: Path) -> int:
-    """Run one arm solo: warmup, then ``rounds`` rounds of ``steps`` steps;
-    writes a JSON list of per-round MINIMUM step seconds (see the
-    statistic note below)."""
+
+def _start_traced_stack():
+    """Bring up the FULL traced stack (aggregator sink + runtime agent +
+    auto patches); returns (traceml_tpu module, stop callable).  Shared
+    by every live bench mode so they all measure the same configuration.
+    """
+    import tempfile
+
+    import traceml_tpu
+    from traceml_tpu.aggregator.trace_aggregator import TraceMLAggregator
+    from traceml_tpu.runtime.identity import RuntimeIdentity
+    from traceml_tpu.runtime.runtime import TraceMLRuntime
+    from traceml_tpu.runtime.settings import AggregatorEndpoint, TraceMLSettings
+
+    tmp = Path(tempfile.mkdtemp(prefix="traceml_bench_"))
+    agg = TraceMLAggregator(TraceMLSettings(
+        session_id="bench", logs_dir=tmp, mode="summary",
+        aggregator=AggregatorEndpoint(port=0), expected_world_size=1,
+        finalize_timeout_sec=10.0,
+    ))
+    agg.start()
+    runtime = TraceMLRuntime(
+        TraceMLSettings(
+            session_id="bench", logs_dir=tmp, mode="summary",
+            aggregator=AggregatorEndpoint(port=agg.port or 0),
+            sampler_interval_sec=1.0,
+        ),
+        RuntimeIdentity(global_rank=0),
+    )
+    runtime.start()
+    traceml_tpu.init(mode="auto")
+
+    def stop():
+        runtime.stop()
+        agg.stop(finalize_timeout=5.0)
+
+    return traceml_tpu, runtime, stop
+
+
+def _pair_child(steps: int, out_path: Path) -> int:
+    """One FULL pair in one process, untraced arm first.
+
+    Isolation holds because no tracer component is initialized until
+    the untraced measurement is done — the baseline runs with zero
+    tracer threads (only traceml's model library gets imported, which
+    starts nothing).  Running both arms back-to-back (~2 s apart,
+    sharing the jit cache) makes the pair robust to SUSTAINED co-tenant
+    bursts: a burst spanning minutes covers both arms and cancels in
+    the ratio, where the two-spawn design left ~15 s between arms for
+    the burst to hit one side only.
+    """
     import jax
 
     cache_dir = os.environ.get("TRACEML_BENCH_CACHE")
     if cache_dir:
-        try:  # persistent compile cache: repeat spawns skip compilation
+        try:
             jax.config.update("jax_compilation_cache_dir", cache_dir)
             jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
         except Exception:
             pass
 
+    # enforce the strongest checkable precondition: the bench process
+    # reached this point without anything preloading traceml
+    assert "traceml_tpu" not in sys.modules
     model, state, tx, train_step, batches = _build()
+    plain = jax.jit(train_step, donate_argnums=(0,))
+    _, state = _run_loop(plain, state, batches, WARMUP_STEPS)
+    u, state = _run_loop(plain, state, batches, steps, stat=min)
 
-    if arm == "untraced":
-        step_fn = jax.jit(train_step, donate_argnums=(0,))
-        bracket = None
-        stop = lambda: None  # noqa: E731
-    else:
-        import tempfile
-
-        import traceml_tpu
-        from traceml_tpu.aggregator.trace_aggregator import TraceMLAggregator
-        from traceml_tpu.runtime.identity import RuntimeIdentity
-        from traceml_tpu.runtime.runtime import TraceMLRuntime
-        from traceml_tpu.runtime.settings import AggregatorEndpoint, TraceMLSettings
-
-        tmp = Path(tempfile.mkdtemp(prefix="traceml_bench_"))
-        agg = TraceMLAggregator(TraceMLSettings(
-            session_id="bench", logs_dir=tmp, mode="summary",
-            aggregator=AggregatorEndpoint(port=0), expected_world_size=1,
-            finalize_timeout_sec=10.0,
-        ))
-        agg.start()
-        runtime = TraceMLRuntime(
-            TraceMLSettings(
-                session_id="bench", logs_dir=tmp, mode="summary",
-                aggregator=AggregatorEndpoint(port=agg.port or 0),
-                sampler_interval_sec=1.0,
-            ),
-            RuntimeIdentity(global_rank=0),
-        )
-        runtime.start()
-        traceml_tpu.init(mode="auto")
-        step_fn = traceml_tpu.wrap_step_fn(train_step, donate_argnums=(0,))
-        bracket = traceml_tpu.trace_step
-
-        def stop():
-            runtime.stop()
-            agg.stop(finalize_timeout=5.0)
-
-    _, state = _run_loop(step_fn, state, batches, WARMUP_STEPS, bracket=bracket)
-
-    # per-phase statistic: MIN of the step times (pyperf-style).  The
-    # tracer's EVERY-step costs (envelope bookkeeping, marker flatten,
-    # resolver wakes — they fire each step) shift the minimum exactly as
-    # much as the mean, so they stay fully measured; transient scheduler
-    # steals from co-tenants (observed: minutes-long bursts inflating
-    # whole phases) do not survive a min over 16 steps.  What the min
-    # DOES exclude is the tracer's intermittent work — the 1 Hz sampler
-    # tick, measured at ~0.25 ms per tick ⇒ ~0.02% amortized at 150 ms
-    # steps — two orders below this host's noise floor; stated here so
-    # the metric's scope is exact.  Cross-pair aggregation stays a
-    # median over 10 alternating pairs.
-    mins = []
-    for _ in range(rounds):
-        best, state = _run_loop(
-            step_fn, state, batches, steps, bracket=bracket, stat=min
-        )
-        mins.append(best)
+    traceml_tpu, runtime, stop = _start_traced_stack()
+    model2, state2, tx2, train_step2, batches2 = _build()
+    traced = traceml_tpu.wrap_step_fn(train_step2, donate_argnums=(0,))
+    _, state2 = _run_loop(
+        traced, state2, batches2, WARMUP_STEPS, bracket=traceml_tpu.trace_step
+    )
+    t, state2 = _run_loop(
+        traced, state2, batches2, steps,
+        bracket=traceml_tpu.trace_step, stat=min,
+    )
     stop()
-    tmp = out_path.with_suffix(".tmp")
-    tmp.write_text(json.dumps(mins))
-    os.replace(tmp, out_path)
+
+    tmp_out = out_path.with_suffix(".tmp")
+    tmp_out.write_text(json.dumps({"u": u, "t": t}))
+    os.replace(tmp_out, out_path)
     return 0
 
-
-# --------------------------------------------------------------------------
-# parent orchestration
-# --------------------------------------------------------------------------
 
 def _bootstrap_ci(deltas, n=2000, seed=0):
     import random
@@ -247,21 +247,6 @@ def _bootstrap_ci(deltas, n=2000, seed=0):
     return meds[int(0.025 * n)], meds[int(0.975 * n)]
 
 
-def _solo_phase(arm: str, rounds: int, out_path: Path, env: dict) -> list:
-    proc = subprocess.run(
-        [
-            sys.executable, __file__, "--arm", arm,
-            "--rounds", str(rounds), "--steps", str(STEPS_PER_ROUND),
-            "--out", str(out_path),
-        ],
-        env=env,
-        timeout=_READY_TIMEOUT_S + rounds * _ROUND_TIMEOUT_S,
-    )
-    if proc.returncode != 0 or not out_path.exists():
-        raise RuntimeError(f"{arm} phase failed rc={proc.returncode}")
-    return json.loads(out_path.read_text())
-
-
 def _orchestrate() -> int:
     import tempfile
 
@@ -270,28 +255,30 @@ def _orchestrate() -> int:
     env["TRACEML_BENCH_CACHE"] = str(work / "xla_cache")
     u_all, t_all, deltas = [], [], []
     for i in range(N_PAIRS):
-        # alternate the order within pairs so slow machine drift biases
-        # half the pairs each way and cancels in the median
-        order = ("untraced", "traced") if i % 2 == 0 else ("traced", "untraced")
-        results = {}
-        for arm in order:
-            results[arm] = _solo_phase(
-                arm, ROUNDS_PER_PHASE, work / f"{arm[0]}{i}.json", env
-            )
-        u, t = results["untraced"], results["traced"]
-        u_med, t_med = statistics.median(u), statistics.median(t)
-        u_all += u
-        t_all += t
-        deltas.append((t_med - u_med) / u_med * 100.0)
+        out = work / f"pair{i}.json"
+        proc = subprocess.run(
+            [
+                sys.executable, __file__, "--pair",
+                "--steps", str(STEPS_PER_ROUND), "--out", str(out),
+            ],
+            env=env,
+            timeout=_READY_TIMEOUT_S + 2 * _ROUND_TIMEOUT_S,
+        )
+        if proc.returncode != 0 or not out.exists():
+            raise RuntimeError(f"pair {i} failed rc={proc.returncode}")
+        pair = json.loads(out.read_text())
+        u, t = pair["u"], pair["t"]
+        u_all.append(u)
+        t_all.append(t)
+        deltas.append((t - u) / u * 100.0)
         print(
-            f"[bench] pair {i} ({order[0][0]}{order[1][0]}): "
-            f"untraced {u_med * 1000:.2f} traced "
-            f"{t_med * 1000:.2f} ms/step ({deltas[-1]:+.2f}%)",
+            f"[bench] pair {i}: untraced {u * 1000:.2f} traced "
+            f"{t * 1000:.2f} ms/step ({deltas[-1]:+.2f}%)",
             file=sys.stderr,
         )
     # backend is known without importing jax here: this path only runs
     # on the cpu backend (device backends use _run_interleaved)
-    return _report(u_all, t_all, deltas, "cpu", "paired-solo")
+    return _report(u_all, t_all, deltas, "cpu", "pair-child")
 
 
 def _report(u_all, t_all, deltas, backend: str, mode: str,
@@ -325,37 +312,13 @@ def _run_interleaved(rounds: int = ROUNDS, steps: int = STEPS_PER_ROUND) -> int:
     where two processes cannot both claim the chip.  Host-side background
     threads overlap device compute there, so sharing the process does not
     perturb the untraced arm the way it does on the CPU backend."""
-    import tempfile
-
     import jax
 
     model, state, tx, train_step, batches = _build()
     plain = jax.jit(train_step, donate_argnums=(0,))
     _, state = _run_loop(plain, state, batches, WARMUP_STEPS)
 
-    import traceml_tpu
-    from traceml_tpu.aggregator.trace_aggregator import TraceMLAggregator
-    from traceml_tpu.runtime.identity import RuntimeIdentity
-    from traceml_tpu.runtime.runtime import TraceMLRuntime
-    from traceml_tpu.runtime.settings import AggregatorEndpoint, TraceMLSettings
-
-    tmp = Path(tempfile.mkdtemp(prefix="traceml_bench_"))
-    agg = TraceMLAggregator(TraceMLSettings(
-        session_id="bench", logs_dir=tmp, mode="summary",
-        aggregator=AggregatorEndpoint(port=0), expected_world_size=1,
-        finalize_timeout_sec=10.0,
-    ))
-    agg.start()
-    runtime = TraceMLRuntime(
-        TraceMLSettings(
-            session_id="bench", logs_dir=tmp, mode="summary",
-            aggregator=AggregatorEndpoint(port=agg.port or 0),
-            sampler_interval_sec=1.0,
-        ),
-        RuntimeIdentity(global_rank=0),
-    )
-    runtime.start()
-    traceml_tpu.init(mode="auto")
+    traceml_tpu, runtime, stop = _start_traced_stack()
 
     model2, state2, tx2, train_step2, batches2 = _build()
     traced = traceml_tpu.wrap_step_fn(train_step2, donate_argnums=(0,))
@@ -378,8 +341,7 @@ def _run_interleaved(rounds: int = ROUNDS, steps: int = STEPS_PER_ROUND) -> int:
         u_all.append(u)
         t_all.append(t)
         deltas.append((t - u) / u * 100.0)
-    runtime.stop()
-    agg.stop(finalize_timeout=5.0)
+    stop()
     return _report(u_all, t_all, deltas, jax.default_backend(), "in-process", steps)
 
 
@@ -437,15 +399,15 @@ def _run_device_child(rounds: int, steps: int) -> bool:
 
 def main() -> int:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--arm", choices=["untraced", "traced"])
+    parser.add_argument("--pair", action="store_true")
     parser.add_argument("--interleaved", action="store_true")
     parser.add_argument("--rounds", type=int, default=ROUNDS)
     parser.add_argument("--steps", type=int, default=STEPS_PER_ROUND)
     parser.add_argument("--out", type=str)
     args = parser.parse_args()
 
-    if args.arm:
-        return _child(args.arm, args.rounds, args.steps, Path(args.out))
+    if args.pair:
+        return _pair_child(args.steps, Path(args.out))
     if args.interleaved:
         return _run_interleaved(args.rounds, args.steps)
 
