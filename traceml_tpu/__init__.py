@@ -31,6 +31,7 @@ _API_SYMBOLS = (
     "wrap_backward",
     "wrap_optimizer",
     "wrap_collective",
+    "wrap_checkpoint",
     "current_step",
     "enable_ici_stats",
 )

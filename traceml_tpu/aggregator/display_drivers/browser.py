@@ -89,7 +89,8 @@ svg.spark{width:100%;height:60px;background:#15151f;border-radius:6px}
 <script>
 const COLORS={input:"#e74c3c",h2d:"#e67e22",forward:"#2d7dd2",
 backward:"#2255a4",optimizer:"#7d3dd2",compute:"#2d7dd2",
-compile:"#f1c40f",collective:"#16a085",residual:"#95a5a6"};
+compile:"#f1c40f",collective:"#16a085",checkpoint:"#8e5a2b",
+residual:"#95a5a6"};
 // telemetry strings (hostnames, diagnosis text, phase/rank keys) arrive
 // from an unauthenticated ingest port — escape EVERY interpolation.
 const esc=s=>String(s).replace(/[&<>"']/g,

@@ -11,6 +11,7 @@ from traceml_tpu.sdk.instrumentation import trace_step, trace_time  # noqa: F401
 from traceml_tpu.sdk.step_fn import wrap_step_fn  # noqa: F401
 from traceml_tpu.sdk.wrappers import (  # noqa: F401
     wrap_backward,
+    wrap_checkpoint,
     wrap_collective,
     wrap_forward,
     wrap_h2d,

@@ -55,6 +55,7 @@ _STRAGGLER_KIND_BY_PHASE = {
     "compute": "COMPUTE_STRAGGLER",
     "collective": "COLLECTIVE_STRAGGLER",
     "compile": "COMPILE_STRAGGLER",
+    "checkpoint": "CHECKPOINT_STRAGGLER",
 }
 
 

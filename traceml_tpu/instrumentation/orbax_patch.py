@@ -1,0 +1,167 @@
+"""Orbax checkpoint instrumentation (gated — applied only when
+``orbax.checkpoint`` is already loaded, same touch-nothing policy as
+every auto-patch).
+
+Beyond the reference (which has no checkpoint observation): a blocking
+checkpoint save gates every synchronous step on a pod, and without a
+phase it lands in ``residual``.  This patch wraps the save entry points
+of ``orbax.checkpoint`` — ``Checkpointer.save`` (which
+``PyTreeCheckpointer``/``StandardCheckpointer`` inherit),
+``AsyncCheckpointer.save`` (times the blocking dispatch part; the
+background wait is by design not in-step), and
+``CheckpointManager.save`` — in the first-class ``checkpoint`` phase
+via the shared duplicate-guarded ``_timed_call`` (a manager save that
+calls a checkpointer save underneath is timed exactly once).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, List
+
+from traceml_tpu.sdk.state import get_state
+from traceml_tpu.sdk.wrappers import _timed_call
+from traceml_tpu.utils.error_log import get_error_log
+from traceml_tpu.utils.timing import CHECKPOINT_TIME
+
+_patched: List[tuple] = []  # (cls, original save) for unpatch
+
+
+def orbax_loaded() -> bool:
+    import sys
+
+    return "orbax.checkpoint" in sys.modules
+
+
+def _wrap_save(cls) -> bool:
+    save = cls.__dict__.get("save")
+    if save is None or getattr(save, "_traceml_wrapped", False):
+        return False
+
+    @functools.wraps(save)
+    def timed_save(self, *args: Any, **kwargs: Any):
+        return _timed_call(
+            CHECKPOINT_TIME,
+            "checkpoint_depth",
+            lambda *a, **k: save(self, *a, **k),
+            get_state(),
+            False,
+            *args,
+            **kwargs,
+        )
+
+    timed_save._traceml_wrapped = True  # type: ignore[attr-defined]
+    cls.save = timed_save
+    _patched.append((cls, save))
+    return True
+
+
+class _PostImportHook:
+    """Meta-path finder that applies ``callback`` right after ``name``
+    is imported, then retires itself.  The launcher initializes tracing
+    BEFORE the user script runs, so a patch gated on "module already
+    loaded" would be inert in the primary deployment mode — this hook
+    closes that gap without importing the module on the user's behalf.
+    """
+
+    def __init__(self, name: str, callback) -> None:
+        self._name = name
+        self._callback = callback
+        self._busy = False
+
+    def find_spec(self, fullname, path=None, target=None):
+        if fullname != self._name or self._busy:
+            return None
+        import importlib.util
+
+        self._busy = True
+        try:
+            spec = importlib.util.find_spec(fullname)
+        finally:
+            self._busy = False
+        if spec is None or spec.loader is None:
+            return None
+        hook = self
+        orig_loader = spec.loader  # capture BEFORE replacing (the proxy
+        orig_exec = orig_loader.exec_module  # must not delegate to itself)
+
+        class _Loader:
+            def create_module(self, s):
+                return orig_loader.create_module(s)
+
+            def exec_module(self, module):
+                orig_exec(module)
+                hook.remove()
+                try:
+                    hook._callback()
+                except Exception as exc:
+                    get_error_log().warning(
+                        f"post-import patch for {fullname} failed", exc
+                    )
+
+            def __getattr__(self, attr):  # loader protocol passthrough
+                return getattr(orig_loader, attr)
+
+        spec.loader = _Loader()
+        return spec
+
+    def remove(self) -> None:
+        import sys
+
+        try:
+            sys.meta_path.remove(self)
+        except ValueError:
+            pass
+
+
+_hook: Any = None
+
+
+def install_orbax_patch() -> str:
+    """Patch now if orbax is loaded, else arm a post-import hook.
+    Returns "patched" | "deferred" | "noop"."""
+    global _hook
+    if orbax_loaded():
+        return "patched" if patch_orbax() else "noop"
+    if _hook is None:
+        import sys
+
+        _hook = _PostImportHook("orbax.checkpoint", patch_orbax)
+        sys.meta_path.insert(0, _hook)
+    return "deferred"
+
+
+def remove_orbax_hook() -> None:
+    global _hook
+    if _hook is not None:
+        _hook.remove()
+        _hook = None
+
+
+def patch_orbax() -> bool:
+    """Idempotent; False when orbax isn't loaded or nothing patched."""
+    if not orbax_loaded():
+        return False
+    try:
+        import orbax.checkpoint as ocp
+    except Exception:
+        return False
+    any_patched = False
+    for name in ("Checkpointer", "AsyncCheckpointer", "CheckpointManager"):
+        cls = getattr(ocp, name, None)
+        if cls is None:
+            continue
+        try:
+            any_patched = _wrap_save(cls) or any_patched
+        except Exception as exc:  # fail-open: never break checkpointing
+            get_error_log().warning(f"orbax patch failed for {name}", exc)
+    return any_patched
+
+
+def unpatch_orbax() -> None:
+    while _patched:
+        cls, save = _patched.pop()
+        try:
+            cls.save = save
+        except Exception:
+            pass

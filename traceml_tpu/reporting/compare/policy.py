@@ -36,6 +36,10 @@ DIAGNOSIS_RANK = {
     "INPUT_STRAGGLER": 4,
     "COMPUTE_STRAGGLER": 4,
     "COLLECTIVE_STRAGGLER": 4,
+    "CHECKPOINT_STRAGGLER": 4,
+    "H2D_STRAGGLER": 4,
+    "RESIDUAL_STRAGGLER": 4,
+    "COMPILE_STRAGGLER": 4,
     "STRAGGLER": 4,
     "MEMORY_CREEP": 4,
     "HIGH_PRESSURE": 4,

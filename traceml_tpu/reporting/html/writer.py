@@ -38,6 +38,7 @@ _PHASE_COLORS = {
     "compute": "#2d7dd2",
     "compile": "#f1c40f",
     "collective": "#16a085",
+    "checkpoint": "#8e5a2b",
     "residual": "#95a5a6",
 }
 

@@ -33,6 +33,7 @@ class TraceMLInitConfig:
     patch_backward: bool = True
     patch_optimizer: bool = True
     patch_h2d: bool = True
+    patch_checkpoint: bool = True
     traced_model: object = None
 
 
@@ -114,6 +115,17 @@ def init(
                     applied.append("jax_h2d")
             except Exception as exc:
                 get_error_log().warning("jax h2d patch failed", exc)
+        if want.patch_checkpoint:
+            try:
+                from traceml_tpu.instrumentation.orbax_patch import (
+                    install_orbax_patch,
+                )
+
+                outcome = install_orbax_patch()  # now, or on first import
+                if outcome != "noop":
+                    applied.append(f"orbax_checkpoint[{outcome}]")
+            except Exception as exc:
+                get_error_log().warning("orbax patch failed", exc)
         # Torch-side patches: when torch is already imported, or the
         # executor's static analysis says this is a torch job.
         want_torch = (
@@ -167,6 +179,16 @@ def shutdown_patches() -> None:
 
         unpatch_torch_dataloader()
         unpatch_all_torch()
+    except Exception:
+        pass
+    try:
+        from traceml_tpu.instrumentation.orbax_patch import (
+            remove_orbax_hook,
+            unpatch_orbax,
+        )
+
+        unpatch_orbax()
+        remove_orbax_hook()
     except Exception:
         pass
     st.initialized = False

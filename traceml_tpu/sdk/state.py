@@ -29,6 +29,7 @@ class _TLS(threading.local):
         self.h2d_depth = 0
         self.dataloader_depth = 0
         self.collective_depth = 0
+        self.checkpoint_depth = 0
 
 
 class TraceState:

@@ -15,6 +15,7 @@ from traceml_tpu.sdk.state import TraceState, get_state
 from traceml_tpu.utils.marker_resolver import get_marker_resolver
 from traceml_tpu.utils.timing import (
     BACKWARD_TIME,
+    CHECKPOINT_TIME,
     COLLECTIVE_TIME,
     FORWARD_TIME,
     H2D_TIME,
@@ -142,6 +143,27 @@ def wrap_collective(fn: Callable, state: Optional[TraceState] = None) -> Callabl
     def wrapped(*args: Any, **kwargs: Any):
         return _timed_call(
             COLLECTIVE_TIME, "collective_depth", fn, st, True, *args, **kwargs
+        )
+
+    wrapped._traceml_wrapped = True  # type: ignore[attr-defined]
+    return wrapped
+
+
+def wrap_checkpoint(fn: Callable, state: Optional[TraceState] = None) -> Callable:
+    """Time a checkpoint save as the first-class ``checkpoint`` phase.
+
+    Checkpoint stalls are a classic TPU training pathology — a blocking
+    save gates every synchronous step, and without this phase the time
+    lands in ``residual``.  The orbax auto-patch
+    (instrumentation/orbax_patch.py) applies this automatically; wrap a
+    custom saver manually for other checkpointing stacks.
+    """
+    st = state or get_state()
+
+    @functools.wraps(fn)
+    def wrapped(*args: Any, **kwargs: Any):
+        return _timed_call(
+            CHECKPOINT_TIME, "checkpoint_depth", fn, st, False, *args, **kwargs
         )
 
     wrapped._traceml_wrapped = True  # type: ignore[attr-defined]

@@ -39,6 +39,7 @@ PHASES: Dict[str, str] = {
     "compute": T.COMPUTE_TIME,
     "compile": T.COMPILE_TIME,
     "collective": T.COLLECTIVE_TIME,
+    "checkpoint": T.CHECKPOINT_TIME,
 }
 STEP_KEY = "step_time"
 RESIDUAL_KEY = "residual"

@@ -50,6 +50,7 @@ OPTIMIZER_STEP = INTERNAL_PREFIX + "optimizer_step"
 COMPUTE_TIME = INTERNAL_PREFIX + "compute_time"  # fused fwd+bwd+opt (JAX jit)
 COMPILE_TIME = INTERNAL_PREFIX + "compile_time"
 COLLECTIVE_TIME = INTERNAL_PREFIX + "collective_time"
+CHECKPOINT_TIME = INTERNAL_PREFIX + "checkpoint_time"  # save stalls (orbax)
 
 ALL_PHASES = (
     STEP_TIME,
@@ -61,6 +62,7 @@ ALL_PHASES = (
     COMPUTE_TIME,
     COMPILE_TIME,
     COLLECTIVE_TIME,
+    CHECKPOINT_TIME,
 )
 
 _QUEUE_MAX = 2048  # reference: bounded step/global queues maxsize 2048
