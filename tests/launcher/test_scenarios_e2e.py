@@ -74,6 +74,16 @@ def test_collective_straggler_four_ranks(tmp_path):
     assert issue["ranks"] == [3]
 
 
+def test_checkpoint_stall_phase_measured(tmp_path):
+    payload = _run(tmp_path, "checkpoint_stall", steps=40)
+    phases = payload["sections"]["step_time"]["global"]["phases"]
+    ckpt = phases.get("checkpoint")
+    assert ckpt and ckpt["median_ms"] is not None, phases.keys()
+    # the save happens every 5th step; window medians are over per-rank
+    # AVERAGES so the phase is present with a nonzero mean
+    assert ckpt["mean_ms"] > 0, ckpt
+
+
 def test_healthy_not_misdiagnosed(tmp_path):
     payload = _run(tmp_path, "healthy", steps=60)
     primary = payload["primary_diagnosis"]

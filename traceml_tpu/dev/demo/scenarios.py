@@ -14,6 +14,9 @@ Scenarios and their expected verdicts:
   gradient-sync collective is slow — degraded ICI link analogue; uses
   ``wrap_collective`` so the time lands in the first-class ``collective``
   phase)
+* ``checkpoint_stall``  → checkpoint phase visible (a blocking save
+  every few steps; with orbax installed the auto-patch times a REAL
+  PyTreeCheckpointer save, else a wrap_checkpoint'd stand-in)
 * ``memory_creep``      → MEMORY_CREEP_* (a list leaks one array/step)
 * ``recompile``         → COMPILE_BOUND (shape churn every few steps)
 """
@@ -130,6 +133,33 @@ def run_scenario(name: str, steps: int = 80) -> None:
                 x, y = jax.device_put(x), jax.device_put(y)
                 params, opt_state, loss = step(params, opt_state, x, y)
                 params = timed_sync(params)
+
+    elif name == "checkpoint_stall":
+        # blocking save every 5 steps; time lands in the checkpoint
+        # phase (not residual)
+        import tempfile
+
+        try:
+            import orbax.checkpoint as ocp
+
+            ckpt_root = tempfile.mkdtemp(prefix="traceml_ckpt_")
+            ckptr = ocp.PyTreeCheckpointer()  # auto-patched by init
+
+            def save(tree, i):
+                ckptr.save(f"{ckpt_root}/step{i}", tree)
+        except Exception:  # orbax missing: a wrap_checkpoint'd stand-in
+            def _slow_save(tree, i):
+                time.sleep(0.05)
+
+            save = traceml_tpu.wrap_checkpoint(_slow_save)
+
+        loader = _batches(steps)
+        for i, (x, y) in enumerate(traceml_tpu.wrap_dataloader(loader)):
+            with traceml_tpu.trace_step():
+                x, y = jax.device_put(x), jax.device_put(y)
+                params, opt_state, loss = step(params, opt_state, x, y)
+                if i % 5 == 4:
+                    save({"params": params}, i)
 
     elif name == "memory_creep":
         leak = []  # grows forever — the classic retained-arrays leak

@@ -40,12 +40,14 @@ def _wrap_save(cls) -> bool:
 
     @functools.wraps(save)
     def timed_save(self, *args: Any, **kwargs: Any):
+        # self rides _timed_call's *args forwarding — no per-call closure
         return _timed_call(
             CHECKPOINT_TIME,
             "checkpoint_depth",
-            lambda *a, **k: save(self, *a, **k),
+            save,
             get_state(),
             False,
+            self,
             *args,
             **kwargs,
         )

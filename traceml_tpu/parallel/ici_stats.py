@@ -32,6 +32,7 @@ STAT_FIELDS = (
     "optimizer_ms",
     "compile_ms",
     "collective_ms",
+    "checkpoint_ms",
     "residual_ms",
     "memory_current_bytes",
     "memory_peak_bytes",

@@ -48,6 +48,7 @@ _FIELD_TO_EVENT = {
     "optimizer_ms": T.OPTIMIZER_STEP,
     "compile_ms": T.COMPILE_TIME,
     "collective_ms": T.COLLECTIVE_TIME,
+    "checkpoint_ms": T.CHECKPOINT_TIME,
 }
 _FOLD_INTO_COMPUTE = (T.FORWARD_TIME, T.BACKWARD_TIME)
 
