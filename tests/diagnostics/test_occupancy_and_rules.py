@@ -12,7 +12,11 @@ from traceml_tpu.utils.step_time_window import build_step_time_window
 GiB = 1024**3
 
 
-def _rows(device_step_ms, host_step_ms=100.0, n=60):
+def _rows(device_busy_ms, host_step_ms=100.0, n=60):
+    """Occupancy numerator = Σ PHASE device durations (here: one compute
+    phase of ``device_busy_ms``); the envelope's own device span is
+    deliberately larger (it includes pre-dispatch idle) and must NOT
+    drive occupancy."""
     return [
         {
             "step": s,
@@ -21,12 +25,12 @@ def _rows(device_step_ms, host_step_ms=100.0, n=60):
             "events": {
                 T.STEP_TIME: {
                     "cpu_ms": host_step_ms,
-                    "device_ms": device_step_ms,
+                    "device_ms": host_step_ms,  # span ≈ wall; not busy!
                     "count": 1,
                 },
                 T.COMPUTE_TIME: {
                     "cpu_ms": 1.0,
-                    "device_ms": device_step_ms * 0.9,
+                    "device_ms": device_busy_ms,
                     "count": 1,
                 },
             },
@@ -43,8 +47,27 @@ def test_window_occupancy_computed():
     assert abs(window.median_occupancy - 0.3) < 1e-6
 
 
+def test_window_occupancy_ignores_envelope_span():
+    """An input-bound shape: envelope device span ≈ wall (the edges
+    carry across the idle input wait) but the only device-executing
+    phase is 30 ms — occupancy must read 0.3, not 1.0."""
+    rows = [
+        {
+            "step": s, "timestamp": float(s), "clock": "device",
+            "events": {
+                T.STEP_TIME: {"cpu_ms": 100.0, "device_ms": 98.0, "count": 1},
+                T.DATALOADER_NEXT: {"cpu_ms": 65.0, "device_ms": None, "count": 1},
+                T.COMPUTE_TIME: {"cpu_ms": 1.0, "device_ms": 30.0, "count": 1},
+            },
+        }
+        for s in range(1, 31)
+    ]
+    w = build_step_time_window({0: rows})
+    assert abs(w.occupancy_by_rank[0] - 0.3) < 1e-6
+
+
 def test_window_occupancy_capped_and_absent():
-    # device nominally exceeding wall clips to 1.0
+    # device busy nominally exceeding wall clips to 1.0
     w = build_step_time_window({0: _rows(130.0)})
     assert w.occupancy_by_rank[0] == 1.0
     # host-only rows → no occupancy

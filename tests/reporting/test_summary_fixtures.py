@@ -218,12 +218,11 @@ def test_occupancy_low_run_yields_low_util_verdict(tmp_path):
     s = _Session(tmp_path)
     rows = []
     for i in range(1, 61):
-        row = _step_row(i, step_ms=100.0, compute_ms=18.0)
-        row["events"][T.STEP_TIME]["device_ms"] = 20.0  # chip busy 20%
-        rows.append(row)
+        # chip busy = phase device (18) / host step (100) = 18%
+        rows.append(_step_row(i, step_ms=100.0, compute_ms=18.0))
     s.inject("step_time", {"step_time": rows}, s.ident(0))
     payload = s.payload()
     g = payload["sections"]["step_time"]["global"]
-    assert g["median_occupancy"] == pytest.approx(0.2)
+    assert g["median_occupancy"] == pytest.approx(0.18)
     kinds = {i["kind"] for i in payload["sections"]["step_time"]["issues"]}
     assert "LOW_DEVICE_UTILIZATION" in kinds

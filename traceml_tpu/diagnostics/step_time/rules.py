@@ -360,10 +360,11 @@ class LowDeviceOccupancyRule:
 
     TPU stand-in for the reference's GPUUtilizationRule
     (reference: diagnostics/system/rules.py:22-120): libtpu exposes no
-    duty-cycle counter here, but occupancy — Σ device(step) / Σ host
-    (step) over the window — is the same signal derived from the timing
-    core.  Fires alongside whatever explains the idleness (INPUT_BOUND,
-    COMPILE_BOUND); the composer ranks them.
+    duty-cycle counter here, but occupancy — Σ phase device durations /
+    Σ host(step envelope) over the window, see
+    utils/step_time_window.py:row_occupancy_parts — is the same signal
+    derived from the timing core.  Fires alongside whatever explains
+    the idleness (INPUT_BOUND, COMPILE_BOUND); the composer ranks them.
     """
 
     def evaluate(self, ctx: _Ctx) -> List[DiagnosticIssue]:

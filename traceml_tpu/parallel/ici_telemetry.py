@@ -50,6 +50,11 @@ _FIELD_TO_EVENT = {
     "collective_ms": T.COLLECTIVE_TIME,
     "checkpoint_ms": T.CHECKPOINT_TIME,
 }
+# phases that execute ON the chip: only these get device_ms when the
+# matrix is converted back into step rows — marking host-side waits
+# (input, compile, checkpoint) as device time would poison the
+# chip-occupancy numerator (Σ phase device durations)
+_DEVICE_FIELDS = {"h2d_ms", "compute_ms", "optimizer_ms", "collective_ms"}
 _FOLD_INTO_COMPUTE = (T.FORWARD_TIME, T.BACKWARD_TIME)
 
 
@@ -106,7 +111,11 @@ def matrix_to_rank_rows(
         for field, event_name in _FIELD_TO_EVENT.items():
             v = vec.get(field) or 0.0
             if v > 0:
-                events[event_name] = {"cpu_ms": v, "device_ms": v, "count": 1}
+                events[event_name] = {
+                    "cpu_ms": v,
+                    "device_ms": v if field in _DEVICE_FIELDS else None,
+                    "count": 1,
+                }
         out[rank] = {
             "step": int(vec["step"]),
             "timestamp": ts,

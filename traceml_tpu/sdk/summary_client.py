@@ -125,15 +125,19 @@ def live_metrics(window: int = 30) -> Dict[str, Any]:
                             per_phase.setdefault(key, []).append(float(v))
                 for key, vals in per_phase.items():
                     out[f"traceml/live/{key}_ms"] = statistics.median(vals)
-                # occupancy only where the envelope has BOTH clocks
-                # (0.0 is a legitimate device duration — `is not None`,
-                # or idle steps would be dropped and occupancy overstated)
+                # chip-busy via THE shared definition (window builder's
+                # row_occupancy_parts) so live metrics and the final
+                # summary can never disagree
+                from traceml_tpu.utils.step_time_window import (
+                    row_occupancy_parts,
+                )
+
                 dev_sum = host_sum = 0.0
                 for row in rows:
-                    env = (row.get("events") or {}).get(STEP_TIME) or {}
-                    if env.get("device_ms") is not None and env.get("cpu_ms") is not None:
-                        dev_sum += float(env["device_ms"])
-                        host_sum += float(env["cpu_ms"])
+                    parts = row_occupancy_parts(row.get("events") or {})
+                    if parts is not None:
+                        dev_sum += parts[0]
+                        host_sum += parts[1]
                 if host_sum > 0:
                     out["traceml/live/occupancy"] = min(1.0, dev_sum / host_sum)
             elif sampler.name == "step_memory":

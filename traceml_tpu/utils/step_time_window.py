@@ -58,9 +58,10 @@ class RankWindow:
     # per phase key → window average ms
     averages: Dict[str, float]
     clock: str
-    # device-busy share of the wall clock: Σ device(step) / Σ host(step)
-    # over the window — the TPU stand-in for a chip-utilization counter
-    # (device envelopes tile chip occupancy; host envelopes tile wall).
+    # device-busy share of the wall clock: Σ phase device durations /
+    # Σ host(step envelope) over the window — the TPU stand-in for a
+    # chip-utilization counter (phase readiness edges tile chip
+    # occupancy; host envelopes tile wall)
     occupancy: Optional[float] = None
 
 
@@ -146,6 +147,38 @@ def _row_value(row: Mapping[str, Any], event_name: str, clock: str) -> Optional[
     return float(v) if v is not None else None
 
 
+def row_occupancy_parts(events: Mapping[str, Any]) -> Optional[tuple]:
+    """(device_busy_ms, host_ms) for ONE step row, or None.
+
+    THE chip-occupancy definition — every consumer (window builder,
+    live_metrics) routes through here so the definition cannot fork:
+
+    * numerator: Σ PHASE device durations (consecutive readiness edges
+      are serial, so they tile device occupancy).  The ENVELOPE's device
+      span is NOT used when phase timings exist — its start edge carries
+      from the previous step's retirement, so it includes pre-dispatch
+      idle (input wait) and reads ~100% busy on an input-bound run;
+    * fallback: envelope-only instrumentation (no timed phase regions)
+      uses the envelope span — an UPPER bound on busy, but far better
+      than silencing the low-utilization rule entirely;
+    * 0.0 is a legitimate duration (idle step); only None excludes.
+    """
+    env = events.get(T.STEP_TIME) or {}
+    host = env.get("cpu_ms")
+    if host is None:
+        return None
+    timed = [
+        ev.get("device_ms")
+        for name, ev in events.items()
+        if name != T.STEP_TIME and ev and ev.get("device_ms") is not None
+    ]
+    if timed:
+        return (float(sum(timed)), float(host))
+    if env.get("device_ms") is not None:
+        return (float(env["device_ms"]), float(host))
+    return None
+
+
 def select_clock(rank_rows: Mapping[int, Sequence[Mapping[str, Any]]]) -> str:
     """"device" only if every rank/step row carries device timing for the
     step envelope (reference: _select_clock_from_events:185)."""
@@ -175,12 +208,10 @@ def build_rank_window(
             for k in ALL_KEYS:
                 series[k].append(0.0)
             continue
-        env = (row.get("events") or {}).get(T.STEP_TIME) or {}
-        # 0.0 is a legitimate device duration (fully idle step) —
-        # truthiness would drop idle steps and overstate occupancy
-        if env.get("device_ms") is not None and env.get("cpu_ms") is not None:
-            dev_sum += float(env["device_ms"])
-            host_sum += float(env["cpu_ms"])
+        parts = row_occupancy_parts(row.get("events") or {})
+        if parts is not None:
+            dev_sum += parts[0]
+            host_sum += parts[1]
         step_ms = _row_value(row, T.STEP_TIME, clock) or 0.0
         accounted = 0.0
         for key, event_name in PHASES.items():
