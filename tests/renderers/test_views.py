@@ -147,3 +147,30 @@ def test_process_view_busiest_and_stale():
     assert view.total_rss_bytes == 3 << 30
     assert not view.ranks[0].stale
     assert view.ranks[1].stale
+
+
+def test_all_views_json_serializable():
+    """The browser endpoint json.dumps() the views verbatim — one numpy
+    scalar anywhere in as_dict() would 500 /api/live."""
+    import json
+
+    rank_rows = {0: _step_rows(), 1: _step_rows(rank_offset=20.0)}
+    window = build_step_time_window(rank_rows)
+    views = [
+        V.build_step_time_view(window, world_size=2, latest_ts=30.0),
+        V.build_memory_view({0: _mem_rows(8 << 30)}),
+        V.build_system_view(
+            {0: [_host_row(0, "a", 10.0, 1.0)],
+             1: [_host_row(1, "b", 90.0, 1.0)]},
+            expected_nodes=2, now=2.0,
+        ),
+        V.build_process_view(
+            {0: [{"hostname": "h", "pid": 1, "cpu_pct": 5.0,
+                  "rss_bytes": 1, "vms_bytes": 1, "num_threads": 1,
+                  "timestamp": 1.0}]},
+            now=2.0,
+        ),
+    ]
+    for view in views:
+        payload = json.dumps(view.as_dict())  # must not raise
+        assert json.loads(payload)  # and round-trips
