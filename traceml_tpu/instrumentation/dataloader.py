@@ -17,8 +17,8 @@ from __future__ import annotations
 from typing import Any, Iterable, Iterator, Optional
 
 from traceml_tpu.sdk.state import TraceState, get_state
+from traceml_tpu.sdk.wrappers import publish_region_marker
 from traceml_tpu.utils.error_log import get_error_log
-from traceml_tpu.utils.marker_resolver import get_marker_resolver
 from traceml_tpu.utils.timing import DATALOADER_NEXT, H2D_TIME, timed_region
 
 _PATCHED_FLAG = "_traceml_tpu_patched"
@@ -32,10 +32,11 @@ def _timed_device_put(batch: Any, state: TraceState, device: Any = None) -> Any:
         out = (
             jax.device_put(batch) if device is None else jax.device_put(batch, device)
         )
-        tr.mark(out)
-    ev = region.event
-    if ev.marker is not None and not ev.marker.resolved:
-        get_marker_resolver().submit(ev.marker)
+        if state.sample_markers or not state.tls.in_step:
+            tr.mark(out)
+    # shared chokepoint: envelope hand-off + governor gate + resolver
+    # submission (sdk/wrappers.publish_region_marker)
+    publish_region_marker(region.event, state)
     return out
 
 

@@ -17,8 +17,8 @@ from __future__ import annotations
 from typing import Any
 
 from traceml_tpu.sdk.state import get_state
+from traceml_tpu.sdk.wrappers import publish_region_marker
 from traceml_tpu.utils.error_log import get_error_log
-from traceml_tpu.utils.marker_resolver import get_marker_resolver
 from traceml_tpu.utils.timing import H2D_TIME, timed_region
 
 _original_device_put = None
@@ -67,10 +67,11 @@ def patch_jax_h2d() -> bool:
             region = timed_region(H2D_TIME, st.current_step, sink=st.buffer.add)
             with region as tr:
                 out = original(x, device, *args, **kwargs)
-                tr.mark(out)
-            ev = region.event
-            if ev.marker is not None and not ev.marker.resolved:
-                get_marker_resolver().submit(ev.marker)
+                if st.sample_markers or not st.tls.in_step:
+                    tr.mark(out)
+            # shared chokepoint: envelope hand-off + governor gate +
+            # resolver submission (sdk/wrappers.publish_region_marker)
+            publish_region_marker(region.event, st)
             return out
         except Exception as exc:
             get_error_log().warning("timed device_put failed; passthrough", exc)

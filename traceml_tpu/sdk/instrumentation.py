@@ -22,6 +22,7 @@ from traceml_tpu.sdk.state import TraceState, get_state
 from traceml_tpu.sdk.wrappers import publish_region_marker
 from traceml_tpu.utils.error_log import get_error_log
 from traceml_tpu.utils.marker_resolver import get_marker_resolver
+from traceml_tpu.utils.overhead_governor import get_governor
 from traceml_tpu.utils.timing import STEP_TIME, TimeEvent, timed_region
 
 
@@ -56,9 +57,15 @@ class trace_step:
             if st.tls.in_step:
                 return self  # nested: inert (reference: outermost-only)
             self._outermost = True
+            gov = get_governor()
             # Stamp the previous step's markers from this thread before
-            # opening a new step — see MarkerResolver.sweep_inline.
-            get_marker_resolver().sweep_inline()
+            # opening a new step — see MarkerResolver.sweep_inline.  On
+            # expensive-probe runtimes (tunneled PJRT: is_ready is an
+            # RPC) the governor moves stamping off the critical path to
+            # the background resolver instead.
+            if gov.allow_inline_sweep():
+                get_marker_resolver().sweep_inline()
+            st.sample_markers = gov.begin_step()
             st.tls.in_step = True
             self._step = st.begin_step()
             st.ensure_mem_tracker().reset(self._step)
@@ -84,6 +91,9 @@ class trace_step:
             if self._region is not None:
                 self._region.__exit__(exc_type, exc, tb)
                 st.last_step_exit = self._region.event.cpu_end
+                ev = self._region.event
+                if ev.cpu_start is not None and ev.cpu_end is not None:
+                    get_governor().observe_step(ev.cpu_end - ev.cpu_start)
             st.active_step_event = None
             step = self._step if self._step is not None else st.current_step
             if exc_type is None:
@@ -96,6 +106,10 @@ class trace_step:
                         resolver.submit(ev.marker)
         except Exception as err:
             get_error_log().warning("trace_step exit failed", err)
+        finally:
+            # out-of-step instrumentation (eval loops) must never inherit
+            # an unsampled step's gate
+            st.sample_markers = True
         return False
 
 
@@ -110,7 +124,10 @@ class trace_time:
         self._region: Optional[timed_region] = None
 
     def mark(self, outputs: Any) -> Any:
-        if self._region is not None:
+        st = self._state
+        if self._region is not None and (
+            st.sample_markers or not st.tls.in_step
+        ):
             self._region.mark(outputs)
         return outputs
 

@@ -50,6 +50,11 @@ class TraceState:
         # idiomatic `for batch in loader: with trace_step():` pattern) is
         # attributed to the step that consumes the batch
         self.last_step_exit: Optional[float] = None
+        # per-step device-marker gate, set by trace_step.__enter__ from
+        # the overhead governor; marker creators (wrap_step_fn, phase
+        # wrappers) consult it so a whole step is either marked or not —
+        # mixed rows would skew the window's clock selection
+        self.sample_markers = True
         # called with the step number after each flush (max-steps lifecycle)
         self.on_step_flushed: List[Callable[[int], None]] = []
         # called with the StepTimeBatch after each non-empty flush
@@ -68,6 +73,9 @@ class TraceState:
             return self.step_counter
 
     def ensure_mem_tracker(self) -> StepMemoryTracker:
+        mt = self.mem_tracker  # lock-free fast path (hot: 2×/step)
+        if mt is not None:
+            return mt
         with self._lock:
             if self.mem_tracker is None:
                 self.mem_tracker = StepMemoryTracker()
@@ -78,8 +86,11 @@ class TraceState:
 
         Called by wrap_step_fn / wrappers after each device dispatch; the
         last call before step exit wins, so the envelope's device end is
-        the readiness of the final dispatched phase.
+        the readiness of the final dispatched phase.  Inert on steps the
+        overhead governor chose not to device-sample.
         """
+        if not self.sample_markers:
+            return
         ev = self.active_step_event
         if ev is not None:
             ev.attach_marker(outputs)
@@ -109,7 +120,13 @@ def get_state() -> TraceState:
 
 
 def reset_state_for_tests() -> TraceState:
-    """Replace global state (test isolation only)."""
+    """Replace global state (test isolation only).  Also resets the
+    overhead governor: its step EMA changes the marker resolver's poll
+    schedule, so leaking it across tests makes timing-sensitive suites
+    order-dependent."""
     global _state
+    from traceml_tpu.utils.overhead_governor import reset_governor_for_tests
+
+    reset_governor_for_tests()
     _state = TraceState()
     return _state

@@ -103,10 +103,22 @@ class WrappedStepFn:
             out = self._jfn(*args, **kwargs)
             # ONE marker shared by the compute event and the open step
             # envelope (same handles, same dispatch instant) — a single
-            # pytree flatten and a single resolver poll per step.
-            handles = self._pick_handles(out)
-            if handles:
-                tr.event.marker = DeviceMarker(handles)
+            # pytree flatten and a single resolver poll per step.  The
+            # overhead governor gates the whole device-probe apparatus
+            # per step (utils/overhead_governor.py).
+            if st.sample_markers or not st.tls.in_step:
+                handles = self._pick_handles(out)
+                if handles:
+                    marker = DeviceMarker(handles)
+                    # the fused fwd+bwd+opt spans ~the whole step: let
+                    # the resolver sleep to the expected completion
+                    # window instead of fine-polling from dispatch.
+                    # In-step only: out-of-step dispatches (eval loops)
+                    # queue behind each other, so their lifetimes
+                    # measure queue depth, not one step's compute —
+                    # they must not feed the lifetime EMA
+                    marker.step_end_hint = st.tls.in_step
+                    tr.event.marker = marker
         # envelope hand-off + dispatch-time resolver submission (the
         # fine-cadence stamping that intra-step device edges need) —
         # see publish_region_marker's docstring

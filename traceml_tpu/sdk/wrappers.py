@@ -42,7 +42,7 @@ def _timed_call(
         region = timed_region(phase, st.current_step, sink=st.buffer.add)
         with region as tr:
             out = fn(*args, **kwargs)
-            if mark_output:
+            if mark_output and (st.sample_markers or not tls.in_step):
                 tr.mark(out)
         publish_region_marker(region.event, st)
         return out
@@ -64,10 +64,20 @@ def publish_region_marker(ev, st: TraceState) -> None:
     edge onto the exit sweep's observation instant and zeroes the
     phase durations (regression caught by the collective-straggler
     scenario E2E) — the per-dispatch wake is the price of observation.
+
+    This is also the overhead-governor chokepoint: on a step the
+    governor chose not to device-sample, the marker is dropped HERE —
+    whichever site created it (h2d patch, dataloader device_put,
+    Lightning, trace_time) — so unsampled steps are uniformly host-only
+    and no RPC-priced readiness probe escapes the budget.  Out-of-step
+    regions (eval loops) are never gated.
     """
     if ev.marker is None:
         return
     if st.tls.in_step:
+        if not st.sample_markers:
+            ev.marker = None  # governor: unsampled step, drop the probe
+            return
         env = st.active_step_event
         if env is not None:
             env.marker = ev.marker

@@ -16,15 +16,47 @@ service* is the shared infrastructure both designs need.
 from __future__ import annotations
 
 import threading
+import time
 from typing import List, Optional
 
 from traceml_tpu.utils.error_log import get_error_log
+from traceml_tpu.utils.overhead_governor import get_governor
 from traceml_tpu.utils.timing import DeviceMarker
 
 _DEFAULT_INTERVAL = 0.002  # 2 ms poll while young markers are pending
 _IDLE_TIMEOUT = 0.25  # park after this long with nothing pending
 _FINE_WINDOW_S = 0.020  # markers younger than this get the fine cadence
 _MAX_BACKOFF_S = 0.025  # cadence ceiling for long-running markers
+
+
+def _poll_batch(pending: List[DeviceMarker]) -> tuple:
+    """Poll a batch of markers and feed the governor ONE probe-cost
+    sample: the batch MINIMUM per-poll duration — robust to the polling
+    thread being descheduled mid-poll (a starved poller measures its own
+    starvation, not the probe).  No-op polls of already-resolved markers
+    and exception-path polls are excluded from the sample.  Returns
+    (#resolved-by-this-batch, min_probe_dt | None).  Shared by
+    sweep_inline (main thread) and the resolver loop."""
+    resolved = 0
+    best = None
+    for m in pending:
+        was_resolved = m.resolved
+        t0 = time.perf_counter()
+        try:
+            if m.poll():
+                resolved += 1
+        except Exception:
+            continue  # poll() fails open; a raise says nothing of cost
+        if was_resolved:
+            continue  # fast-path no-op poll: not a probe-cost sample
+        dt = time.perf_counter() - t0
+        if best is None or dt < best:
+            best = dt
+    # this is THE signal that detects RPC-priced is_ready (tunneled
+    # PJRT) and turns inline sweeping off / stretches the marker stride
+    if best is not None:
+        get_governor().observe_probe(best, 1)
+    return resolved, best
 
 
 class MarkerResolver:
@@ -78,34 +110,49 @@ class MarkerResolver:
         of the resolver's scheduling luck.  Cost: a handful of local
         ``is_ready()`` calls, microseconds.
         """
+        if not self._pending:  # unlocked fast path: hot loops with the
+            return 0           # governor subsampling usually have none
         with self._lock:
             pending = list(self._pending[:max_n])
         if not pending:
             return 0
-        resolved = 0
-        for m in pending:
-            try:
-                if m.poll():
-                    resolved += 1
-            except Exception:
-                pass
+        resolved, _ = _poll_batch(pending)
         if resolved:
             with self._lock:
                 self._pending = [m for m in self._pending if not m.resolved]
         return resolved
 
-    def _delay_for(self, age_s: float) -> float:
-        """Age-proportional poll backoff.
+    def _delay_for(self, age_s: float, step_end_hint: bool = False) -> float:
+        """Per-marker poll schedule.
 
-        Young markers (short phases) are polled at the fine cadence so
-        their stamps stay ~2 ms accurate.  A marker that has been in
-        flight for a while is a long device phase; polling it every 2 ms
-        buys nothing but wakeups — on a 1-core host those wakeups alone
-        cost ~2% of a 150 ms step.  Back off to 10% of the marker's age,
-        capped: relative stamp error stays ≤10% (absolute ≤25 ms), and in
-        bracketed loops sweep_inline() at the next step boundary usually
-        stamps first anyway, at inter-step precision.
+        Every resolver wakeup PREEMPTS the training thread on a
+        saturated host (context switch + cache pollution — measured
+        ~2-4% of a 190 ms step at a 30-wakeup/step schedule on a
+        1-core host), so wakeups are spent where a stamp can land:
+
+        * **step-end markers** (``step_end_hint``: the fused
+          compute/envelope marker) in the long-lifetime regime
+          (governor's marker-lifetime EMA ≥ 20 ms — the observed
+          dispatch→readiness duration of previous step-end markers, NOT
+          the step envelope, which also contains pre-dispatch host
+          time): sleep straight to ~85% of the expected lifetime, then
+          poll at 2% of it — ≤ ~8 wakeups/step, relative stamp error
+          ≤ 2%, and in bracketed loops sweep_inline() at the next step
+          boundary stamps first anyway;
+        * **intra-step phase markers** (h2d, collective, user regions)
+          and the short-step/unknown regime: fine cadence — poll every
+          2 ms while young, back off to 10% of age (relative error
+          ≤10%, absolute ≤25 ms).  Phase markers resolve quickly, so
+          the fine window costs a handful of wakeups, and delaying them
+          to step end would collapse the intra-step device edges
+          (regression caught by the straggler scenario E2Es).
         """
+        if step_end_hint:
+            ema = get_governor().marker_lifetime_ema
+            if ema is not None and ema >= _FINE_WINDOW_S:
+                if age_s < 0.85 * ema:
+                    return 0.85 * ema - age_s
+                return max(self._interval, 0.02 * ema, 0.1 * (age_s - ema))
         if age_s < _FINE_WINDOW_S:
             return self._interval
         return min(_MAX_BACKOFF_S, max(self._interval, 0.1 * age_s))
@@ -122,11 +169,7 @@ class MarkerResolver:
                     if fired:
                         self._wake.clear()
                     continue
-                for m in pending:
-                    try:
-                        m.poll()
-                    except Exception:
-                        pass  # poll() itself fails open, but belt+braces
+                _poll_batch(pending)
                 now = _time.perf_counter()
                 with self._lock:
                     # Identity-based prune: concurrent submits and
@@ -136,10 +179,19 @@ class MarkerResolver:
                     unresolved = list(self._pending)
                 if unresolved:
                     delay = min(
-                        self._delay_for(now - m.dispatched_at) for m in unresolved
+                        self._delay_for(
+                            now - m.dispatched_at,
+                            getattr(m, "step_end_hint", False),
+                        )
+                        for m in unresolved
                     )
                 else:
                     delay = self._interval
+                # expensive-probe floor: keep this thread's probe duty
+                # cycle within the overhead budget (RPC-priced is_ready
+                # through a tunneled PJRT client must not hammer the
+                # channel the main thread dispatches on)
+                delay = max(delay, get_governor().resolver_min_delay())
                 # waiting on _wake (not _stop) lets a fresh submit
                 # re-tighten the cadence mid-backoff
                 fired = self._wake.wait(timeout=delay)

@@ -31,10 +31,10 @@ The reference carries the mirror-image caveat for very short steps
 
 from __future__ import annotations
 
-import queue
+import collections
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Deque, Dict, List, Optional, Sequence
 
 from traceml_tpu.utils.error_log import get_error_log
 
@@ -82,12 +82,21 @@ class DeviceMarker:
     with the observation time.
     """
 
-    __slots__ = ("_handles", "dispatched_at", "ready_at", "late_stamp", "submitted")
+    __slots__ = (
+        "_handles", "dispatched_at", "ready_at", "late_stamp", "submitted",
+        "step_end_hint",
+    )
 
     def __init__(self, handles: Sequence[Any], dispatched_at: Optional[float] = None):
         self._handles: Optional[List[Any]] = [
             h for h in handles if hasattr(h, "is_ready")
         ]
+        # True for markers expected to resolve ~at step end (the fused
+        # compute/envelope marker): the resolver may then sleep through
+        # most of the expected step instead of fine-polling.  Intra-step
+        # phase markers (h2d, collective, user regions) leave this False
+        # — they become ready mid-step and need the fine cadence.
+        self.step_end_hint = False
         self.dispatched_at = _now() if dispatched_at is None else dispatched_at
         self.ready_at: Optional[float] = None
         self.late_stamp = False
@@ -126,6 +135,14 @@ class DeviceMarker:
         self.ready_at = _now() if now is None else now
         self.late_stamp = late
         self._handles = None
+        if self.step_end_hint and not late:
+            # feed the resolver's sleep-to-completion schedule (see
+            # overhead_governor.observe_marker_lifetime)
+            from traceml_tpu.utils.overhead_governor import get_governor
+
+            get_governor().observe_marker_lifetime(
+                self.ready_at - self.dispatched_at
+            )
         return True
 
 
@@ -298,15 +315,17 @@ class BoundedDropQueue:
 
     def __init__(self, label: str, maxsize: int = _QUEUE_MAX) -> None:
         self._label = label
-        self._q: "queue.Queue[Any]" = queue.Queue(maxsize=maxsize)
+        # deque, not queue.Queue: append/popleft are GIL-atomic and ~10×
+        # cheaper than Queue's lock+notify, and this queue is written on
+        # the per-step hot path.  The len() check races benignly (a
+        # concurrent writer can overshoot the bound by #threads items).
+        self._q: Deque[Any] = collections.deque()
+        self._maxsize = maxsize
         self.dropped = 0
         self._warned = False
 
     def put(self, item: Any) -> bool:
-        try:
-            self._q.put_nowait(item)
-            return True
-        except queue.Full:
+        if len(self._q) >= self._maxsize:
             self.dropped += 1
             if not self._warned:
                 self._warned = True
@@ -314,18 +333,21 @@ class BoundedDropQueue:
                     f"{self._label} queue full; dropping (sampler stalled?)"
                 )
             return False
+        self._q.append(item)
+        return True
 
     def drain(self, max_items: Optional[int] = None) -> List[Any]:
         out: List[Any] = []
+        q = self._q
         while max_items is None or len(out) < max_items:
             try:
-                out.append(self._q.get_nowait())
-            except queue.Empty:
+                out.append(q.popleft())
+            except IndexError:
                 break
         return out
 
     def qsize(self) -> int:
-        return self._q.qsize()
+        return len(self._q)
 
 
 # kept as an alias for the step-batch use of the shared queue class
