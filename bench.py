@@ -103,11 +103,14 @@ def _build():
 
     platform = jax.default_backend()
     if platform != "cpu":  # tpu (incl. tunneled backends)
+        # sized so one fwd+bwd+opt step is ~7 TFLOP — tens of ms on a
+        # real single chip, comfortably above the tracer's µs-scale
+        # per-step cost and the measurement noise floor
         cfg = ModelConfig(
-            vocab_size=16384, hidden=1024, n_layers=8, n_heads=16,
+            vocab_size=16384, hidden=1024, n_layers=12, n_heads=16,
             n_kv_heads=8, max_seq_len=512,
         )
-        batch, seq = 8, 512
+        batch, seq = 16, 512
     else:  # CPU proxy: big enough that steps are ≥100 ms (noise floor)
         cfg = ModelConfig(
             vocab_size=2048, hidden=256, n_layers=2, n_heads=4,
@@ -123,6 +126,36 @@ def _build():
         for _ in range(8)
     ]
     return model, state, tx, train_step, batches
+
+
+# One training step is ~6·params·tokens FLOPs (fwd 2 + bwd 4); no single
+# chip sustains more than this many FLOP/s (fastest shipping chip peak:
+# v6e/Trillium 918 TFLOP/s bf16, with ~30% headroom for the next
+# generation) — a measurement implying more means ``block_until_ready``
+# did not actually wait (observed through the axon tunnel: an
+# RPC-proxied PJRT client can report buffers ready on enqueue, which
+# turns the "step time" into dispatch throughput and the overhead ratio
+# into tunnel-latency noise).  Such a run must not be certified.
+_PHYSICAL_PEAK_FLOPS = 1.2e15
+_DEVICE_MIN_STEP_S = 3e-3
+
+
+def _step_flops(state, batches) -> float:
+    import jax
+
+    params = getattr(state, "params", state)
+    n_params = sum(
+        x.size for x in jax.tree_util.tree_leaves(params) if hasattr(x, "size")
+    )
+    tokens = batches[0].shape[0] * batches[0].shape[1]
+    return 6.0 * float(n_params) * float(tokens)
+
+
+def _device_measurement_physical(min_step_s: float, flops: float) -> bool:
+    """True when a device-arm timing is physically possible."""
+    if min_step_s < _DEVICE_MIN_STEP_S:
+        return False
+    return flops / min_step_s <= _PHYSICAL_PEAK_FLOPS
 
 
 def _run_loop(step_fn, state, batches, n_steps, bracket=None, stat=None):
@@ -311,12 +344,33 @@ def _run_interleaved(rounds: int = ROUNDS, steps: int = STEPS_PER_ROUND) -> int:
     """Single-process paired rounds — for device-exclusive backends (TPU)
     where two processes cannot both claim the chip.  Host-side background
     threads overlap device compute there, so sharing the process does not
-    perturb the untraced arm the way it does on the CPU backend."""
+    perturb the untraced arm the way it does on the CPU backend.
+
+    Robustness against a degrading runtime (the tunnel's latency can ramp
+    over minutes): arm ORDER alternates per round so monotone drift
+    cancels in the cross-round median instead of biasing one arm, and the
+    per-round statistic is the min over steps (runtime hiccups are
+    one-sided).  A physicality gate (see _device_measurement_physical)
+    refuses to certify timings no real chip can produce — exit code 3
+    tells the parent to use the CPU proxy instead."""
     import jax
 
     model, state, tx, train_step, batches = _build()
     plain = jax.jit(train_step, donate_argnums=(0,))
     _, state = _run_loop(plain, state, batches, WARMUP_STEPS)
+
+    if jax.default_backend() != "cpu":
+        probe, state = _run_loop(plain, state, batches, 4, stat=min)
+        if not _device_measurement_physical(probe, _step_flops(state, batches)):
+            implied = _step_flops(state, batches) / max(probe, 1e-9) / 1e12
+            print(
+                f"[bench] device timing non-physical: min step "
+                f"{probe * 1e3:.2f} ms implies {implied:.0f} TFLOP/s on one "
+                "chip — block_until_ready is not waiting (tunneled PJRT); "
+                "refusing to certify",
+                file=sys.stderr,
+            )
+            return 3
 
     traceml_tpu, runtime, stop = _start_traced_stack()
 
@@ -326,22 +380,45 @@ def _run_interleaved(rounds: int = ROUNDS, steps: int = STEPS_PER_ROUND) -> int:
         traced, state2, batches2, WARMUP_STEPS, bracket=traceml_tpu.trace_step
     )
 
-    u_all, t_all, deltas = [], [], []
-    for _ in range(rounds):
+    def _untraced():
         # quiesce the traced stack's background threads while timing the
         # untraced arm — the arms share one process on device-exclusive
         # backends, and the sampler must not perturb the baseline
+        nonlocal state
         runtime.pause()
-        u, state = _run_loop(plain, state, batches, steps)
+        u, state = _run_loop(plain, state, batches, steps, stat=min)
         runtime.resume()
+        return u
+
+    def _traced():
+        nonlocal state2
         t, state2 = _run_loop(
             traced, state2, batches2, steps,
-            bracket=traceml_tpu.trace_step,
+            bracket=traceml_tpu.trace_step, stat=min,
         )
+        return t
+
+    u_all, t_all, deltas = [], [], []
+    for r in range(rounds):
+        if r % 2 == 0:
+            u, t = _untraced(), _traced()
+        else:
+            t, u = _traced(), _untraced()
         u_all.append(u)
         t_all.append(t)
         deltas.append((t - u) / u * 100.0)
     stop()
+    if jax.default_backend() != "cpu" and not _device_measurement_physical(
+        min(u_all), _step_flops(state, batches)
+    ):
+        # the startup probe can pass and the runtime degrade mid-run —
+        # the certified rounds themselves must also be physical
+        print(
+            "[bench] device timing turned non-physical during the run; "
+            "refusing to certify",
+            file=sys.stderr,
+        )
+        return 3
     return _report(u_all, t_all, deltas, jax.default_backend(), "in-process", steps)
 
 
@@ -375,6 +452,13 @@ def _run_device_child(rounds: int, steps: int) -> bool:
     )
     try:
         out, _ = proc.communicate(timeout=budget)
+        if proc.returncode == 3:
+            print(
+                "[bench] device timing refused certification (non-physical "
+                "through the tunnel); falling back to CPU proxy",
+                file=sys.stderr,
+            )
+            return False
         if proc.returncode != 0:
             print(
                 f"[bench] device bench failed rc={proc.returncode}; "

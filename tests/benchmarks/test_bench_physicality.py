@@ -1,0 +1,48 @@
+"""The bench's device-timing physicality gate.
+
+A tunneled PJRT client can report buffers ready on enqueue, which makes
+``block_until_ready`` a no-op and turns "step time" into dispatch
+throughput — the resulting overhead ratio is tunnel-latency noise, not a
+tracer measurement.  ``bench.py`` refuses to certify any device timing
+whose implied FLOP/s exceeds what one chip can physically sustain.
+"""
+
+import bench
+
+
+class _Leaf:
+    def __init__(self, size):
+        self.size = size
+
+
+class _State:
+    def __init__(self, n_params):
+        self.params = {"w": _Leaf(n_params)}
+
+
+def test_impossible_throughput_rejected():
+    # 150M params, 8192 tokens → ~7.4 TFLOP/step; 5 ms (ABOVE the
+    # min-step floor, so this exercises the FLOP/s branch, not the
+    # floor) implies ~1.5 PFLOP/s — past any single chip
+    flops = bench._step_flops(_State(150_000_000), [_Batch(16, 512)])
+    assert flops == 6.0 * 150_000_000 * 16 * 512
+    assert 5e-3 >= bench._DEVICE_MIN_STEP_S
+    assert not bench._device_measurement_physical(5e-3, flops)
+
+
+def test_realistic_throughput_accepted():
+    # the same step at 40 ms implies ~185 TFLOP/s — a real chip
+    flops = bench._step_flops(_State(150_000_000), [_Batch(16, 512)])
+    assert bench._device_measurement_physical(40e-3, flops)
+
+
+def test_sub_floor_steps_rejected_even_if_flops_ok():
+    # tiny model, tiny step: physically possible FLOP/s but far below
+    # the noise floor where a % overhead claim means anything
+    flops = bench._step_flops(_State(1_000), [_Batch(1, 8)])
+    assert not bench._device_measurement_physical(1e-3, flops)
+
+
+class _Batch:
+    def __init__(self, b, s):
+        self.shape = (b, s)

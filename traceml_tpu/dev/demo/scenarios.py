@@ -91,9 +91,14 @@ def run_scenario(name: str, steps: int = 80) -> None:
                 params, opt_state, loss = step(params, opt_state, x, y)
 
     elif name == "input_straggler":
-        # rank (world_size-1) eats a 0.18 s input delay per step
+        # rank (world_size-1) eats a 0.32 s input delay per step.  The
+        # delay is sized for the worst CI host: with 4 rank processes
+        # timesharing one core, scheduler noise can inflate the slow
+        # rank's *compute* delta by >100 ms, and the clean-straggler
+        # dominance gate (1.25×) needs the injected input delta to stay
+        # clearly on top of that.
         world = int(os.environ.get("WORLD_SIZE", 1))
-        loader = _batches(steps, delay_s=0.18, delay_rank=world - 1)
+        loader = _batches(steps, delay_s=0.32, delay_rank=world - 1)
         for x, y in traceml_tpu.wrap_dataloader(loader):
             with traceml_tpu.trace_step():
                 x, y = jax.device_put(x), jax.device_put(y)

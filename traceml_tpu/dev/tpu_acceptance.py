@@ -1,0 +1,217 @@
+"""On-chip acceptance tier (SURVEY.md §4): the checks that only a REAL
+TPU can exercise, run whenever the device tunnel is alive.
+
+CI runs everything else on a virtual CPU mesh; this script is the
+complement — it validates the handful of behaviors that interpret mode
+and host-platform meshes cannot reach:
+
+* the pallas flash kernel COMPILES (``interpret=False``) and matches the
+  XLA reference numerically (bf16-MXU tolerance);
+* the ``device.memory_stats()`` surface — present or absent — and that
+  the step-memory tracker's live-arrays fallback engages when absent;
+* a single-rank traced scenario end-to-end on the tpu backend, producing
+  a ``final_summary.json`` whose step-time section carries device-clock
+  timing;
+* the device-marker readiness edge: markers resolve asynchronously on
+  the real PJRT client (no host sync on the hot path).
+
+Usage::
+
+    python -m traceml_tpu.dev.tpu_acceptance [--out TPU_ACCEPTANCE.json]
+
+Prints one human block per check plus a final JSON line; exit 0 iff all
+REQUIRED checks pass (memory_stats presence is informational — both
+shapes are valid behavior, the tracker must simply survive either).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+
+def _check_backend(report: dict) -> bool:
+    import jax
+
+    backend = jax.default_backend()
+    report["backend"] = backend
+    report["devices"] = [str(d) for d in jax.devices()]
+    print(f"[tpu-acceptance] backend={backend} devices={report['devices']}")
+    return backend == "tpu"
+
+
+def _check_pallas_compiled(report: dict) -> bool:
+    import jax
+    import jax.numpy as jnp
+
+    from traceml_tpu.ops.attention import causal_attention_reference
+    from traceml_tpu.ops.pallas_attention import flash_attention
+
+    B, S, H, D = 2, 512, 4, 64
+    q, k, v = (
+        jax.random.normal(key, (B, S, H, D), jnp.float32)
+        for key in jax.random.split(jax.random.PRNGKey(0), 3)
+    )
+    out = flash_attention(q, k, v)  # interpret=False on the tpu backend
+    ref = causal_attention_reference(q, k, v)
+    err = float(jnp.max(jnp.abs(out - ref)))
+    # MXU matmuls default to bf16 accumulation entry precision on TPU;
+    # 3e-2 bounds the worst observed bf16-vs-f32 divergence at D=64
+    ok = err < 3e-2
+    report["pallas_compiled"] = {"max_abs_err": err, "ok": ok}
+    print(f"[tpu-acceptance] pallas compiled: max_abs_err={err:.2e} ok={ok}")
+    return ok
+
+
+def _check_memory_stats(report: dict) -> bool:
+    import jax
+    import jax.numpy as jnp
+
+    from traceml_tpu.utils.step_memory import StepMemoryTracker
+
+    dev = jax.devices()[0]
+    try:
+        stats = dev.memory_stats()
+    except Exception:  # some PJRT clients raise instead of returning None
+        stats = None
+    report["memory_stats_present"] = stats is not None
+    if stats is not None:
+        report["memory_stats_keys"] = sorted(stats)[:12]
+
+    tracker = StepMemoryTracker(min_sample_interval_s=0.0)
+    tracker.reset(step=1)
+    x = jnp.ones((256, 1024), jnp.float32)  # 1 MiB live
+    jax.block_until_ready(x)
+    rows = tracker.record(step=1)
+    peak = max((r.get("step_peak_bytes") or 0) for r in rows) if rows else None
+    ok = peak is not None and peak > 0
+    report["step_memory"] = {
+        "backend": tracker.backend_name, "step_peak_bytes": peak, "ok": ok,
+    }
+    print(
+        f"[tpu-acceptance] memory_stats present={stats is not None}; "
+        f"tracker backend={tracker.backend_name} peak={peak} ok={ok}"
+    )
+    del x
+    return ok
+
+
+def _check_marker_async(report: dict) -> bool:
+    """Device markers must resolve WITHOUT a blocking host sync."""
+    import jax
+    import jax.numpy as jnp
+
+    from traceml_tpu.utils.timing import DeviceMarker, smallest_leaf
+
+    x = jnp.ones((1024, 1024), jnp.float32)
+    f = jax.jit(lambda a: jnp.tanh(a @ a))
+    jax.block_until_ready(f(x))  # warm
+
+    t0 = time.perf_counter()
+    y = f(x)
+    marker = DeviceMarker(smallest_leaf(y))
+    dispatch_s = time.perf_counter() - t0
+    # NO block_until_ready here: the poll loop itself must observe the
+    # not-ready → ready transition, otherwise the check cannot tell an
+    # async client from one whose is_ready only flips after a host sync
+    deadline = time.perf_counter() + 5.0
+    ready_after_s = None
+    while time.perf_counter() < deadline:
+        if marker.poll():
+            ready_after_s = time.perf_counter() - t0
+            break
+        time.sleep(0.002)
+    # dispatch must return ~instantly (async), and the marker must
+    # resolve from polling alone, with no host sync anywhere
+    ok = marker.resolved and dispatch_s < 0.5
+    report["marker_async"] = {
+        "dispatch_s": dispatch_s,
+        "ready_after_s": ready_after_s,
+        "resolved": bool(marker.resolved),
+        "ok": ok,
+    }
+    print(
+        f"[tpu-acceptance] marker async: dispatch={dispatch_s * 1e3:.2f} ms "
+        f"ready_after={None if ready_after_s is None else round(ready_after_s * 1e3, 2)} ms "
+        f"resolved={marker.resolved} ok={ok}"
+    )
+    return ok
+
+
+def _check_scenario_e2e(report: dict) -> bool:
+    """input_bound scenario through the full CLI on the tpu backend."""
+    import os
+    import subprocess
+    import tempfile
+
+    repo = Path(__file__).resolve().parents[2]
+    tmp = Path(tempfile.mkdtemp(prefix="tpu_accept_"))
+    script = tmp / "scenario.py"
+    script.write_text(
+        "from traceml_tpu.dev.demo.scenarios import run_scenario\n"
+        "run_scenario('input_bound', steps=30)\n"
+    )
+    logs = tmp / "logs"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(repo)
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "traceml_tpu", "run",
+            "--mode", "summary", "--logs-dir", str(logs),
+            "--run-name", "tpu-accept", "--sampler-interval", "0.25",
+            "--finalize-timeout", "45", str(script),
+        ],
+        env=env, capture_output=True, text=True, timeout=420, cwd=str(tmp),
+    )
+    if proc.returncode != 0:
+        report["scenario_e2e"] = {"ok": False, "rc": proc.returncode,
+                                  "stderr": proc.stderr[-1500:]}
+        print(f"[tpu-acceptance] scenario e2e FAILED rc={proc.returncode}")
+        return False
+    session = next(iter(logs.iterdir()))
+    payload = json.loads((session / "final_summary.json").read_text())
+    st = payload["sections"]["step_time"]
+    diag = st["diagnosis"]["kind"]
+    clock = (st.get("global") or {}).get("clock")
+    ok = st["status"] == "OK" and diag == "INPUT_BOUND"
+    report["scenario_e2e"] = {"ok": ok, "diagnosis": diag, "clock": clock}
+    print(f"[tpu-acceptance] scenario e2e: diagnosis={diag} clock={clock} ok={ok}")
+    return ok
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--out", type=str, default=None)
+    args = parser.parse_args(argv)
+
+    report: dict = {"ts": time.time()}
+    checks = [
+        ("backend", _check_backend, True),
+        ("pallas_compiled", _check_pallas_compiled, True),
+        ("memory_stats", _check_memory_stats, True),
+        ("marker_async", _check_marker_async, True),
+        ("scenario_e2e", _check_scenario_e2e, True),
+    ]
+    all_ok = True
+    for name, fn, required in checks:
+        try:
+            ok = fn(report)
+        except Exception as exc:  # any one check failing must not hide the rest
+            report[name] = {"ok": False, "error": repr(exc)}
+            ok = False
+            print(f"[tpu-acceptance] {name} raised: {exc!r}")
+        if required and not ok:
+            all_ok = False
+    report["ok"] = all_ok
+    line = json.dumps(report)
+    print(line)
+    if args.out:
+        Path(args.out).write_text(line + "\n")
+    return 0 if all_ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

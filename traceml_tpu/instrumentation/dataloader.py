@@ -32,7 +32,7 @@ def _timed_device_put(batch: Any, state: TraceState, device: Any = None) -> Any:
         out = (
             jax.device_put(batch) if device is None else jax.device_put(batch, device)
         )
-        if state.sample_markers or not state.tls.in_step:
+        if state.markers_enabled():
             tr.mark(out)
     # shared chokepoint: envelope hand-off + governor gate + resolver
     # submission (sdk/wrappers.publish_region_marker)

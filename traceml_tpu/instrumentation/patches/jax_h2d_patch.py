@@ -67,7 +67,7 @@ def patch_jax_h2d() -> bool:
             region = timed_region(H2D_TIME, st.current_step, sink=st.buffer.add)
             with region as tr:
                 out = original(x, device, *args, **kwargs)
-                if st.sample_markers or not st.tls.in_step:
+                if st.markers_enabled():
                     tr.mark(out)
             # shared chokepoint: envelope hand-off + governor gate +
             # resolver submission (sdk/wrappers.publish_region_marker)

@@ -125,9 +125,7 @@ class trace_time:
 
     def mark(self, outputs: Any) -> Any:
         st = self._state
-        if self._region is not None and (
-            st.sample_markers or not st.tls.in_step
-        ):
+        if self._region is not None and st.markers_enabled():
             self._region.mark(outputs)
         return outputs
 

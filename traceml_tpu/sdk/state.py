@@ -81,6 +81,20 @@ class TraceState:
                 self.mem_tracker = StepMemoryTracker()
             return self.mem_tracker
 
+    def markers_enabled(self) -> bool:
+        """THE device-marker gating policy, in one place.
+
+        Sample markers when the governor chose to for this step, and
+        always for out-of-step dispatches (eval loops etc. are not under
+        the per-step stride — they carry no step envelope to skew).
+        Every marker creator (wrap_step_fn, phase wrappers, trace_time,
+        dataloader/h2d patches) must route through this so a whole step
+        is either marked or not — a policy fork at one site would
+        produce the mixed marked/unmarked rows the window's clock
+        selection cannot tolerate.
+        """
+        return self.sample_markers or not self.tls.in_step
+
     def mark_step_outputs(self, outputs: Any) -> None:
         """Point the open step envelope's device marker at ``outputs``.
 

@@ -106,7 +106,7 @@ class WrappedStepFn:
             # pytree flatten and a single resolver poll per step.  The
             # overhead governor gates the whole device-probe apparatus
             # per step (utils/overhead_governor.py).
-            if st.sample_markers or not st.tls.in_step:
+            if st.markers_enabled():
                 handles = self._pick_handles(out)
                 if handles:
                     marker = DeviceMarker(handles)

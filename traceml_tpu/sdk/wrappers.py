@@ -42,7 +42,7 @@ def _timed_call(
         region = timed_region(phase, st.current_step, sink=st.buffer.add)
         with region as tr:
             out = fn(*args, **kwargs)
-            if mark_output and (st.sample_markers or not tls.in_step):
+            if mark_output and st.markers_enabled():
                 tr.mark(out)
         publish_region_marker(region.event, st)
         return out

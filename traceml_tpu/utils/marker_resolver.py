@@ -152,7 +152,15 @@ class MarkerResolver:
             if ema is not None and ema >= _FINE_WINDOW_S:
                 if age_s < 0.85 * ema:
                     return 0.85 * ema - age_s
-                return max(self._interval, 0.02 * ema, 0.1 * (age_s - ema))
+                # capped like the non-hint path: a marker wedged behind a
+                # stall (blocking checkpoint, retrace) must not push its
+                # own poll cadence — and hence its stamp error —
+                # unboundedly (the stalled lifetime is EMA-rejected, so
+                # the schedule cannot self-correct mid-stall)
+                return min(
+                    _MAX_BACKOFF_S,
+                    max(self._interval, 0.02 * ema, 0.1 * (age_s - ema)),
+                )
         if age_s < _FINE_WINDOW_S:
             return self._interval
         return min(_MAX_BACKOFF_S, max(self._interval, 0.1 * age_s))

@@ -42,9 +42,14 @@ _FIXED_MARKER_COST = 15e-6   # s; host-side flatten+submit+wake per marker
 _PROBES_PER_MARKER = 3.0     # inline sweep + resolver polls, typical
 _EMA_ALPHA = 0.2
 _MAX_STRIDE = 256
-# per-probe samples above this are scheduling artifacts (a descheduled
-# poller measuring its own GIL starvation, not the probe): even a
-# tunneled-RPC is_ready answers well under this.  Ignored, not clamped.
+# per-probe samples above this are either scheduling artifacts (a
+# descheduled poller measuring its own GIL starvation) or a runtime
+# whose probes are catastrophically slow.  CLAMPED, not ignored: the
+# two cases are indistinguishable from one sample, and the safe failure
+# direction is over-throttling (coarser observation) — discarding would
+# leave the governor blind to a genuinely slow runtime, freezing the
+# stride/inline policy in its maximum-overhead configuration.  A
+# clamped 20 ms sample already drives every knob to full backoff.
 _PROBE_SAMPLE_CEILING = 20e-3
 _MAX_RESOLVER_DELAY = 0.1  # cap: stamp quality must bound EMA poisoning
 
@@ -91,9 +96,7 @@ class OverheadGovernor:
         samples above the artifact ceiling are discarded outright."""
         if n_probes <= 0 or total_s < 0:
             return
-        per = total_s / n_probes
-        if per > _PROBE_SAMPLE_CEILING:
-            return
+        per = min(total_s / n_probes, _PROBE_SAMPLE_CEILING)
         self.probe_cost_ema += _EMA_ALPHA * (per - self.probe_cost_ema)
 
     def observe_marker_lifetime(self, dur_s: float) -> None:
