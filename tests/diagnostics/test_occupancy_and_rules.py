@@ -115,9 +115,19 @@ def _dev_rows(**kw):
 def test_utilization_counter_rule():
     result = diagnose_system({}, _dev_rows(utilization_pct=15.0))
     assert "LOW_DEVICE_UTILIZATION" in {i.kind for i in result.issues}
+    # the 30–70% band is informational, not a warning
+    # (reference: MODERATE_GPU_UTILIZATION)
+    result = diagnose_system({}, _dev_rows(utilization_pct=50.0))
+    issue = next(
+        i for i in result.issues if i.kind == "MODERATE_DEVICE_UTILIZATION"
+    )
+    assert issue.severity == "info"
+    assert "LOW_DEVICE_UTILIZATION" not in {i.kind for i in result.issues}
     # healthy util → silent
     result = diagnose_system({}, _dev_rows(utilization_pct=85.0))
-    assert "LOW_DEVICE_UTILIZATION" not in {i.kind for i in result.issues}
+    kinds = {i.kind for i in result.issues}
+    assert "LOW_DEVICE_UTILIZATION" not in kinds
+    assert "MODERATE_DEVICE_UTILIZATION" not in kinds
     # null columns (current TPU runtime) → gated off, no crash
     result = diagnose_system({}, _dev_rows())
     assert "LOW_DEVICE_UTILIZATION" not in {i.kind for i in result.issues}

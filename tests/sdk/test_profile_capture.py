@@ -1,0 +1,267 @@
+"""On-demand XLA profiler capture (sdk/profile_capture.py).
+
+The operator drops ``control/profile_request.json``; the per-rank
+service — driven by step-flush callbacks on the training thread —
+brackets the next N steps with the XLA profiler and answers via
+``control/profile_response.json``.  No reference counterpart (TPU-first
+capability).
+"""
+
+import json
+import os
+import time
+from pathlib import Path
+
+import pytest
+
+from traceml_tpu.sdk.profile_capture import (
+    ProfileCaptureService,
+    profile_request_path,
+    profile_response_path,
+    read_profile_response,
+    write_profile_request,
+)
+
+
+def _drive(svc, steps):
+    for s in range(steps):
+        svc.on_step_flushed(s)
+
+
+def test_idle_without_request(tmp_path):
+    svc = ProfileCaptureService(tmp_path, rank=0, check_every=1)
+    _drive(svc, 20)
+    assert not profile_response_path(tmp_path).exists()
+
+
+def test_capture_cycle_real_profiler(tmp_path):
+    """Full cycle against the real jax.profiler on CPU: request → N
+    traced steps → response + trace artifacts on disk."""
+    import jax
+    import jax.numpy as jnp
+
+    svc = ProfileCaptureService(tmp_path, rank=0, check_every=1)
+    ts = write_profile_request(tmp_path, steps=3)
+    f = jax.jit(lambda a: a @ a / 16.0)
+    x = jnp.ones((16, 16))
+    # the hook starts the trace at a flush edge; subsequent flushes
+    # count down while real device work happens in between
+    for s in range(8):
+        x = f(x)
+        jax.block_until_ready(x)
+        svc.on_step_flushed(s)
+    resp = read_profile_response(tmp_path, for_request=ts)
+    assert resp is not None and resp["ok"], resp
+    trace_root = Path(resp["trace_dir"])
+    assert trace_root.is_dir()
+    rank_dir = trace_root / "rank_0"
+    produced = [str(p) for p in rank_dir.rglob("*") if p.is_file()]
+    assert produced, "profiler produced no artifacts"
+
+
+def test_rank_filter(tmp_path):
+    svc = ProfileCaptureService(tmp_path, rank=2, check_every=1)
+    write_profile_request(tmp_path, steps=2, ranks=[0, 1])
+    _drive(svc, 10)
+    # rank 2 is excluded: no capture, no response
+    assert not profile_response_path(tmp_path).exists()
+    assert not (tmp_path / "profiles").exists()
+
+
+def test_non_primary_rank_stays_silent_on_response(tmp_path, monkeypatch):
+    """Both ranks capture, only the primary writes the response file."""
+    calls = []
+
+    class _FakeProfiler:
+        def start_trace(self, d):
+            calls.append(("start", d))
+
+        def stop_trace(self):
+            calls.append(("stop",))
+
+    import jax
+
+    monkeypatch.setattr(jax, "profiler", _FakeProfiler())
+    # services exist BEFORE the request (a request predating the service
+    # is treated as stale — see test_stale_request_not_replayed)
+    svc1 = ProfileCaptureService(tmp_path, rank=1, check_every=1)
+    svc0 = ProfileCaptureService(tmp_path, rank=0, check_every=1)
+    time.sleep(0.02)
+    write_profile_request(tmp_path, steps=1, ranks=[0, 1])
+    _drive(svc1, 4)
+    assert ("stop",) in calls  # rank 1 captured…
+    assert not profile_response_path(tmp_path).exists()  # …but didn't respond
+    _drive(svc0, 4)
+    resp = json.loads(profile_response_path(tmp_path).read_text())
+    assert resp["ok"] and resp["rank"] == 0
+
+
+def test_ranks_share_one_trace_dir(tmp_path, monkeypatch):
+    """The stamp derives from the request, not each rank's clock — all
+    ranks land under ONE profiles/<stamp>/ even if their flush edges
+    straddle a second boundary."""
+    starts = []
+
+    class _FakeProfiler:
+        def start_trace(self, d):
+            starts.append(d)
+
+        def stop_trace(self):
+            pass
+
+    import jax
+
+    monkeypatch.setattr(jax, "profiler", _FakeProfiler())
+    svcs = [
+        ProfileCaptureService(tmp_path, rank=r, check_every=1)
+        for r in range(3)
+    ]
+    time.sleep(0.02)
+    write_profile_request(tmp_path, steps=1)
+    for svc in svcs:
+        _drive(svc, 3)
+    parents = {Path(d).parent for d in starts}
+    assert len(starts) == 3 and len(parents) == 1, starts
+
+
+def test_same_request_not_replayed(tmp_path, monkeypatch):
+    starts = []
+
+    class _FakeProfiler:
+        def start_trace(self, d):
+            starts.append(d)
+
+        def stop_trace(self):
+            pass
+
+    import jax
+
+    monkeypatch.setattr(jax, "profiler", _FakeProfiler())
+    svc = ProfileCaptureService(tmp_path, rank=0, check_every=1)
+    write_profile_request(tmp_path, steps=2)
+    _drive(svc, 10)
+    assert len(starts) == 1  # handled once, mtime remembered
+    # a NEW request (newer mtime) re-engages
+    time.sleep(0.02)
+    write_profile_request(tmp_path, steps=2)
+    os.utime(profile_request_path(tmp_path))
+    _drive(svc, 10)
+    assert len(starts) == 2
+
+
+def test_answered_request_not_replayed_after_restart(tmp_path, monkeypatch):
+    """A request that was already ANSWERED in a previous life of this
+    session dir must not replay as an unsolicited capture on restart;
+    an unanswered request, by contrast, is honored whenever the job
+    starts stepping (the CLI may file it before the first step)."""
+    starts = []
+
+    class _FakeProfiler:
+        def start_trace(self, d):
+            starts.append(d)
+
+        def stop_trace(self):
+            pass
+
+    import jax
+
+    monkeypatch.setattr(jax, "profiler", _FakeProfiler())
+    # previous life: request + full capture + response
+    write_profile_request(tmp_path, steps=1)
+    svc_old = ProfileCaptureService(tmp_path, rank=0, check_every=1)
+    _drive(svc_old, 3)
+    assert len(starts) == 1
+    assert profile_response_path(tmp_path).exists()
+    # restart: same files on disk, fresh service → no replay
+    svc_new = ProfileCaptureService(tmp_path, rank=0, check_every=1)
+    _drive(svc_new, 10)
+    assert len(starts) == 1
+
+
+def test_close_finishes_inflight_capture(tmp_path, monkeypatch):
+    """Shutdown mid-capture stops the profiler and answers with a
+    truncated response instead of leaving the operator to time out."""
+    calls = []
+
+    class _FakeProfiler:
+        def start_trace(self, d):
+            calls.append("start")
+
+        def stop_trace(self):
+            calls.append("stop")
+
+    import jax
+
+    monkeypatch.setattr(jax, "profiler", _FakeProfiler())
+    svc = ProfileCaptureService(tmp_path, rank=0, check_every=1)
+    time.sleep(0.02)
+    ts = write_profile_request(tmp_path, steps=100)
+    _drive(svc, 5)  # capture starts, far from finishing
+    assert calls == ["start"]
+    svc.close()
+    assert calls == ["start", "stop"]
+    resp = read_profile_response(tmp_path, for_request=ts)
+    assert resp is not None and resp["ok"] and resp["truncated"]
+    svc.close()  # idempotent
+    assert calls == ["start", "stop"]
+
+
+def test_response_matching_is_exact(tmp_path, monkeypatch):
+    """A second request must not be satisfied by the first request's
+    response (exact requested_at match, no clock-slack window)."""
+
+    class _FakeProfiler:
+        def start_trace(self, d):
+            pass
+
+        def stop_trace(self):
+            pass
+
+    import jax
+
+    monkeypatch.setattr(jax, "profiler", _FakeProfiler())
+    svc = ProfileCaptureService(tmp_path, rank=0, check_every=1)
+    time.sleep(0.02)
+    ts_a = write_profile_request(tmp_path, steps=1)
+    _drive(svc, 3)
+    assert read_profile_response(tmp_path, for_request=ts_a) is not None
+    # request B issued immediately after: A's response must not match
+    ts_b = ts_a + 0.5
+    assert read_profile_response(tmp_path, for_request=ts_b) is None
+
+
+def test_broken_profiler_answers_error(tmp_path, monkeypatch):
+    class _Broken:
+        def start_trace(self, d):
+            raise RuntimeError("unsupported runtime")
+
+        def stop_trace(self):  # pragma: no cover
+            pass
+
+    import jax
+
+    monkeypatch.setattr(jax, "profiler", _Broken())
+    svc = ProfileCaptureService(tmp_path, rank=0, check_every=1)
+    ts = write_profile_request(tmp_path, steps=2)
+    _drive(svc, 5)
+    resp = read_profile_response(tmp_path, for_request=ts)
+    assert resp is not None and not resp["ok"]
+    assert "unsupported" in (resp["error"] or "")
+
+
+def test_steps_bounded_against_typo(tmp_path, monkeypatch):
+    class _FakeProfiler:
+        def start_trace(self, d):
+            pass
+
+        def stop_trace(self):
+            pass
+
+    import jax
+
+    monkeypatch.setattr(jax, "profiler", _FakeProfiler())
+    svc = ProfileCaptureService(tmp_path, rank=0, check_every=1)
+    ts = write_profile_request(tmp_path, steps=10_000_000)
+    _drive(svc, 250)  # > _MAX_STEPS flushes
+    resp = read_profile_response(tmp_path, for_request=ts)
+    assert resp is not None and resp["ok"]  # finished within the bound

@@ -34,6 +34,7 @@ _API_SYMBOLS = (
     "wrap_checkpoint",
     "current_step",
     "enable_ici_stats",
+    "request_profile",
 )
 
 __all__ = list(_API_SYMBOLS) + ["__version__"]

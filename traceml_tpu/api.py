@@ -23,6 +23,9 @@ from traceml_tpu.sdk.summary_client import (  # noqa: F401
     live_metrics,
     summary,
 )
+from traceml_tpu.sdk.profile_capture import (  # noqa: F401
+    request_profile_and_wait as request_profile,
+)
 
 
 def current_step() -> int:

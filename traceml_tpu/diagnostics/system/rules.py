@@ -14,6 +14,7 @@ from typing import Any, Dict, List, Mapping, Sequence
 
 from traceml_tpu.diagnostics.common import (
     SEVERITY_CRITICAL,
+    SEVERITY_INFO,
     SEVERITY_WARNING,
     DiagnosticIssue,
 )
@@ -32,6 +33,7 @@ class SystemPolicy:
     # without the counters (current libtpu), populated where available
     # (reference: system/rules.py utilization/temperature/power rules)
     device_util_low_warn: float = 30.0  # %
+    device_util_moderate: float = 70.0  # % — below this is "moderate"
     device_temp_warn: float = 85.0  # °C
     device_temp_critical: float = 95.0
     device_power_warn_frac: float = 0.95  # of rated, when rated known
@@ -188,16 +190,25 @@ class LowDeviceUtilizationCounterRule:
         p = ctx.policy
         for (node, dev), rows in ctx.devices.items():
             util = _recent_mean(rows, "utilization_pct")
-            if util is None or util >= p.device_util_low_warn:
+            if util is None or util >= p.device_util_moderate:
                 continue
+            if util < p.device_util_low_warn:
+                kind, severity = "LOW_DEVICE_UTILIZATION", SEVERITY_WARNING
+                summary = (
+                    f"Node {node} chip {dev} duty cycle at {util:.0f}% "
+                    "(recent mean) — the accelerator is mostly idle."
+                )
+            else:  # the 30–70% band (reference: MODERATE_GPU_UTILIZATION)
+                kind, severity = "MODERATE_DEVICE_UTILIZATION", SEVERITY_INFO
+                summary = (
+                    f"Node {node} chip {dev} duty cycle at {util:.0f}% "
+                    "(recent mean) — headroom left on the accelerator."
+                )
             issues.append(
                 DiagnosticIssue(
-                    kind="LOW_DEVICE_UTILIZATION",
-                    severity=SEVERITY_WARNING,
-                    summary=(
-                        f"Node {node} chip {dev} duty cycle at {util:.0f}% "
-                        "(recent mean) — the accelerator is mostly idle."
-                    ),
+                    kind=kind,
+                    severity=severity,
+                    summary=summary,
                     action=(
                         "Feed the chip: prefetch input, increase per-step "
                         "work, check for host-side stalls in the phase table."

@@ -98,6 +98,19 @@ def _build_parser() -> argparse.ArgumentParser:
     insp.add_argument("path", help="a rank data dir or .msgpack file")
     insp.add_argument("--limit", type=int, default=20)
 
+    prof = sub.add_parser(
+        "profile",
+        help="capture an XLA profiler trace from a RUNNING session",
+    )
+    prof.add_argument("session_dir", help="path to <logs>/<session>")
+    prof.add_argument("--steps", type=int, default=5, help="steps to trace")
+    prof.add_argument("--timeout", type=float, default=60.0)
+    prof.add_argument(
+        "--ranks",
+        default=None,
+        help="comma-separated global ranks (default: all)",
+    )
+
     return p
 
 
@@ -154,6 +167,42 @@ def main(argv: Optional[List[str]] = None) -> int:
             interval=args.interval,
             browser=args.browser,
         )
+    if args.command == "profile":
+        from traceml_tpu.sdk.profile_capture import request_profile_and_wait
+
+        try:
+            ranks = (
+                [int(r) for r in args.ranks.split(",")] if args.ranks else None
+            )
+        except ValueError:
+            print(
+                f"traceml-tpu profile: --ranks must be comma-separated "
+                f"integers, got {args.ranks!r}",
+                file=sys.stderr,
+            )
+            return 2
+        resp = request_profile_and_wait(
+            Path(args.session_dir),
+            steps=args.steps,
+            timeout=args.timeout,
+            ranks=ranks,
+        )
+        if resp is None:
+            print(
+                "[TraceML] no response — is the job stepping? (capture "
+                "engages at step boundaries)",
+                file=sys.stderr,
+            )
+            return 1
+        if not resp.get("ok"):
+            print(f"[TraceML] profile failed: {resp.get('error')}", file=sys.stderr)
+            return 1
+        print(f"[TraceML] trace captured: {resp.get('trace_dir')}")
+        print(
+            "  open with: xprof / tensorboard --logdir <dir> "
+            "(the trace_.json.gz is also chrome://tracing-compatible)"
+        )
+        return 0
     return 2
 
 

@@ -40,6 +40,7 @@ class TraceMLRuntime:
         self.client: Optional[TCPClient] = None
         self.publisher: Optional[TelemetryPublisher] = None
         self._thread: Optional[threading.Thread] = None
+        self._profile_service = None
         self._stop_evt = threading.Event()
         self._started = False
         self._finished_sent = False
@@ -71,6 +72,19 @@ class TraceMLRuntime:
         self.publisher = TelemetryPublisher(self.samplers, self.client, sender_identity)
         # max-steps lifecycle: observe sdk step flushes
         get_state().on_step_flushed.append(self.recording.on_step_flushed)
+        # on-demand XLA profiler capture (control-file protocol)
+        try:
+            from traceml_tpu.sdk.profile_capture import ProfileCaptureService
+
+            self._profile_service = ProfileCaptureService(
+                self.settings.session_dir, rank=self.identity.global_rank
+            )
+            get_state().on_step_flushed.append(
+                self._profile_service.on_step_flushed
+            )
+        except Exception as exc:
+            get_error_log().warning("profile capture unavailable", exc)
+            self._profile_service = None
         self._stop_evt.clear()
         self._thread = threading.Thread(
             target=self._sampler_loop, name="traceml-runtime", daemon=True
@@ -100,6 +114,20 @@ class TraceMLRuntime:
             get_state().on_step_flushed.remove(self.recording.on_step_flushed)
         except ValueError:
             pass
+        if getattr(self, "_profile_service", None) is not None:
+            try:
+                get_state().on_step_flushed.remove(
+                    self._profile_service.on_step_flushed
+                )
+            except ValueError:
+                pass
+            try:
+                # finish any in-flight capture: never leave the XLA
+                # profiler tracing through teardown or the operator
+                # CLI waiting on a response that will never come
+                self._profile_service.close()
+            except Exception as exc:
+                get_error_log().warning("profile capture close failed", exc)
 
     def _take_rank_finished(self) -> Optional[list]:
         """The send-once rank_finished marker, or None if already sent.

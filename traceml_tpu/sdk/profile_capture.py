@@ -1,0 +1,254 @@
+"""On-demand XLA profiler capture — grab a trace from a RUNNING job.
+
+No reference counterpart (the reference's CUDA world leans on external
+nsight; on TPU the XLA profiler trace IS the performance tool, so the
+tracer makes it reachable without restarting the run).  Same file-IPC
+shape as the final-summary protocol (sdk/protocol.py): an operator (or
+``traceml-tpu profile <session_dir>``) drops
+``control/profile_request.json``; each rank's
+:class:`ProfileCaptureService` — driven by the SDK's per-step flush
+callback on the training thread — notices it, brackets the next N steps
+with ``jax.profiler.start_trace/stop_trace`` into
+``<session>/profiles/<stamp>/rank_<r>/``, and the primary rank writes
+``control/profile_response.json``.
+
+Design constraints:
+
+* **Fail-open** — a broken profiler (unsupported runtime, disk full)
+  must answer with an error response, never raise into training.
+* **Cheap when idle** — the request probe is one ``os.stat`` every
+  ``check_every`` steps (sub-µs amortized); no extra thread.
+* **Step-aligned** — capture starts at a step FLUSH edge (so the trace
+  holds whole steps) and stops N flushes later.  Short traces keep the
+  artifact small; the XLA trace of even a few steps holds the full
+  fusion/overlap story.
+* **Multi-rank** — every rank captures its own process trace (XLA
+  profiling is per-process); the request may restrict via ``ranks``.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+from traceml_tpu.sdk.protocol import control_dir
+from traceml_tpu.utils.atomic_io import atomic_write_json, read_json
+from traceml_tpu.utils.error_log import get_error_log
+
+PROFILE_REQUEST_FILE = "profile_request.json"
+PROFILE_RESPONSE_FILE = "profile_response.json"
+_DEFAULT_STEPS = 5
+_MAX_STEPS = 200  # bound the artifact even against a typo'd request
+
+
+def profile_request_path(session_dir: Path) -> Path:
+    return control_dir(session_dir) / PROFILE_REQUEST_FILE
+
+
+def profile_response_path(session_dir: Path) -> Path:
+    return control_dir(session_dir) / PROFILE_RESPONSE_FILE
+
+
+def write_profile_request(
+    session_dir: Path, steps: int = _DEFAULT_STEPS, ranks=None
+) -> float:
+    """Operator side: ask the running job for a trace.  Returns the
+    request timestamp (pass to :func:`read_profile_response` matching)."""
+    ts = time.time()
+    atomic_write_json(
+        profile_request_path(session_dir),
+        {"requested_at": ts, "steps": int(steps), "ranks": ranks},
+    )
+    return ts
+
+
+def read_profile_response(
+    session_dir: Path, for_request: Optional[float] = None
+) -> Optional[Dict[str, Any]]:
+    """The response matching ``for_request`` (the timestamp returned by
+    :func:`write_profile_request`, echoed back verbatim by the service —
+    exact-match, so neither clock skew between hosts nor a stale
+    previous response can satisfy a new request), or any response when
+    ``for_request`` is None."""
+    resp = read_json(profile_response_path(session_dir))
+    if not resp:
+        return None
+    if for_request is not None and resp.get("requested_at") != for_request:
+        return None
+    return resp
+
+
+class ProfileCaptureService:
+    """Per-rank request watcher + capture state machine.
+
+    Wire up by appending :meth:`on_step_flushed` to
+    ``TraceState.on_step_flushed`` (the runtime does this in
+    ``start()``).  All work happens on the training thread at step-flush
+    edges — starting/stopping the XLA profiler from another thread would
+    tear mid-step.
+    """
+
+    def __init__(
+        self,
+        session_dir: Path,
+        rank: int = 0,
+        check_every: int = 5,
+    ) -> None:
+        self._session_dir = Path(session_dir)
+        self._rank = int(rank)
+        self._check_every = max(1, int(check_every))
+        self._flushes = 0
+        self._handled_mtime = 0.0
+        self._remaining = 0
+        self._trace_dir: Optional[Path] = None
+        self._request: Dict[str, Any] = {}
+
+    # -- the per-step hook (training thread) ---------------------------
+    def on_step_flushed(self, step: int) -> None:
+        try:
+            if self._remaining > 0:
+                self._remaining -= 1
+                if self._remaining == 0:
+                    self._finish(ok=True)
+                return
+            self._flushes += 1
+            if self._flushes % self._check_every:
+                return
+            self._maybe_start()
+        except Exception as exc:  # never raise into the training loop
+            get_error_log().warning("profile capture hook failed", exc)
+            self._remaining = 0
+
+    # -- internals -----------------------------------------------------
+    def _handled_marker_path(self) -> Path:
+        return (
+            control_dir(self._session_dir)
+            / f".profile_handled_rank_{self._rank}.json"
+        )
+
+    def _maybe_start(self) -> None:
+        req_path = profile_request_path(self._session_dir)
+        try:
+            mtime = os.stat(req_path).st_mtime
+        except OSError:
+            return
+        if mtime <= self._handled_mtime:
+            return
+        self._handled_mtime = mtime
+        req = read_json(req_path) or {}
+        # per-rank handled marker: a request this rank already handled
+        # in a PREVIOUS life of the session dir (restart/resume) must
+        # not replay as an unsolicited capture.  Per-rank (not the
+        # shared response file) because the primary can finish and
+        # respond while a slower rank has not even started its capture —
+        # a shared answered-check would silently drop that rank's trace.
+        # An unhandled request is honored regardless of age: the
+        # operator may legitimately file it before the first step.
+        marker = self._handled_marker_path()
+        prior = read_json(marker)
+        if prior is not None and prior.get("requested_at") == req.get(
+            "requested_at"
+        ):
+            return
+        try:
+            atomic_write_json(
+                marker, {"requested_at": req.get("requested_at")}
+            )
+        except Exception:
+            pass  # worst case: a restart replays one capture
+        ranks = req.get("ranks")
+        if ranks is not None and self._rank not in ranks:
+            return
+        steps = min(_MAX_STEPS, max(1, int(req.get("steps") or _DEFAULT_STEPS)))
+        # stamp from the REQUEST time, not each rank's local now: ranks
+        # reach their flush edges at different instants, and a wall-clock
+        # stamp would scatter one capture across two profiles/<stamp>/
+        # dirs whenever ranks straddle a second boundary
+        req_ts = float(req.get("requested_at") or time.time())
+        stamp = time.strftime("%Y%m%d_%H%M%S", time.localtime(req_ts))
+        trace_dir = self._session_dir / "profiles" / stamp / f"rank_{self._rank}"
+        try:
+            import jax
+
+            trace_dir.mkdir(parents=True, exist_ok=True)
+            jax.profiler.start_trace(str(trace_dir))
+        except Exception as exc:
+            get_error_log().warning("profile capture start failed", exc)
+            self._respond(ok=False, error=repr(exc), trace_dir=None, req=req)
+            return
+        self._request = req
+        self._trace_dir = trace_dir
+        self._remaining = steps
+
+    def _finish(self, ok: bool, truncated: bool = False) -> None:
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception as exc:
+            get_error_log().warning("profile capture stop failed", exc)
+            ok = False
+        self._respond(
+            ok=ok,
+            error=None if ok else "stop_trace failed",
+            trace_dir=self._trace_dir,
+            req=self._request,
+            truncated=truncated,
+        )
+        self._trace_dir = None
+        self._request = {}
+
+    def close(self) -> None:
+        """Shutdown path (runtime.stop): finish an in-flight capture so
+        the profiler is never left tracing through teardown and the
+        waiting operator gets an answer (a truncated trace of the steps
+        that did run, not a timeout)."""
+        if self._remaining > 0:
+            self._remaining = 0
+            self._finish(ok=True, truncated=True)
+
+    def _respond(self, ok, error, trace_dir, req, truncated=False) -> None:
+        # one response per request, written by the primary participating
+        # rank (responses from N ranks would race the same file)
+        ranks = req.get("ranks")
+        primary = min(ranks) if ranks else 0
+        if self._rank != primary:
+            return
+        try:
+            atomic_write_json(
+                profile_response_path(self._session_dir),
+                {
+                    # echoed verbatim: the operator's exact-match key
+                    "requested_at": req.get("requested_at"),
+                    "completed_at": time.time(),
+                    "ok": bool(ok),
+                    "error": error,
+                    "trace_dir": str(trace_dir.parent) if trace_dir else None,
+                    "steps": req.get("steps"),
+                    "truncated": bool(truncated),
+                    "rank": self._rank,
+                },
+            )
+        except Exception as exc:
+            get_error_log().warning("profile capture respond failed", exc)
+
+
+def request_profile_and_wait(
+    session_dir: Path,
+    steps: int = _DEFAULT_STEPS,
+    timeout: float = 60.0,
+    poll_interval: float = 0.25,
+    ranks=None,
+) -> Optional[Dict[str, Any]]:
+    """Operator convenience: request + poll until the job answers (the
+    job must be stepping — capture engages at step-flush edges)."""
+    ts = write_profile_request(session_dir, steps=steps, ranks=ranks)
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        resp = read_profile_response(session_dir, for_request=ts)
+        if resp is not None:
+            return resp
+        time.sleep(poll_interval)
+    return None
