@@ -185,6 +185,54 @@ def test_multi_node_cluster_rollup_in_summary(tmp_path):
     assert cluster["busiest_node"] == "host1"
 
 
+def test_per_rank_identity_blocks_two_nodes(tmp_path):
+    """Section per-rank groups carry identity blocks (reference:
+    SCHEMA.md groups.rows[*].identity) — hostname/node placement is
+    readable straight off a rank row in a multi-node summary."""
+    s = _Session(tmp_path)
+    for rank, node in ((0, 0), (1, 0), (2, 1), (3, 1)):
+        ident = s.ident(rank, world=4, node=node)
+        s.inject(
+            "step_time",
+            {"step_time": [_step_row(i) for i in range(1, 25)]},
+            ident,
+        )
+        s.inject(
+            "process",
+            {"process": [{"timestamp": 1.0, "cpu_pct": 10.0,
+                          "rss_bytes": GiB, "num_threads": 8}]},
+            ident,
+        )
+        s.inject(
+            "step_memory",
+            {"step_memory": [{"step": i, "timestamp": float(i),
+                              "device_id": 0, "device_kind": "tpu",
+                              "current_bytes": GiB, "peak_bytes": GiB,
+                              "step_peak_bytes": GiB, "limit_bytes": 16 * GiB}
+                             for i in range(1, 10)]},
+            ident,
+        )
+    payload = s.payload()
+    for section, rank_key in (
+        ("step_time", "per_rank"),
+        ("step_memory", "per_rank"),
+        ("process", "per_rank"),
+    ):
+        per_rank = payload["sections"][section]["global"][rank_key]
+        assert set(per_rank) == {"0", "1", "2", "3"}, section
+        for rank, node in (("0", 0), ("2", 1)):
+            ident = per_rank[rank]["identity"]
+            assert ident is not None, (section, rank)
+            assert ident["hostname"] == f"host{node}"
+            assert ident["node_rank"] == node
+            assert ident["world_size"] == 4
+    # section-local text cards (reference SCHEMA `card`) carry the
+    # per-rank detail including placement
+    for section in ("step_time", "step_memory", "process"):
+        card = payload["sections"][section]["card"]
+        assert "rank 2" in card and "[host1#1]" in card, (section, card)
+
+
 def test_garbage_rows_do_not_break_summary(tmp_path):
     """Rows with missing/None fields degrade gracefully, never throw."""
     s = _Session(tmp_path)

@@ -63,7 +63,7 @@ def _safe_section(key: str, builder: Callable[[], Dict[str, Any]]) -> Dict[str, 
 # -- section builders ----------------------------------------------------
 
 
-def _build_step_time_section(db_path: Path, mode: str):
+def _build_step_time_section(db_path: Path, mode: str, identities=None):
     rank_rows = loaders.load_step_time_rows(db_path)
     if not rank_rows:
         return _no_data_section("step_time"), None
@@ -122,9 +122,12 @@ def _build_step_time_section(db_path: Path, mode: str):
                     ),
                 }
         # per-rank cards: the per-rank group view the renderers and
-        # compare consume (reference: per-rank groups in sections)
+        # compare consume (reference: per-rank groups with identity
+        # blocks, SCHEMA.md groups.rows[*].identity)
+        identities = identities or {}
         rank_cards = {
             str(r): {
+                "identity": identities.get(r),
                 "avg_ms": {k: round(v, 4) for k, v in w.averages.items()},
                 "occupancy": w.occupancy,
                 "steps_seen": len(w.steps),
@@ -150,13 +153,14 @@ def _build_step_time_section(db_path: Path, mode: str):
     return section, result
 
 
-def _build_step_memory_section(db_path: Path):
+def _build_step_memory_section(db_path: Path, identities=None):
     rank_rows = loaders.load_step_memory_rows(db_path)
     if not rank_rows:
         return _no_data_section("step_memory"), None
     result = diagnose_memory(rank_rows)
     from traceml_tpu.analytics.trends.core import compute_window_trend
 
+    identities = identities or {}
     per_rank = {}
     for rank, rows in rank_rows.items():
         if not rows:
@@ -168,6 +172,7 @@ def _build_step_memory_section(db_path: Path):
         first_cur = next((v for v in series if v), None)
         trend = compute_window_trend(series) if len(series) >= 8 else None
         per_rank[str(rank)] = {
+            "identity": identities.get(rank),
             "devices": sorted({int(r.get("device_id") or 0) for r in rows}),
             "current_bytes": last.get("current_bytes"),
             "step_peak_bytes": peak,
@@ -275,11 +280,12 @@ def _build_system_section(db_path: Path):
     return section, result
 
 
-def _build_process_section(db_path: Path):
+def _build_process_section(db_path: Path, identities=None):
     procs, devices = loaders.load_process_rows(db_path)
     if not procs and not devices:
         return _no_data_section("process"), None
     result = diagnose_process(procs, devices)
+    identities = identities or {}
     per_rank = {}
     for rank, rows in procs.items():
         if not rows:
@@ -288,6 +294,7 @@ def _build_process_section(db_path: Path):
         cpu_vals = [r["cpu_pct"] for r in rows if r.get("cpu_pct") is not None]
         rss_vals = [r["rss_bytes"] for r in rows if r.get("rss_bytes") is not None]
         per_rank[str(rank)] = {
+            "identity": identities.get(rank),
             "pid": last.get("pid"),
             "hostname": last.get("hostname"),
             "rss_bytes": last.get("rss_bytes"),
@@ -326,6 +333,152 @@ def _box(lines) -> str:
     bottom = "└" + "─" * (width + 2) + "┘"
     body = "\n".join(f"│ {l.ljust(width)} │" for l in lines)
     return f"{top}\n{body}\n{bottom}"
+
+
+def _ident_suffix(info: Dict[str, Any]) -> str:
+    ident = info.get("identity") or {}
+    host = ident.get("hostname")
+    return f"  [{host}#{ident.get('node_rank')}]" if host else ""
+
+
+def _step_time_card(sec: Dict[str, Any]) -> str:
+    g = sec.get("global") or {}
+    phases = g.get("phases") or {}
+    if not phases:
+        return ""
+    out = []
+    header = (
+        f"clock {g.get('clock')} · {g.get('n_steps')} steps "
+        f"({g.get('step_range', ['?', '?'])[0]}–{g.get('step_range', ['?', '?'])[1]})"
+    )
+    occ = g.get("median_occupancy")
+    if occ is not None:
+        header += f" · chip busy {fmt_pct(occ)}"
+    out.append(header)
+    for key, p in phases.items():
+        share = p.get("share_of_step")
+        out.append(
+            f"{key:<11} median {fmt_ms(p.get('median_ms')):>10}  "
+            f"share {fmt_pct(share) if share is not None else 'n/a':>6}  "
+            f"skew {fmt_pct(p.get('skew_pct')) if p.get('skew_pct') is not None else 'n/a':>6}  "
+            f"worst rank {p.get('worst_rank')}"
+        )
+    per_rank = g.get("per_rank") or {}
+    if len(per_rank) > 1:
+        out.append("per rank:")
+        for rank, info in sorted(per_rank.items(), key=lambda kv: int(kv[0])):
+            avg = (info.get("avg_ms") or {}).get(STEP_KEY)
+            occ_r = info.get("occupancy")
+            out.append(
+                f"  rank {rank}: step {fmt_ms(avg)}"
+                + (f"  busy {fmt_pct(occ_r)}" if occ_r is not None else "")
+                + _ident_suffix(info)
+            )
+    return "\n".join(out)
+
+
+def _step_memory_card(sec: Dict[str, Any]) -> str:
+    per_rank = (sec.get("global") or {}).get("per_rank") or {}
+    if not per_rank:
+        return ""
+    out = []
+    for rank, info in sorted(per_rank.items(), key=lambda kv: int(kv[0])):
+        line = (
+            f"rank {rank}: current {fmt_bytes(info.get('current_bytes'))}  "
+            f"peak {fmt_bytes(info.get('step_peak_bytes'))}  "
+            f"limit {fmt_bytes(info.get('limit_bytes'))}"
+        )
+        if info.get("pressure") is not None:
+            line += f"  pressure {fmt_pct(info['pressure'])}"
+        growth = info.get("growth_bytes")
+        if growth:
+            # fmt_bytes carries the sign for negatives; '+' marks growth
+            line += f"  growth {'+' if growth > 0 else ''}{fmt_bytes(growth)}"
+        out.append(line + _ident_suffix(info))
+    rollup = (sec.get("global") or {}).get("rollup") or {}
+    skew = rollup.get("peak_skew_pct")
+    if skew is not None:
+        out.append(f"peak skew across ranks: {fmt_pct(skew)}")
+    return "\n".join(out)
+
+
+def _system_card(sec: Dict[str, Any]) -> str:
+    g = sec.get("global") or {}
+    out = []
+    for node, info in sorted((g.get("nodes") or {}).items(), key=lambda kv: int(kv[0])):
+        cpu = info.get("cpu_pct_mean")
+        out.append(
+            f"node {node} ({info.get('hostname')}): "
+            f"cpu {cpu:.0f}%" if cpu is not None else
+            f"node {node} ({info.get('hostname')}): cpu n/a"
+        )
+        if info.get("memory_used_bytes") and info.get("memory_total_bytes"):
+            out[-1] += (
+                f"  ram {fmt_bytes(info['memory_used_bytes'])}"
+                f"/{fmt_bytes(info['memory_total_bytes'])}"
+            )
+    def _dev_key(kv):  # "node:dev" → numeric order (10 after 2)
+        try:
+            node, dev = kv[0].split(":", 1)
+            return (int(node), int(dev))
+        except (ValueError, AttributeError):
+            return (1 << 30, 0)
+
+    for key, dev in sorted((g.get("devices") or {}).items(), key=_dev_key):
+        line = f"chip {key} ({dev.get('device_kind')})"
+        if dev.get("memory_used_bytes") is not None:
+            line += f": hbm {fmt_bytes(dev['memory_used_bytes'])}"
+            if dev.get("memory_total_bytes"):
+                line += f"/{fmt_bytes(dev['memory_total_bytes'])}"
+        if dev.get("utilization_pct_mean") is not None:
+            line += f"  duty {dev['utilization_pct_mean']:.0f}%"
+        out.append(line)
+    return "\n".join(out)
+
+
+def _process_card(sec: Dict[str, Any]) -> str:
+    per_rank = (sec.get("global") or {}).get("per_rank") or {}
+    if not per_rank:
+        return ""
+    out = []
+    for rank, info in sorted(per_rank.items(), key=lambda kv: int(kv[0])):
+        cpu = info.get("cpu_pct_mean")
+        out.append(
+            f"rank {rank} (pid {info.get('pid')}): "
+            f"cpu {cpu:.0f}%  " if cpu is not None
+            else f"rank {rank} (pid {info.get('pid')}): cpu n/a  "
+        )
+        out[-1] += f"rss {fmt_bytes(info.get('rss_bytes'))}"
+        if info.get("num_threads") is not None:
+            out[-1] += f"  threads {info['num_threads']}"
+        out[-1] += _ident_suffix(info)
+    rollup = (sec.get("global") or {}).get("rollup") or {}
+    if rollup.get("total_rss_bytes"):
+        out.append(f"total rss: {fmt_bytes(rollup['total_rss_bytes'])}")
+    return "\n".join(out)
+
+
+_CARD_BUILDERS = {
+    "step_time": _step_time_card,
+    "step_memory": _step_memory_card,
+    "system": _system_card,
+    "process": _process_card,
+}
+
+
+def attach_section_cards(payload: Dict[str, Any]) -> None:
+    """Attach the section-local detailed text block to each section
+    (reference: SCHEMA.md `card` — retained in JSON even though the
+    top-level text uses the compact verdict report)."""
+    for key, sec in (payload.get("sections") or {}).items():
+        builder = _CARD_BUILDERS.get(key)
+        if builder is None or not isinstance(sec, dict):
+            continue
+        try:
+            sec["card"] = builder(sec) if sec.get("status") == "OK" else ""
+        except Exception as exc:
+            get_error_log().warning(f"section card {key} failed", exc)
+            sec["card"] = ""
 
 
 def render_text_summary(payload: Dict[str, Any]) -> str:
@@ -382,20 +535,16 @@ def render_text_summary(payload: Dict[str, Any]) -> str:
             )
         out.append("")
 
+    # one formatter for the per-rank memory lines: the JSON card IS the
+    # text block (attach_section_cards may not have run for payloads
+    # loaded from older artifacts — build on demand then)
     sm = (payload.get("sections") or {}).get("step_memory") or {}
-    per_rank = (sm.get("global") or {}).get("per_rank") or {}
-    if per_rank:
+    mem_card = sm.get("card")
+    if mem_card is None and sm.get("status") == "OK":
+        mem_card = _step_memory_card(sm)
+    if mem_card:
         out.append("Device memory (per rank):")
-        for rank, info in sorted(per_rank.items(), key=lambda kv: int(kv[0])):
-            line = (
-                f"  rank {rank}: current {fmt_bytes(info.get('current_bytes'))}  "
-                f"peak {fmt_bytes(info.get('step_peak_bytes'))}  "
-                f"limit {fmt_bytes(info.get('limit_bytes'))}"
-            )
-            pressure = info.get("pressure")
-            if pressure is not None:
-                line += f"  pressure {fmt_pct(pressure)}"
-            out.append(line)
+        out.extend(f"  {l}" for l in mem_card.splitlines())
         out.append("")
 
     cluster = ((payload.get("sections") or {}).get("system") or {}).get(
@@ -409,6 +558,16 @@ def render_text_summary(payload: Dict[str, Any]) -> str:
             f"{cluster.get('busiest_node')})"
         )
         out.append("")
+
+    # system/process detail cards (step_time/step_memory detail is the
+    # richer inline layout above)
+    for key, title in (("system", "System"), ("process", "Processes")):
+        sec = (payload.get("sections") or {}).get(key) or {}
+        card = sec.get("card")
+        if card:
+            out.append(f"{title}:")
+            out.extend(f"  {l}" for l in card.splitlines())
+            out.append("")
 
     for key in ("system", "process", "step_memory", "step_time"):
         sec = (payload.get("sections") or {}).get(key) or {}
@@ -459,13 +618,18 @@ def generate_summary(
 
     results: Dict[str, Optional[DiagnosticResult]] = {}
 
+    try:
+        identities = loaders.load_rank_identities(db_path)
+    except Exception:
+        identities = {}
+
     def run_step_time():
-        section, result = _build_step_time_section(db_path, mode)
+        section, result = _build_step_time_section(db_path, mode, identities)
         results["step_time"] = result
         return section
 
     def run_step_memory():
-        section, result = _build_step_memory_section(db_path)
+        section, result = _build_step_memory_section(db_path, identities)
         results["step_memory"] = result
         return section
 
@@ -475,7 +639,7 @@ def generate_summary(
         return section
 
     def run_process():
-        section, result = _build_process_section(db_path)
+        section, result = _build_process_section(db_path, identities)
         results["process"] = result
         return section
 
@@ -520,6 +684,7 @@ def generate_summary(
         "primary_diagnosis": primary,
         "sections": sections,
     }
+    attach_section_cards(payload)
     atomic_write_json(protocol.get_final_summary_json_path(session_dir), payload)
     atomic_write_text(
         protocol.get_final_summary_txt_path(session_dir),

@@ -165,6 +165,45 @@ def load_topology(db_path: Path) -> Dict[str, Any]:
     }
 
 
+def load_rank_identities(db_path: Path) -> Dict[int, Dict[str, Any]]:
+    """global_rank → identity block (reference contract:
+    ``groups.rows[*].identity`` — SCHEMA.md field rules).  Pulled from
+    whichever projection tables exist; across tables the row with the
+    newest telemetry timestamp wins, so a rank that moved hosts
+    (restart/resume) reports its current placement even if its newest
+    rows live in a different sampler's table."""
+    identity: Dict[int, Dict[str, Any]] = {}
+    newest: Dict[int, float] = {}
+    with _connect_ro(db_path) as conn:
+        for table in ("step_time_samples", "process_samples",
+                      "step_memory_samples"):
+            if not _table_exists(conn, table):
+                continue
+            # SQLite bare-column semantics: with MAX(id) the other
+            # selected columns come from that same max-id row
+            rows = conn.execute(
+                f"SELECT global_rank, local_rank, node_rank, hostname, pid,"
+                f" world_size, local_world_size, timestamp, MAX(id)"
+                f" FROM {table} GROUP BY global_rank"
+            ).fetchall()
+            for r in rows:
+                rank = int(r["global_rank"])
+                ts = float(r["timestamp"] or 0.0)
+                if rank in identity and ts <= newest[rank]:
+                    continue
+                newest[rank] = ts
+                identity[rank] = {
+                    "global_rank": rank,
+                    "local_rank": r["local_rank"],
+                    "node_rank": r["node_rank"],
+                    "hostname": r["hostname"],
+                    "pid": r["pid"],
+                    "world_size": r["world_size"],
+                    "local_world_size": r["local_world_size"],
+                }
+    return identity
+
+
 def load_stdout_tail(db_path: Path, n: int = 12) -> List[Tuple[str, str]]:
     """Last n (stream, line) pairs from the stdout projection."""
     with _connect_ro(db_path) as conn:
