@@ -233,6 +233,39 @@ def test_per_rank_identity_blocks_two_nodes(tmp_path):
         assert "rank 2" in card and "[host1#1]" in card, (section, card)
 
 
+def test_mfu_in_step_time_section(tmp_path):
+    """model_stats telemetry → achieved TFLOP/s + MFU in the summary.
+
+    100 ms steps at 10 TFLOP/step → 100 TFLOP/s achieved; on a v5p
+    (459 TFLOP/s peak) that is ~21.8% MFU."""
+    s = _Session(tmp_path)
+    ident = s.ident(0)
+    s.inject(
+        "step_time",
+        {"step_time": [_step_row(i, step_ms=100.0) for i in range(1, 41)],
+         "model_stats": [{"timestamp": 1.0, "flops_per_step": 10e12,
+                          "flops_source": "cost_analysis",
+                          "device_kind": "TPU v5p", "peak_flops": 459e12}]},
+        ident,
+    )
+    payload = s.payload()
+    eff = payload["sections"]["step_time"]["global"]["efficiency"]
+    assert eff is not None
+    assert eff["achieved_tflops_median"] == pytest.approx(100.0, rel=0.05)
+    assert eff["mfu_median"] == pytest.approx(100.0 / 459.0, rel=0.05)
+    assert eff["device_kind"] == "TPU v5p"
+    txt = (tmp_path / "final_summary.txt").read_text()
+    assert "TFLOP/s" in txt and "MFU" in txt
+
+
+def test_no_model_stats_no_efficiency(tmp_path):
+    s = _Session(tmp_path)
+    s.inject("step_time", {"step_time": [_step_row(i) for i in range(1, 30)]},
+             s.ident(0))
+    payload = s.payload()
+    assert payload["sections"]["step_time"]["global"]["efficiency"] is None
+
+
 def test_garbage_rows_do_not_break_summary(tmp_path):
     """Rows with missing/None fields degrade gracefully, never throw."""
     s = _Session(tmp_path)

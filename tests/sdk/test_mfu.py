@@ -1,0 +1,115 @@
+"""MFU plumbing: FLOPs declaration/estimation → telemetry →
+achieved-TFLOP/s + MFU in the step-time section (TPU-first metric, no
+reference counterpart)."""
+
+import jax
+import jax.numpy as jnp
+
+from traceml_tpu.sdk.state import get_state, reset_state_for_tests
+from traceml_tpu.utils.chip_specs import peak_flops_for
+
+
+def test_peak_flops_table():
+    assert peak_flops_for("TPU v5p") == 459e12
+    assert peak_flops_for("TPU v5 lite") == 197e12  # before the bare v5 guess
+    assert peak_flops_for("TPU v6e") == 918e12
+    assert peak_flops_for("TPU v4") == 275e12
+    assert peak_flops_for("TFRT_CPU") is None
+    assert peak_flops_for(None) is None
+
+
+def test_set_step_flops_manual():
+    import traceml_tpu
+
+    reset_state_for_tests()
+    traceml_tpu.set_step_flops(1.5e12, device_kind="TPU v5p")
+    st = get_state()
+    assert st.flops_per_step == 1.5e12
+    assert st.flops_source == "manual"
+    assert st.flops_device_kind == "TPU v5p"
+
+
+def test_wrap_step_fn_estimates_flops_on_first_in_step_call():
+    import traceml_tpu
+    from traceml_tpu.sdk.step_fn import wrap_step_fn
+
+    reset_state_for_tests()
+
+    def step(x, y):
+        return (x @ y).sum()
+
+    wrapped = wrap_step_fn(step)
+    x = jnp.ones((64, 128))
+    y = jnp.ones((128, 32))
+    with traceml_tpu.trace_step():
+        wrapped(x, y)
+    st = get_state()
+    assert st.flops_per_step is not None
+    # 2*M*K*N matmul flops, give-or-take the reduction
+    assert st.flops_per_step >= 2 * 64 * 128 * 32
+    assert st.flops_per_step < 4 * 64 * 128 * 32
+    assert st.flops_source == "cost_analysis"
+
+
+def test_out_of_step_eval_fn_does_not_publish_flops():
+    """A wrapped EVAL fn dispatched outside trace_step (common pattern)
+    must not claim the MFU numerator just because it ran first."""
+    import traceml_tpu
+    from traceml_tpu.sdk.step_fn import wrap_step_fn
+
+    reset_state_for_tests()
+    eval_fn = wrap_step_fn(lambda x: (x * 2).sum())
+    eval_fn(jnp.ones((8, 8)))  # out-of-step: no estimate
+    assert get_state().flops_per_step is None
+    train = wrap_step_fn(lambda x, y: (x @ y).sum())
+    with traceml_tpu.trace_step():
+        train(jnp.ones((32, 64)), jnp.ones((64, 16)))
+    flops = get_state().flops_per_step
+    assert flops is not None and flops >= 2 * 32 * 64 * 16
+
+
+def test_manual_value_wins_over_estimate():
+    import traceml_tpu
+    from traceml_tpu.sdk.step_fn import wrap_step_fn
+
+    reset_state_for_tests()
+    traceml_tpu.set_step_flops(7.0e9)
+    wrapped = wrap_step_fn(lambda x: (x * 2).sum())
+    with traceml_tpu.trace_step():
+        wrapped(jnp.ones((8, 8)))
+    assert get_state().flops_per_step == 7.0e9
+    assert get_state().flops_source == "manual"
+
+
+def test_estimate_opt_out():
+    import traceml_tpu
+    from traceml_tpu.sdk.step_fn import wrap_step_fn
+
+    reset_state_for_tests()
+    wrapped = wrap_step_fn(lambda x: (x @ x).sum(), estimate_flops=False)
+    with traceml_tpu.trace_step():
+        wrapped(jnp.ones((16, 16)))
+    assert get_state().flops_per_step is None
+
+
+def test_sampler_publishes_model_stats_once(tmp_path):
+    import traceml_tpu
+    from traceml_tpu.samplers.step_time_sampler import StepTimeSampler
+
+    reset_state_for_tests()
+    sampler = StepTimeSampler()
+    traceml_tpu.set_step_flops(2.0e12, device_kind="TPU v5p")
+    sampler.sample()
+    sampler.sample()  # unchanged → no second row
+    rows = sampler.db.tail("model_stats", 10)
+    assert len(rows) == 1
+    assert rows[0]["flops_per_step"] == 2.0e12
+    assert rows[0]["peak_flops"] == 459e12
+    traceml_tpu.set_step_flops(3.0e12)  # changed → one more row
+    sampler.sample()
+    assert len(sampler.db.tail("model_stats", 10)) == 2
+    # a device_kind correction with UNCHANGED flops republishes too
+    traceml_tpu.set_step_flops(3.0e12, device_kind="TPU v6e")
+    sampler.sample()
+    rows = sampler.db.tail("model_stats", 10)
+    assert len(rows) == 3 and rows[-1]["peak_flops"] == 918e12

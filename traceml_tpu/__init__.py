@@ -35,6 +35,7 @@ _API_SYMBOLS = (
     "current_step",
     "enable_ici_stats",
     "request_profile",
+    "set_step_flops",
 )
 
 __all__ = list(_API_SYMBOLS) + ["__version__"]

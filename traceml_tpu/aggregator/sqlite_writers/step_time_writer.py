@@ -20,7 +20,11 @@ from traceml_tpu.aggregator.sqlite_writers.common import (
 from traceml_tpu.telemetry.envelope import TelemetryEnvelope
 
 TABLE = "step_time_samples"
-RETENTION_TABLES = (TABLE,)
+MODEL_STATS_TABLE = "model_stats_samples"
+# model_stats is one-row-per-change, but per-step set_step_flops calls
+# (variable seq lengths) can make changes frequent — prune it like the
+# sample tables so the db stays bounded (the loader reads latest-per-rank)
+RETENTION_TABLES = (TABLE, MODEL_STATS_TABLE)
 
 
 def accepts_sampler(name: str) -> bool:
@@ -43,9 +47,27 @@ def init_schema(conn) -> None:
         f"CREATE INDEX IF NOT EXISTS idx_{TABLE}_rank_step "
         f"ON {TABLE} (session_id, global_rank, step)"
     )
+    conn.execute(
+        f"""CREATE TABLE IF NOT EXISTS {MODEL_STATS_TABLE} (
+            id INTEGER PRIMARY KEY AUTOINCREMENT,
+            {IDENTITY_SCHEMA},
+            timestamp REAL,
+            flops_per_step REAL,
+            flops_source TEXT,
+            device_kind TEXT,
+            peak_flops REAL
+        )"""
+    )
 
 
 def insert_sql(table: str) -> str:
+    if table == MODEL_STATS_TABLE:
+        return (
+            f"INSERT INTO {MODEL_STATS_TABLE} (session_id, global_rank,"
+            " local_rank, world_size, local_world_size, node_rank, hostname,"
+            " pid, timestamp, flops_per_step, flops_source, device_kind,"
+            " peak_flops) VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)"
+        )
     return (
         f"INSERT INTO {TABLE} (session_id, global_rank, local_rank, world_size,"
         " local_world_size, node_rank, hostname, pid, step, timestamp, clock,"
@@ -67,4 +89,20 @@ def build_rows(env: TelemetryEnvelope) -> Dict[str, List[Tuple]]:
                 dumps(row.get("events", {})),
             )
         )
-    return {TABLE: out} if out else {}
+    tables: Dict[str, List[Tuple]] = {}
+    if out:
+        tables[TABLE] = out
+    stats_rows = [
+        ident
+        + (
+            fnum(row, "timestamp"),
+            fnum(row, "flops_per_step"),
+            row.get("flops_source"),
+            row.get("device_kind"),
+            fnum(row, "peak_flops"),
+        )
+        for row in env.tables.get("model_stats", [])
+    ]
+    if stats_rows:
+        tables[MODEL_STATS_TABLE] = stats_rows
+    return tables

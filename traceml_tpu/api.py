@@ -28,6 +28,27 @@ from traceml_tpu.sdk.profile_capture import (  # noqa: F401
 )
 
 
+def set_step_flops(flops: float, device_kind=None) -> None:
+    """Declare the model FLOPs of ONE training step (fwd+bwd+optimizer)
+    — the MFU numerator.  Overrides wrap_step_fn's cost-analysis
+    estimate; use for grad-accum loops (sum the micro-batch dispatches)
+    or models traced outside wrap_step_fn."""
+    from traceml_tpu.sdk.state import get_state
+
+    st = get_state()
+    st.flops_per_step = float(flops)
+    st.flops_source = "manual"
+    if device_kind is not None:
+        st.flops_device_kind = str(device_kind)
+    elif st.flops_device_kind is None:
+        try:
+            import jax
+
+            st.flops_device_kind = str(jax.devices()[0].device_kind)
+        except Exception:
+            pass
+
+
 def current_step() -> int:
     """The current trace step counter (0 before the first step)."""
     from traceml_tpu.sdk.state import get_state

@@ -134,11 +134,49 @@ def _build_step_time_section(db_path: Path, mode: str, identities=None):
             }
             for r, w in window.rank_windows.items()
         }
+        # MFU: achieved model FLOP/s per rank over the chip's peak
+        # (TPU-first metric — no reference counterpart).  Steady-state
+        # medians when available: warmup compile stalls are not a
+        # statement about sustained efficiency.
+        efficiency = None
+        model_stats = loaders.load_model_stats(db_path)
+        if model_stats:
+            ms0 = next(iter(model_stats.values()))
+            flops = ms0.get("flops_per_step")
+            peak = ms0.get("peak_flops")
+            per_rank_step = (
+                {int(r): v for r, v in steady["per_rank_median_ms"].items()}
+                if steady
+                else {
+                    r: w.averages.get(STEP_KEY)
+                    for r, w in window.rank_windows.items()
+                }
+            )
+            if flops:
+                achieved = {
+                    str(r): flops / (v / 1000.0) / 1e12
+                    for r, v in per_rank_step.items()
+                    if v
+                }
+                if achieved:
+                    med = statistics.median(achieved.values())
+                    efficiency = {
+                        "flops_per_step": flops,
+                        "flops_source": ms0.get("flops_source"),
+                        "device_kind": ms0.get("device_kind"),
+                        "peak_tflops": (peak / 1e12) if peak else None,
+                        "achieved_tflops_by_rank": {
+                            r: round(v, 3) for r, v in achieved.items()
+                        },
+                        "achieved_tflops_median": round(med, 3),
+                        "mfu_median": (med * 1e12 / peak) if peak else None,
+                    }
         section["global"] = {
             "clock": window.clock,
             "n_steps": window.n_steps,
             "step_range": [window.steps[0], window.steps[-1]],
             "ranks": window.ranks,
+            "efficiency": efficiency,
             "phases": phases,
             "occupancy_by_rank": {
                 str(r): round(v, 4)
@@ -355,6 +393,16 @@ def _step_time_card(sec: Dict[str, Any]) -> str:
     if occ is not None:
         header += f" · chip busy {fmt_pct(occ)}"
     out.append(header)
+    eff = g.get("efficiency")
+    if eff:
+        line = f"model: {eff['flops_per_step'] / 1e12:.2f} TFLOP/step → " \
+               f"{eff['achieved_tflops_median']:.1f} TFLOP/s achieved"
+        if eff.get("mfu_median") is not None:
+            line += (
+                f" = {fmt_pct(eff['mfu_median'])} MFU "
+                f"({eff.get('device_kind')}, peak {eff['peak_tflops']:.0f} TFLOP/s)"
+            )
+        out.append(line)
     for key, p in phases.items():
         share = p.get("share_of_step")
         out.append(
@@ -523,6 +571,15 @@ def render_text_summary(payload: Dict[str, Any]) -> str:
             infl = steady.get("warmup_inflation_pct")
             if infl is not None and infl > 0.02:
                 line += f"  (warmup inflated the overall median {fmt_pct(infl)})"
+            out.append(line)
+        eff = g.get("efficiency")
+        if eff:
+            line = (
+                f"  model {eff['flops_per_step'] / 1e12:.2f} TFLOP/step → "
+                f"{eff['achieved_tflops_median']:.1f} TFLOP/s"
+            )
+            if eff.get("mfu_median") is not None:
+                line += f"  MFU {fmt_pct(eff['mfu_median'])}"
             out.append(line)
         for key, p in phases.items():
             if key == STEP_KEY:

@@ -177,16 +177,25 @@ def render_html_summary(payload: Dict[str, Any]) -> str:
     rank_cards = g.get("per_rank") or {}
     if 1 < len(rank_cards) <= 8 and phases:
         phase_keys = [k for k in phases if k != "step_time"]
+        show_host = any(
+            (c.get("identity") or {}).get("hostname") for c in rank_cards.values()
+        )
         out.append("<h2>Per-rank breakdown (window avg, ms)</h2><table><tr>"
-                   "<th>rank</th><th>step</th>"
+                   "<th>rank</th>" + ("<th>host</th>" if show_host else "")
+                   + "<th>step</th>"
                    + "".join(f"<th>{_esc(k)}</th>" for k in phase_keys)
                    + "<th>busy</th></tr>")
         for rank, card in sorted(rank_cards.items(), key=lambda kv: int(kv[0])):
             avgs = card.get("avg_ms") or {}
             occ_r = card.get("occupancy")
+            ident = card.get("identity") or {}
+            host_cell = (
+                f"<td>{_esc(ident.get('hostname'))}#{_esc(ident.get('node_rank'))}</td>"
+                if show_host else ""
+            )
             out.append(
-                f"<tr><td>{_esc(rank)}</td>"
-                f"<td>{avgs.get('step_time', 0):.1f}</td>"
+                f"<tr><td>{_esc(rank)}</td>" + host_cell
+                + f"<td>{avgs.get('step_time', 0):.1f}</td>"
                 + "".join(f"<td>{avgs.get(k, 0):.1f}</td>" for k in phase_keys)
                 + f"<td>{'' if occ_r is None else f'{occ_r * 100:.0f}%'}</td></tr>"
             )
@@ -207,7 +216,7 @@ def render_html_summary(payload: Dict[str, Any]) -> str:
                 f"<td>{fmt_bytes(info.get('step_peak_bytes'))}</td>"
                 f"<td>{fmt_bytes(info.get('limit_bytes'))}</td>"
                 f"<td>{'' if pressure is None else f'{pressure * 100:.0f}%'}</td>"
-                f"<td>{'' if not growth else ('+' if growth > 0 else '-') + fmt_bytes(abs(growth))}</td>"
+                f"<td>{'' if not growth else ('+' if growth > 0 else '') + fmt_bytes(growth)}</td>"
                 f"</tr>"
             )
         out.append("</table>")

@@ -204,6 +204,27 @@ def load_rank_identities(db_path: Path) -> Dict[int, Dict[str, Any]]:
     return identity
 
 
+def load_model_stats(db_path: Path) -> Dict[int, Dict[str, Any]]:
+    """global_rank → latest model-FLOPs declaration (the MFU numerator
+    + the chip peak captured at estimation time)."""
+    out: Dict[int, Dict[str, Any]] = {}
+    with _connect_ro(db_path) as conn:
+        if not _table_exists(conn, "model_stats_samples"):
+            return out
+        rows = conn.execute(
+            "SELECT global_rank, flops_per_step, flops_source, device_kind,"
+            " peak_flops, MAX(id) FROM model_stats_samples GROUP BY global_rank"
+        ).fetchall()
+    for r in rows:
+        out[int(r["global_rank"])] = {
+            "flops_per_step": r["flops_per_step"],
+            "flops_source": r["flops_source"],
+            "device_kind": r["device_kind"],
+            "peak_flops": r["peak_flops"],
+        }
+    return out
+
+
 def load_stdout_tail(db_path: Path, n: int = 12) -> List[Tuple[str, str]]:
     """Last n (stream, line) pairs from the stdout projection."""
     with _connect_ro(db_path) as conn:

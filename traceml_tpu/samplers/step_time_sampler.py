@@ -37,6 +37,7 @@ from traceml_tpu.utils.timing import (
 )
 
 TABLE = "step_time"
+MODEL_STATS_TABLE = "model_stats"
 _RESOLVE_TIMEOUT_S = 10.0
 
 
@@ -118,10 +119,40 @@ class StepTimeSampler(BaseSampler):
         self._pending: List[StepTimeBatch] = []
         self._resolve_timeout = resolve_timeout_s
         self._last_ready: Optional[float] = None  # cross-step device edge
+        self._flops_sent: Optional[float] = None
         self.steps_emitted = 0
         self.steps_timed_out = 0
 
+    def _publish_model_stats(self) -> None:
+        """One MODEL_STATS row whenever the declared/estimated per-step
+        FLOPs change (the MFU numerator, shipped once — not per step)."""
+        try:
+            from traceml_tpu.sdk.state import get_state
+            from traceml_tpu.utils.chip_specs import peak_flops_for
+
+            st = get_state()
+            flops = st.flops_per_step
+            # keyed on the full declaration: a device_kind correction
+            # with unchanged FLOPs must still republish
+            sent_key = (flops, st.flops_source, st.flops_device_kind)
+            if flops is None or sent_key == self._flops_sent:
+                return
+            self._flops_sent = sent_key
+            self.db.add_record(
+                MODEL_STATS_TABLE,
+                {
+                    "timestamp": time.time(),
+                    "flops_per_step": float(flops),
+                    "flops_source": st.flops_source,
+                    "device_kind": st.flops_device_kind,
+                    "peak_flops": peak_flops_for(st.flops_device_kind),
+                },
+            )
+        except Exception:
+            pass  # fail-open: MFU is garnish, never breaks sampling
+
     def _sample(self) -> None:
+        self._publish_model_stats()
         self._pending.extend(GLOBAL_STEP_QUEUE.drain())
         now = time.perf_counter()
         emit_upto = 0
@@ -146,6 +177,8 @@ class StepTimeSampler(BaseSampler):
         """End-of-run: give the fine-cadence resolver one last bounded
         window, then stamp leftovers as late and emit."""
         from traceml_tpu.utils.marker_resolver import get_marker_resolver
+
+        self._publish_model_stats()
 
         deadline = time.monotonic() + 2.0
         while time.monotonic() < deadline:

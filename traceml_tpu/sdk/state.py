@@ -55,6 +55,13 @@ class TraceState:
         # wrappers) consult it so a whole step is either marked or not —
         # mixed rows would skew the window's clock selection
         self.sample_markers = True
+        # model FLOPs per training step (set_step_flops / wrap_step_fn's
+        # cost-analysis estimate) — the MFU numerator.  flops_source is
+        # "manual" | "cost_analysis"; device_kind pins the chip whose
+        # peak is the denominator.
+        self.flops_per_step: Optional[float] = None
+        self.flops_source: Optional[str] = None
+        self.flops_device_kind: Optional[str] = None
         # called with the step number after each flush (max-steps lifecycle)
         self.on_step_flushed: List[Callable[[int], None]] = []
         # called with the StepTimeBatch after each non-empty flush

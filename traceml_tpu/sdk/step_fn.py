@@ -38,9 +38,15 @@ class WrappedStepFn:
         state: Optional[TraceState] = None,
         phase_name: str = COMPUTE_TIME,
         jit_kwargs: Optional[Dict[str, Any]] = None,
+        estimate_flops: Optional[bool] = None,
     ) -> None:
         self._state = state or get_state()
         self._phase = phase_name
+        if estimate_flops is None:
+            import os
+
+            estimate_flops = os.environ.get("TRACEML_NO_FLOPS_ESTIMATE") != "1"
+        self._flops_pending = bool(estimate_flops)
 
         if hasattr(fn, "lower") and callable(getattr(fn, "lower")):
             # already a jax.jit-wrapped callable
@@ -96,8 +102,48 @@ class WrappedStepFn:
         except Exception:
             return []
 
+    def estimate_flops(self, *args, **kwargs) -> Optional[float]:
+        """Per-dispatch model FLOPs from XLA's cost analysis on the
+        LOWERED (uncompiled) program — a trace, not a compile, so it
+        costs milliseconds-to-seconds of host work once.  Publishes the
+        estimate into TraceState (the MFU numerator; assumes one wrapped
+        dispatch per step — grad-accum loops with K inner dispatches
+        should call ``set_step_flops`` with the summed value instead).
+
+        Fail-open: returns None (and publishes nothing) on any error.
+        """
+        try:
+            import jax
+
+            ca = self._jfn.lower(*args, **kwargs).cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            flops = float(ca.get("flops", 0.0))
+            if flops <= 0:
+                return None
+            st = self._state
+            st.flops_per_step = flops
+            st.flops_source = "cost_analysis"
+            try:
+                st.flops_device_kind = str(jax.devices()[0].device_kind)
+            except Exception:
+                st.flops_device_kind = None
+            return flops
+        except Exception:
+            return None
+
     def __call__(self, *args, **kwargs):
         st = self._state
+        if self._flops_pending and st.tls.in_step:
+            # once, BEFORE the first IN-STEP dispatch (args not yet
+            # donated): host-side trace only, overlapped with that
+            # call's compile wait; never on the steady-state hot path.
+            # In-step gating keeps a wrapped EVAL fn (dispatched outside
+            # trace_step) from publishing its FLOPs as the step's MFU
+            # numerator just because it ran first.
+            self._flops_pending = False
+            if st.flops_per_step is None:  # a manual value wins
+                self.estimate_flops(*args, **kwargs)
         region = timed_region(self._phase, st.current_step, sink=st.buffer.add)
         with region as tr:
             out = self._jfn(*args, **kwargs)
@@ -131,16 +177,20 @@ def wrap_step_fn(
     *,
     donate_argnums: Tuple[int, ...] = (),
     static_argnums: Tuple[int, ...] = (),
+    estimate_flops: Optional[bool] = None,
     **jit_kwargs: Any,
 ) -> WrappedStepFn:
     """Wrap a JAX training-step function for tracing.
 
     ``fn`` may be a plain function (it will be ``jax.jit``-ed with the
     given options) or an existing jitted callable (used as-is).
+    ``estimate_flops`` controls the one-time cost-analysis FLOPs
+    estimate on first call (default on; env
+    ``TRACEML_NO_FLOPS_ESTIMATE=1`` turns it off globally).
     """
     kw = dict(jit_kwargs)
     if donate_argnums:
         kw["donate_argnums"] = donate_argnums
     if static_argnums:
         kw["static_argnums"] = static_argnums
-    return WrappedStepFn(fn, jit_kwargs=kw)
+    return WrappedStepFn(fn, jit_kwargs=kw, estimate_flops=estimate_flops)

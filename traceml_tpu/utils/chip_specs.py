@@ -1,0 +1,42 @@
+"""Per-chip peak-FLOPs table → MFU denominators.
+
+No reference counterpart (the reference reports utilization from NVML
+duty cycles; on TPU the canonical efficiency number is **MFU** —
+achieved model FLOP/s over the chip's peak bf16 FLOP/s, the metric the
+scaling playbooks optimize).  Figures are peak *dense* bf16 (or
+equivalent) per chip, from Google's published TPU specs; they are
+denominators for a ratio, so ±few-% spec drift does not change any
+verdict band.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+# substring match against jax.Device.device_kind (e.g. "TPU v4",
+# "TPU v5 lite", "TPU v5p", "TPU v6e").  Order matters: more specific
+# first ("v5 lite" before "v5").
+_PEAK_BF16_FLOPS = (
+    ("v6e", 918e12),
+    ("trillium", 918e12),
+    ("v5 lite", 197e12),
+    ("v5litepod", 197e12),
+    ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 46e12),
+)
+
+
+def peak_flops_for(device_kind: Optional[str]) -> Optional[float]:
+    """Peak dense-bf16 FLOP/s for a chip, or None when unknown (CPU,
+    unrecognized kinds) — callers then report achieved FLOP/s without
+    an MFU ratio rather than inventing a denominator."""
+    if not device_kind:
+        return None
+    kind = device_kind.lower()
+    for needle, peak in _PEAK_BF16_FLOPS:
+        if needle in kind:
+            return peak
+    return None
