@@ -47,6 +47,24 @@ def test_step_time_view_shapes():
     assert d["coverage"]["incomplete"] is True
 
 
+def test_step_time_view_efficiency_block():
+    window = build_step_time_window({0: _step_rows(), 1: _step_rows()})
+    stats = {0: {"flops_per_step": 10e12, "flops_source": "manual",
+                 "device_kind": "TPU v5p", "peak_flops": 459e12}}
+    view = V.build_step_time_view(window, world_size=2, model_stats=stats)
+    eff = view.efficiency
+    assert eff is not None and eff["mfu_median"] is not None
+    assert eff["peak_tflops"] == 459.0
+    # unknown chip → achieved only, no MFU ratio
+    stats[0]["peak_flops"] = None
+    view = V.build_step_time_view(window, world_size=2, model_stats=stats)
+    assert view.efficiency["mfu_median"] is None
+    assert view.efficiency["achieved_tflops_median"] > 0
+    # no stats → no block
+    view = V.build_step_time_view(window, world_size=2)
+    assert view.efficiency is None
+
+
 def test_step_time_view_none_passthrough():
     assert V.build_step_time_view(None) is None
 

@@ -92,6 +92,68 @@ def test_estimate_opt_out():
     assert get_state().flops_per_step is None
 
 
+def _window(step_ms=100.0, compute_ms=90.0, n=60):
+    from traceml_tpu.utils import timing as T
+    from traceml_tpu.utils.step_time_window import build_step_time_window
+
+    rows = [
+        {
+            "step": i,
+            "timestamp": float(i),
+            "clock": "device",
+            "events": {
+                T.STEP_TIME: {"cpu_ms": step_ms, "device_ms": step_ms, "count": 1},
+                T.COMPUTE_TIME: {"cpu_ms": 0.5, "device_ms": compute_ms, "count": 1},
+            },
+        }
+        for i in range(1, n + 1)
+    ]
+    return build_step_time_window({0: rows})
+
+
+def test_low_mfu_rule_fires_when_compute_bound_and_wasteful():
+    from traceml_tpu.diagnostics.step_time.api import diagnose_window
+
+    eff = {
+        "mfu_median": 0.08, "achieved_tflops_median": 36.7,
+        "peak_tflops": 459.0, "device_kind": "TPU v5p",
+        "flops_source": "cost_analysis",
+    }
+    result = diagnose_window(_window(), mode="summary", efficiency=eff)
+    issue = next(i for i in result.issues if i.kind == "LOW_MFU")
+    assert issue.severity == "warning"
+    assert issue.evidence["compute_share"] > 0.5
+    # moderate band → info
+    eff["mfu_median"] = 0.22
+    result = diagnose_window(_window(), mode="summary", efficiency=eff)
+    issue = next(i for i in result.issues if i.kind == "MODERATE_MFU")
+    assert issue.severity == "info"
+    # healthy MFU → silent
+    eff["mfu_median"] = 0.45
+    result = diagnose_window(_window(), mode="summary", efficiency=eff)
+    assert not any("MFU" in i.kind for i in result.issues)
+
+
+def test_low_mfu_gated_on_compute_share():
+    """An input-bound job's low MFU is the input's fault — no MFU
+    verdict when compute doesn't dominate."""
+    from traceml_tpu.diagnostics.step_time.api import diagnose_window
+
+    eff = {"mfu_median": 0.05, "achieved_tflops_median": 10.0,
+           "peak_tflops": 459.0, "device_kind": "TPU v5p"}
+    result = diagnose_window(
+        _window(step_ms=100.0, compute_ms=30.0), mode="summary", efficiency=eff
+    )
+    assert not any("MFU" in i.kind for i in result.issues)
+
+
+def test_no_efficiency_no_mfu_verdict():
+    from traceml_tpu.diagnostics.step_time.api import diagnose_window
+
+    result = diagnose_window(_window(), mode="summary")
+    assert not any("MFU" in i.kind for i in result.issues)
+
+
 def test_sampler_publishes_model_stats_once(tmp_path):
     import traceml_tpu
     from traceml_tpu.samplers.step_time_sampler import StepTimeSampler

@@ -127,10 +127,13 @@ function renderStepTime(d){
   const st=d.step_time;badge("st-badge",d.ts,st&&st.latest_ts);
   if(!st)return;
   const cov=st.coverage||{};
+  const eff=st.efficiency;
   document.getElementById("st-cov").textContent=
     `${st.n_steps} steps · ${st.clock} clock · `+
     `${cov.ranks_present}/${cov.world_size} ranks`+
     (st.median_occupancy!=null?` · chip busy ${(st.median_occupancy*100).toFixed(0)}%`:"")+
+    (eff?` · ${eff.achieved_tflops_median.toFixed(1)} TFLOP/s`+
+      (eff.mfu_median!=null?` (MFU ${(eff.mfu_median*100).toFixed(0)}%)`:""):"")+
     (cov.incomplete?" · INCOMPLETE":"");
   // stacked per-step phase chart (cross-rank medians)
   const stack=st.phase_stack||{};const keys=Object.keys(stack);

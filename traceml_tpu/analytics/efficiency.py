@@ -1,0 +1,51 @@
+"""Achieved-FLOP/s + MFU computation — THE shared formula.
+
+One implementation consumed by both the final summary
+(reporting/final.py) and the live views (renderers/views.py) so the
+same-named ``efficiency`` block can never drift between surfaces.
+"""
+
+from __future__ import annotations
+
+import statistics
+from typing import Any, Dict, Mapping, Optional
+
+
+def build_efficiency(
+    stats: Optional[Mapping[int, Mapping[str, Any]]],
+    per_rank_step_ms: Mapping[Any, Optional[float]],
+) -> Optional[Dict[str, Any]]:
+    """The ``efficiency`` block (SCHEMA.md) or None.
+
+    ``stats`` is loaders.load_model_stats output: per rank, the MEDIAN
+    ``flops_per_step`` over recent declarations (robust to the
+    per-step ``set_step_flops`` pattern under variable sequence
+    lengths — pairing only the LAST declaration with window-median
+    step times would skew MFU by the last batch's size) plus the
+    latest source/device_kind/peak.  ``per_rank_step_ms`` maps rank →
+    representative step duration (steady-state median when available).
+    """
+    if not stats:
+        return None
+    ms0 = next(iter(stats.values()))
+    flops = ms0.get("flops_per_step")
+    peak = ms0.get("peak_flops")
+    if not flops:
+        return None
+    achieved = {
+        str(r): flops / (v / 1000.0) / 1e12
+        for r, v in per_rank_step_ms.items()
+        if v
+    }
+    if not achieved:
+        return None
+    med = statistics.median(achieved.values())
+    return {
+        "flops_per_step": flops,
+        "flops_source": ms0.get("flops_source"),
+        "device_kind": ms0.get("device_kind"),
+        "peak_tflops": (peak / 1e12) if peak else None,
+        "achieved_tflops_by_rank": {r: round(v, 3) for r, v in achieved.items()},
+        "achieved_tflops_median": round(med, 3),
+        "mfu_median": (med * 1e12 / peak) if peak else None,
+    }

@@ -19,7 +19,13 @@ from traceml_tpu.utils.step_time_window import StepTimeWindow, build_step_time_w
 DOMAIN = "step_time"
 
 
-def diagnose_window(window: Optional[StepTimeWindow], mode: str = "summary") -> DiagnosticResult:
+def diagnose_window(
+    window: Optional[StepTimeWindow],
+    mode: str = "summary",
+    efficiency: Optional[Mapping[str, Any]] = None,
+) -> DiagnosticResult:
+    """``efficiency`` is the section's MFU block (mfu_median etc.) when
+    model FLOPs were declared — feeds the LowMfuRule."""
     policy = policy_for(mode)
     if window is None or window.n_steps < policy.min_steps:
         return DiagnosticResult(
@@ -37,7 +43,7 @@ def diagnose_window(window: Optional[StepTimeWindow], mode: str = "summary") -> 
                 )
             ],
         )
-    ctx = build_context(window, policy)
+    ctx = build_context(window, policy, efficiency=efficiency)
     return run_rules(DOMAIN, DEFAULT_RULES, ctx)
 
 

@@ -34,6 +34,15 @@ class StepTimePolicy:
     # (reference: diagnostics/system/rules.py GPUUtilizationRule)
     occupancy_warn: float = 0.30
     occupancy_critical: float = 0.15
+    # MFU (achieved/peak FLOP/s, TPU-new): only judged when the chip is
+    # the bottleneck (compute share ≥ mfu_compute_gate) — a busy chip
+    # at low MFU means the program wastes the MXU (fusion, precision,
+    # tiny matmuls), which occupancy alone cannot see.  Well-tuned LLM
+    # training lands 0.35–0.55; below 0.15 something is structurally
+    # wrong.
+    mfu_low_warn: float = 0.15
+    mfu_moderate: float = 0.30
+    mfu_compute_gate: float = 0.50
     min_steps: int = 20
 
 

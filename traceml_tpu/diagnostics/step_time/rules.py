@@ -60,15 +60,19 @@ _STRAGGLER_KIND_BY_PHASE = {
 
 
 class _Ctx:
-    """Evaluation context: the window + policy."""
+    """Evaluation context: the window + policy (+ the section's MFU
+    block when model FLOPs were declared)."""
 
-    def __init__(self, window: StepTimeWindow, policy: StepTimePolicy):
+    def __init__(self, window: StepTimeWindow, policy: StepTimePolicy,
+                 efficiency=None):
         self.window = window
         self.policy = policy
+        self.efficiency = efficiency or None
 
 
-def build_context(window: StepTimeWindow, policy: StepTimePolicy) -> _Ctx:
-    return _Ctx(window, policy)
+def build_context(window: StepTimeWindow, policy: StepTimePolicy,
+                  efficiency=None) -> _Ctx:
+    return _Ctx(window, policy, efficiency=efficiency)
 
 
 def _enough_data(ctx: _Ctx) -> bool:
@@ -408,11 +412,74 @@ class LowDeviceOccupancyRule:
         ]
 
 
+class LowMfuRule:
+    """TPU-new: the chip is the bottleneck AND the program wastes it.
+
+    Occupancy answers "is the chip busy?"; MFU answers "is the busy
+    time worth anything?".  A compute-dominated step at 8% MFU means
+    the MXU starves — tiny/mis-tiled matmuls, f32 where bf16 would do,
+    fusion breaks — which no amount of input-pipeline work will fix.
+    Gated on: model FLOPs declared, a known chip peak, device clock,
+    and compute share ≥ ``mfu_compute_gate`` (an input-bound job's low
+    MFU is the input's fault; that verdict already exists).
+    """
+
+    def evaluate(self, ctx: _Ctx) -> List[DiagnosticIssue]:
+        eff = ctx.efficiency
+        if not _enough_data(ctx) or not eff:
+            return []
+        mfu = eff.get("mfu_median")
+        if mfu is None or ctx.window.clock != "device":
+            return []
+        share = ctx.window.share_of_step("compute")
+        p = ctx.policy
+        if share is None or share < p.mfu_compute_gate:
+            return []
+        if mfu >= p.mfu_moderate:
+            return []
+        severity = SEVERITY_WARNING if mfu < p.mfu_low_warn else SEVERITY_INFO
+        kind = "LOW_MFU" if mfu < p.mfu_low_warn else "MODERATE_MFU"
+        return [
+            DiagnosticIssue(
+                kind=kind,
+                severity=severity,
+                summary=(
+                    f"Model FLOPs utilization is {mfu * 100:.0f}% "
+                    f"({eff.get('achieved_tflops_median', 0):.1f} of "
+                    f"{eff.get('peak_tflops', 0):.0f} TFLOP/s peak on "
+                    f"{eff.get('device_kind')}) while compute dominates the "
+                    f"step ({share * 100:.0f}%) — the chip is busy but the "
+                    "program wastes it."
+                ),
+                action=(
+                    "Feed the MXU: bf16 matmuls (jax.default_matmul_precision),"
+                    " larger per-chip batch/seq so matmul tiles fill the "
+                    "systolic array, check for fusion breaks and tiny ops "
+                    "with `traceml-tpu profile`, consider remat to enable "
+                    "bigger batches."
+                ),
+                metric="mfu",
+                phase="compute",
+                score=1.0 - mfu,
+                share_pct=mfu,
+                ranks=list(ctx.window.ranks),
+                evidence={
+                    "mfu_median": mfu,
+                    "achieved_tflops_median": eff.get("achieved_tflops_median"),
+                    "peak_tflops": eff.get("peak_tflops"),
+                    "flops_source": eff.get("flops_source"),
+                    "compute_share": share,
+                },
+            )
+        ]
+
+
 DEFAULT_RULES = (
     CleanStragglerRule(),
     InputBoundRule(),
     CompileBoundRule(),
     ResidualHeavyRule(),
     LowDeviceOccupancyRule(),
+    LowMfuRule(),
     ComputeBoundRule(),
 )

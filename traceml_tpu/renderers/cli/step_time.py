@@ -73,6 +73,11 @@ def step_time_panel(payload: Dict[str, Any]) -> Panel:
     )
     if view.median_occupancy is not None:
         sub += f" · chip busy {view.median_occupancy * 100:.0f}%"
+    eff = view.efficiency
+    if eff:
+        sub += f" · {eff['achieved_tflops_median']:.1f} TFLOP/s"
+        if eff.get("mfu_median") is not None:
+            sub += f" (MFU {eff['mfu_median'] * 100:.0f}%)"
     if cov.incomplete:
         sub += " · INCOMPLETE"
     return Panel(Group(*parts), title="step time", subtitle=sub)

@@ -60,8 +60,13 @@ class LiveComputer:
                     default=None,
                 )
                 out["latest_row_ts"] = latest
+                try:
+                    model_stats = loaders.load_model_stats(self.db_path)
+                except Exception:
+                    model_stats = {}
                 out["views"]["step_time"] = V.build_step_time_view(
-                    window, world_size=world, latest_ts=latest
+                    window, world_size=world, latest_ts=latest,
+                    model_stats=model_stats,
                 )
                 out["step_time"] = {
                     "window": window,

@@ -73,6 +73,9 @@ class StepTimeView:
     phase_stack: Dict[str, List[float]]           # phase → cross-rank median/step
     occupancy_by_rank: Dict[str, float]           # device-busy share of wall
     median_occupancy: Optional[float]
+    # MFU block (achieved TFLOP/s + mfu vs chip peak) when model FLOPs
+    # were declared; None otherwise
+    efficiency: Optional[Dict[str, Any]]
     latest_ts: Optional[float]
 
     def as_dict(self) -> Dict[str, Any]:
@@ -85,6 +88,7 @@ def build_step_time_view(
     world_size: Optional[int] = None,
     latest_ts: Optional[float] = None,
     series_tail: int = 60,
+    model_stats: Optional[Dict[int, Dict[str, Any]]] = None,
 ) -> Optional[StepTimeView]:
     if window is None:
         return None
@@ -143,7 +147,21 @@ def build_step_time_view(
             str(r): round(v, 4) for r, v in window.occupancy_by_rank.items()
         },
         median_occupancy=window.median_occupancy,
+        efficiency=_efficiency_from_stats(model_stats, per_rank_avg),
         latest_ts=latest_ts,
+    )
+
+
+def _efficiency_from_stats(model_stats, per_rank_avg) -> Optional[Dict[str, Any]]:
+    """Live MFU from model_stats + the window's per-rank step averages
+    (the live view has no steady-state split — the rolling window is
+    already recent steps only).  Formula shared with the final summary
+    via analytics/efficiency.py."""
+    from traceml_tpu.analytics.efficiency import build_efficiency
+
+    return build_efficiency(
+        model_stats,
+        {r: avgs.get(STEP_KEY) for r, avgs in per_rank_avg.items()},
     )
 
 

@@ -63,12 +63,62 @@ def _safe_section(key: str, builder: Callable[[], Dict[str, Any]]) -> Dict[str, 
 # -- section builders ----------------------------------------------------
 
 
+def _steady_state(window: StepTimeWindow) -> Dict[str, Any]:
+    """Warmup vs steady-state split: the first quarter of the window
+    carries compile/cache-warm effects; steady-state medians are the
+    number a capacity plan should use (reference concept: the report's
+    warmup-excluded aggregates)."""
+    if window.n_steps < 12:
+        return {}
+    cut = max(3, window.n_steps // 4)
+    per_rank_steady = {}
+    for r, w in window.rank_windows.items():
+        vals = w.series[STEP_KEY][cut:]
+        if vals:
+            per_rank_steady[str(r)] = statistics.median(vals)
+    if not per_rank_steady:
+        return {}
+    overall = statistics.median(per_rank_steady.values())
+    step_m = window.metric(STEP_KEY)
+    return {
+        "warmup_steps_excluded": cut,
+        "median_ms": overall,
+        "per_rank_median_ms": per_rank_steady,
+        "warmup_inflation_pct": (
+            (step_m.median_ms - overall) / overall if overall > 0 else None
+        ),
+    }
+
+
+def _efficiency_block(db_path: Path, window: StepTimeWindow, steady) -> Optional[Dict[str, Any]]:
+    """MFU: achieved model FLOP/s per rank over the chip's peak
+    (TPU-first metric — no reference counterpart).  Steady-state
+    medians when available: warmup compile stalls are not a statement
+    about sustained efficiency.  The formula lives in
+    analytics/efficiency.py (shared with the live views)."""
+    from traceml_tpu.analytics.efficiency import build_efficiency
+
+    per_rank_step = (
+        {int(r): v for r, v in steady["per_rank_median_ms"].items()}
+        if steady
+        else {
+            r: w.averages.get(STEP_KEY)
+            for r, w in window.rank_windows.items()
+        }
+    )
+    return build_efficiency(loaders.load_model_stats(db_path), per_rank_step)
+
+
 def _build_step_time_section(db_path: Path, mode: str, identities=None):
     rank_rows = loaders.load_step_time_rows(db_path)
     if not rank_rows:
         return _no_data_section("step_time"), None
     window: Optional[StepTimeWindow] = build_step_time_window(rank_rows)
-    result = diagnose_window(window, mode=mode)
+    steady = _steady_state(window) if window else {}
+    efficiency = (
+        _efficiency_block(db_path, window, steady) if window else None
+    )
+    result = diagnose_window(window, mode=mode, efficiency=efficiency)
     section: Dict[str, Any] = {
         "status": "OK" if window else "NO_DATA",
         "diagnosis": result.diagnosis.to_dict(),
@@ -96,31 +146,6 @@ def _build_step_time_section(db_path: Path, mode: str, identities=None):
             str(r): [round(v, 3) for v in w.series[STEP_KEY][-tail:]]
             for r, w in window.rank_windows.items()
         }
-        # warmup vs steady-state split: the first quarter of the window
-        # carries compile/cache-warm effects; steady-state medians are
-        # the number a capacity plan should use (reference concept: the
-        # report's warmup-excluded aggregates)
-        steady: Dict[str, Any] = {}
-        if window.n_steps >= 12:
-            cut = max(3, window.n_steps // 4)
-            per_rank_steady = {}
-            for r, w in window.rank_windows.items():
-                vals = w.series[STEP_KEY][cut:]
-                if vals:
-                    per_rank_steady[str(r)] = statistics.median(vals)
-            if per_rank_steady:
-                overall = statistics.median(per_rank_steady.values())
-                step_m = window.metric(STEP_KEY)
-                steady = {
-                    "warmup_steps_excluded": cut,
-                    "median_ms": overall,
-                    "per_rank_median_ms": per_rank_steady,
-                    "warmup_inflation_pct": (
-                        (step_m.median_ms - overall) / overall
-                        if overall > 0
-                        else None
-                    ),
-                }
         # per-rank cards: the per-rank group view the renderers and
         # compare consume (reference: per-rank groups with identity
         # blocks, SCHEMA.md groups.rows[*].identity)
@@ -134,43 +159,6 @@ def _build_step_time_section(db_path: Path, mode: str, identities=None):
             }
             for r, w in window.rank_windows.items()
         }
-        # MFU: achieved model FLOP/s per rank over the chip's peak
-        # (TPU-first metric — no reference counterpart).  Steady-state
-        # medians when available: warmup compile stalls are not a
-        # statement about sustained efficiency.
-        efficiency = None
-        model_stats = loaders.load_model_stats(db_path)
-        if model_stats:
-            ms0 = next(iter(model_stats.values()))
-            flops = ms0.get("flops_per_step")
-            peak = ms0.get("peak_flops")
-            per_rank_step = (
-                {int(r): v for r, v in steady["per_rank_median_ms"].items()}
-                if steady
-                else {
-                    r: w.averages.get(STEP_KEY)
-                    for r, w in window.rank_windows.items()
-                }
-            )
-            if flops:
-                achieved = {
-                    str(r): flops / (v / 1000.0) / 1e12
-                    for r, v in per_rank_step.items()
-                    if v
-                }
-                if achieved:
-                    med = statistics.median(achieved.values())
-                    efficiency = {
-                        "flops_per_step": flops,
-                        "flops_source": ms0.get("flops_source"),
-                        "device_kind": ms0.get("device_kind"),
-                        "peak_tflops": (peak / 1e12) if peak else None,
-                        "achieved_tflops_by_rank": {
-                            r: round(v, 3) for r, v in achieved.items()
-                        },
-                        "achieved_tflops_median": round(med, 3),
-                        "mfu_median": (med * 1e12 / peak) if peak else None,
-                    }
         section["global"] = {
             "clock": window.clock,
             "n_steps": window.n_steps,

@@ -189,10 +189,15 @@ def render_html_summary(payload: Dict[str, Any]) -> str:
             avgs = card.get("avg_ms") or {}
             occ_r = card.get("occupancy")
             ident = card.get("identity") or {}
-            host_cell = (
-                f"<td>{_esc(ident.get('hostname'))}#{_esc(ident.get('node_rank'))}</td>"
-                if show_host else ""
-            )
+            if show_host:
+                host_cell = (
+                    f"<td>{_esc(ident.get('hostname'))}"
+                    f"#{_esc(ident.get('node_rank'))}</td>"
+                    if ident.get("hostname")
+                    else "<td></td>"
+                )
+            else:
+                host_cell = ""
             out.append(
                 f"<tr><td>{_esc(rank)}</td>" + host_cell
                 + f"<td>{avgs.get('step_time', 0):.1f}</td>"

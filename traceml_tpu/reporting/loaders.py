@@ -204,25 +204,42 @@ def load_rank_identities(db_path: Path) -> Dict[int, Dict[str, Any]]:
     return identity
 
 
-def load_model_stats(db_path: Path) -> Dict[int, Dict[str, Any]]:
-    """global_rank → latest model-FLOPs declaration (the MFU numerator
-    + the chip peak captured at estimation time)."""
+def load_model_stats(
+    db_path: Path, recent_rows: int = 64
+) -> Dict[int, Dict[str, Any]]:
+    """global_rank → model-FLOPs declaration (the MFU numerator + the
+    chip peak captured at estimation time).
+
+    ``flops_per_step`` is the MEDIAN over the rank's recent
+    declarations: under per-step ``set_step_flops`` with variable
+    sequence lengths the declarations vary per batch, and pairing only
+    the last one with window-median step times would skew MFU by the
+    final batch's size.  Source/device_kind/peak come from the newest
+    row (a device_kind correction should win immediately)."""
+    import statistics
+
     out: Dict[int, Dict[str, Any]] = {}
+    per_rank_flops: Dict[int, List[float]] = {}
     with _connect_ro(db_path) as conn:
         if not _table_exists(conn, "model_stats_samples"):
             return out
         rows = conn.execute(
-            "SELECT global_rank, flops_per_step, flops_source, device_kind,"
-            " peak_flops, MAX(id) FROM model_stats_samples GROUP BY global_rank"
+            "SELECT * FROM (SELECT global_rank, flops_per_step, flops_source,"
+            " device_kind, peak_flops, id FROM model_stats_samples"
+            f" ORDER BY id DESC LIMIT {int(recent_rows)}) ORDER BY id ASC"
         ).fetchall()
     for r in rows:
-        out[int(r["global_rank"])] = {
-            "flops_per_step": r["flops_per_step"],
+        rank = int(r["global_rank"])
+        if r["flops_per_step"]:
+            per_rank_flops.setdefault(rank, []).append(float(r["flops_per_step"]))
+        out[rank] = {  # ascending order → the newest row wins
             "flops_source": r["flops_source"],
             "device_kind": r["device_kind"],
             "peak_flops": r["peak_flops"],
         }
-    return out
+    for rank, vals in per_rank_flops.items():
+        out[rank]["flops_per_step"] = statistics.median(vals)
+    return {r: v for r, v in out.items() if v.get("flops_per_step")}
 
 
 def load_stdout_tail(db_path: Path, n: int = 12) -> List[Tuple[str, str]]:

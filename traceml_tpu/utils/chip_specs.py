@@ -18,6 +18,8 @@ from typing import Optional
 # first ("v5 lite" before "v5").
 _PEAK_BF16_FLOPS = (
     ("v6e", 918e12),
+    ("v6 lite", 918e12),
+    ("v6litepod", 918e12),
     ("trillium", 918e12),
     ("v5 lite", 197e12),
     ("v5litepod", 197e12),
