@@ -67,24 +67,29 @@ _ROUND_TIMEOUT_S = 120
 def _probe_backend() -> str:
     """Backend platform name via a bounded subprocess probe, '' on failure."""
     backend = ""
+    n_devices = 0
     try:
         proc = subprocess.run(
             [
                 sys.executable, "-c",
-                "import jax; jax.devices(); print(jax.default_backend())",
+                "import jax; print(len(jax.devices()), jax.default_backend())",
             ],
             timeout=_PROBE_TIMEOUT_S,
             capture_output=True,
             text=True,
         )
         if proc.returncode == 0:
-            backend = proc.stdout.strip().splitlines()[-1]
-    except (subprocess.TimeoutExpired, OSError, IndexError):
+            fields = proc.stdout.strip().splitlines()[-1].split()
+            n_devices, backend = int(fields[0]), fields[1]
+    except (subprocess.TimeoutExpired, OSError, ValueError, IndexError):
         pass
     try:  # share the verdict so other entry points skip the timeout
         from traceml_tpu.utils.probe_cache import write_cache
 
-        write_cache({"backend": backend, "physical": None}, REPO)
+        write_cache(
+            {"backend": backend, "n_devices": n_devices, "physical": None},
+            REPO,
+        )
     except Exception:
         pass
     return backend
@@ -121,18 +126,31 @@ def _watch_stats() -> dict:
     return stats
 
 
+_PERSISTED_MAX_AGE_S = 12 * 3600  # ~one round: older captures describe old code
+
+
 def _emit_persisted_tpu() -> bool:
     """Report the watch daemon's certified on-chip capture when the chip
-    is unreachable NOW but was healthy earlier in the round."""
+    is unreachable NOW but was healthy earlier in the round.  Captures
+    older than roughly a round are ignored — a number measured against a
+    previous round's code must not masquerade as this round's result."""
     path = REPO / "TPU_BENCH_RESULT.json"
     try:
         data = json.loads(path.read_text())
         row = dict(data["result"])
+        age = time.time() - float(data["captured_at"])
     except (OSError, ValueError, KeyError, TypeError):
+        return False
+    if not (0 <= age <= _PERSISTED_MAX_AGE_S):
+        print(
+            f"[bench] ignoring persisted on-chip capture from "
+            f"{data.get('captured_at_iso')} (age {age / 3600:.1f}h — stale)",
+            file=sys.stderr,
+        )
         return False
     row.setdefault("backend", "tpu")
     row.setdefault("device_kind", data.get("device_kind"))
-    row["captured_at"] = data.get("captured_at_iso")
+    row["captured_at_iso"] = data.get("captured_at_iso")
     row["source"] = "tpu_watch"
     print(
         "[bench] live device unavailable; reporting the certified on-chip "

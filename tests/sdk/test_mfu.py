@@ -175,3 +175,43 @@ def test_sampler_publishes_model_stats_once(tmp_path):
     sampler.sample()
     rows = sampler.db.tail("model_stats", 10)
     assert len(rows) == 3 and rows[-1]["peak_flops"] == 918e12
+
+
+def test_efficiency_scales_denominator_by_device_count():
+    """cost_analysis() FLOPs are for the whole pre-partition program:
+    one process driving 4 chips must be judged against 4 chips' peak
+    (ADVICE r2 medium — MFU was inflated N× before)."""
+    from traceml_tpu.analytics.efficiency import build_efficiency
+
+    stats = {0: {"flops_per_step": 459e12 * 0.4 * 4,  # 40% MFU on 4 chips
+                 "flops_source": "cost_analysis", "device_kind": "TPU v5p",
+                 "peak_flops": 459e12, "device_count": 4}}
+    eff = build_efficiency(stats, {0: 1000.0})  # 1 s/step
+    assert eff is not None
+    assert abs(eff["mfu_median"] - 0.4) < 1e-6
+    assert eff["device_count"] == 4
+    # without device_count the old single-chip semantics hold
+    stats[0]["device_count"] = None
+    eff = build_efficiency(stats, {0: 1000.0})
+    assert abs(eff["mfu_median"] - 1.6) < 1e-6
+
+
+def test_efficiency_uses_each_ranks_own_declaration():
+    """Heterogeneous declarations (pipeline stages, mixed chips) must
+    not silently inherit rank 0's numbers (ADVICE r2 low)."""
+    from traceml_tpu.analytics.efficiency import build_efficiency
+
+    stats = {
+        0: {"flops_per_step": 100e12, "flops_source": "manual",
+            "device_kind": "TPU v5p", "peak_flops": 459e12,
+            "device_count": 1},
+        1: {"flops_per_step": 200e12, "flops_source": "manual",
+            "device_kind": "TPU v6e", "peak_flops": 918e12,
+            "device_count": 1},
+    }
+    eff = build_efficiency(stats, {0: 1000.0, 1: 1000.0})
+    by_rank = eff["achieved_tflops_by_rank"]
+    assert by_rank["0"] == 100.0 and by_rank["1"] == 200.0
+    # a rank with NO declaration falls back to the first declaring rank
+    eff = build_efficiency(stats, {0: 1000.0, 1: 1000.0, 2: 500.0})
+    assert eff["achieved_tflops_by_rank"]["2"] == 200.0

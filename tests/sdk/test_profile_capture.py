@@ -265,3 +265,65 @@ def test_steps_bounded_against_typo(tmp_path, monkeypatch):
     _drive(svc, 250)  # > _MAX_STEPS flushes
     resp = read_profile_response(tmp_path, for_request=ts)
     assert resp is not None and resp["ok"]  # finished within the bound
+
+
+def test_empty_ranks_rejected_at_write(tmp_path):
+    """ranks=[] names no captor — reject up front instead of letting
+    the operator's poll time out (ADVICE r2)."""
+    with pytest.raises(ValueError):
+        write_profile_request(tmp_path, steps=2, ranks=[])
+    assert not profile_request_path(tmp_path).exists()
+
+
+def test_dead_ranks_get_error_response(tmp_path, monkeypatch):
+    """A request naming only nonexistent ranks is answered with an
+    error by rank 0 (the conventional responder) — never a timeout."""
+    svc = ProfileCaptureService(tmp_path, rank=0, check_every=1, world_size=2)
+    ts = write_profile_request(tmp_path, steps=2, ranks=[5, 9])
+    _drive(svc, 6)
+    resp = read_profile_response(tmp_path, for_request=ts)
+    assert resp is not None and not resp["ok"]
+    assert "no live rank" in resp["error"]
+    assert not (tmp_path / "profiles").exists()
+
+
+def test_dead_primary_live_secondary_still_answers(tmp_path, monkeypatch):
+    """ranks=[dead, live]: the live rank captures AND responds (the
+    primary is the min of the LIVE set, not of the raw request)."""
+    calls = []
+
+    class _FakeProfiler:
+        def start_trace(self, d):
+            calls.append(("start", d))
+
+        def stop_trace(self):
+            calls.append(("stop",))
+
+    import jax
+
+    monkeypatch.setattr(jax, "profiler", _FakeProfiler())
+    svc = ProfileCaptureService(tmp_path, rank=1, check_every=1, world_size=2)
+    ts = write_profile_request(tmp_path, steps=1, ranks=[1, 7])
+    _drive(svc, 4)
+    resp = read_profile_response(tmp_path, for_request=ts)
+    assert resp is not None and resp["ok"] and resp["rank"] == 1
+    assert ("stop",) in calls
+
+
+def test_response_echoes_clamped_steps(tmp_path, monkeypatch):
+    class _FakeProfiler:
+        def start_trace(self, d):
+            pass
+
+        def stop_trace(self):
+            pass
+
+    import jax
+
+    monkeypatch.setattr(jax, "profiler", _FakeProfiler())
+    svc = ProfileCaptureService(tmp_path, rank=0, check_every=1)
+    ts = write_profile_request(tmp_path, steps=10_000_000)
+    _drive(svc, 250)
+    resp = read_profile_response(tmp_path, for_request=ts)
+    assert resp is not None and resp["ok"]
+    assert resp["steps"] == 200  # _MAX_STEPS, not the typo'd request

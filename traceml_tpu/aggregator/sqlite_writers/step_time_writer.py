@@ -55,7 +55,8 @@ def init_schema(conn) -> None:
             flops_per_step REAL,
             flops_source TEXT,
             device_kind TEXT,
-            peak_flops REAL
+            peak_flops REAL,
+            device_count INTEGER
         )"""
     )
 
@@ -66,7 +67,7 @@ def insert_sql(table: str) -> str:
             f"INSERT INTO {MODEL_STATS_TABLE} (session_id, global_rank,"
             " local_rank, world_size, local_world_size, node_rank, hostname,"
             " pid, timestamp, flops_per_step, flops_source, device_kind,"
-            " peak_flops) VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)"
+            " peak_flops, device_count) VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?)"
         )
     return (
         f"INSERT INTO {TABLE} (session_id, global_rank, local_rank, world_size,"
@@ -100,6 +101,7 @@ def build_rows(env: TelemetryEnvelope) -> Dict[str, List[Tuple]]:
             row.get("flops_source"),
             row.get("device_kind"),
             fnum(row, "peak_flops"),
+            inum(row, "device_count"),
         )
         for row in env.tables.get("model_stats", [])
     ]

@@ -11,6 +11,16 @@ import statistics
 from typing import Any, Dict, Mapping, Optional
 
 
+def _rank_key(stats: Mapping[int, Mapping[str, Any]], rank: Any):
+    """Stats key for a per-step-ms rank id (int keys in stats, str or
+    int in step-ms maps), or None when that rank never declared."""
+    try:
+        r = int(rank)
+    except (TypeError, ValueError):
+        return None
+    return r if r in stats else None
+
+
 def build_efficiency(
     stats: Optional[Mapping[int, Mapping[str, Any]]],
     per_rank_step_ms: Mapping[Any, Optional[float]],
@@ -22,30 +32,56 @@ def build_efficiency(
     per-step ``set_step_flops`` pattern under variable sequence
     lengths — pairing only the LAST declaration with window-median
     step times would skew MFU by the last batch's size) plus the
-    latest source/device_kind/peak.  ``per_rank_step_ms`` maps rank →
-    representative step duration (steady-state median when available).
+    latest source/device_kind/peak/device_count.  ``per_rank_step_ms``
+    maps rank → representative step duration (steady-state median when
+    available).
+
+    Each rank's achieved FLOP/s uses that rank's OWN declaration
+    (pipeline stages and mixed chip generations declare different
+    values), falling back to the first declaring rank for ranks without
+    one.  The MFU denominator per rank is chip peak × the rank's
+    addressable-device count: lowered cost_analysis() FLOPs are for the
+    whole pre-partition program, so a process driving N chips must be
+    judged against N chips' peak.
     """
     if not stats:
         return None
     ms0 = next(iter(stats.values()))
-    flops = ms0.get("flops_per_step")
-    peak = ms0.get("peak_flops")
-    if not flops:
-        return None
-    achieved = {
-        str(r): flops / (v / 1000.0) / 1e12
-        for r, v in per_rank_step_ms.items()
-        if v
-    }
+    if not ms0.get("flops_per_step"):
+        # the fallback declaration is unusable; require per-rank ones
+        ms0 = next(
+            (v for v in stats.values() if v.get("flops_per_step")), None
+        )
+        if ms0 is None:
+            return None
+
+    achieved: Dict[str, float] = {}
+    mfu: Dict[str, float] = {}
+    for rank, step_ms in per_rank_step_ms.items():
+        if not step_ms:
+            continue
+        key = _rank_key(stats, rank)
+        decl = stats[key] if key is not None else ms0
+        flops = decl.get("flops_per_step") or ms0.get("flops_per_step")
+        if not flops:
+            continue
+        tflops = flops / (step_ms / 1000.0) / 1e12
+        achieved[str(rank)] = tflops
+        peak = decl.get("peak_flops")
+        if peak:
+            n_dev = int(decl.get("device_count") or 1)
+            mfu[str(rank)] = tflops * 1e12 / (peak * max(n_dev, 1))
     if not achieved:
         return None
     med = statistics.median(achieved.values())
+    peak0 = ms0.get("peak_flops")
     return {
-        "flops_per_step": flops,
+        "flops_per_step": ms0.get("flops_per_step"),
         "flops_source": ms0.get("flops_source"),
         "device_kind": ms0.get("device_kind"),
-        "peak_tflops": (peak / 1e12) if peak else None,
+        "device_count": ms0.get("device_count"),
+        "peak_tflops": (peak0 / 1e12) if peak0 else None,
         "achieved_tflops_by_rank": {r: round(v, 3) for r, v in achieved.items()},
         "achieved_tflops_median": round(med, 3),
-        "mfu_median": (med * 1e12 / peak) if peak else None,
+        "mfu_median": statistics.median(mfu.values()) if mfu else None,
     }

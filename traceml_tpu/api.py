@@ -28,11 +28,15 @@ from traceml_tpu.sdk.profile_capture import (  # noqa: F401
 )
 
 
-def set_step_flops(flops: float, device_kind=None) -> None:
+def set_step_flops(flops: float, device_kind=None, device_count=None) -> None:
     """Declare the model FLOPs of ONE training step (fwd+bwd+optimizer)
     — the MFU numerator.  Overrides wrap_step_fn's cost-analysis
     estimate; use for grad-accum loops (sum the micro-batch dispatches)
-    or models traced outside wrap_step_fn."""
+    or models traced outside wrap_step_fn.
+
+    Declare the GLOBAL program's FLOPs: when this process drives N
+    addressable chips, the MFU denominator becomes N × chip peak
+    (``device_count`` defaults to ``jax.local_device_count()``)."""
     from traceml_tpu.sdk.state import get_state
 
     st = get_state()
@@ -45,6 +49,15 @@ def set_step_flops(flops: float, device_kind=None) -> None:
             import jax
 
             st.flops_device_kind = str(jax.devices()[0].device_kind)
+        except Exception:
+            pass
+    if device_count is not None:
+        st.flops_device_count = int(device_count)
+    elif st.flops_device_count is None:
+        try:
+            import jax
+
+            st.flops_device_count = int(jax.local_device_count())
         except Exception:
             pass
 

@@ -152,7 +152,9 @@ def _check_scenario_e2e(report: dict) -> bool:
     script = tmp / "scenario.py"
     script.write_text(
         "from traceml_tpu.dev.demo.scenarios import run_scenario\n"
-        "run_scenario('input_bound', steps=30)\n"
+        # ≥50 aligned steps: the summary-policy diagnosis gate returns
+        # INSUFFICIENT_STEP_TIME_DATA below that (diagnostics/step_time)
+        "run_scenario('input_bound', steps=60)\n"
     )
     logs = tmp / "logs"
     env = dict(os.environ)

@@ -44,6 +44,7 @@ from pathlib import Path
 
 REPO = Path(__file__).resolve().parents[2]
 
+from traceml_tpu.utils.atomic_io import atomic_write_json  # noqa: E402
 from traceml_tpu.utils.probe_cache import write_cache  # noqa: E402
 
 _PROBE_TIMEOUT_S = 75
@@ -54,7 +55,9 @@ _UTIL_TIMEOUT_S = 300
 # one real chip cannot exceed this (fastest shipping chip + headroom);
 # a probe implying more means block_until_ready is not waiting
 _PHYSICAL_PEAK_FLOPS = 1.2e15
-_PROBE_MATMUL_FLOPS = 2.0 * 4096**3
+_PROBE_MATMUL_N = 4096
+_PROBE_MATMUL_FLOPS = 2.0 * _PROBE_MATMUL_N**3
+_PROBE_MIN_STEP_S = 2e-4
 
 _PROBE_SRC = r"""
 import json, time, sys
@@ -66,7 +69,7 @@ out = {
     "device_kind": devs[0].device_kind,
 }
 if out["backend"] != "cpu":
-    x = jnp.ones((4096, 4096), jnp.bfloat16)
+    x = jnp.ones((%(n)d, %(n)d), jnp.bfloat16)
     f = jax.jit(lambda a: a @ a)
     jax.block_until_ready(f(x)); jax.block_until_ready(f(x))
     best = min(
@@ -76,12 +79,17 @@ if out["backend"] != "cpu":
         for _ in range(8)
     )
     out["matmul_min_s"] = best
-    out["implied_tflops"] = 2.0 * 4096**3 / best / 1e12
-    out["physical"] = best >= 2e-4 and (2.0 * 4096**3 / best) <= 1.2e15
+    out["implied_tflops"] = %(flops)r / best / 1e12
+    out["physical"] = best >= %(min_step)r and (%(flops)r / best) <= %(peak)r
 else:
     out["physical"] = False
 print(json.dumps(out))
-"""
+""" % {
+    "n": _PROBE_MATMUL_N,
+    "flops": _PROBE_MATMUL_FLOPS,
+    "min_step": _PROBE_MIN_STEP_S,
+    "peak": _PHYSICAL_PEAK_FLOPS,
+}
 
 
 def _device_env() -> dict:
@@ -128,9 +136,7 @@ def _load_state(path: Path) -> dict:
 
 
 def _save_state(path: Path, state: dict) -> None:
-    tmp = path.with_suffix(".tmp")
-    tmp.write_text(json.dumps(state, indent=1))
-    os.replace(tmp, path)
+    atomic_write_json(path, state, indent=1)
 
 
 def _capture_bench(verdict: dict) -> bool:
@@ -158,9 +164,7 @@ def _capture_bench(verdict: dict) -> bool:
         "result": row,
         "stderr_tail": (proc.stderr or "")[-2000:],
     }
-    tmp = REPO / "TPU_BENCH_RESULT.tmp"
-    tmp.write_text(json.dumps(out, indent=1))
-    os.replace(tmp, REPO / "TPU_BENCH_RESULT.json")
+    atomic_write_json(REPO / "TPU_BENCH_RESULT.json", out, indent=1)
     return True
 
 
@@ -230,9 +234,10 @@ def main(argv=None) -> int:
     parser.add_argument("--duration-s", type=float, default=39600.0)
     parser.add_argument("--interval-s", type=float, default=180.0)
     parser.add_argument(
-        "--settle-interval-s", type=float, default=900.0,
-        help="probe cadence after every capture has succeeded "
-             "(keeps PROBE_CACHE.json fresh at lower cost)",
+        "--settle-interval-s", type=float, default=480.0,
+        help="probe cadence after every capture has succeeded — kept "
+             "UNDER probe_cache.DEFAULT_MAX_AGE_S (600 s) so the cache "
+             "never expires between refreshes",
     )
     args = parser.parse_args(argv)
     return run(args.duration_s, args.interval_s, args.settle_interval_s)

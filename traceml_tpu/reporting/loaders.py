@@ -223,11 +223,21 @@ def load_model_stats(
     with _connect_ro(db_path) as conn:
         if not _table_exists(conn, "model_stats_samples"):
             return out
-        rows = conn.execute(
-            "SELECT * FROM (SELECT global_rank, flops_per_step, flops_source,"
-            " device_kind, peak_flops, id FROM model_stats_samples"
-            f" ORDER BY id DESC LIMIT {int(recent_rows)}) ORDER BY id ASC"
-        ).fetchall()
+        try:
+            rows = conn.execute(
+                "SELECT * FROM (SELECT global_rank, flops_per_step,"
+                " flops_source, device_kind, peak_flops, device_count, id"
+                " FROM model_stats_samples"
+                f" ORDER BY id DESC LIMIT {int(recent_rows)}) ORDER BY id ASC"
+            ).fetchall()
+        except sqlite3.OperationalError:
+            # archived sessions written before the device_count column
+            rows = conn.execute(
+                "SELECT *, NULL AS device_count FROM (SELECT global_rank,"
+                " flops_per_step, flops_source, device_kind, peak_flops, id"
+                " FROM model_stats_samples"
+                f" ORDER BY id DESC LIMIT {int(recent_rows)}) ORDER BY id ASC"
+            ).fetchall()
     for r in rows:
         rank = int(r["global_rank"])
         if r["flops_per_step"]:
@@ -236,6 +246,7 @@ def load_model_stats(
             "flops_source": r["flops_source"],
             "device_kind": r["device_kind"],
             "peak_flops": r["peak_flops"],
+            "device_count": r["device_count"],
         }
     for rank, vals in per_rank_flops.items():
         out[rank]["flops_per_step"] = statistics.median(vals)

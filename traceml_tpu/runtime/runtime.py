@@ -77,7 +77,9 @@ class TraceMLRuntime:
             from traceml_tpu.sdk.profile_capture import ProfileCaptureService
 
             self._profile_service = ProfileCaptureService(
-                self.settings.session_dir, rank=self.identity.global_rank
+                self.settings.session_dir,
+                rank=self.identity.global_rank,
+                world_size=self.identity.world_size,
             )
             get_state().on_step_flushed.append(
                 self._profile_service.on_step_flushed

@@ -134,7 +134,10 @@ class StepTimeSampler(BaseSampler):
             flops = st.flops_per_step
             # keyed on the full declaration: a device_kind correction
             # with unchanged FLOPs must still republish
-            sent_key = (flops, st.flops_source, st.flops_device_kind)
+            sent_key = (
+                flops, st.flops_source, st.flops_device_kind,
+                st.flops_device_count,
+            )
             if flops is None or sent_key == self._flops_sent:
                 return
             self._flops_sent = sent_key
@@ -146,6 +149,7 @@ class StepTimeSampler(BaseSampler):
                     "flops_source": st.flops_source,
                     "device_kind": st.flops_device_kind,
                     "peak_flops": peak_flops_for(st.flops_device_kind),
+                    "device_count": st.flops_device_count,
                 },
             )
         except Exception:

@@ -55,7 +55,17 @@ def write_profile_request(
     session_dir: Path, steps: int = _DEFAULT_STEPS, ranks=None
 ) -> float:
     """Operator side: ask the running job for a trace.  Returns the
-    request timestamp (pass to :func:`read_profile_response` matching)."""
+    request timestamp (pass to :func:`read_profile_response` matching).
+
+    ``ranks`` must be None (all ranks) or a NON-EMPTY list of rank ids —
+    an empty list would name no captor and the request could only time
+    out, so it is rejected here rather than silently dropped."""
+    if ranks is not None:
+        ranks = [int(r) for r in ranks]
+        if not ranks:
+            raise ValueError(
+                "ranks must be None (all ranks) or a non-empty list"
+            )
     ts = time.time()
     atomic_write_json(
         profile_request_path(session_dir),
@@ -95,15 +105,19 @@ class ProfileCaptureService:
         session_dir: Path,
         rank: int = 0,
         check_every: int = 5,
+        world_size: Optional[int] = None,
     ) -> None:
         self._session_dir = Path(session_dir)
         self._rank = int(rank)
         self._check_every = max(1, int(check_every))
+        self._world_size = int(world_size) if world_size else None
         self._flushes = 0
         self._handled_mtime = 0.0
         self._remaining = 0
         self._trace_dir: Optional[Path] = None
         self._request: Dict[str, Any] = {}
+        self._steps = 0
+        self._primary = 0
 
     # -- the per-step hook (training thread) ---------------------------
     def on_step_flushed(self, step: int) -> None:
@@ -158,10 +172,35 @@ class ProfileCaptureService:
             )
         except Exception:
             pass  # worst case: a restart replays one capture
-        ranks = req.get("ranks")
-        if ranks is not None and self._rank not in ranks:
-            return
         steps = min(_MAX_STEPS, max(1, int(req.get("steps") or _DEFAULT_STEPS)))
+        ranks = req.get("ranks")
+        if ranks is not None:
+            try:
+                ranks = [int(r) for r in ranks]
+            except (TypeError, ValueError):
+                ranks = []
+            live = [
+                r for r in ranks
+                if self._world_size is None or 0 <= r < self._world_size
+            ]
+            if not live:
+                # nobody will ever capture this request — the
+                # conventional responder (rank 0) answers with an error
+                # instead of leaving the operator's CLI to time out
+                # with a misleading "is the job stepping?" message
+                if self._rank == 0:
+                    self._respond(
+                        ok=False,
+                        error=f"ranks {ranks!r} names no live rank "
+                              f"(world_size={self._world_size})",
+                        trace_dir=None, req=req, steps=steps, primary=0,
+                    )
+                return
+            if self._rank not in live:
+                return
+            self._primary = min(live)
+        else:
+            self._primary = 0
         # stamp from the REQUEST time, not each rank's local now: ranks
         # reach their flush edges at different instants, and a wall-clock
         # stamp would scatter one capture across two profiles/<stamp>/
@@ -176,11 +215,15 @@ class ProfileCaptureService:
             jax.profiler.start_trace(str(trace_dir))
         except Exception as exc:
             get_error_log().warning("profile capture start failed", exc)
-            self._respond(ok=False, error=repr(exc), trace_dir=None, req=req)
+            self._respond(
+                ok=False, error=repr(exc), trace_dir=None, req=req,
+                steps=steps, primary=self._primary,
+            )
             return
         self._request = req
         self._trace_dir = trace_dir
         self._remaining = steps
+        self._steps = steps
 
     def _finish(self, ok: bool, truncated: bool = False) -> None:
         try:
@@ -196,6 +239,8 @@ class ProfileCaptureService:
             trace_dir=self._trace_dir,
             req=self._request,
             truncated=truncated,
+            steps=self._steps,
+            primary=self._primary,
         )
         self._trace_dir = None
         self._request = {}
@@ -209,11 +254,14 @@ class ProfileCaptureService:
             self._remaining = 0
             self._finish(ok=True, truncated=True)
 
-    def _respond(self, ok, error, trace_dir, req, truncated=False) -> None:
-        # one response per request, written by the primary participating
-        # rank (responses from N ranks would race the same file)
-        ranks = req.get("ranks")
-        primary = min(ranks) if ranks else 0
+    def _respond(
+        self, ok, error, trace_dir, req, truncated=False,
+        steps: Optional[int] = None, primary: int = 0,
+    ) -> None:
+        # one response per request, written by the primary PARTICIPATING
+        # rank (responses from N ranks would race the same file; the
+        # caller computes primary from the LIVE rank set so a request
+        # naming dead ranks still gets its answer)
         if self._rank != primary:
             return
         try:
@@ -224,9 +272,12 @@ class ProfileCaptureService:
                     "requested_at": req.get("requested_at"),
                     "completed_at": time.time(),
                     "ok": bool(ok),
+                    # the CLAMPED step count actually captured, not the
+                    # requested value (a typo'd steps=10**6 is bounded
+                    # by _MAX_STEPS and the response must say so)
+                    "steps": steps if steps is not None else req.get("steps"),
                     "error": error,
                     "trace_dir": str(trace_dir.parent) if trace_dir else None,
-                    "steps": req.get("steps"),
                     "truncated": bool(truncated),
                     "rank": self._rank,
                 },

@@ -62,6 +62,11 @@ class TraceState:
         self.flops_per_step: Optional[float] = None
         self.flops_source: Optional[str] = None
         self.flops_device_kind: Optional[str] = None
+        # addressable devices behind this process's dispatches: lowered
+        # cost_analysis() FLOPs are for the whole (global, pre-partition)
+        # program, so with one process driving N chips the MFU
+        # denominator must be N × chip peak or the ratio inflates N×
+        self.flops_device_count: Optional[int] = None
         # called with the step number after each flush (max-steps lifecycle)
         self.on_step_flushed: List[Callable[[int], None]] = []
         # called with the StepTimeBatch after each non-empty flush

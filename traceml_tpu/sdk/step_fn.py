@@ -110,6 +110,12 @@ class WrappedStepFn:
         dispatch per step — grad-accum loops with K inner dispatches
         should call ``set_step_flops`` with the summed value instead).
 
+        The estimate is for the whole (global, pre-partition) program:
+        when this process drives N addressable chips (a pjit program
+        over a local mesh), the matching MFU denominator is N × chip
+        peak, so ``flops_device_count`` is published alongside and the
+        efficiency formula (analytics/efficiency.py) scales by it.
+
         Fail-open: returns None (and publishes nothing) on any error.
         """
         try:
@@ -128,6 +134,10 @@ class WrappedStepFn:
                 st.flops_device_kind = str(jax.devices()[0].device_kind)
             except Exception:
                 st.flops_device_kind = None
+            try:
+                st.flops_device_count = int(jax.local_device_count())
+            except Exception:
+                st.flops_device_count = None
             return flops
         except Exception:
             return None

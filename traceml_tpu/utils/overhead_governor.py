@@ -93,7 +93,10 @@ class OverheadGovernor:
 
         Callers should pass the MINIMUM per-poll duration they saw in a
         batch (robust to a poller thread being descheduled mid-poll);
-        samples above the artifact ceiling are discarded outright."""
+        samples above the artifact ceiling are CLAMPED to it before
+        entering the EMA (see _PROBE_SAMPLE_CEILING — a descheduling
+        artifact should register as "expensive", not be unboundedly
+        believed)."""
         if n_probes <= 0 or total_s < 0:
             return
         per = min(total_s / n_probes, _PROBE_SAMPLE_CEILING)

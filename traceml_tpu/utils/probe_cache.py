@@ -17,10 +17,11 @@ interval.  Default freshness window is 600 s.
 from __future__ import annotations
 
 import json
-import os
 import time
 from pathlib import Path
 from typing import Optional
+
+from traceml_tpu.utils.atomic_io import atomic_write_json
 
 DEFAULT_MAX_AGE_S = 600.0
 
@@ -46,12 +47,11 @@ def read_cache(
 
 
 def write_cache(verdict: dict, repo_root: Optional[Path] = None) -> None:
-    """Atomically persist a probe verdict (best-effort; never raises)."""
-    verdict = dict(verdict, ts=time.time())
-    path = cache_path(repo_root)
+    """Atomically persist a probe verdict (best-effort; never raises).
+
+    atomic_write_json's per-writer mkstemp names matter here: the watch
+    daemon, bench.py, and __graft_entry__ can all write concurrently."""
     try:
-        tmp = path.with_suffix(".tmp")
-        tmp.write_text(json.dumps(verdict))
-        os.replace(tmp, path)
+        atomic_write_json(cache_path(repo_root), dict(verdict, ts=time.time()))
     except OSError:
         pass
