@@ -1,0 +1,37 @@
+"""Fake ``torch_xla.core.xla_model`` — see package docstring."""
+
+import os
+import time
+
+_KB_TOTAL = 8 * 1024 * 1024  # 8 GiB "HBM"
+_used_kb = 256 * 1024  # grows per get_memory_info call
+_mark_steps = 0
+
+
+def mark_step(wait: bool = False):
+    """The lazy-execution barrier: the pending graph 'executes' here."""
+    global _mark_steps
+    _mark_steps += 1
+    time.sleep(float(os.environ.get("FAKE_XLA_MARK_STEP_MS", "50")) / 1000.0)
+
+
+def get_xla_supported_devices(devkind=None, max_devices=None):
+    return ["xla:0"]
+
+
+def xla_device(n=None, devkind=None):
+    return "xla:0"
+
+
+def get_memory_info(dev):
+    global _used_kb
+    _used_kb += 1024  # +1 MiB per sample: growth is observable
+    return {"kb_total": _KB_TOTAL, "kb_free": _KB_TOTAL - _used_kb}
+
+
+def get_ordinal():
+    return int(os.environ.get("RANK", 0))
+
+
+def xrt_world_size():
+    return int(os.environ.get("WORLD_SIZE", 1))

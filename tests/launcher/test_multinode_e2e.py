@@ -29,7 +29,12 @@ rng = np.random.default_rng(rank)
 def batches():
     for i in range(60):
         if rank == 1:
-            time.sleep(0.03)  # node-1 rank has the slow input pipeline
+            # node-1 rank has the slow input pipeline.  0.12 s (toward
+            # the reference demo's 0.18 s) keeps the injected skew far
+            # above full-suite host-contention noise — 0.03 s was
+            # under-margined and flaked INPUT_STRAGGLER → INPUT_BOUND
+            # when 2 launchers × (aggregator + rank) timeshared cores
+            time.sleep(0.12)
         yield rng.normal(size=(8, 32)).astype(np.float32)
 
 for x in traceml_tpu.wrap_dataloader(batches()):

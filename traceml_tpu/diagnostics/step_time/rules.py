@@ -80,6 +80,33 @@ def _enough_data(ctx: _Ctx) -> bool:
 
 
 class InputBoundRule:
+    @staticmethod
+    def _global_share(ctx: _Ctx) -> Optional[float]:
+        """Input share on the LOW-quantile rank — the "globally slow
+        pipeline" statistic.  The cross-rank median is contaminated by a
+        single straggler rank in small worlds (2 ranks: median = the
+        midpoint of healthy and straggler), which let INPUT_STRAGGLER
+        degrade into INPUT_BOUND under host contention.  A genuinely
+        input-bound job has a high input share on (nearly) EVERY rank,
+        so the gate reads the min (≤4 ranks) / 25th percentile share
+        over per-rank MEANS — the same statistic share_of_step fires
+        on, so a bursty-but-global pipeline (prefetch refills every Nth
+        step: median input ≈ 0 on every rank) cannot be suppressed by
+        a statistic mismatch."""
+        w = ctx.window
+        shares = []
+        for r in w.ranks:
+            avg = w.rank_windows[r].averages
+            step = avg.get(STEP_KEY, 0.0)
+            if step > 0:
+                shares.append(avg.get("input", 0.0) / step)
+        if not shares:
+            return None
+        shares.sort()
+        if len(shares) <= 4:
+            return shares[0]
+        return shares[max(0, (len(shares) - 1) // 4)]
+
     def evaluate(self, ctx: _Ctx) -> List[DiagnosticIssue]:
         if not _enough_data(ctx):
             return []
@@ -88,6 +115,12 @@ class InputBoundRule:
             return []
         p = ctx.policy
         if share < p.input_share_warn:
+            return []
+        gate = self._global_share(ctx)
+        if gate is not None and gate < p.input_share_warn * 0.5:
+            # the median-rank share clears the bar only because one
+            # straggler rank drags it up — that is the straggler rule's
+            # verdict, not a global input problem
             return []
         severity = (
             SEVERITY_CRITICAL if share >= p.input_share_critical else SEVERITY_WARNING
@@ -134,6 +167,44 @@ class CleanStragglerRule:
             return "compute"
         return None
 
+    @staticmethod
+    def _clean_math(w, sync_phase: Optional[str], stat_name: str):
+        """The clean-straggler pipeline under one per-rank statistic
+        (``"medians"`` or ``"averages"``); returns (score, worst_rank,
+        clean_step, clean_sync, step_stat) or None.
+
+        Both statistics run and the STRONGER score wins: medians are
+        contention-robust (a host burst inflates a few steps' means
+        while the median holds — the round-2 flake), but means are the
+        only statistic that can SEE spiky per-rank pathologies (a rank
+        checkpointing/recompiling on 1-in-10 steps has median ≈ healthy;
+        cf. CompileBoundRule's means-over-medians rationale)."""
+        step_stat = {
+            r: getattr(w.rank_windows[r], stat_name)[STEP_KEY] for r in w.ranks
+        }
+        sync_stat = {
+            r: (
+                getattr(w.rank_windows[r], stat_name).get(sync_phase, 0.0)
+                if sync_phase
+                else 0.0
+            )
+            for r in w.ranks
+        }
+        non_sync = {r: max(0.0, step_stat[r] - sync_stat[r]) for r in w.ranks}
+        max_non_sync = max(non_sync.values())
+        clean_sync = {
+            r: max(0.0, sync_stat[r] - max(0.0, max_non_sync - non_sync[r]))
+            for r in w.ranks
+        }
+        clean_step = {r: non_sync[r] + clean_sync[r] for r in w.ranks}
+        med_clean = statistics.median(clean_step.values())
+        worst_rank = max(clean_step, key=lambda r: clean_step[r])
+        med_actual = statistics.median(step_stat.values())
+        if med_actual <= 0:
+            return None
+        score = (clean_step[worst_rank] - med_clean) / med_actual
+        return score, worst_rank, clean_step, clean_sync, step_stat
+
     def evaluate(self, ctx: _Ctx) -> List[DiagnosticIssue]:
         w = ctx.window
         if not _enough_data(ctx) or len(w.ranks) < 2:
@@ -143,36 +214,29 @@ class CleanStragglerRule:
         if step_m is None or step_m.median_ms <= 0:
             return []
         sync_phase = self._sync_phase(ctx)
-        step_avg = {r: w.rank_windows[r].averages[STEP_KEY] for r in w.ranks}
-        sync_avg = {
-            r: (w.rank_windows[r].averages.get(sync_phase, 0.0) if sync_phase else 0.0)
-            for r in w.ranks
-        }
-        non_sync = {r: max(0.0, step_avg[r] - sync_avg[r]) for r in w.ranks}
-        max_non_sync = max(non_sync.values())
-        clean_sync = {
-            r: max(0.0, sync_avg[r] - max(0.0, max_non_sync - non_sync[r]))
-            for r in w.ranks
-        }
-        clean_step = {r: non_sync[r] + clean_sync[r] for r in w.ranks}
-        med_clean = statistics.median(clean_step.values())
-        worst_rank = max(clean_step, key=lambda r: clean_step[r])
-        med_actual = statistics.median(step_avg.values())
-        if med_actual <= 0:
+        candidates = [
+            (self._clean_math(w, sync_phase, stat), stat)
+            for stat in ("medians", "averages")
+        ]
+        candidates = [(c, s) for c, s in candidates if c is not None]
+        if not candidates:
             return []
-        score = (clean_step[worst_rank] - med_clean) / med_actual
+        (score, worst_rank, clean_step, clean_sync, step_avg), stat_name = max(
+            candidates, key=lambda cs: cs[0][0]
+        )
         if score < p.straggler_score_fire:
             return []
 
         # Component attribution on the worst rank: per-phase delta vs the
-        # cross-rank median, with the sync phase replaced by its clean form.
+        # cross-rank median, with the sync phase replaced by its clean
+        # form — read from the SAME statistic that produced the score.
         deltas: Dict[str, float] = {}
         for key in list(w.phases_present) + [RESIDUAL_KEY]:
             per_rank = {
                 r: (
                     clean_sync[r]
                     if key == sync_phase
-                    else w.rank_windows[r].averages.get(key, 0.0)
+                    else getattr(w.rank_windows[r], stat_name).get(key, 0.0)
                 )
                 for r in w.ranks
             }
@@ -210,7 +274,13 @@ class CleanStragglerRule:
                 ranks=[worst_rank],
                 evidence={
                     "clean_step_ms": {str(r): v for r, v in clean_step.items()},
-                    "step_avg_ms": {str(r): v for r, v in step_avg.items()},
+                    # per-rank step statistic that produced the score —
+                    # see "statistic" for whether these are medians or
+                    # means (they diverge under bursty load)
+                    "step_stat_ms": {str(r): v for r, v in step_avg.items()},
+                    "statistic": (
+                        "median" if stat_name == "medians" else "mean"
+                    ),
                     "sync_phase": sync_phase,
                     "component_deltas_ms": {k: v for k, v in ordered[:4]},
                     "clock": w.clock,

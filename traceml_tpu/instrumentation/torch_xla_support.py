@@ -29,6 +29,16 @@ from traceml_tpu.utils.error_log import get_error_log
 from traceml_tpu.utils.timing import COLLECTIVE_TIME, timed_region
 
 _original_mark_step: Optional[Any] = None
+_hook: Any = None
+
+
+def torch_xla_loaded() -> bool:
+    """True only when the PROCESS already imported torch_xla — the
+    touch-nothing policy: importing torch_xla on the user's behalf can
+    initialize the XLA runtime in jobs that never wanted it."""
+    import sys
+
+    return "torch_xla" in sys.modules
 
 
 def torch_xla_available() -> bool:
@@ -38,6 +48,42 @@ def torch_xla_available() -> bool:
         return True
     except Exception:
         return False
+
+
+def install_torch_xla_patch() -> str:
+    """Patch now if torch_xla is loaded, else arm a post-import hook
+    (the launcher initializes tracing BEFORE the user script imports
+    its stack — same gap the orbax patch closes).
+    Returns "patched" | "deferred" | "noop"."""
+    global _hook
+    if torch_xla_loaded():
+        return "patched" if patch_mark_step() else "noop"
+    try:
+        import importlib.util
+
+        # find_spec never imports/initializes the runtime — it only
+        # answers "could this ever be imported?".  Without it, every
+        # plain-torch job would carry a dead meta_path hook for life
+        # and log a misleading [deferred] patch.
+        if importlib.util.find_spec("torch_xla") is None:
+            return "noop"
+    except (ImportError, ValueError):
+        return "noop"
+    if _hook is None:
+        import sys
+
+        from traceml_tpu.instrumentation.orbax_patch import _PostImportHook
+
+        _hook = _PostImportHook("torch_xla.core.xla_model", patch_mark_step)
+        sys.meta_path.insert(0, _hook)
+    return "deferred"
+
+
+def remove_torch_xla_hook() -> None:
+    global _hook
+    if _hook is not None:
+        _hook.remove()
+        _hook = None
 
 
 def patch_mark_step() -> bool:

@@ -126,6 +126,24 @@ def init(
                     applied.append(f"orbax_checkpoint[{outcome}]")
             except Exception as exc:
                 get_error_log().warning("orbax patch failed", exc)
+        # torch-xla lazy-barrier timing: mark_step wall time IS the
+        # device execution + collective wait for the step (BASELINE
+        # BERT-base / Llama FSDP configs run through this path).
+        # Armed UNCONDITIONALLY (like orbax, not inside want_torch): the
+        # executor inits before the script imports torch, so framework
+        # preference can be unknown here; arming is cheap, self-gating
+        # (noop when torch_xla isn't even installed), and never imports
+        # torch_xla on the user's behalf.
+        try:
+            from traceml_tpu.instrumentation.torch_xla_support import (
+                install_torch_xla_patch,
+            )
+
+            outcome = install_torch_xla_patch()
+            if outcome != "noop":
+                applied.append(f"torch_xla_mark_step[{outcome}]")
+        except Exception as exc:
+            get_error_log().warning("torch-xla mark_step patch failed", exc)
         # Torch-side patches: when torch is already imported, or the
         # executor's static analysis says this is a torch job.
         want_torch = (
@@ -189,6 +207,16 @@ def shutdown_patches() -> None:
 
         unpatch_orbax()
         remove_orbax_hook()
+    except Exception:
+        pass
+    try:
+        from traceml_tpu.instrumentation.torch_xla_support import (
+            remove_torch_xla_hook,
+            unpatch_mark_step,
+        )
+
+        unpatch_mark_step()
+        remove_torch_xla_hook()
     except Exception:
         pass
     st.initialized = False

@@ -172,7 +172,23 @@ class NullMemoryBackend:
 
 
 def detect_backend() -> MemoryBackend:
-    """Best available backend, fail-open to null."""
+    """Best available backend, fail-open to null.
+
+    torch-xla wins when the process has torch_xla LOADED (explicit
+    signal this is a torch-xla job — its lazy tensors never show up in
+    jax's live-arrays view); detection is sys.modules-gated so this
+    never imports a framework the job didn't choose."""
+    import sys
+
+    if "torch_xla" in sys.modules:
+        try:
+            from traceml_tpu.instrumentation.torch_xla_support import (
+                XlaMemoryBackend,
+            )
+
+            return XlaMemoryBackend()
+        except Exception:
+            pass
     try:
         return JaxMemoryStatsBackend()
     except Exception:
@@ -226,7 +242,12 @@ def device_memory_rows(backend_holder: Dict[str, Any], ts: float) -> List[Dict[s
     """
     backend = backend_holder.get("backend")
     if backend is None:
-        if not jax_is_initialized():
+        import sys
+
+        # torch-xla jobs never initialize jax — their own loaded module
+        # is the detection signal (sys.modules check only: this thread
+        # must never import a framework)
+        if not jax_is_initialized() and "torch_xla" not in sys.modules:
             return []
         try:
             backend = detect_backend()

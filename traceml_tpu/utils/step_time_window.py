@@ -57,6 +57,13 @@ class RankWindow:
     series: Dict[str, List[float]]
     # per phase key → window average ms
     averages: Dict[str, float]
+    # per phase key → window MEDIAN ms — the contention-robust per-rank
+    # statistic: a host burst covering a few steps inflates the mean
+    # but barely moves the median, so cross-rank comparisons (the
+    # straggler math) read medians to keep attribution stable when the
+    # host is loaded (round-2 flake: INPUT_STRAGGLER degraded to
+    # INPUT_BOUND under full-suite contention)
+    medians: Dict[str, float]
     clock: str
     # device-busy share of the wall clock: Σ phase device durations /
     # Σ host(step envelope) over the window — the TPU stand-in for a
@@ -227,11 +234,15 @@ def build_rank_window(
     averages = {
         k: (sum(vs) / len(vs) if vs else 0.0) for k, vs in series.items()
     }
+    medians = {
+        k: (statistics.median(vs) if vs else 0.0) for k, vs in series.items()
+    }
     return RankWindow(
         rank=rank,
         steps=list(steps),
         series=series,
         averages=averages,
+        medians=medians,
         clock=clock,
         # cap: device readiness quantization can nominally exceed wall.
         # host_sum>0 alone gates (dual-clock rows existed): a fully idle
