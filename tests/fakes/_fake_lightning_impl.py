@@ -1,0 +1,121 @@
+"""Shared machinery for the fake Lightning packages (both layouts —
+``lightning.pytorch`` and ``pytorch_lightning``; VERDICT r2 item 8).
+
+Each layout gets its OWN ``Callback`` base class (so the dual-base
+construction in traceml's integration is observable) and a ``Trainer``
+that drives a REAL torch model through Lightning's automatic-
+optimization hook order, including the trap traceml's callback must
+survive: ``on_before_zero_grad`` fires BEFORE backward while the
+forward region is still open.
+
+Hook order reproduced (lightning.pytorch.loops automatic optimization):
+    on_train_batch_start → [training_step] → on_before_zero_grad →
+    zero_grad → on_before_backward → backward → on_after_backward →
+    on_before_optimizer_step → step → on_train_batch_end
+"""
+
+from typing import Any, List, Optional
+
+_HOOKS = (
+    "setup", "teardown",
+    "on_train_batch_start", "on_before_zero_grad", "on_before_backward",
+    "on_after_backward", "on_before_optimizer_step", "on_train_batch_end",
+    "on_train_end",
+)
+
+
+def make_layout(layout_name: str):
+    """Fresh (Callback, Trainer) pair for one package layout."""
+
+    class Callback:
+        _fake_lightning_layout = layout_name
+
+        def setup(self, trainer, pl_module, stage=None):
+            pass
+
+        def teardown(self, trainer, pl_module, stage=None):
+            pass
+
+        def on_train_batch_start(self, trainer, pl_module, batch, batch_idx):
+            pass
+
+        def on_before_zero_grad(self, trainer, pl_module, optimizer):
+            pass
+
+        def on_before_backward(self, trainer, pl_module, loss):
+            pass
+
+        def on_after_backward(self, trainer, pl_module):
+            pass
+
+        def on_before_optimizer_step(self, trainer, pl_module, optimizer):
+            pass
+
+        def on_train_batch_end(
+            self, trainer, pl_module, outputs, batch, batch_idx
+        ):
+            pass
+
+        def on_train_end(self, trainer, pl_module):
+            pass
+
+    class Trainer:
+        _fake_lightning_layout = layout_name
+
+        def __init__(
+            self,
+            callbacks: Optional[List[Any]] = None,
+            max_steps: int = 10,
+            num_sanity_val_steps: int = 2,
+        ) -> None:
+            self.callbacks = list(callbacks or [])
+            self.max_steps = int(max_steps)
+            self.num_sanity_val_steps = int(num_sanity_val_steps)
+            self.sanity_checking = False
+
+        def _hook(self, name: str, *args: Any, **kwargs: Any) -> None:
+            for cb in self.callbacks:
+                getattr(cb, name)(*args, **kwargs)
+
+        def fit(self, model, train_dataloader) -> None:
+            import torch
+
+            self._hook("setup", self, model, stage="fit")
+            optimizer = torch.optim.SGD(model.parameters(), lr=0.01)
+            batches = iter(train_dataloader)
+
+            # sanity-check pass: hooks fire with sanity_checking=True and
+            # must produce NO timed rows
+            self.sanity_checking = True
+            for idx in range(self.num_sanity_val_steps):
+                try:
+                    batch = next(batches)
+                except StopIteration:
+                    break
+                self._hook("on_train_batch_start", self, model, batch, idx)
+                self._hook(
+                    "on_train_batch_end", self, model, None, batch, idx
+                )
+            self.sanity_checking = False
+
+            for idx in range(self.max_steps):
+                try:
+                    batch = next(batches)
+                except StopIteration:
+                    break
+                self._hook("on_train_batch_start", self, model, batch, idx)
+                loss = model(batch).pow(2).mean()  # "training_step"
+                self._hook("on_before_zero_grad", self, model, optimizer)
+                optimizer.zero_grad()
+                self._hook("on_before_backward", self, model, loss)
+                loss.backward()
+                self._hook("on_after_backward", self, model)
+                self._hook("on_before_optimizer_step", self, model, optimizer)
+                optimizer.step()
+                self._hook(
+                    "on_train_batch_end", self, model, loss.detach(), batch, idx
+                )
+            self._hook("on_train_end", self, model)
+            self._hook("teardown", self, model, stage="fit")
+
+    return Callback, Trainer

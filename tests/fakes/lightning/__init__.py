@@ -1,0 +1,7 @@
+"""Fake `lightning` (new layout): the API lives in lightning.pytorch."""
+
+from lightning import pytorch  # noqa: F401
+
+Trainer = pytorch.Trainer
+Callback = pytorch.Callback
+__version__ = "2.0-fake"

@@ -1,0 +1,3 @@
+from _fake_lightning_impl import make_layout
+
+Callback, Trainer = make_layout("lightning.pytorch")

@@ -1,0 +1,6 @@
+"""Fake `pytorch_lightning` (legacy layout)."""
+
+from _fake_lightning_impl import make_layout
+
+Callback, Trainer = make_layout("pytorch_lightning")
+__version__ = "1.9-fake"

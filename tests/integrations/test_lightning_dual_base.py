@@ -1,0 +1,117 @@
+"""Lightning integration against the fake packages in BOTH callback-base
+layouts (VERDICT r2 item 8; reference dynamic multi-base construction:
+src/traceml_ai/integrations/lightning.py:30-90).
+
+Unlike the hook-sequence stubs in test_lightning_ray_ast.py, these run a
+REAL torch model through a Trainer.fit() loop that reproduces
+Lightning's automatic-optimization hook order (including the
+zero_grad-before-backward trap and the sanity-check pass).
+"""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+from traceml_tpu.utils import timing as T
+
+FAKES = Path(__file__).resolve().parents[1] / "fakes"
+
+
+@pytest.fixture()
+def fake_lightning_path(monkeypatch):
+    import traceml_tpu.integrations.lightning as L
+
+    monkeypatch.syspath_prepend(str(FAKES))
+    monkeypatch.setattr(L, "_cached_callback_cls", None)
+    yield L
+    for name in [
+        m for m in sys.modules
+        if m == "_fake_lightning_impl"
+        or m.startswith(("lightning", "pytorch_lightning"))
+    ]:
+        del sys.modules[name]
+
+
+def _fit_and_capture(L, trainer_cls, steps=6):
+    import numpy as np
+    import torch
+
+    from traceml_tpu.sdk.state import get_state
+
+    model = torch.nn.Linear(16, 1)
+    cb = L.TraceMLCallback(auto_init=False)
+    st = get_state()
+    captured = []
+    st.on_batch_flushed.append(captured.append)
+    try:
+        rng = np.random.default_rng(0)
+        batches = [
+            torch.tensor(rng.normal(size=(8, 16)).astype("float32"))
+            for _ in range(steps + 2)  # +2 sanity batches
+        ]
+        trainer = trainer_cls(callbacks=[cb], max_steps=steps)
+        trainer.fit(model, batches)
+    finally:
+        st.on_batch_flushed.remove(captured.append)
+    return cb, captured
+
+
+def test_new_layout_full_fit(fake_lightning_path):
+    """lightning.pytorch layout: a real fit() yields one timed batch per
+    training step with forward/backward/optimizer phases, none for the
+    sanity pass."""
+    L = fake_lightning_path
+    import lightning.pytorch as lp
+
+    cb, captured = _fit_and_capture(L, lp.Trainer, steps=6)
+    assert isinstance(cb, lp.Callback)
+    assert len(captured) == 6  # sanity batches produced nothing
+    for batch in captured:
+        names = [e.name for e in batch.events]
+        assert T.FORWARD_TIME in names
+        assert T.BACKWARD_TIME in names
+        assert T.OPTIMIZER_STEP in names
+        assert T.STEP_TIME in names
+        # real torch tensors carry no readiness probe (host-clock
+        # timing is the correct behavior for eager torch) — the phase
+        # ordering is the contract: forward closed before backward began
+        fwd = next(e for e in batch.events if e.name == T.FORWARD_TIME)
+        bwd = next(e for e in batch.events if e.name == T.BACKWARD_TIME)
+        assert fwd.cpu_end is not None and fwd.cpu_end <= bwd.cpu_start
+
+
+def test_legacy_layout_full_fit(fake_lightning_path, monkeypatch):
+    """pytorch_lightning-only environment: same contract on the legacy
+    base (the new layout is hidden to force the fallback)."""
+    L = fake_lightning_path
+    import importlib
+
+    real_import = importlib.import_module
+
+    def no_new_layout(name, *a, **kw):
+        if name == "lightning.pytorch":
+            raise ImportError("hidden by test")
+        return real_import(name, *a, **kw)
+
+    monkeypatch.setattr(importlib, "import_module", no_new_layout)
+    import pytorch_lightning as pl
+
+    cb, captured = _fit_and_capture(L, pl.Trainer, steps=4)
+    assert isinstance(cb, pl.Callback)
+    assert type(cb).__mro__[1:3] != (object,)
+    assert len(captured) == 4
+
+
+def test_dual_base_when_both_installed(fake_lightning_path):
+    """Both layouts importable → ONE callback class subclassing both
+    bases, usable with either flavor's Trainer."""
+    L = fake_lightning_path
+    import lightning.pytorch as lp
+    import pytorch_lightning as pl
+
+    cls = L.make_traceml_callback()
+    assert issubclass(cls, lp.Callback) and issubclass(cls, pl.Callback)
+    cb, captured = _fit_and_capture(L, pl.Trainer, steps=3)
+    assert isinstance(cb, lp.Callback) and isinstance(cb, pl.Callback)
+    assert len(captured) == 3

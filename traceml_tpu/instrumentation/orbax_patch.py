@@ -119,18 +119,46 @@ class _PostImportHook:
 _hook: Any = None
 
 
+def arm_post_import_patch(
+    loaded_name: str,
+    spec_name: str,
+    hook_name: str,
+    callback,
+    existing,
+):
+    """Shared now-or-deferred arming logic for module patches.
+
+    ``loaded_name`` in sys.modules → patch immediately via ``callback``
+    (must return truthy on success).  Otherwise, only arm a
+    ``_PostImportHook`` on ``hook_name`` when ``spec_name`` is
+    importable at all (``find_spec`` never executes the module) — a job
+    whose environment can never import the target must not carry a dead
+    meta_path hook for life.  Returns (outcome, hook).
+    """
+    import importlib.util
+    import sys
+
+    if loaded_name in sys.modules:
+        return ("patched" if callback() else "noop"), existing
+    try:
+        if importlib.util.find_spec(spec_name) is None:
+            return "noop", existing
+    except (ImportError, ValueError):
+        return "noop", existing
+    if existing is None:
+        existing = _PostImportHook(hook_name, callback)
+        sys.meta_path.insert(0, existing)
+    return "deferred", existing
+
+
 def install_orbax_patch() -> str:
     """Patch now if orbax is loaded, else arm a post-import hook.
     Returns "patched" | "deferred" | "noop"."""
     global _hook
-    if orbax_loaded():
-        return "patched" if patch_orbax() else "noop"
-    if _hook is None:
-        import sys
-
-        _hook = _PostImportHook("orbax.checkpoint", patch_orbax)
-        sys.meta_path.insert(0, _hook)
-    return "deferred"
+    outcome, _hook = arm_post_import_patch(
+        "orbax.checkpoint", "orbax", "orbax.checkpoint", patch_orbax, _hook
+    )
+    return outcome
 
 
 def remove_orbax_hook() -> None:

@@ -53,30 +53,20 @@ def torch_xla_available() -> bool:
 def install_torch_xla_patch() -> str:
     """Patch now if torch_xla is loaded, else arm a post-import hook
     (the launcher initializes tracing BEFORE the user script imports
-    its stack — same gap the orbax patch closes).
+    its stack — same gap the orbax patch closes; shared arming logic
+    lives next to _PostImportHook).
     Returns "patched" | "deferred" | "noop"."""
     global _hook
-    if torch_xla_loaded():
-        return "patched" if patch_mark_step() else "noop"
-    try:
-        import importlib.util
+    from traceml_tpu.instrumentation.orbax_patch import arm_post_import_patch
 
-        # find_spec never imports/initializes the runtime — it only
-        # answers "could this ever be imported?".  Without it, every
-        # plain-torch job would carry a dead meta_path hook for life
-        # and log a misleading [deferred] patch.
-        if importlib.util.find_spec("torch_xla") is None:
-            return "noop"
-    except (ImportError, ValueError):
-        return "noop"
-    if _hook is None:
-        import sys
-
-        from traceml_tpu.instrumentation.orbax_patch import _PostImportHook
-
-        _hook = _PostImportHook("torch_xla.core.xla_model", patch_mark_step)
-        sys.meta_path.insert(0, _hook)
-    return "deferred"
+    outcome, _hook = arm_post_import_patch(
+        "torch_xla",
+        "torch_xla",
+        "torch_xla.core.xla_model",
+        patch_mark_step,
+        _hook,
+    )
+    return outcome
 
 
 def remove_torch_xla_hook() -> None:

@@ -21,8 +21,10 @@ from typing import Any, Dict, List, Optional
 from traceml_tpu.config.yaml_loader import load_yaml_config
 from traceml_tpu.launcher import manifest as mf
 from traceml_tpu.launcher.process import (
+    SupervisedChild,
     python_argv,
     spawn,
+    spawn_supervised,
     terminate,
     wait_for_ready_file,
 )
@@ -156,21 +158,34 @@ def launch_process(
     base_env = settings_to_env(settings)
 
     # 1. aggregator on the owner node
-    agg_proc = None
+    agg_child: Optional[SupervisedChild] = None
     agg_port = settings.aggregator.port
     telemetry_ok = True
+    crash_logs: List[str] = []
     if owner:
-        agg_proc = spawn(python_argv("traceml_tpu.aggregator.aggregator_main"), env=base_env)
+        agg_child = spawn_supervised(
+            python_argv("traceml_tpu.aggregator.aggregator_main"),
+            label="aggregator",
+            env=base_env,
+        )
         ready = wait_for_ready_file(
             session_dir / "aggregator_ready.json", timeout=30.0
         )
-        if ready is None or agg_proc.poll() is not None:
+        if ready is None or agg_child.poll() is not None:
             telemetry_ok = False
             print("[TraceML] aggregator failed to start; running untraced")
-            mf.update_run_manifest(session_dir, telemetry_status="degraded")
-            if agg_proc is not None:
-                terminate(agg_proc, grace_sec=2)
-                agg_proc = None
+            if agg_child.poll() is not None:
+                log = agg_child.write_crash_log(session_dir)
+                if log is not None:
+                    crash_logs.append(str(log))
+            mf.update_run_manifest(
+                session_dir,
+                telemetry_status="degraded",
+                **({"crash_logs": crash_logs} if crash_logs else {}),
+            )
+            if agg_child is not None:
+                terminate(agg_child.proc, grace_sec=2)
+                agg_child = None
         else:
             agg_port = int(ready["port"])
 
@@ -185,7 +200,7 @@ def launch_process(
     if not telemetry_ok:
         rank_env_base["TRACEML_DISABLE"] = "1"
 
-    procs = []
+    procs: List[SupervisedChild] = []
     world = nprocs * nnodes
     for local_rank in range(nprocs):
         rank = node_rank * nprocs + local_rank
@@ -199,39 +214,97 @@ def launch_process(
                 "NODE_RANK": str(node_rank),
             }
         )
-        procs.append(spawn(python_argv("traceml_tpu.runtime.executor"), env=env))
+        procs.append(
+            spawn_supervised(
+                python_argv("traceml_tpu.runtime.executor"),
+                label=f"rank_{rank}",
+                env=env,
+            )
+        )
     mf.update_run_manifest(session_dir, status=mf.STATUS_RUNNING)
+
+    # signal propagation: SIGTERM to the launcher tears the tree down
+    # exactly like Ctrl-C (children terminated, aggregator finalized,
+    # manifest stamped) instead of orphaning the process groups
+    import signal as _signal
+
+    def _sigterm(_signum, _frame):
+        raise KeyboardInterrupt
+
+    old_sigterm = None
+    try:
+        old_sigterm = _signal.signal(_signal.SIGTERM, _sigterm)
+    except ValueError:
+        pass  # non-main thread (embedding): skip, the embedder owns signals
 
     # 3. supervise
     exit_code = 0
+    launcher_stopped: set = set()  # pids WE terminated (victims, not crashes)
     try:
         while True:
             alive = [p for p in procs if p.poll() is None]
             for p in procs:
-                if p.poll() is not None and p.returncode not in (0, None):
-                    exit_code = p.returncode
-            if owner and agg_proc is not None and agg_proc.poll() is not None:
+                if (
+                    p.poll() is not None
+                    and p.returncode not in (0, None)
+                    and p.proc.pid not in launcher_stopped
+                ):
+                    if exit_code in (0, None):
+                        exit_code = p.returncode
+                    log = p.write_crash_log(session_dir)
+                    if log is not None and str(log) not in crash_logs:
+                        print(
+                            f"[TraceML] {p.label} {p.describe_exit()}; "
+                            f"stderr tail: {log}"
+                        )
+                        crash_logs.append(str(log))
+            if owner and agg_child is not None and agg_child.poll() is not None:
                 # aggregator died mid-run: degrade, keep training
                 print("[TraceML] aggregator exited early; telemetry degraded")
+                log = agg_child.write_crash_log(session_dir)
+                if log is not None:
+                    crash_logs.append(str(log))
                 mf.update_run_manifest(session_dir, telemetry_status="degraded")
-                agg_proc = None
+                agg_child = None
                 telemetry_ok = False
             if not alive:
                 break
             if exit_code not in (0, None):
                 # a rank failed → stop the rest
                 for p in alive:
-                    terminate(p)
+                    launcher_stopped.add(p.proc.pid)
+                    terminate(p.proc)
                 break
             time.sleep(0.2)
     except KeyboardInterrupt:
         exit_code = 130
         for p in procs:
-            terminate(p)
+            launcher_stopped.add(p.proc.pid)
+            terminate(p.proc)
     finally:
-        if owner and agg_proc is not None:
-            # graceful stop: SIGTERM → aggregator finalizes + writes summary
-            terminate(agg_proc, grace_sec=max(10.0, settings.finalize_timeout_sec))
+        # our SIGTERM handler stays installed until the manifest is
+        # stamped: finalization can block for finalize_timeout_sec, and
+        # a SECOND signal there must cut it short (KeyboardInterrupt
+        # caught below), not kill the launcher with status="running"
+        try:
+            if owner and agg_child is not None:
+                # graceful stop: SIGTERM → aggregator finalizes + summary
+                try:
+                    terminate(
+                        agg_child.proc,
+                        grace_sec=max(10.0, settings.finalize_timeout_sec),
+                    )
+                except KeyboardInterrupt:
+                    exit_code = exit_code or 130
+                    terminate(agg_child.proc, grace_sec=2.0)
+            if crash_logs:
+                mf.update_run_manifest(session_dir, crash_logs=crash_logs)
+        finally:
+            if old_sigterm is not None:
+                try:
+                    _signal.signal(_signal.SIGTERM, old_sigterm)
+                except ValueError:
+                    pass
 
     status = mf.STATUS_COMPLETED if exit_code in (0, None) else mf.STATUS_FAILED
     mf.update_run_manifest(session_dir, status=status, exit_code=exit_code or 0)
