@@ -10,12 +10,15 @@ Serves:
 * ``GET /api/live``  — live JSON payload (renderers/web_payload.py, v2:
   the typed views from renderers/views.py serialized verbatim)
 * ``GET /api/summary`` — final_summary.json once it exists
+* ``GET /healthz``   — readiness probe ({"ok": true, session, ts}) —
+  ``wait_until_ready()`` polls it so watchers/tests never race startup
 
 Sections (each with its own staleness badge, computed against the
 server's payload timestamp so client clock skew is irrelevant):
-findings · step time (phase-stack chart + phase table + per-rank
-sparklines) · device memory (per-rank pressure bars + history) ·
-cluster rollup (multi-node) · system nodes · processes · rank-0 output.
+final summary (appears when the run finalizes) · findings · step time
+(phase-stack chart + phase table + per-rank sparklines) · device memory
+(per-rank pressure bars + history) · cluster rollup + per-rank heatmap
+(multi-rank) · system nodes · processes · rank-0 output.
 
 Security: every interpolated value that originates in telemetry
 (hostnames, diagnosis text, phase/rank keys) goes through ``esc()`` —
@@ -68,6 +71,7 @@ svg.spark{width:100%;height:60px;background:#15151f;border-radius:6px}
 </style></head><body>
 <h1>TraceML-TPU — live dashboard</h1>
 <div class="muted" id="meta">connecting…</div>
+<div class="card" id="summary" style="display:none"></div>
 <div id="findings"></div>
 <div class="card"><h2>Step time <span id="st-badge"></span></h2>
   <div id="st-cov" class="muted"></div>
@@ -81,6 +85,9 @@ svg.spark{width:100%;height:60px;background:#15151f;border-radius:6px}
 <div class="card" id="cluster-card" style="display:none">
   <h2>Cluster <span id="cluster-sub" class="muted"></span></h2>
   <div id="cluster"></div></div>
+<div class="card" id="heatmap-card" style="display:none">
+  <h2>Per-rank heatmap <span class="muted">relative to cross-rank median</span></h2>
+  <div id="heatmap"></div></div>
 <div class="card"><h2>System <span id="sys-badge"></span></h2>
   <div id="system"></div></div>
 <div class="card"><h2>Processes <span id="proc-badge"></span></h2>
@@ -234,6 +241,79 @@ function renderSystem(d){
     document.getElementById("cluster").innerHTML=cr+"</table>"
   }else card.style.display="none"}
 
+function heatColor(ratio){
+  // 1.0 = at the cross-rank median (cool); hue walks blue→red as a
+  // rank runs hotter than its peers; capped at 2× for the scale
+  if(ratio==null||isNaN(ratio))return"#2c2c3c";
+  const x=Math.max(0,Math.min(1,(ratio-0.85)/1.15));
+  return`hsl(${(220-220*x).toFixed(0)},65%,${(28+x*14).toFixed(0)}%)`}
+function renderHeatmap(d){
+  const card=document.getElementById("heatmap-card");
+  const el=document.getElementById("heatmap");
+  const ranks={};
+  const st=d.step_time;
+  if(st&&st.step_series)for(const r in st.step_series){
+    const s=st.step_series[r];if(!s.length)continue;
+    const tail=s.slice(-8);
+    (ranks[r]=ranks[r]||{}).step_ms=tail.reduce((a,b)=>a+b,0)/tail.length}
+  if(d.memory&&d.memory.ranks)for(const m of d.memory.ranks)
+    (ranks[m.rank]=ranks[m.rank]||{}).mem_pressure=m.pressure;
+  if(d.process&&d.process.ranks)for(const p of d.process.ranks){
+    (ranks[p.rank]=ranks[p.rank]||{}).cpu_pct=p.cpu_pct;
+    ranks[p.rank].rss=p.rss_bytes}
+  const ids=Object.keys(ranks).sort((a,b)=>a-b);
+  if(ids.length<2){card.style.display="none";return}
+  card.style.display="";
+  const METRICS=["step_ms","mem_pressure","cpu_pct","rss"];
+  const med={};
+  for(const m of METRICS){
+    const vs=ids.map(r=>ranks[r][m]).filter(v=>v!=null).sort((a,b)=>a-b);
+    med[m]=vs.length?vs[Math.floor(vs.length/2)]:null}
+  let html=`<table><tr><th class="num">rank</th>`+
+    METRICS.map(m=>`<th>${esc(m)}</th>`).join("")+`</tr>`;
+  for(const r of ids){
+    html+=`<tr><td class="num">${esc(r)}</td>`;
+    for(const m of METRICS){
+      const v=ranks[r][m];
+      // zero median (e.g. 3 wedged ranks at 0% cpu, 1 spinning) must
+      // still flag the nonzero outlier — treat it as "infinitely hot"
+      const ratio=(v==null||med[m]==null)?null:
+        med[m]>0?v/med[m]:(v>0?2:1);
+      const label=v==null?"—":(m==="rss"?fmtB(v):m==="mem_pressure"?pct(v):
+        m==="cpu_pct"?v.toFixed(0)+"%":fmtMs(v));
+      html+=`<td style="background:${heatColor(ratio)}">${label}
+        ${ratio!=null&&ratio>1.15?`<span class="muted">(${ratio.toFixed(2)}×)</span>`:""}</td>`}
+    html+="</tr>"}
+  el.innerHTML=html+"</table>"}
+
+let summaryLoaded=false,summaryTick=0;
+async function maybeSummary(){
+  if(summaryLoaded||(summaryTick++%5))return;
+  try{
+    const r=await fetch("/api/summary");if(!r.ok)return;
+    const s=await r.json();if(!s||!s.sections)return;
+    summaryLoaded=true;renderSummary(s)
+  }catch(e){}}
+function renderSummary(s){
+  const el=document.getElementById("summary");
+  const p=s.primary_diagnosis||{};
+  const secs=s.sections||{};
+  const chips=Object.keys(secs).map(k=>
+    `<span class="badge">${esc(k)}: ${esc((secs[k]||{}).status||"—")}</span>`).join(" ");
+  const topo=(s.meta||{}).topology||{};
+  const eff=((secs.step_time||{}).global||{}).efficiency;
+  el.style.display="";
+  el.innerHTML=`<h2>Final summary <span class="badge">run finished</span></h2>
+    <div class="finding sev-${esc(p.severity||"info")}">
+      <b>${esc(p.kind||"NO_DATA")}</b>
+      <span class="muted">[${esc(p.severity||"")}]</span><br>${esc(p.summary||"")}
+      ${p.action?`<br><span class="muted">→ ${esc(p.action)}</span>`:""}</div>
+    <div style="margin:.4rem 0">${chips}</div>
+    <div class="muted">world ${esc(topo.world_size!=null?topo.world_size:"?")}
+      · mode ${esc(topo.mode||"?")}
+      ${eff?` · ${Number(eff.achieved_tflops_median).toFixed(1)} TFLOP/s`+
+        (eff.mfu_median!=null?` · MFU ${(eff.mfu_median*100).toFixed(0)}%`:""):""}</div>`}
+
 function renderProcess(d){
   const p=d.process;badge("proc-badge",d.ts,p&&p.latest_ts);
   const el=document.getElementById("process");
@@ -258,15 +338,38 @@ async function tick(){
     `session ${d.session} · updated ${new Date(d.ts*1000).toLocaleTimeString()}`;
   meta.className="muted";
   renderFindings(d);renderStepTime(d);renderMemory(d);
-  renderSystem(d);renderProcess(d);
+  renderSystem(d);renderProcess(d);renderHeatmap(d);
   document.getElementById("stdout").textContent=
     (d.stdout||[]).map(l=>l.line).join("\\n");
+  maybeSummary();
  }catch(e){const meta=document.getElementById("meta");
    meta.textContent="poll failed: "+e;meta.className="err"}
  setTimeout(tick,1000);
 }
 tick();
 </script></body></html>"""
+
+
+def wait_until_ready(
+    host: str, port: int, timeout: float = 10.0
+) -> bool:
+    """Poll the dashboard's ``/healthz`` until it answers — the server
+    readiness probe (reference role: nicegui's startup wait), so
+    watchers, tests, and launch tooling never race the bind."""
+    import time
+    import urllib.request
+
+    deadline = time.monotonic() + timeout
+    url = f"http://{host}:{port}/healthz"
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(url, timeout=1.0) as resp:
+                if resp.status == 200:
+                    return True
+        except OSError:
+            pass
+        time.sleep(0.05)
+    return False
 
 
 class BrowserDisplayDriver(BaseDisplayDriver):
@@ -281,6 +384,10 @@ class BrowserDisplayDriver(BaseDisplayDriver):
         self._db_path: Optional[Path] = None
         self._session = ""
         self._session_dir: Optional[Path] = None
+
+    @property
+    def host(self) -> str:
+        return self._host
 
     def start(self, context: Optional[Any] = None) -> None:
         try:
@@ -305,6 +412,18 @@ class BrowserDisplayDriver(BaseDisplayDriver):
                     try:
                         if self.path == "/" or self.path.startswith("/index"):
                             self._send(200, _PAGE.encode(), "text/html; charset=utf-8")
+                        elif self.path.startswith("/healthz"):
+                            import time as _time
+
+                            self._send(
+                                200,
+                                json.dumps({
+                                    "ok": True,
+                                    "session": driver._session,
+                                    "ts": _time.time(),
+                                }).encode(),
+                                "application/json",
+                            )
                         elif self.path.startswith("/api/live"):
                             from traceml_tpu.renderers.web_payload import (
                                 build_web_payload,

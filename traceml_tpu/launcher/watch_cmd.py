@@ -99,6 +99,13 @@ def _run_watch_browser(session_dir: Path) -> int:
     if driver.port is None:
         print("dashboard failed to start")
         return 1
+    from traceml_tpu.aggregator.display_drivers.browser import wait_until_ready
+
+    # probe the driver's OWN bind host (start() already printed the URL)
+    if not wait_until_ready(driver.host, driver.port, timeout=10.0):
+        print("dashboard bound but never became ready")
+        driver.stop()
+        return 1
     try:
         while True:
             time.sleep(1.0)
