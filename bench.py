@@ -121,7 +121,8 @@ def _watch_stats() -> dict:
     if rows:
         stats["tpu_probe_attempts"] = len(rows)
         stats["tpu_probe_healthy"] = sum(
-            1 for r in rows if r.get("backend") == "tpu" and r.get("physical")
+            1 for r in rows
+            if r.get("backend") not in ("", "cpu", None) and r.get("physical")
         )
     return stats
 
@@ -600,7 +601,9 @@ def main() -> int:
                 f"physical={cached.get('physical')}",
                 file=sys.stderr,
             )
-            if backend == "tpu" and cached.get("physical") is False:
+            # any non-cpu name counts as the device: the tunnel may
+            # register its PJRT platform as "axon" rather than "tpu"
+            if backend not in ("", "cpu") and cached.get("physical") is False:
                 # chip visible but block_until_ready provably not waiting
                 # — a live run would only burn the round's time budget
                 backend = ""

@@ -1,0 +1,217 @@
+"""Per-section payload contracts (VERDICT r3 item 2 "Done =" clause).
+
+Every dashboard section declares the payload paths its JS reads
+(``Section.contract``).  These tests resolve each declared path against
+the TYPED view schema (renderers/views.py dataclasses) or, for the few
+intentionally-untyped blocks (``efficiency``), the producer's literal
+key set — so a payload rename breaks a test here, not the page in a
+user's browser.  A second layer checks the assembled page itself:
+every section's render function is defined and called exactly once per
+tick, and every element id the section JS touches exists in its HTML.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+
+import pytest
+
+from traceml_tpu.aggregator.display_drivers.browser_sections.pages import (
+    ALL_SECTIONS,
+    build_page,
+)
+from traceml_tpu.renderers import views as V
+
+_PAGE = build_page()
+
+# --- schema resolution ----------------------------------------------------
+
+# dict-typed leaves on the views: path segment → how to resolve children
+_EFFICIENCY_KEYS = {
+    "achieved_tflops_by_rank", "achieved_tflops_median", "device_count",
+    "device_kind", "flops_per_step", "flops_source", "mfu_median",
+    "peak_flops", "peak_tflops",
+}
+_ISSUE_KEYS = {"kind", "severity", "summary", "action", "domain"}
+
+_ROOTS = {
+    "ts": None,  # scalar in build_web_payload
+    "step_time": V.StepTimeView,
+    "memory": V.MemoryView,
+    "system": V.SystemView,
+    "process": V.ProcessView,
+    "diagnosis": _ISSUE_KEYS,
+    "findings": _ISSUE_KEYS,
+    "stdout": {"stream", "line"},
+}
+
+# dataclass field name → element dataclass for list/dict-of-dataclass
+_CHILD_TYPES = {
+    ("StepTimeView", "phases"): V.PhaseStat,
+    ("StepTimeView", "coverage"): V.Coverage,
+    ("MemoryView", "ranks"): V.MemoryRankStat,
+    ("SystemView", "nodes"): V.NodeSystemStat,
+    ("SystemView", "rollups"): V.ClusterRollup,
+    ("NodeSystemStat", "devices"): V.DeviceStat,
+    ("ProcessView", "ranks"): V.ProcessRankStat,
+}
+# untyped dict fields: the path may end here but not go deeper, except
+# efficiency whose keys are pinned to the producer's literal set
+_DICT_LEAVES = {"phase_stack", "step_series", "per_rank_avg_ms",
+                "occupancy_by_rank"}
+
+# properties serialized by as_dict() on top of dataclass fields
+_EXTRA_FIELDS = {"SystemView": {"is_cluster"}}
+
+
+def _fields(cls) -> set:
+    names = {f.name for f in dataclasses.fields(cls)}
+    return names | _EXTRA_FIELDS.get(cls.__name__, set())
+
+
+def _resolve(path: str) -> bool:
+    parts = path.split(".")
+    root = _ROOTS.get(parts[0], KeyError)
+    if root is KeyError:
+        return False
+    if root is None or len(parts) == 1:
+        return True
+    node = root
+    for i, seg in enumerate(parts[1:], start=1):
+        if isinstance(node, set):
+            return seg in node and i == len(parts) - 1
+        if not dataclasses.is_dataclass(node):
+            return False
+        if seg == "efficiency" and node is V.StepTimeView:
+            rest = parts[i + 1:]
+            return not rest or (len(rest) == 1 and rest[0] in _EFFICIENCY_KEYS)
+        if seg in _DICT_LEAVES and seg in _fields(node):
+            return i == len(parts) - 1
+        if seg not in _fields(node):
+            return False
+        node = _CHILD_TYPES.get((node.__name__, seg), _leaf_ok(node, seg))
+    return node is not False
+
+
+def _leaf_ok(node, seg):
+    # plain scalar field: valid only as the path's end
+    return True
+
+
+def test_sections_declare_contracts():
+    with_data = [s for s in ALL_SECTIONS if s.id != "summary"]
+    for s in with_data:
+        assert s.contract, f"section {s.id} declares no payload contract"
+
+
+@pytest.mark.parametrize(
+    "section", ALL_SECTIONS, ids=lambda s: s.id
+)
+def test_contract_paths_resolve_in_schema(section):
+    bad = [p for p in section.contract if not _resolve(p)]
+    assert not bad, (
+        f"section {section.id!r} reads payload paths absent from the "
+        f"view schema: {bad}"
+    )
+
+
+# --- page assembly contracts ---------------------------------------------
+
+def test_every_section_render_fn_defined_and_called():
+    for s in ALL_SECTIONS:
+        assert f"function render_{s.id}(" in _PAGE, (
+            f"render_{s.id} missing from page"
+        )
+        if s.js:
+            assert _PAGE.count(f"render_{s.id}(d);") == 1, (
+                f"render_{s.id} must be called exactly once per tick"
+            )
+        else:
+            # js-less sections are driven by another section's render fn
+            # (the gauge rides render_system) — tick() must not also call
+            # them, so the call appears only inside that driving fn
+            tick_body = _PAGE[_PAGE.index("async function tick()"):]
+            assert f"render_{s.id}(d);" not in tick_body
+
+
+@pytest.mark.parametrize(
+    "section", [s for s in ALL_SECTIONS if s.js], ids=lambda s: s.id
+)
+def test_section_js_ids_exist_on_page(section):
+    used = set(re.findall(r'getElementById\("([\w-]+)"\)', section.js))
+    declared = set(re.findall(r'id="([\w-]+)"', _PAGE))
+    # ids built by kpiTile(...) at runtime: kpi-<key>
+    dynamic = {u for u in used if u.startswith("kpi-")}
+    missing = used - declared - dynamic
+    assert not missing, (
+        f"section {section.id!r} JS touches ids with no markup: {missing}"
+    )
+
+
+def test_dynamic_kpi_ids_are_built_by_their_section():
+    # setKpi("x",…) must have a matching kpiTile("x",…) somewhere on the page
+    set_keys = set(re.findall(r'setKpi\("([\w-]+)"', _PAGE))
+    tile_keys = set(re.findall(r'kpiTile\("([\w-]+)"', _PAGE))
+    # keys defined via table-driven tiles: [["median","MEDIAN STEP",…],…]
+    tile_keys |= set(re.findall(r'\["([\w-]+)","[A-Z0-9 %]+",', _PAGE))
+    missing = set_keys - tile_keys
+    assert not missing, f"setKpi targets with no kpiTile: {missing}"
+
+
+# every ${...} interpolation must either call a safe wrapper (escaper /
+# numeric formatter) or be an explicitly vetted local whose construction
+# was itself audited.  New interpolations must pick one — they cannot
+# slip through just because the section calls esc() elsewhere.
+_SAFE_MARKERS = (
+    "esc(", "fmtB(", "fmtMs(", "pct(", "meter(", "kpiTile(", "sparkPath(",
+    "rankColor(", "heatColor(", ".toFixed(", "COLORS[", "SEV[", "Math.",
+)
+# vetted locals: accumulated HTML strings whose every input above was
+# escaped/formatted (audited per section), pure-numeric locals, and
+# JS-literal ternaries
+_STALE_TERNARY = "s.stale?'<span class=\"badge stale\">stale</span>':\"\""
+_VETTED = {
+    # hero-win template is assigned via textContent (inert), fields numeric
+    "hero": {"w>=7?esc(p.key):\"\"", "chips",
+             "st.n_steps", "st.clock", "cov.ranks_present", "cov.world_size"},
+    "step_time": {"h", "bars", "paths", "stepId", "i",
+                  'rankHidden.has(r)?" off":""'},
+    "memory": {"spark", "worst", "hot",
+               "g?(g>0?\"+\":\"-\")+fmtB(Math.abs(g)):\"—\"",
+               _STALE_TERNARY},
+    "system": {"paths", "v", "src", "LEN",
+               _STALE_TERNARY.replace("s.stale", "n.stale")},
+    "process": {"hot", _STALE_TERNARY},
+    "diagnostics": set(),
+    # cluster-sub template is assigned via textContent (inert), numeric
+    "cluster": {"label", "s.nodes.length", "s.expected_nodes",
+                "s.missing_nodes"},
+    "summary": {"chips"},
+    "output": set(),
+    "gauge": set(),
+}
+
+
+@pytest.mark.parametrize(
+    "section", [s for s in ALL_SECTIONS if s.js], ids=lambda s: s.id
+)
+def test_every_interpolation_is_escaped_or_vetted(section):
+    vetted = _VETTED.get(section.id, set())
+    bad = []
+    for m in re.finditer(r"\$\{([^{}]+)\}", section.js):
+        expr = m.group(1).strip()
+        if any(mark in expr for mark in _SAFE_MARKERS):
+            continue
+        if expr in vetted:
+            continue
+        # ternaries whose every branch is a JS string literal are inert
+        if re.fullmatch(r"""[\w.!&|=<>()\s?:"'\-+—%]*""", expr) and (
+            '"' in expr or "'" in expr
+        ) and not re.search(r"\w\s*\.\s*\w+\s*[^(]", expr):
+            continue
+        bad.append(expr)
+    assert not bad, (
+        f"section {section.id!r} interpolates unvetted expressions "
+        f"(wrap in esc()/a formatter, or audit + add to _VETTED): {bad}"
+    )

@@ -107,3 +107,21 @@ def test_sigterm_to_launcher_tears_down_tree(tmp_path):
     assert proc.returncode == 130, (proc.returncode, out[-2000:])
     manifest = json.loads((session / "manifest.json").read_text())
     assert manifest.get("status") == "failed"
+
+
+def test_systemexit_message_reaches_crash_log(tmp_path):
+    """SystemExit("message") must die loudly: the interpreter prints the
+    message to stderr before exiting 1, and the executor must too — a
+    swallowed message left an empty crash_stderr.log (found in r4
+    verification when a demo scenario name was misspelled)."""
+    proc, session = _launch(
+        tmp_path,
+        'raise SystemExit("unknown scenario \'slow_input\'")\n',
+        "sysexit",
+    )
+    crash = session / "rank_0" / "crash_stderr.log"
+    assert crash.exists(), "abnormal exit must leave a crash artifact"
+    text = crash.read_text()
+    assert "unknown scenario" in text, (
+        f"SystemExit message swallowed; crash log was:\n{text}"
+    )

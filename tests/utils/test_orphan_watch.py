@@ -1,0 +1,106 @@
+"""Parent-death watchdog: helpers must not outlive a SIGKILLed launcher.
+
+Round 3 leaked nine aggregator_main processes for hours after their
+test runners died — the watchdog (utils/orphan_watch.py) closes that
+hole.  Tested for real: an intermediate parent spawns a child that arms
+the watch, the parent is SIGKILLed (no signal reaches the child), and
+the child must exit on its own.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+_CHILD = r"""
+import sys, threading
+from traceml_tpu.utils.orphan_watch import arm_parent_death_watch
+evt = threading.Event()
+t = arm_parent_death_watch(evt.set, poll_s=0.1)
+print("armed" if t else "disarmed", flush=True)
+evt.wait(20.0)
+sys.exit(7 if evt.is_set() else 8)
+"""
+
+_PARENT = r"""
+import os, subprocess, sys, time
+child = subprocess.Popen(
+    [sys.executable, "-c", %r],
+    stdout=open(sys.argv[1], "w"), stderr=subprocess.STDOUT,
+)
+print(child.pid, flush=True)
+time.sleep(60)
+""" % _CHILD
+
+
+def _wait_gone(pid: int, timeout: float) -> bool:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            os.kill(pid, 0)
+        except OSError:
+            return True
+        # reap if it's our zombie (it isn't — grandchild), else just poll
+        time.sleep(0.1)
+    return False
+
+
+def _zombie(pid: int) -> bool:
+    try:
+        with open(f"/proc/{pid}/stat") as fh:
+            return fh.read().split(")")[-1].split()[0] == "Z"
+    except OSError:
+        return True
+
+
+def test_child_exits_after_parent_sigkill(tmp_path):
+    out = tmp_path / "child.out"
+    parent = subprocess.Popen(
+        [sys.executable, "-c", _PARENT, str(out)],
+        stdout=subprocess.PIPE, text=True, cwd=os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        ),
+    )
+    try:
+        child_pid = int(parent.stdout.readline().strip())
+        # child prints "armed" once the watchdog thread is running
+        deadline = time.time() + 10
+        while time.time() < deadline and not out.exists():
+            time.sleep(0.05)
+        while time.time() < deadline and "armed" not in out.read_text():
+            time.sleep(0.05)
+        assert "armed" in out.read_text()
+        os.kill(parent.pid, signal.SIGKILL)
+        parent.wait(10)
+        # no signal was ever sent to the grandchild: only the watchdog
+        # can make it exit
+        assert _wait_gone(child_pid, 10.0) or _zombie(child_pid), (
+            "child survived parent SIGKILL"
+        )
+    finally:
+        if parent.poll() is None:
+            parent.kill()
+            parent.wait(5)
+        try:
+            os.kill(child_pid, signal.SIGKILL)
+        except (OSError, UnboundLocalError):
+            pass
+
+
+def test_disarmed_by_env(monkeypatch):
+    from traceml_tpu.utils.orphan_watch import arm_parent_death_watch
+
+    monkeypatch.setenv("TRACEML_NO_PPID_WATCH", "1")
+    assert arm_parent_death_watch(lambda: None) is None
+
+
+def test_armed_returns_thread():
+    from traceml_tpu.utils.orphan_watch import arm_parent_death_watch
+
+    t = arm_parent_death_watch(lambda: None, poll_s=5.0)
+    if os.getppid() <= 1:
+        pytest.skip("already orphaned (container init quirk)")
+    assert t is not None and t.daemon

@@ -30,6 +30,13 @@ def main() -> int:
     signal.signal(signal.SIGTERM, _on_signal)
     signal.signal(signal.SIGINT, _on_signal)
 
+    # a launcher that dies WITHOUT signaling (SIGKILLed test runner)
+    # must not leave this process looping forever — treat parent death
+    # like SIGTERM (finalize + exit); see utils/orphan_watch.py
+    from traceml_tpu.utils.orphan_watch import arm_parent_death_watch
+
+    arm_parent_death_watch(stop_evt.set)
+
     try:
         agg = TraceMLAggregator(settings)
         agg.start()

@@ -1,10 +1,11 @@
-"""Dependency-free browser dashboard
-(reference role: the NiceGUI dashboard, display_drivers/nicegui.py:503 +
-nicegui_sections/ — rebuilt on the stdlib since this image ships no web
-framework; a single HTML page polls ``/api/live`` and renders per-domain
-sections with vanilla JS + inline SVG).
+"""Dependency-free browser dashboard server
+(reference role: the NiceGUI dashboard driver, display_drivers/
+nicegui.py:503 — rebuilt on the stdlib since this image ships no web
+framework).
 
-Serves:
+The PAGE itself is assembled by ``browser_sections/pages.py`` from
+per-domain section modules + a theme layer (reference role:
+nicegui_sections/); this module is only the HTTP server:
 
 * ``GET /``          — the dashboard page (self-contained HTML/JS/CSS)
 * ``GET /api/live``  — live JSON payload (renderers/web_payload.py, v2:
@@ -13,17 +14,10 @@ Serves:
 * ``GET /healthz``   — readiness probe ({"ok": true, session, ts}) —
   ``wait_until_ready()`` polls it so watchers/tests never race startup
 
-Sections (each with its own staleness badge, computed against the
-server's payload timestamp so client clock skew is irrelevant):
-final summary (appears when the run finalizes) · findings · step time
-(phase-stack chart + phase table + per-rank sparklines) · device memory
-(per-rank pressure bars + history) · cluster rollup + per-rank heatmap
-(multi-rank) · system nodes · processes · rank-0 output.
-
 Security: every interpolated value that originates in telemetry
 (hostnames, diagnosis text, phase/rank keys) goes through ``esc()`` —
 the ingest port is unauthenticated, so the page treats all payload
-strings as hostile.
+strings as hostile (enforced by the escape-coverage contract test).
 """
 
 from __future__ import annotations
@@ -38,316 +32,11 @@ from traceml_tpu.aggregator.display_drivers.base import BaseDisplayDriver
 from traceml_tpu.utils.atomic_io import read_json
 from traceml_tpu.utils.error_log import get_error_log
 
-_PAGE = """<!doctype html><html><head><meta charset="utf-8">
-<title>TraceML-TPU live</title>
-<style>
-body{font-family:system-ui,sans-serif;margin:1.5rem auto;max-width:1100px;
-     background:#12121a;color:#e8e8f0;padding:0 1rem}
-h1{font-size:1.2rem} .muted{color:#9a9ab0;font-size:.85rem}
-.card{background:#1c1c28;border-radius:10px;padding:1rem;margin:.8rem 0}
-.card h2{font-size:.95rem;margin:0 0 .5rem 0;display:flex;
-         justify-content:space-between;align-items:center}
-.sev-info{border-left:5px solid #2d7dd2}
-.sev-warning{border-left:5px solid #e67e22}
-.sev-critical{border-left:5px solid #c0392b}
-table{border-collapse:collapse;width:100%;font-size:.88rem}
-th,td{text-align:left;padding:.3rem .55rem;border-bottom:1px solid #2c2c3c}
-td.num,th.num{text-align:right}
-.bar{height:14px;display:inline-block;vertical-align:middle;border-radius:2px}
-.meter{background:#2c2c3c;border-radius:3px;width:120px;height:12px;
-       display:inline-block;vertical-align:middle;overflow:hidden}
-.meter>i{display:block;height:100%;background:#2d7dd2}
-.meter>i.warn{background:#e67e22}.meter>i.crit{background:#c0392b}
-pre{white-space:pre-wrap;font-size:.8rem;color:#b8e0b8;margin:0}
-.err{color:#f0a0a0}
-.badge{font-size:.72rem;border-radius:4px;padding:.1rem .4rem;background:#2c2c3c}
-.badge.stale{background:#6b4e16;color:#ffd27f}
-svg.chart{width:100%;height:110px;background:#15151f;border-radius:6px}
-svg.spark{width:100%;height:60px;background:#15151f;border-radius:6px}
-.legend span{margin-right:.8rem;font-size:.78rem}
-.legend i{display:inline-block;width:10px;height:10px;border-radius:2px;
-          margin-right:.3rem;vertical-align:middle}
-.finding{margin:.3rem 0;padding:.45rem .6rem;border-radius:6px;background:#23232f}
-</style></head><body>
-<h1>TraceML-TPU — live dashboard</h1>
-<div class="muted" id="meta">connecting…</div>
-<div class="card" id="summary" style="display:none"></div>
-<div id="findings"></div>
-<div class="card"><h2>Step time <span id="st-badge"></span></h2>
-  <div id="st-cov" class="muted"></div>
-  <div class="legend" id="st-legend"></div>
-  <svg id="st-stack" class="chart" viewBox="0 0 600 110" preserveAspectRatio="none"></svg>
-  <div id="st-table"></div>
-  <svg id="st-spark" class="spark" viewBox="0 0 600 60" preserveAspectRatio="none"></svg>
-  <div class="muted">per-rank step time (window tail)</div></div>
-<div class="card"><h2>Device memory <span id="mem-badge"></span></h2>
-  <div id="memory"></div></div>
-<div class="card" id="cluster-card" style="display:none">
-  <h2>Cluster <span id="cluster-sub" class="muted"></span></h2>
-  <div id="cluster"></div></div>
-<div class="card" id="heatmap-card" style="display:none">
-  <h2>Per-rank heatmap <span class="muted">relative to cross-rank median</span></h2>
-  <div id="heatmap"></div></div>
-<div class="card"><h2>System <span id="sys-badge"></span></h2>
-  <div id="system"></div></div>
-<div class="card"><h2>Processes <span id="proc-badge"></span></h2>
-  <div id="process"></div></div>
-<div class="card"><h2>Rank 0 output</h2><pre id="stdout"></pre></div>
-<script>
-const COLORS={input:"#e74c3c",h2d:"#e67e22",forward:"#2d7dd2",
-backward:"#2255a4",optimizer:"#7d3dd2",compute:"#2d7dd2",
-compile:"#f1c40f",collective:"#16a085",checkpoint:"#8e5a2b",
-residual:"#95a5a6"};
-// telemetry strings (hostnames, diagnosis text, phase/rank keys) arrive
-// from an unauthenticated ingest port — escape EVERY interpolation.
-const esc=s=>String(s).replace(/[&<>"']/g,
-  c=>({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;","'":"&#39;"}[c]));
-const fmtB=n=>{if(n==null||isNaN(n))return"n/a";
-  const u=["B","KiB","MiB","GiB","TiB"];let i=0;
-  while(n>=1024&&i<u.length-1){n/=1024;i++}return n.toFixed(i?2:0)+" "+u[i]};
-const fmtMs=v=>v==null?"n/a":(v<1?(v*1000).toFixed(0)+" µs":
-  v<1000?v.toFixed(1)+" ms":(v/1000).toFixed(2)+" s");
-const pct=v=>v==null?"—":(v*100).toFixed(1)+"%";
-function badge(el,serverTs,latestTs){
-  const e=document.getElementById(el);if(!e)return;
-  if(latestTs==null){e.innerHTML='<span class="badge">no data</span>';return}
-  const age=serverTs-latestTs;
-  e.innerHTML=age>5?`<span class="badge stale">${age.toFixed(0)}s stale</span>`
-                   :'<span class="badge">live</span>'}
-function meter(frac,warn,crit){
-  if(frac==null)return"—";
-  const cls=frac>=crit?"crit":frac>=warn?"warn":"";
-  const w=Math.min(100,frac*100).toFixed(0);
-  return`<span class="meter"><i class="${cls}" style="width:${w}%"></i></span>
-    <span class="muted">${(frac*100).toFixed(0)}%</span>`}
+from traceml_tpu.aggregator.display_drivers.browser_sections.pages import (
+    build_page,
+)
 
-function renderFindings(d){
-  const el=document.getElementById("findings");
-  const fs=d.findings||[];
-  if(!fs.length){el.innerHTML="";return}
-  el.innerHTML=fs.map(f=>`<div class="finding card sev-${esc(f.severity)}">
-    <b>${esc(f.domain)}/${esc(f.kind)}</b>
-    <span class="muted">[${esc(f.severity)}]</span><br>${esc(f.summary)}
-    ${f.action?`<br><span class="muted">→ ${esc(f.action)}</span>`:""}</div>`).join("")}
-
-function renderStepTime(d){
-  const st=d.step_time;badge("st-badge",d.ts,st&&st.latest_ts);
-  if(!st)return;
-  const cov=st.coverage||{};
-  const eff=st.efficiency;
-  document.getElementById("st-cov").textContent=
-    `${st.n_steps} steps · ${st.clock} clock · `+
-    `${cov.ranks_present}/${cov.world_size} ranks`+
-    (st.median_occupancy!=null?` · chip busy ${(st.median_occupancy*100).toFixed(0)}%`:"")+
-    (eff?` · ${eff.achieved_tflops_median.toFixed(1)} TFLOP/s`+
-      (eff.mfu_median!=null?` (MFU ${(eff.mfu_median*100).toFixed(0)}%)`:""):"")+
-    (cov.incomplete?" · INCOMPLETE":"");
-  // stacked per-step phase chart (cross-rank medians)
-  const stack=st.phase_stack||{};const keys=Object.keys(stack);
-  const n=keys.length?stack[keys[0]].length:0;
-  let maxTot=1;const totals=[];
-  for(let i=0;i<n;i++){let t=0;for(const k of keys)t+=stack[k][i]||0;
-    totals.push(t);maxTot=Math.max(maxTot,t)}
-  let bars="";const bw=600/Math.max(1,n);
-  for(let i=0;i<n;i++){let y=108;
-    for(const k of keys){const h=(stack[k][i]||0)/maxTot*104;y-=h;
-      bars+=`<rect x="${(i*bw).toFixed(1)}" y="${y.toFixed(1)}"
-        width="${Math.max(0.5,bw-0.6).toFixed(1)}" height="${h.toFixed(1)}"
-        fill="${COLORS[k]||"#888"}"><title>step ${esc((st.steps||[])[i])} ${esc(k)} ${fmtMs(stack[k][i])}</title></rect>`}}
-  document.getElementById("st-stack").innerHTML=bars;
-  document.getElementById("st-legend").innerHTML=keys.map(k=>
-    `<span><i style="background:${COLORS[k]||"#888"}"></i>${esc(k)}</span>`).join("");
-  // phase table
-  let rows=`<table><tr><th>phase</th><th class="num">median</th>
-    <th class="num">share</th><th class="num">worst rank</th>
-    <th class="num">skew</th></tr>`;
-  for(const p of st.phases||[]){
-    rows+=`<tr><td>${esc(p.key)}</td><td class="num">${fmtMs(p.median_ms)}</td>
-      <td class="num">${pct(p.share)}</td><td class="num">${esc(p.worst_rank)}</td>
-      <td class="num">${pct(p.skew_pct)}</td></tr>`}
-  document.getElementById("st-table").innerHTML=rows+"</table>";
-  // per-rank sparkline
-  const svg=document.getElementById("st-spark");
-  const series=st.step_series||{};const ranks=Object.keys(series);
-  let max=1;for(const r of ranks)for(const v of series[r])max=Math.max(max,v);
-  let paths="";
-  ranks.forEach((r,ri)=>{const s=series[r];if(!s.length)return;
-    const pts=s.map((v,i)=>`${(i/(s.length-1||1))*600},${58-(v/max)*52}`).join(" ");
-    paths+=`<polyline fill="none" stroke="hsl(${(ri*67)%360},70%,60%)"
-      stroke-width="1.5" points="${pts}"><title>rank ${esc(r)}</title></polyline>`});
-  svg.innerHTML=paths}
-
-function renderMemory(d){
-  const m=d.memory;badge("mem-badge",d.ts,m&&m.latest_ts);
-  const el=document.getElementById("memory");
-  if(!m||!m.ranks||!m.ranks.length){el.innerHTML='<span class="muted">no memory telemetry</span>';return}
-  let rows=`<table><tr><th class="num">rank</th><th>device</th>
-    <th class="num">current</th><th class="num">step peak</th>
-    <th class="num">limit</th><th>pressure</th><th class="num">growth</th><th>history</th></tr>`;
-  for(const s of m.ranks){
-    const hist=s.history||[];const hmax=Math.max(1,...hist);
-    const pts=hist.map((v,i)=>`${(i/(hist.length-1||1))*100},${18-(v/hmax)*16}`).join(" ");
-    const spark=hist.length>1?`<svg width="100" height="18" viewBox="0 0 100 18">
-      <polyline fill="none" stroke="#2d7dd2" stroke-width="1" points="${pts}"/></svg>`:"—";
-    const g=s.growth_bytes;
-    rows+=`<tr><td class="num">${esc(s.rank)}</td><td>${esc(s.device_kind)}</td>
-      <td class="num">${fmtB(s.current_bytes)}</td>
-      <td class="num">${fmtB(s.step_peak_bytes)}</td>
-      <td class="num">${fmtB(s.limit_bytes)}</td>
-      <td>${meter(s.pressure,0.92,0.97)}</td>
-      <td class="num">${g?(g>0?"+":"-")+fmtB(Math.abs(g)):"—"}</td>
-      <td>${spark}</td></tr>`}
-  el.innerHTML=rows+"</table>"}
-
-function renderSystem(d){
-  const s=d.system;badge("sys-badge",d.ts,s&&s.latest_ts);
-  const el=document.getElementById("system");
-  const card=document.getElementById("cluster-card");
-  if(!s||!s.nodes||!s.nodes.length){el.innerHTML='<span class="muted">no system telemetry</span>';
-    card.style.display="none";return}
-  let rows=`<table><tr><th>node</th><th class="num">cpu</th>
-    <th class="num">host mem</th><th class="num">load</th><th></th></tr>`;
-  for(const n of s.nodes){
-    rows+=`<tr><td>${esc(n.hostname)} (#${esc(n.node_rank)})</td>
-      <td class="num">${n.cpu_pct==null?"n/a":n.cpu_pct.toFixed(0)+"%"}</td>
-      <td class="num">${fmtB(n.memory_used_bytes)} / ${fmtB(n.memory_total_bytes)}</td>
-      <td class="num">${n.load_1m==null?"—":n.load_1m.toFixed(1)}</td>
-      <td>${n.stale?'<span class="badge stale">stale</span>':""}</td></tr>`}
-  const devs=[];for(const n of s.nodes)for(const dv of n.devices||[])devs.push([n,dv]);
-  if(devs.length){
-    rows+=`</table><table><tr><th>node</th><th class="num">dev</th><th>kind</th>
-      <th class="num">mem</th><th class="num">util</th><th class="num">temp</th>
-      <th class="num">power</th></tr>`;
-    for(const[n,dv]of devs){
-      rows+=`<tr><td>${esc(n.hostname)}</td><td class="num">${esc(dv.device_id)}</td>
-        <td>${esc(dv.device_kind)}</td>
-        <td class="num">${dv.memory_used_bytes==null?"—":fmtB(dv.memory_used_bytes)+" / "+fmtB(dv.memory_total_bytes)}</td>
-        <td class="num">${dv.utilization_pct==null?"—":dv.utilization_pct.toFixed(0)+"%"}</td>
-        <td class="num">${dv.temperature_c==null?"—":dv.temperature_c.toFixed(0)+"°C"}</td>
-        <td class="num">${dv.power_w==null?"—":dv.power_w.toFixed(0)+"W"}</td></tr>`}}
-  el.innerHTML=rows+"</table>";
-  // cluster rollups (multi-node only)
-  if(s.is_cluster&&(s.rollups||[]).length){
-    card.style.display="";
-    document.getElementById("cluster-sub").textContent=
-      `${s.nodes.length}/${s.expected_nodes} nodes`+
-      (s.missing_nodes?` · ${s.missing_nodes} MISSING`:"");
-    let cr=`<table><tr><th>metric</th><th class="num">min</th>
-      <th class="num">median</th><th class="num">max</th><th>max node</th></tr>`;
-    for(const r of s.rollups){
-      cr+=`<tr><td>${esc(r.metric)}</td><td class="num">${r.min_value.toFixed(1)}</td>
-        <td class="num">${r.median_value.toFixed(1)}</td>
-        <td class="num">${r.max_value.toFixed(1)}</td><td>${esc(r.max_node)}</td></tr>`}
-    document.getElementById("cluster").innerHTML=cr+"</table>"
-  }else card.style.display="none"}
-
-function heatColor(ratio){
-  // 1.0 = at the cross-rank median (cool); hue walks blue→red as a
-  // rank runs hotter than its peers; capped at 2× for the scale
-  if(ratio==null||isNaN(ratio))return"#2c2c3c";
-  const x=Math.max(0,Math.min(1,(ratio-0.85)/1.15));
-  return`hsl(${(220-220*x).toFixed(0)},65%,${(28+x*14).toFixed(0)}%)`}
-function renderHeatmap(d){
-  const card=document.getElementById("heatmap-card");
-  const el=document.getElementById("heatmap");
-  const ranks={};
-  const st=d.step_time;
-  if(st&&st.step_series)for(const r in st.step_series){
-    const s=st.step_series[r];if(!s.length)continue;
-    const tail=s.slice(-8);
-    (ranks[r]=ranks[r]||{}).step_ms=tail.reduce((a,b)=>a+b,0)/tail.length}
-  if(d.memory&&d.memory.ranks)for(const m of d.memory.ranks)
-    (ranks[m.rank]=ranks[m.rank]||{}).mem_pressure=m.pressure;
-  if(d.process&&d.process.ranks)for(const p of d.process.ranks){
-    (ranks[p.rank]=ranks[p.rank]||{}).cpu_pct=p.cpu_pct;
-    ranks[p.rank].rss=p.rss_bytes}
-  const ids=Object.keys(ranks).sort((a,b)=>a-b);
-  if(ids.length<2){card.style.display="none";return}
-  card.style.display="";
-  const METRICS=["step_ms","mem_pressure","cpu_pct","rss"];
-  const med={};
-  for(const m of METRICS){
-    const vs=ids.map(r=>ranks[r][m]).filter(v=>v!=null).sort((a,b)=>a-b);
-    med[m]=vs.length?vs[Math.floor(vs.length/2)]:null}
-  let html=`<table><tr><th class="num">rank</th>`+
-    METRICS.map(m=>`<th>${esc(m)}</th>`).join("")+`</tr>`;
-  for(const r of ids){
-    html+=`<tr><td class="num">${esc(r)}</td>`;
-    for(const m of METRICS){
-      const v=ranks[r][m];
-      // zero median (e.g. 3 wedged ranks at 0% cpu, 1 spinning) must
-      // still flag the nonzero outlier — treat it as "infinitely hot"
-      const ratio=(v==null||med[m]==null)?null:
-        med[m]>0?v/med[m]:(v>0?2:1);
-      const label=v==null?"—":(m==="rss"?fmtB(v):m==="mem_pressure"?pct(v):
-        m==="cpu_pct"?v.toFixed(0)+"%":fmtMs(v));
-      html+=`<td style="background:${heatColor(ratio)}">${label}
-        ${ratio!=null&&ratio>1.15?`<span class="muted">(${ratio.toFixed(2)}×)</span>`:""}</td>`}
-    html+="</tr>"}
-  el.innerHTML=html+"</table>"}
-
-let summaryLoaded=false,summaryTick=0;
-async function maybeSummary(){
-  if(summaryLoaded||(summaryTick++%5))return;
-  try{
-    const r=await fetch("/api/summary");if(!r.ok)return;
-    const s=await r.json();if(!s||!s.sections)return;
-    summaryLoaded=true;renderSummary(s)
-  }catch(e){}}
-function renderSummary(s){
-  const el=document.getElementById("summary");
-  const p=s.primary_diagnosis||{};
-  const secs=s.sections||{};
-  const chips=Object.keys(secs).map(k=>
-    `<span class="badge">${esc(k)}: ${esc((secs[k]||{}).status||"—")}</span>`).join(" ");
-  const topo=(s.meta||{}).topology||{};
-  const eff=((secs.step_time||{}).global||{}).efficiency;
-  el.style.display="";
-  el.innerHTML=`<h2>Final summary <span class="badge">run finished</span></h2>
-    <div class="finding sev-${esc(p.severity||"info")}">
-      <b>${esc(p.kind||"NO_DATA")}</b>
-      <span class="muted">[${esc(p.severity||"")}]</span><br>${esc(p.summary||"")}
-      ${p.action?`<br><span class="muted">→ ${esc(p.action)}</span>`:""}</div>
-    <div style="margin:.4rem 0">${chips}</div>
-    <div class="muted">world ${esc(topo.world_size!=null?topo.world_size:"?")}
-      · mode ${esc(topo.mode||"?")}
-      ${eff?` · ${Number(eff.achieved_tflops_median).toFixed(1)} TFLOP/s`+
-        (eff.mfu_median!=null?` · MFU ${(eff.mfu_median*100).toFixed(0)}%`:""):""}</div>`}
-
-function renderProcess(d){
-  const p=d.process;badge("proc-badge",d.ts,p&&p.latest_ts);
-  const el=document.getElementById("process");
-  if(!p||!p.ranks||!p.ranks.length){el.innerHTML='<span class="muted">no process telemetry</span>';return}
-  let rows=`<table><tr><th class="num">rank</th><th>host</th><th class="num">pid</th>
-    <th class="num">cpu</th><th class="num">rss</th><th class="num">threads</th><th></th></tr>`;
-  for(const s of p.ranks){
-    const hot=s.rank===p.busiest_rank?' style="color:#ffd27f"':"";
-    rows+=`<tr><td class="num">${esc(s.rank)}</td><td>${esc(s.hostname)}</td>
-      <td class="num">${esc(s.pid==null?"—":s.pid)}</td>
-      <td class="num"${hot}>${s.cpu_pct==null?"n/a":s.cpu_pct.toFixed(0)+"%"}</td>
-      <td class="num">${fmtB(s.rss_bytes)}</td>
-      <td class="num">${esc(s.num_threads==null?"—":s.num_threads)}</td>
-      <td>${s.stale?'<span class="badge stale">stale</span>':""}</td></tr>`}
-  el.innerHTML=rows+`</table><div class="muted">total rss ${fmtB(p.total_rss_bytes)}</div>`}
-
-async function tick(){
- try{
-  const r=await fetch("/api/live");const d=await r.json();
-  const meta=document.getElementById("meta");
-  meta.textContent=
-    `session ${d.session} · updated ${new Date(d.ts*1000).toLocaleTimeString()}`;
-  meta.className="muted";
-  renderFindings(d);renderStepTime(d);renderMemory(d);
-  renderSystem(d);renderProcess(d);renderHeatmap(d);
-  document.getElementById("stdout").textContent=
-    (d.stdout||[]).map(l=>l.line).join("\\n");
-  maybeSummary();
- }catch(e){const meta=document.getElementById("meta");
-   meta.textContent="poll failed: "+e;meta.className="err"}
- setTimeout(tick,1000);
-}
-tick();
-</script></body></html>"""
+_PAGE = build_page()
 
 
 def wait_until_ready(

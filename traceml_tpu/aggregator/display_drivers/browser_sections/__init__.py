@@ -1,0 +1,36 @@
+"""Dashboard section system (reference role: display_drivers/
+nicegui_sections/ — per-domain section modules + theme layer, rebuilt
+dependency-free: each section is a Python module contributing a static
+HTML fragment, a JS render function, and a declared payload CONTRACT;
+``pages.py`` assembles them into the single self-contained page the
+stdlib server ships).
+
+A ``Section`` is data, not behavior: the server never executes section
+code per request — assembly happens once at import.  The CONTRACT
+(payload paths the JS reads) is what the payload-to-DOM contract tests
+verify against ``build_web_payload``'s actual output, so a payload
+rename breaks a test, not the page at 2am.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Tuple
+
+
+@dataclass(frozen=True)
+class Section:
+    """One dashboard section: static fragment + render fn + contract."""
+
+    id: str                      # DOM id of the section root
+    title: str                   # card title
+    html: str                    # static HTML fragment (placed by pages)
+    js: str                      # JS: defines render_<id>(d) (d = payload)
+    contract: Tuple[str, ...] = field(default_factory=tuple)
+    # payload paths the JS reads, dot-separated ("step_time.phases");
+    # verified against build_web_payload by the contract tests
+
+
+def render_call(section: Section) -> str:
+    """The JS call pages.py emits for one section per tick."""
+    return f"render_{section.id}(d);"

@@ -1,0 +1,142 @@
+"""Page assembly (reference role: nicegui_sections/pages.py — the
+bento layout that places section cards and wires one update per
+payload).
+
+``build_page()`` stitches the theme CSS, every section's static HTML
+(wrapped in a glass card, laid out step-time-first), the shared JS
+helpers, each section's render function, and one ``tick()`` that polls
+``/api/live`` and fans the payload out to every section — assembled
+once at import, served as a single self-contained page.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from traceml_tpu.aggregator.display_drivers.browser_sections import (
+    Section,
+    render_call,
+)
+from traceml_tpu.aggregator.display_drivers.browser_sections import theme
+from traceml_tpu.aggregator.display_drivers.browser_sections.cluster import (
+    SECTION as CLUSTER,
+)
+from traceml_tpu.aggregator.display_drivers.browser_sections.diagnostics import (
+    SECTION as DIAGNOSTICS,
+)
+from traceml_tpu.aggregator.display_drivers.browser_sections.hero import (
+    SECTION as HERO,
+)
+from traceml_tpu.aggregator.display_drivers.browser_sections.memory import (
+    SECTION as MEMORY,
+)
+from traceml_tpu.aggregator.display_drivers.browser_sections.process import (
+    SECTION as PROCESS,
+)
+from traceml_tpu.aggregator.display_drivers.browser_sections.step_time import (
+    SECTION as STEP_TIME,
+)
+from traceml_tpu.aggregator.display_drivers.browser_sections.summary import (
+    OUTPUT_SECTION as OUTPUT,
+)
+from traceml_tpu.aggregator.display_drivers.browser_sections.summary import (
+    SECTION as SUMMARY,
+)
+from traceml_tpu.aggregator.display_drivers.browser_sections.system import (
+    GAUGE_SECTION as GAUGE,
+)
+from traceml_tpu.aggregator.display_drivers.browser_sections.system import (
+    SECTION as SYSTEM,
+)
+
+#: every section on the page, in render order (contract tests iterate this)
+ALL_SECTIONS: List[Section] = [
+    SUMMARY, HERO, GAUGE, STEP_TIME, DIAGNOSTICS,
+    MEMORY, PROCESS, SYSTEM, CLUSTER, OUTPUT,
+]
+
+_HEADER = """
+<div class="card reveal" style="padding:13px 20px">
+  <div style="display:flex;align-items:center;gap:14px;flex-wrap:wrap">
+    <span class="wm">TraceML<b>-TPU</b></span>
+    <span class="eyebrow">live training</span>
+    <span class="cmeta" id="runctx"></span>
+    <span style="flex:1"></span>
+    <span class="muted" id="meta">connecting…</span>
+    <span class="livedot"></span>
+  </div>
+</div>
+"""
+
+
+def _card(section: Section, reveal: str = "reveal") -> str:
+    return f'<div class="card {reveal}">{section.html}</div>'
+
+
+def _cell(inner: str, flex: str) -> str:
+    return f'<div class="cell" style="flex:{flex}">{inner}</div>'
+
+
+def build_page() -> str:
+    body = [
+        '<div class="wrap">',
+        _HEADER,
+        SUMMARY.html,  # a self-styled card; hidden until the run finalizes
+        '<div class="grid">',
+        _cell(_card(HERO, "reveal d1"), "2.4"),
+        _cell(_card(GAUGE, "reveal d1"), "1"),
+        "</div>",
+        '<div class="grid">',
+        _cell(_card(STEP_TIME, "reveal d2"), "2"),
+        _cell(_card(DIAGNOSTICS, "reveal d2"), "1.3"),
+        "</div>",
+        '<div class="grid">',
+        _cell(_card(MEMORY, "reveal d3"), "1.3"),
+        _cell(_card(PROCESS, "reveal d3"), "1"),
+        "</div>",
+        _card(SYSTEM, "reveal d3"),
+        _card(CLUSTER, "reveal d3"),
+        _card(OUTPUT, "reveal d3"),
+        "</div>",
+        '<div id="tip"></div>',
+    ]
+    # sections with no JS of their own (the gauge) are driven by another
+    # section's render fn — one subscriber per payload, like the ref
+    calls = "".join(render_call(s) for s in ALL_SECTIONS if s.js)
+    scripts = "\n".join(s.js for s in ALL_SECTIONS if s.js)
+    js = f"""
+{theme.HELPERS_JS}
+{scripts}
+function runContext(d){{
+  const bits=[];
+  const st=d.step_time;
+  if(st&&st.coverage&&st.coverage.world_size)
+    bits.push(`world ${{st.coverage.world_size}}`);
+  const s=d.system;
+  if(s&&s.nodes&&s.nodes.length){{
+    const devs=s.nodes.reduce((a,n)=>a+(n.devices||[]).length,0);
+    if(devs)bits.push(`${{devs}} chip${{devs>1?"s":""}}`);
+    bits.push(String(s.nodes[0].hostname).split(".")[0])}}
+  document.getElementById("runctx").textContent=bits.join(" · ")}}
+async function tick(){{
+ try{{
+  const r=await fetch("/api/live");const d=await r.json();
+  const meta=document.getElementById("meta");
+  meta.textContent=
+    `session ${{d.session}} · updated ${{new Date(d.ts*1000).toLocaleTimeString()}}`;
+  meta.className="muted";
+  runContext(d);
+  {calls}
+ }}catch(e){{const meta=document.getElementById("meta");
+   meta.textContent="poll failed: "+e;meta.className="err"}}
+ setTimeout(tick,1000);
+}}
+tick();
+"""
+    return (
+        "<!doctype html><html><head><meta charset=\"utf-8\">\n"
+        "<title>TraceML-TPU live</title>\n"
+        f"{theme.head()}\n</head><body>\n"
+        + "\n".join(body)
+        + f"\n<script>{js}</script></body></html>"
+    )

@@ -34,9 +34,13 @@ def set_step_flops(flops: float, device_kind=None, device_count=None) -> None:
     estimate; use for grad-accum loops (sum the micro-batch dispatches)
     or models traced outside wrap_step_fn.
 
-    Declare the GLOBAL program's FLOPs: when this process drives N
-    addressable chips, the MFU denominator becomes N × chip peak
-    (``device_count`` defaults to ``jax.local_device_count()``)."""
+    Declare the GLOBAL program's FLOPs: the MFU denominator becomes
+    ``device_count`` × chip peak.  ``device_count`` defaults to
+    ``jax.device_count()`` — the GLOBAL chip count, because
+    cost-analysis FLOPs describe the whole pre-partition program; in
+    multi-process SPMD every rank declares the same global FLOPs, so
+    judging against only local chips would inflate MFU by the process
+    count (advisor r3)."""
     from traceml_tpu.sdk.state import get_state
 
     st = get_state()
@@ -57,7 +61,7 @@ def set_step_flops(flops: float, device_kind=None, device_count=None) -> None:
         try:
             import jax
 
-            st.flops_device_count = int(jax.local_device_count())
+            st.flops_device_count = int(jax.device_count())
         except Exception:
             pass
 

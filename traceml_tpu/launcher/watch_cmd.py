@@ -106,9 +106,18 @@ def _run_watch_browser(session_dir: Path) -> int:
         print("dashboard bound but never became ready")
         driver.stop()
         return 1
+    # a test runner (or shell) that dies without ^C must not leave this
+    # server looping forever — round 3 leaked one for 6 hours
+    import threading
+
+    stop_evt = threading.Event()
+    from traceml_tpu.utils.orphan_watch import arm_parent_death_watch
+
+    arm_parent_death_watch(stop_evt.set)
     try:
-        while True:
-            time.sleep(1.0)
+        while not stop_evt.wait(1.0):
+            pass
+        return 0
     except KeyboardInterrupt:
         return 0
     finally:

@@ -47,7 +47,14 @@ def run_user_script(script: str, args: list[str]) -> int:
         code = exc.code
         if code is None:
             return 0
-        return code if isinstance(code, int) else 1
+        if isinstance(code, int):
+            return code
+        # SystemExit("message"): the interpreter would print the message
+        # to stderr before exiting 1 — swallowing it here made such
+        # scripts die silently (empty crash_stderr.log, found in r4
+        # verification)
+        print(code, file=sys.stderr)
+        return 1
     finally:
         sys.argv = old_argv
         if path_added:

@@ -129,27 +129,41 @@ class SystemSampler(BaseSampler):
             get_error_log().warning("system manifest write failed", exc)
 
     def _duty_cycles(self) -> Optional[List[float]]:
-        """Per-chip duty cycle via libtpu monitoring (utils/tpu_metrics);
-        cached unavailability — one failed construction, zero retries."""
+        """Per-chip duty cycle via libtpu monitoring (utils/tpu_metrics).
+
+        Unavailability is latched (``False``) only when CONSTRUCTION
+        fails — SDK absent or a non-tpu backend, conditions that won't
+        change within a run.  Per-read exceptions return None for this
+        sample but keep the reader alive: duty_cycle_by_device is
+        already fail-soft, and one transient jax hiccup must not
+        disable utilization sampling for the rest of the run
+        (advisor r3)."""
         if self._tpu_metrics is False:
             return None
-        try:
-            if self._tpu_metrics is None:
+        if self._tpu_metrics is None:
+            try:
                 from traceml_tpu.utils.step_memory import jax_is_initialized
 
                 if not jax_is_initialized():
                     return None  # stay untried until the user inits jax
                 import jax
 
-                if jax.default_backend() != "tpu":
+                if jax.default_backend() == "cpu":
+                    # cpu is definitively chip-less; any other backend
+                    # name ("tpu", tunneled "axon") gets one
+                    # construction attempt — a wrong one fails below
+                    # and latches there
                     self._tpu_metrics = False
                     return None
                 from traceml_tpu.utils.tpu_metrics import TpuMetricsReader
 
                 self._tpu_metrics = TpuMetricsReader()
+            except Exception:
+                self._tpu_metrics = False
+                return None
+        try:
             return self._tpu_metrics.duty_cycle_by_device()
         except Exception:
-            self._tpu_metrics = False
             return None
 
     def _device_rows(self, ts: float) -> List[Dict[str, Any]]:

@@ -135,7 +135,12 @@ class WrappedStepFn:
             except Exception:
                 st.flops_device_kind = None
             try:
-                st.flops_device_count = int(jax.local_device_count())
+                # GLOBAL device count: cost_analysis() describes the
+                # whole pre-partition SPMD program, so the MFU
+                # denominator must span every chip that executes it —
+                # local_device_count would inflate MFU by the process
+                # count under multi-process meshes (advisor r3)
+                st.flops_device_count = int(jax.device_count())
             except Exception:
                 st.flops_device_count = None
             return flops

@@ -1,0 +1,62 @@
+"""Parent-death watchdog for helper processes.
+
+The aggregator and the ``watch --browser`` server are children of a
+launcher (or of a test runner).  They stop on SIGTERM/SIGINT — but a
+parent that dies WITHOUT signaling (SIGKILLed pytest, crashed driver)
+leaves them orphaned forever: round 3 leaked nine ``aggregator_main``
+processes that ran for hours after their test tmpdirs were deleted.
+
+The watchdog records the parent pid at arm time and polls
+``os.getppid()``; when the process is reparented (to init/subreaper),
+the parent is gone and the run it served is over — the callback fires
+so the helper can shut down cleanly.  Polling (not ``prctl
+PR_SET_PDEATHSIG``) keeps it portable and works when the parent already
+died before arming.
+
+Opt-out via ``TRACEML_NO_PPID_WATCH=1`` for deliberate daemonization
+(e.g. ``nohup traceml watch &`` double-forks through a shell that
+exits immediately — arming there would kill the watcher at startup,
+which is why arming is skipped when the process is ALREADY reparented).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, Optional
+
+_POLL_S = 2.0
+
+
+def arm_parent_death_watch(
+    on_parent_death: Callable[[], None],
+    *,
+    poll_s: float = _POLL_S,
+) -> Optional[threading.Thread]:
+    """Start a daemon thread that fires ``on_parent_death`` once the
+    original parent exits.  Returns the thread, or None when disarmed
+    (opt-out env, or already orphaned at arm time — a deliberately
+    detached daemon must not be killed by its own watchdog)."""
+    if os.environ.get("TRACEML_NO_PPID_WATCH") == "1":
+        return None
+    parent = os.getppid()
+    if parent <= 1:
+        return None  # already reparented: deliberate daemonization
+
+    def _watch() -> None:
+        while True:
+            if os.getppid() != parent:
+                try:
+                    on_parent_death()
+                except Exception:
+                    pass
+                return
+            # Event.wait-free sleep: the thread is daemonic, so process
+            # exit never blocks on it
+            threading.Event().wait(poll_s)
+
+    t = threading.Thread(
+        target=_watch, name="traceml-ppid-watch", daemon=True
+    )
+    t.start()
+    return t
