@@ -159,6 +159,22 @@ def _build_step_time_section(db_path: Path, mode: str, identities=None):
             }
             for r, w in window.rank_windows.items()
         }
+        # uniform cross-rank rollup with median/worst rank attribution
+        # (reference BaseGlobal: sections/step_time/builder.py:92-119)
+        from traceml_tpu.reporting.rollup import build_rollup
+
+        rollup = build_rollup(
+            {
+                key: p["per_rank_avg_ms"]
+                for key, p in phases.items()
+            },
+            window={
+                "kind": "step_window",
+                "alignment": "common_steps",
+                "steps_analyzed": window.n_steps,
+                "end_step": window.steps[-1],
+            },
+        )
         section["global"] = {
             "clock": window.clock,
             "n_steps": window.n_steps,
@@ -166,6 +182,7 @@ def _build_step_time_section(db_path: Path, mode: str, identities=None):
             "ranks": window.ranks,
             "efficiency": efficiency,
             "phases": phases,
+            "rollup": rollup,
             "occupancy_by_rank": {
                 str(r): round(v, 4)
                 for r, v in window.occupancy_by_rank.items()
@@ -220,6 +237,8 @@ def _build_step_memory_section(db_path: Path, identities=None):
             "n_rows": len(rows),
         }
     peaks = [v["step_peak_bytes"] for v in per_rank.values() if v["step_peak_bytes"]]
+    from traceml_tpu.reporting.rollup import build_rollup
+
     rollup = {
         "total_current_bytes": sum(
             v["current_bytes"] or 0 for v in per_rank.values()
@@ -230,6 +249,16 @@ def _build_step_memory_section(db_path: Path, identities=None):
             if len(peaks) > 1 and statistics.median(peaks) > 0
             else None
         ),
+        # uniform median/worst rank attribution (reference BaseGlobal,
+        # sections/step_memory/model.py:395-424)
+        **build_rollup({
+            "step_peak_bytes": {
+                r: v["step_peak_bytes"] for r, v in per_rank.items()
+            },
+            "current_bytes": {
+                r: v["current_bytes"] for r, v in per_rank.items()
+            },
+        }),
     }
     section = {
         "status": "OK",
@@ -334,11 +363,19 @@ def _build_process_section(db_path: Path, identities=None):
     with_cpu = {
         r: v["cpu_pct_mean"] for r, v in per_rank.items() if v["cpu_pct_mean"]
     }
+    from traceml_tpu.reporting.rollup import build_rollup
+
     rollup = {
         "total_rss_bytes": sum(v["rss_bytes"] or 0 for v in per_rank.values()),
         "busiest_rank": max(with_cpu, key=lambda r: with_cpu[r])
         if with_cpu
         else None,
+        **build_rollup({
+            "rss_bytes": {r: v["rss_bytes"] for r, v in per_rank.items()},
+            "cpu_pct_mean": {
+                r: v["cpu_pct_mean"] for r, v in per_rank.items()
+            },
+        }),
     }
     section = {
         "status": "OK",
@@ -401,6 +438,25 @@ def _step_time_card(sec: Dict[str, Any]) -> str:
         )
     per_rank = g.get("per_rank") or {}
     if len(per_rank) > 1:
+        # median/worst value+rank pairs per bucket (reference card's
+        # "Stats"/"Ranks" lines, sections/step_time/builder.py:162-232):
+        # both ends name a concrete rank to look at
+        rollup = g.get("rollup") or {}
+        med, wor = rollup.get("median") or {}, rollup.get("worst") or {}
+        buckets = [k for k in phases if k != STEP_KEY][:4]
+        pairs = []
+        rank_pairs = []
+        for key in [STEP_KEY] + buckets:
+            m, w = med.get(key) or {}, wor.get(key) or {}
+            if m.get("value") is None:
+                continue
+            pairs.append(
+                f"{key} {m['value']:.1f}/{w['value']:.1f}ms"
+            )
+            rank_pairs.append(f"{key} r{m['idx']}/r{w['idx']}")
+        if pairs:
+            out.append("stats (median/worst): " + " | ".join(pairs))
+            out.append("ranks (median/worst): " + " | ".join(rank_pairs))
         out.append("per rank:")
         for rank, info in sorted(per_rank.items(), key=lambda kv: int(kv[0])):
             avg = (info.get("avg_ms") or {}).get(STEP_KEY)
