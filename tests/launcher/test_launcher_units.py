@@ -206,3 +206,150 @@ def test_code_manifest_multiple_dataloaders_not_merged(tmp_path):
     assert len(info["dataloader_args"]) == 2
     # the val loader (default num_workers=0) still flags single-worker
     assert "single_worker_dataloader" in info["input_hints"]
+
+
+# -- per-site AST classification (VERDICT r3 item 8; reference
+#    ast_analysis/visitor.py:498-565) ---------------------------------------
+
+_LOOP_SCRIPT = """
+import torch
+from torch.utils.data import DataLoader, DistributedSampler
+
+sampler = DistributedSampler(ds)
+loader = DataLoader(ds, sampler=sampler)
+model.to("cuda", non_blocking=True)
+for batch in loader:
+    optimizer.zero_grad()
+    loss = model(batch.to("cuda"))
+    loss.backward()
+    optimizer.step()
+    print(loss.item())
+    if step % 100 == 0:
+        torch.save(model.state_dict(), "ckpt.pt")
+x = tensor.item()  # outside any loop: must not count as in_loop
+"""
+
+
+def test_sync_sites_classified_per_site_with_loop_context(tmp_path):
+    script = tmp_path / "loopy.py"
+    script.write_text(_LOOP_SCRIPT)
+    info = analyze_script(script)
+    sites = info["sync_sites"]
+    assert sites["item"]["count"] == 2
+    assert sites["item"]["in_loop"] == 1  # the print(loss.item()) one
+    assert len(sites["item"]["lines"]) == 2
+    assert "host_sync_in_loop" in info["input_hints"]
+
+
+def test_h2d_idioms_and_loop_flags(tmp_path):
+    script = tmp_path / "loopy.py"
+    script.write_text(_LOOP_SCRIPT)
+    info = analyze_script(script)
+    h2d = info["h2d"]
+    assert h2d["to_device"] and h2d["non_blocking"]
+    assert h2d["h2d_in_loop"] == 1  # batch.to inside the loop
+    assert "blocking_h2d" not in info["input_hints"]
+    flags = info["loop_flags"]
+    assert flags["checkpoint_in_loop"] and flags["logging_in_loop"]
+
+
+def test_distributed_sampler_without_set_epoch_flagged(tmp_path):
+    script = tmp_path / "loopy.py"
+    script.write_text(_LOOP_SCRIPT)
+    info = analyze_script(script)
+    assert "distributed_sampler" in info["input_hints"]
+    assert "distributed_sampler_no_set_epoch" in info["input_hints"]
+    fixed = tmp_path / "fixed.py"
+    fixed.write_text(_LOOP_SCRIPT + "\nsampler.set_epoch(0)\n")
+    info2 = analyze_script(fixed)
+    assert "distributed_sampler_no_set_epoch" not in info2["input_hints"]
+
+
+def test_jax_sync_and_device_put_sites(tmp_path):
+    script = tmp_path / "jaxy.py"
+    script.write_text(
+        "import jax\n"
+        "import traceml_tpu\n"
+        "for x in loader:\n"
+        "    with traceml_tpu.trace_step():\n"
+        "        x = jax.device_put(x)\n"
+        "        loss = step(x)\n"
+        "        jax.block_until_ready(loss)\n"
+    )
+    info = analyze_script(script)
+    assert info["sync_sites"]["block_until_ready"]["in_loop"] == 1
+    assert info["h2d"]["device_put_count"] == 1
+    assert info["h2d"]["h2d_in_loop"] == 1
+
+
+def test_non_training_loop_not_counted(tmp_path):
+    script = tmp_path / "plain.py"
+    script.write_text(
+        "for f in files:\n"
+        "    data.append(f.item())\n"  # a loop, but not a TRAINING loop
+    )
+    info = analyze_script(script)
+    assert info["sync_sites"]["item"]["in_loop"] == 0
+    assert "host_sync_in_loop" not in info.get("input_hints", [])
+
+
+def test_maybe_pin_cpu_gating(monkeypatch):
+    """Pinning activates only when opted in AND cores >= local world."""
+    from traceml_tpu.runtime.executor import _maybe_pin_cpu
+
+    monkeypatch.delenv("TRACEML_PIN_RANK_CPUS", raising=False)
+    assert _maybe_pin_cpu() is False  # not opted in
+
+    import os
+
+    before = os.sched_getaffinity(0)
+    try:
+        monkeypatch.setenv("TRACEML_PIN_RANK_CPUS", "1")
+        monkeypatch.setenv("LOCAL_RANK", "0")
+        # more ranks than any host has cores → must refuse to pin
+        monkeypatch.setenv("LOCAL_WORLD_SIZE", str(len(before) + 1))
+        assert _maybe_pin_cpu() is False
+        assert os.sched_getaffinity(0) == before
+
+        monkeypatch.setenv("LOCAL_WORLD_SIZE", "1")
+        assert _maybe_pin_cpu() is True  # 1 rank always fits
+        assert os.sched_getaffinity(0) == before  # all cores → unchanged
+    finally:
+        os.sched_setaffinity(0, before)
+
+
+def test_set_epoch_in_other_module_not_flagged(tmp_path):
+    """DistributedSampler in data.py + set_epoch in train.py (the entry,
+    scanned first) must NOT fabricate the missing-set_epoch hint —
+    extraction is per-file over a BFS, so the fold is unconditional."""
+    from traceml_tpu.launcher.ast_scan import analyze_project
+
+    (tmp_path / "data.py").write_text(
+        "from torch.utils.data import DistributedSampler\n"
+        "def make(ds):\n"
+        "    return DistributedSampler(ds)\n"
+    )
+    (tmp_path / "train.py").write_text(
+        "import data\n"
+        "sampler = data.make(ds)\n"
+        "for epoch in range(3):\n"
+        "    sampler.set_epoch(epoch)\n"
+    )
+    info = analyze_project(tmp_path / "train.py")
+    assert "distributed_sampler" in info["input_hints"]
+    assert "distributed_sampler_no_set_epoch" not in info["input_hints"]
+    assert not any(k.startswith("_") for k in info)  # no state leak
+
+
+def test_blocking_h2d_hint_retracted_by_later_file(tmp_path):
+    from traceml_tpu.launcher.ast_scan import analyze_project
+
+    (tmp_path / "train.py").write_text(
+        "import data\nmodel.to('cuda')\n"
+    )
+    (tmp_path / "data.py").write_text(
+        "batch.to('cuda', non_blocking=True)\n"
+    )
+    info = analyze_project(tmp_path / "train.py")
+    assert info["h2d"]["non_blocking"] is True
+    assert "blocking_h2d" not in info["input_hints"]

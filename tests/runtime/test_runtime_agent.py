@@ -129,3 +129,27 @@ def test_runtime_without_aggregator_never_raises(tmp_path, fresh_state):
         pass
     time.sleep(0.15)
     rt.stop()  # no exception = pass
+
+
+def test_forced_final_memory_sample_bypasses_throttle():
+    """A run shorter than the sampling throttle still records its end
+    state: record(force=True) must emit past the min-interval gate —
+    the shutdown path relies on it so growth (last − first) is never
+    measured over a single row (r4 memory_creep flake fix)."""
+    rows = [
+        [{"device_id": 0, "device_kind": "fake",
+          "current_bytes": 10 * (i + 1), "peak_bytes": 10 * (i + 1),
+          "limit_bytes": 1000}]
+        for i in range(4)
+    ]
+    tracker = StepMemoryTracker(
+        FakeMemoryBackend(rows), min_sample_interval_s=60.0
+    )
+    drain_step_memory_rows()
+    tracker.reset(1)
+    assert tracker.record(1), "first sample must pass the throttle"
+    assert tracker.record(2) == [], "inside throttle window → skipped"
+    forced = tracker.record(2, force=True)
+    assert forced and forced[0]["current_bytes"] > 0
+    emitted = drain_step_memory_rows()
+    assert len(emitted) == 2  # first + forced, the throttled one dropped

@@ -174,6 +174,11 @@ def run_scenario(name: str, steps: int = 80) -> None:
                 x, y = jax.device_put(x), jax.device_put(y)
                 params, opt_state, loss = step(params, opt_state, x, y)
                 leak.append(jnp.ones((256, 1024)) * i)  # 1 MiB/step
+                # realistic step cadence: a compiled CPU step is ~5 ms,
+                # finishing all 80 steps inside the memory tracker's
+                # 0.2 s throttle window — creep needs intermediate
+                # samples, not just the forced end-of-run one
+                time.sleep(0.015)
 
     elif name == "recompile":
         loader = _batches(steps)

@@ -21,6 +21,17 @@ _MAX_MODULES = 24
 _MAX_FILE_BYTES = 512 * 1024
 
 
+#: host-sync leaf attrs — each forces a device→host round trip
+_SYNC_ATTRS = (
+    "item", "cpu", "numpy", "tolist", "block_until_ready", "device_get",
+)
+#: calls that mark a loop as training-like (torch AND jax vocabularies)
+_TRAIN_MARKERS = (
+    "backward", "zero_grad", "step", "apply_gradients", "apply_updates",
+    "trace_step", "train_step",
+)
+
+
 class _ScriptVisitor(ast.NodeVisitor):
     def __init__(self) -> None:
         self.imports: Set[str] = set()        # top-level names
@@ -33,6 +44,49 @@ class _ScriptVisitor(ast.NodeVisitor):
         # call name → list of per-call {kwarg: literal value} (a script
         # may build several DataLoaders with different configs)
         self.call_kwargs: Dict[str, List[Dict[str, Any]]] = {}
+        # per-site classification (reference role: ast_analysis/
+        # visitor.py:498-565 — sync calls, H2D idioms, and loop flags
+        # are classified PER CALL SITE with training-loop context, not
+        # just noted to exist)
+        self.sync_sites: Dict[str, Dict[str, Any]] = {}
+        self.h2d: Dict[str, Any] = {
+            "to_device": False, "non_blocking": False,
+            "device_put_count": 0, "h2d_in_loop": 0,
+        }
+        self.loop_flags: Dict[str, bool] = {}
+        self.distributed_sampler_used = False
+        self.set_epoch_called = False
+        self._loop_stack: List[bool] = []  # is-training per open loop
+
+    # -- loop context ------------------------------------------------
+
+    def _loop_is_training(self, loop: ast.AST) -> bool:
+        for child in ast.walk(loop):
+            if isinstance(child, ast.Call):
+                f = child.func
+                if (
+                    isinstance(f, ast.Attribute)
+                    and f.attr in _TRAIN_MARKERS
+                ):
+                    return True
+                if isinstance(f, ast.Name) and f.id in _TRAIN_MARKERS:
+                    return True
+        return False
+
+    def _in_train_loop(self) -> bool:
+        return any(self._loop_stack)
+
+    def visit_For(self, node: ast.For) -> None:
+        self._loop_stack.append(self._loop_is_training(node))
+        self.generic_visit(node)
+        self._loop_stack.pop()
+
+    visit_AsyncFor = visit_For
+
+    def visit_While(self, node: ast.While) -> None:
+        self._loop_stack.append(self._loop_is_training(node))
+        self.generic_visit(node)
+        self._loop_stack.pop()
 
     _KWARG_TARGETS = (
         "DataLoader",
@@ -80,7 +134,46 @@ class _ScriptVisitor(ast.NodeVisitor):
                     except (ValueError, SyntaxError):
                         kws[kw.arg] = "<dynamic>"
                 self.call_kwargs.setdefault(tail, []).append(kws)
+            self._classify_site(node, tail)
         self.generic_visit(node)
+
+    def _classify_site(self, node: ast.Call, leaf: str) -> None:
+        in_loop = self._in_train_loop()
+        line = getattr(node, "lineno", 0)
+        if leaf in _SYNC_ATTRS:
+            site = self.sync_sites.setdefault(
+                leaf, {"count": 0, "in_loop": 0, "lines": []}
+            )
+            site["count"] += 1
+            site["in_loop"] += int(in_loop)
+            if len(site["lines"]) < 10:
+                site["lines"].append(line)
+        if leaf in ("to", "cuda"):
+            self.h2d["to_device"] = True
+            for kw in node.keywords:
+                if kw.arg == "non_blocking":
+                    try:
+                        if ast.literal_eval(kw.value) is True:
+                            self.h2d["non_blocking"] = True
+                    except (ValueError, SyntaxError):
+                        pass
+            if in_loop:
+                self.h2d["h2d_in_loop"] += 1
+        elif leaf == "device_put":
+            self.h2d["device_put_count"] += 1
+            if in_loop:
+                self.h2d["h2d_in_loop"] += 1
+        if in_loop:
+            if leaf in ("save", "save_checkpoint", "save_pretrained"):
+                self.loop_flags["checkpoint_in_loop"] = True
+            elif leaf in ("eval", "no_grad", "inference_mode"):
+                self.loop_flags["validation_in_loop"] = True
+            elif leaf in ("log", "add_scalar", "print"):
+                self.loop_flags["logging_in_loop"] = True
+        if leaf == "DistributedSampler":
+            self.distributed_sampler_used = True
+        elif leaf == "set_epoch":
+            self.set_epoch_called = True
 
     def visit_Attribute(self, node: ast.Attribute) -> None:
         name = _dotted(node)
@@ -266,6 +359,55 @@ def _extract(v: _ScriptVisitor, out: Dict[str, Any]) -> None:
         if m not in out.setdefault("sync_call_hints", []):
             out["sync_call_hints"].append(m)
 
+    # per-site classification (reference visitor.py:498-565): sync call
+    # counts with training-loop context and line numbers, H2D idioms,
+    # and loop hygiene flags — merged across project files
+    if v.sync_sites:
+        merged = out.setdefault("sync_sites", {})
+        for leaf, site in v.sync_sites.items():
+            dst = merged.setdefault(
+                leaf, {"count": 0, "in_loop": 0, "lines": []}
+            )
+            dst["count"] += site["count"]
+            dst["in_loop"] += site["in_loop"]
+            dst["lines"] = (dst["lines"] + site["lines"])[:10]
+        if any(s["in_loop"] for s in merged.values()):
+            add("input_hints", "host_sync_in_loop")
+    if v.h2d["to_device"] or v.h2d["device_put_count"]:
+        h2d = out.setdefault("h2d", {
+            "to_device": False, "non_blocking": False,
+            "device_put_count": 0, "h2d_in_loop": 0,
+        })
+        h2d["to_device"] = h2d["to_device"] or v.h2d["to_device"]
+        h2d["non_blocking"] = h2d["non_blocking"] or v.h2d["non_blocking"]
+        h2d["device_put_count"] += v.h2d["device_put_count"]
+        h2d["h2d_in_loop"] += v.h2d["h2d_in_loop"]
+        if h2d["to_device"] and not h2d["non_blocking"]:
+            add("input_hints", "blocking_h2d")
+        elif h2d["non_blocking"] and "blocking_h2d" in out["input_hints"]:
+            # an earlier file looked blocking; a later one proved
+            # non_blocking is used — drop the stale hint
+            out["input_hints"].remove("blocking_h2d")
+    if v.loop_flags:
+        out.setdefault("loop_flags", {}).update(v.loop_flags)
+    # fold set_epoch UNCONDITIONALLY: the sampler and its set_epoch
+    # call may live in different project files, and extraction order
+    # is BFS over imports — gating this on the same file using
+    # DistributedSampler would fabricate the missing-set_epoch hint
+    out["set_epoch_called"] = (
+        out.get("set_epoch_called") or v.set_epoch_called
+    )
+    if v.distributed_sampler_used:
+        add("input_hints", "distributed_sampler")
+        out["_sampler_seen"] = True
+    if out.get("_sampler_seen"):
+        if not out["set_epoch_called"]:
+            # same-order shards every epoch — the classic missing
+            # sampler.set_epoch bug the reference flags
+            add("input_hints", "distributed_sampler_no_set_epoch")
+        elif "distributed_sampler_no_set_epoch" in out["input_hints"]:
+            out["input_hints"].remove("distributed_sampler_no_set_epoch")
+
 
 def _empty_manifest(script: Path) -> Dict[str, Any]:
     return {
@@ -290,7 +432,7 @@ def analyze_script(script: Path) -> Dict[str, Any]:
             out["error"] = str(exc)
         return out
     _extract(v, out)
-    return out
+    return {k: val for k, val in out.items() if not k.startswith("_")}
 
 
 def _resolve_local(module: str, roots: List[Path]) -> Optional[Path]:
@@ -355,4 +497,6 @@ def analyze_project(script: Path, max_modules: int = _MAX_MODULES) -> Dict[str, 
     out["local_modules"] = [str(p) for p in scanned if Path(p) != entry]
     if failed:
         out["modules_failed"] = failed
+    # cross-file extraction state (e.g. _sampler_seen) is not manifest
+    out = {k: v for k, v in out.items() if not k.startswith("_")}
     return out

@@ -64,6 +64,38 @@ def run_user_script(script: str, args: list[str]) -> int:
                 pass
 
 
+def _maybe_pin_cpu() -> bool:
+    """Opt-in per-rank CPU pinning (``TRACEML_PIN_RANK_CPUS=1``).
+
+    On hosts with at least one core per local rank, pin this rank to
+    its own core slice so cross-rank wall-clock skew measures the
+    WORKLOAD, not the scheduler — the condition under which
+    COMPUTE_STRAGGLER detection is a counted (non-advisory) quality
+    metric (dev/precision_harness.py; VERDICT r3 item 5a).  No-op when
+    cores < local world size (pinning would serialize ranks worse than
+    timesharing) or on platforms without sched_setaffinity."""
+    if os.environ.get("TRACEML_PIN_RANK_CPUS") != "1":
+        return False
+    if not hasattr(os, "sched_setaffinity"):
+        return False
+    try:
+        local_rank = int(os.environ.get("LOCAL_RANK", 0))
+        local_world = int(os.environ.get("LOCAL_WORLD_SIZE", 1))
+        cores = sorted(os.sched_getaffinity(0))
+        if local_world < 1 or len(cores) < local_world:
+            return False
+        per = len(cores) // local_world
+        mine = cores[local_rank * per:(local_rank + 1) * per]
+        os.sched_setaffinity(0, set(mine))
+        print(
+            f"[TraceML] rank {local_rank} pinned to cpus {mine}",
+            file=sys.stderr,
+        )
+        return True
+    except (OSError, ValueError):
+        return False
+
+
 def main() -> int:
     script = os.environ.get(ENV_SCRIPT)
     raw_args = os.environ.get(ENV_SCRIPT_ARGS, "")
@@ -82,6 +114,7 @@ def main() -> int:
         print("[TraceML] executor: TRACEML_SCRIPT not set", file=sys.stderr)
         return 2
 
+    _maybe_pin_cpu()
     runtime = lifecycle.start_runtime(settings)
     exit_code = 0
     try:

@@ -195,6 +195,15 @@ class TraceMLRuntime:
 
     def _final_drain(self) -> None:
         """Shutdown: drain every sampler, publish leftovers + rank_finished."""
+        try:
+            # force one last memory sample past the tracker's throttle:
+            # a run shorter than the throttle window would otherwise end
+            # with a single row, and growth (last − first) would read 0
+            st = get_state()
+            if st.mem_tracker is not None:
+                st.mem_tracker.record(st.current_step, force=True)
+        except Exception as exc:
+            get_error_log().warning("final memory sample failed", exc)
         for s in self.samplers:
             s.drain()
         if self.publisher is not None:

@@ -307,11 +307,18 @@ class StepMemoryTracker:
             get_error_log().warning("step memory reset failed", exc)
             self._step_start = {}
 
-    def record(self, step: int) -> List[Dict[str, Any]]:
+    def record(self, step: int, *, force: bool = False) -> List[Dict[str, Any]]:
         """Step-end edge; emits rows and returns them (for tests).
-        Skipped (returns []) when inside the sampling throttle window."""
+        Skipped (returns []) when inside the sampling throttle window,
+        unless ``force`` — the shutdown path forces one last sample so
+        a run shorter than the throttle interval still records its end
+        state (a creep diagnosis needs first AND last; r4 fix)."""
         now = time.monotonic()
-        if self._min_interval > 0 and now - self._last_sample_mono < self._min_interval:
+        if (
+            not force
+            and self._min_interval > 0
+            and now - self._last_sample_mono < self._min_interval
+        ):
             return []
         self._last_sample_mono = now
         rows: List[Dict[str, Any]] = []
