@@ -1,0 +1,45 @@
+#!/usr/bin/env bash
+# TPU-pod launch walkthrough (reference role: examples/slurm/launch.sh —
+# the cluster-scheduler launch recipe, here for GCP TPU pod slices).
+#
+# Runs the SAME command on every pod worker via `gcloud ... ssh
+# --worker=all`; worker 0 owns the aggregator, every worker resolves
+# identity from the TPU env (TPU_WORKER_ID / TPU_WORKER_HOSTNAMES).
+# See docs/user_guide/tpu-pods.md for the identity model.
+#
+# Usage:
+#   TPU_NAME=my-v5p-64 ZONE=us-east5-a ./launch_pod.sh train.py
+set -euo pipefail
+
+TPU_NAME="${TPU_NAME:?set TPU_NAME to your TPU VM/slice name}"
+ZONE="${ZONE:?set ZONE, e.g. us-east5-a}"
+SCRIPT="${1:?usage: launch_pod.sh <train.py> [args...]}"
+shift || true
+AGG_PORT="${AGG_PORT:-9911}"
+
+# worker 0's internal address — every rank connects its telemetry here
+WORKER0_ADDR=$(gcloud compute tpus tpu-vm describe "$TPU_NAME" \
+  --zone "$ZONE" \
+  --format='value(networkEndpoints[0].ipAddress)')
+
+echo "worker 0 at ${WORKER0_ADDR}; launching on all workers"
+
+# Every worker runs the same line:
+#  - node-rank comes from the TPU env on each worker;
+#  - worker 0 (node-rank 0) binds the aggregator on $AGG_PORT;
+#  - everyone else connects out to it over DCN.
+gcloud compute tpus tpu-vm ssh "$TPU_NAME" --zone "$ZONE" --worker=all \
+  --command "cd ~/app && \
+    traceml-tpu run \
+      --nnodes \${TPU_WORKER_COUNT:-1} \
+      --node-rank \${TPU_WORKER_ID:-0} \
+      --aggregator-host ${WORKER0_ADDR} \
+      --aggregator-port ${AGG_PORT} \
+      --mode summary \
+      ${SCRIPT} $*"
+
+# Artifacts land on worker 0 under ./traceml_logs/<session>/:
+#   final_summary.json / .txt / .html, telemetry.sqlite, manifests.
+# Pull them back with:
+#   gcloud compute tpus tpu-vm scp --zone "$ZONE" --worker=0 \
+#     "$TPU_NAME":~/app/traceml_logs ./pod_logs --recurse

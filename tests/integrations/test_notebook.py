@@ -36,3 +36,38 @@ def test_quickstart_notebook_executes(tmp_path):
     assert proc.returncode == 0, proc.stderr[-3000:]
     assert "NB-OK" in proc.stdout
     assert "INPUT_BOUND" in proc.stdout  # the designed verdict
+
+
+def test_diagnosis_walkthrough_notebook_executes(tmp_path):
+    """The diagnosis walkthrough runs its full diagnose → fix → compare
+    loop and lands on INPUT_BOUND → IMPROVEMENT (VERDICT r3 item 9)."""
+    import os
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("JAX_PLATFORMS", None)
+    proc = subprocess.run(
+        [sys.executable, "-c", RUNNER.format(
+            repo=str(REPO),
+            nb=str(REPO / "examples" / "diagnosis_walkthrough.ipynb"))],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=str(tmp_path),
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "WALKTHROUGH-OK" in proc.stdout
+    assert "INPUT_BOUND" in proc.stdout
+
+
+def test_ray_example_help_runs_without_ray(tmp_path):
+    """The Ray example's CLI surface works on machines without ray —
+    imports happen after argparse by design."""
+    import os
+
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "examples" / "ray" /
+                             "ray_train_minimal.py"), "--help"],
+        capture_output=True, text=True, timeout=60,
+        env=dict(os.environ), cwd=str(tmp_path),
+    )
+    assert proc.returncode == 0, proc.stderr[-1000:]
+    assert "--num-workers" in proc.stdout
