@@ -1,0 +1,112 @@
+"""Gradient accumulation with a correct MFU declaration
+(reference role: examples/advanced/bert_gradient_accum.py — the
+grad-accum pattern, TPU-first).
+
+Gradient accumulation dispatches N micro-batch programs per optimizer
+step, so the auto cost-analysis of ONE dispatch under-counts the step's
+FLOPs by N×.  Declare the SUM with ``set_step_flops`` — the MFU
+numerator is the whole optimizer step:
+
+    python examples/advanced/grad_accum_mfu.py --accum 4 --steps 40
+
+Works anywhere (CPU backend included); on a TPU host the MFU line in
+the final summary becomes meaningful against the chip's bf16 peak.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import traceml_tpu
+from traceml_tpu.runtime import lifecycle
+from traceml_tpu.runtime.settings import settings_from_env
+
+HIDDEN, BATCH, CLASSES = 512, 32, 10
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--accum", type=int, default=4)
+    parser.add_argument("--steps", type=int, default=40)
+    args = parser.parse_args()
+
+    rng = np.random.default_rng(0)
+    w1 = jnp.asarray(rng.normal(0, 0.02, (HIDDEN, HIDDEN)), jnp.float32)
+    w2 = jnp.asarray(rng.normal(0, 0.02, (HIDDEN, CLASSES)), jnp.float32)
+    params = {"w1": w1, "w2": w2}
+    opt = optax.adamw(1e-3)
+    opt_state = opt.init(params)
+
+    def loss_fn(params, x, y):
+        h = jax.nn.gelu(x @ params["w1"])
+        logits = h @ params["w2"]
+        return -jnp.mean(jnp.sum(
+            jax.nn.one_hot(y, CLASSES) * jax.nn.log_softmax(logits), -1))
+
+    grad_fn = jax.jit(jax.value_and_grad(loss_fn))
+
+    @jax.jit
+    def apply(params, opt_state, grads):
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state
+
+    # One micro-batch's model FLOPs from the lowered program, then the
+    # DECLARED step FLOPs = accum × micro.  (The optimizer-apply
+    # program is a negligible O(params) addition and is intentionally
+    # not counted.)
+    x0 = jnp.zeros((BATCH, HIDDEN))
+    y0 = jnp.zeros((BATCH,), jnp.int32)
+    micro = grad_fn.lower(params, x0, y0).compile().cost_analysis()
+    if isinstance(micro, (list, tuple)):  # older jax returns [dict]
+        micro = micro[0] if micro else {}
+    micro_flops = float((micro or {}).get("flops", 0.0))
+
+    def batches(n):
+        for _ in range(n):
+            yield (
+                rng.normal(size=(BATCH, HIDDEN)).astype(np.float32),
+                rng.integers(0, CLASSES, size=(BATCH,)),
+            )
+
+    settings = settings_from_env()
+    lifecycle.start_aggregator(settings)
+    lifecycle.start_runtime(settings)
+    traceml_tpu.init(mode="manual")
+    if micro_flops:
+        traceml_tpu.set_step_flops(micro_flops * args.accum)
+    try:
+        it = iter(traceml_tpu.wrap_dataloader(batches(args.steps * args.accum)))
+        for _ in range(args.steps):
+            with traceml_tpu.trace_step():
+                grads_sum = None
+                for _ in range(args.accum):
+                    x, y = next(it)
+                    x, y = jax.device_put(x), jax.device_put(y)
+                    loss, grads = grad_fn(params, x, y)
+                    grads_sum = grads if grads_sum is None else jax.tree.map(
+                        jnp.add, grads_sum, grads)
+                grads_mean = jax.tree.map(
+                    lambda g: g / args.accum, grads_sum)
+                params, opt_state = apply(params, opt_state, grads_mean)
+        print(f"done: loss {float(loss):.4f}")
+        summary = traceml_tpu.summary()
+        eff_keys = {
+            k: v for k, v in summary.items()
+            if any(s in k for s in ("flops", "mfu", "tflops", "step_time"))
+        }
+        print("summary keys:", eff_keys or sorted(summary)[:6])
+        print("full efficiency block lands in final_summary.json "
+              "(sections.step_time.global.efficiency)")
+    finally:
+        lifecycle.stop_runtime()
+        lifecycle.stop_aggregator(finalize=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

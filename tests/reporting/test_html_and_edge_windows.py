@@ -105,8 +105,9 @@ def test_html_renders_round2_sections():
         },
     }
     html = render_html_summary(payload)
-    assert "chip busy 83%" in html
-    assert "steady-state median" in html
+    # occupancy + steady state render as KPI tiles now
+    assert "chip busy" in html and ">83<" in html
+    assert "steady state" in html
     assert "Per-rank breakdown" in html
     assert "31%" in html  # memory pressure
     assert "cluster: 2 nodes" in html
@@ -160,3 +161,57 @@ def test_compare_accepts_session_dirs(tmp_path):
         }))
     payload = compare_summaries(tmp_path / "a", tmp_path / "b")
     assert payload["verdict"] in ("REGRESSION", "LIKELY_REGRESSION")
+
+
+def test_html_kpis_rollup_and_efficiency(tmp_path):
+    """r4 additions: MFU/efficiency KPI tiles, the verdict's evidence
+    line, per-section status chips, and the median→worst spread bars
+    from the uniform rollup all render."""
+    payload = {
+        "meta": {"session_id": "k", "topology": {"world_size": 2}},
+        "primary_diagnosis": {
+            "kind": "INPUT_STRAGGLER", "severity": "critical",
+            "summary": "rank 1 lags", "action": "look at rank 1",
+            "ranks": [1],
+            "evidence": {"score": 0.42, "statistic": "median"},
+        },
+        "sections": {
+            "step_time": {
+                "status": "OK", "issues": [],
+                "diagnosis": {"kind": "INPUT_STRAGGLER"},
+                "global": {
+                    "clock": "device", "n_steps": 50,
+                    "phases": {
+                        "step_time": {"median_ms": 100.0, "worst_ms": 160.0,
+                                      "worst_rank": 1, "skew_pct": 0.6,
+                                      "share_of_step": None},
+                        "input": {"median_ms": 20.0, "worst_ms": 80.0,
+                                  "worst_rank": 1, "skew_pct": 3.0,
+                                  "share_of_step": 0.2},
+                    },
+                    "efficiency": {
+                        "flops_per_step": 2.5e12, "flops_source": "manual",
+                        "achieved_tflops_median": 25.0, "mfu_median": 0.41,
+                        "peak_tflops": 459.0, "peak_flops": 4.59e14,
+                        "device_kind": "TPU v5p", "device_count": 4,
+                    },
+                    "rollup": {
+                        "index_by": "global_rank",
+                        "window": {"steps_analyzed": 50},
+                        "average": {"step_time": 110.0, "input": 35.0},
+                        "median": {"step_time": {"value": 100.0, "idx": "0"},
+                                   "input": {"value": 20.0, "idx": "0"}},
+                        "worst": {"step_time": {"value": 160.0, "idx": "1"},
+                                  "input": {"value": 80.0, "idx": "1"}},
+                    },
+                },
+            },
+        },
+    }
+    html = render_html_summary(payload)
+    assert "MFU" in html and ">41<" in html
+    assert "TFLOP/step" in html and "TPU v5p" in html
+    assert "score=0.42" in html and "statistic=median" in html
+    assert "step_time: OK" in html  # status chip
+    assert "Cross-rank spread" in html
+    assert "r0/r1" in html  # rollup median/worst rank pairing

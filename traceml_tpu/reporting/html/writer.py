@@ -1,297 +1,56 @@
-"""Dependency-free self-contained HTML summary
+"""Dependency-free self-contained HTML summary — composition layer
 (reference: src/traceml_ai/reporting/html/ — no JS frameworks, inline
 SVG charts, one file that opens anywhere).
+
+Split of responsibilities mirrors the reference package: `style.py`
+owns chrome + functional colors, `svg.py` the chart builders,
+`sections.py` the per-domain fragments; this module only composes the
+document and writes it atomically.  Public API unchanged:
+``render_html_summary(payload) -> str`` / ``write_html_summary``.
 """
 
 from __future__ import annotations
 
 import html
 from pathlib import Path
-from typing import Any, Dict, List
+from typing import Any, Dict
 
+from traceml_tpu.reporting.html.sections import (
+    build_banner,
+    build_findings,
+    build_process,
+    build_status_chips,
+    build_step_memory,
+    build_step_time,
+    build_system,
+)
+from traceml_tpu.reporting.html.style import CSS
 from traceml_tpu.utils.atomic_io import atomic_write_text
-from traceml_tpu.utils.formatting import fmt_bytes, fmt_ms
-
-_SEV_COLOR = {"critical": "#c0392b", "warning": "#e67e22", "info": "#2d7dd2"}
-
-_CSS = """
-body{font-family:system-ui,-apple-system,sans-serif;margin:2rem auto;
-     max-width:960px;color:#1a1a2e;background:#fafafa;padding:0 1rem}
-h1{font-size:1.4rem} h2{font-size:1.1rem;margin-top:2rem;
-   border-bottom:1px solid #ddd;padding-bottom:.3rem}
-.verdict{border-radius:8px;padding:1rem 1.25rem;color:#fff;margin:1rem 0}
-.verdict small{opacity:.85}
-table{border-collapse:collapse;width:100%;font-size:.9rem}
-th,td{text-align:left;padding:.35rem .6rem;border-bottom:1px solid #eee}
-th{background:#f0f0f5;font-weight:600}
-.bar{height:18px;border-radius:3px;display:inline-block;vertical-align:middle}
-.muted{color:#777;font-size:.85rem}
-code{background:#eee;padding:.05rem .3rem;border-radius:3px}
-"""
-
-_PHASE_COLORS = {
-    "input": "#e74c3c",
-    "h2d": "#e67e22",
-    "forward": "#2d7dd2",
-    "backward": "#2255a4",
-    "optimizer": "#7d3dd2",
-    "compute": "#2d7dd2",
-    "compile": "#f1c40f",
-    "collective": "#16a085",
-    "checkpoint": "#8e5a2b",
-    "residual": "#95a5a6",
-}
 
 
 def _esc(x: Any) -> str:
     return html.escape(str(x))
 
 
-def _phase_bar(phases: Dict[str, Any]) -> str:
-    """One stacked horizontal share bar (inline SVG-ish via divs)."""
-    parts: List[str] = []
-    total = 0.0
-    for key, info in phases.items():
-        if key == "step_time":
-            continue
-        share = info.get("share_of_step")
-        if not share or share <= 0:
-            continue
-        share = min(share, 1.0 - total)
-        total += share
-        color = _PHASE_COLORS.get(key, "#888")
-        parts.append(
-            f'<span class="bar" title="{_esc(key)}: {share * 100:.1f}%" '
-            f'style="width:{share * 100:.2f}%;background:{color}"></span>'
-        )
-    legend = " ".join(
-        f'<span class="muted"><span class="bar" style="width:10px;'
-        f'background:{_PHASE_COLORS.get(k, "#888")}"></span> {_esc(k)}</span>'
-        for k in phases
-        if k != "step_time"
-    )
-    return (
-        f'<div style="width:100%;background:#eee;border-radius:3px">{"".join(parts)}</div>'
-        f"<div>{legend}</div>"
-    )
-
-
-def _step_series_svg(series: Dict[str, Any], width: int = 900, height: int = 120) -> str:
-    """Inline SVG polylines: one line per rank, shared scale."""
-    all_vals = [v for vs in series.values() for v in vs if v is not None]
-    if not all_vals:
-        return ""
-    vmax = max(all_vals) or 1.0
-    lines = []
-    hues = [210, 0, 120, 280, 30, 170, 330, 60]
-    for i, (rank, vs) in enumerate(sorted(series.items(), key=lambda kv: int(kv[0]))):
-        if not vs:
-            continue
-        n = len(vs)
-        pts = " ".join(
-            f"{(j / max(1, n - 1)) * width:.1f},"
-            f"{height - 4 - (v / vmax) * (height - 10):.1f}"
-            for j, v in enumerate(vs)
-        )
-        hue = hues[i % len(hues)]
-        lines.append(
-            f'<polyline fill="none" stroke="hsl({hue},65%,45%)" '
-            f'stroke-width="1.2" points="{pts}"><title>rank {_esc(rank)}'
-            f"</title></polyline>"
-        )
-    legend = " ".join(
-        f'<tspan fill="hsl({hues[i % len(hues)]},65%,45%)">rank {_esc(r)}</tspan>'
-        for i, r in enumerate(sorted(series, key=int))
-    )
-    return (
-        f'<svg viewBox="0 0 {width} {height}" '
-        f'style="width:100%;height:{height}px;background:#f4f4f8;'
-        f'border-radius:6px">{"".join(lines)}'
-        f'<text x="6" y="14" font-size="11">{legend} · max {vmax:.1f} ms</text>'
-        f"</svg>"
-    )
-
-
 def render_html_summary(payload: Dict[str, Any]) -> str:
     meta = payload.get("meta") or {}
-    primary = payload.get("primary_diagnosis") or {}
-    color = _SEV_COLOR.get(primary.get("severity", "info"), "#2d7dd2")
+    topo = meta.get("topology") or {}
     out = [
         "<!doctype html><html><head><meta charset='utf-8'>",
         f"<title>TraceML-TPU — {_esc(meta.get('session_id', 'summary'))}</title>",
-        f"<style>{_CSS}</style></head><body>",
+        f"<style>{CSS}</style></head><body>",
         "<h1>TraceML-TPU — final training summary</h1>",
         f"<p class='muted'>session <code>{_esc(meta.get('session_id'))}</code>"
-        f" · mode {_esc((meta.get('topology') or {}).get('mode'))}"
-        f" · world size {_esc((meta.get('topology') or {}).get('world_size'))}</p>",
-        f"<div class='verdict' style='background:{color}'>"
-        f"<strong>{_esc(primary.get('kind'))}</strong>"
-        f" <small>[{_esc(primary.get('severity'))}]</small><br>"
-        f"{_esc(primary.get('summary', ''))}"
-        + (
-            f"<br><small>→ {_esc(primary.get('action'))}</small>"
-            if primary.get("action")
-            else ""
-        )
-        + "</div>",
+        f" · mode {_esc(topo.get('mode'))}"
+        f" · world size {_esc(topo.get('world_size'))}</p>",
+        build_banner(payload),
+        build_status_chips(payload),
+        build_step_time(payload),
+        build_step_memory(payload),
+        build_system(payload),
+        build_process(payload),
+        build_findings(payload),
     ]
-
-    st = (payload.get("sections") or {}).get("step_time") or {}
-    g = st.get("global") or {}
-    phases = g.get("phases") or {}
-    series = g.get("step_series_ms") or {}
-    if series:
-        out.append("<h2>Step time per step</h2>")
-        out.append(_step_series_svg(series))
-    if phases:
-        out.append("<h2>Step time</h2>")
-        sub = (
-            f"{_esc(g.get('n_steps'))} steps, {_esc(g.get('clock'))} clock"
-        )
-        occ = g.get("median_occupancy")
-        if occ is not None:
-            sub += f", chip busy {occ * 100:.0f}%"
-        steady = g.get("steady_state") or {}
-        if steady.get("median_ms") is not None:
-            sub += f" · steady-state median {fmt_ms(steady['median_ms'])}"
-            infl = steady.get("warmup_inflation_pct")
-            if infl is not None and infl > 0.02:
-                sub += f" (warmup inflated {infl * 100:.0f}%)"
-        out.append(f"<p class='muted'>{sub}</p>")
-        out.append(_phase_bar(phases))
-        out.append(
-            "<table><tr><th>phase</th><th>median</th><th>share</th>"
-            "<th>worst rank</th><th>skew</th></tr>"
-        )
-        for key, info in phases.items():
-            share = info.get("share_of_step")
-            out.append(
-                f"<tr><td>{_esc(key)}</td><td>{fmt_ms(info.get('median_ms'))}</td>"
-                f"<td>{'' if share is None else f'{share * 100:.1f}%'}</td>"
-                f"<td>{_esc(info.get('worst_rank'))}</td>"
-                f"<td>{(info.get('skew_pct') or 0) * 100:.1f}%</td></tr>"
-            )
-        out.append("</table>")
-
-    # per-rank phase matrix (small worlds)
-    rank_cards = g.get("per_rank") or {}
-    if 1 < len(rank_cards) <= 8 and phases:
-        phase_keys = [k for k in phases if k != "step_time"]
-        show_host = any(
-            (c.get("identity") or {}).get("hostname") for c in rank_cards.values()
-        )
-        out.append("<h2>Per-rank breakdown (window avg, ms)</h2><table><tr>"
-                   "<th>rank</th>" + ("<th>host</th>" if show_host else "")
-                   + "<th>step</th>"
-                   + "".join(f"<th>{_esc(k)}</th>" for k in phase_keys)
-                   + "<th>busy</th></tr>")
-        for rank, card in sorted(rank_cards.items(), key=lambda kv: int(kv[0])):
-            avgs = card.get("avg_ms") or {}
-            occ_r = card.get("occupancy")
-            ident = card.get("identity") or {}
-            if show_host:
-                host_cell = (
-                    f"<td>{_esc(ident.get('hostname'))}"
-                    f"#{_esc(ident.get('node_rank'))}</td>"
-                    if ident.get("hostname")
-                    else "<td></td>"
-                )
-            else:
-                host_cell = ""
-            out.append(
-                f"<tr><td>{_esc(rank)}</td>" + host_cell
-                + f"<td>{avgs.get('step_time', 0):.1f}</td>"
-                + "".join(f"<td>{avgs.get(k, 0):.1f}</td>" for k in phase_keys)
-                + f"<td>{'' if occ_r is None else f'{occ_r * 100:.0f}%'}</td></tr>"
-            )
-        out.append("</table>")
-
-    sm = (payload.get("sections") or {}).get("step_memory") or {}
-    per_rank = (sm.get("global") or {}).get("per_rank") or {}
-    if per_rank:
-        out.append("<h2>Device memory</h2><table><tr><th>rank</th>"
-                   "<th>current</th><th>peak</th><th>limit</th>"
-                   "<th>pressure</th><th>growth</th></tr>")
-        for rank, info in sorted(per_rank.items(), key=lambda kv: int(kv[0])):
-            pressure = info.get("pressure")
-            growth = info.get("growth_bytes")
-            out.append(
-                f"<tr><td>{_esc(rank)}</td>"
-                f"<td>{fmt_bytes(info.get('current_bytes'))}</td>"
-                f"<td>{fmt_bytes(info.get('step_peak_bytes'))}</td>"
-                f"<td>{fmt_bytes(info.get('limit_bytes'))}</td>"
-                f"<td>{'' if pressure is None else f'{pressure * 100:.0f}%'}</td>"
-                f"<td>{'' if not growth else ('+' if growth > 0 else '') + fmt_bytes(growth)}</td>"
-                f"</tr>"
-            )
-        out.append("</table>")
-        rollup = (sm.get("global") or {}).get("rollup") or {}
-        if rollup:
-            out.append(
-                f"<p class='muted'>total {fmt_bytes(rollup.get('total_current_bytes'))}"
-                f" · max peak {fmt_bytes(rollup.get('max_peak_bytes'))}</p>"
-            )
-
-    sysg = ((payload.get("sections") or {}).get("system") or {}).get("global") or {}
-    nodes = sysg.get("nodes") or {}
-    if nodes:
-        out.append("<h2>System</h2><table><tr><th>node</th><th>cpu mean/max</th>"
-                   "<th>host mem</th><th>load</th></tr>")
-        def _node_key(kv):
-            try:
-                return (0, int(kv[0]))
-            except (TypeError, ValueError):
-                return (1, kv[0])
-
-        for node, info in sorted(nodes.items(), key=_node_key):
-            cpu_m, cpu_x = info.get("cpu_pct_mean"), info.get("cpu_pct_max")
-            load = info.get("load_1m")
-            out.append(
-                f"<tr><td>{_esc(info.get('hostname'))} (#{_esc(node)})</td>"
-                f"<td>{'' if cpu_m is None else f'{cpu_m:.0f}%'}/"
-                f"{'' if cpu_x is None else f'{cpu_x:.0f}%'}</td>"
-                f"<td>{fmt_bytes(info.get('memory_used_bytes'))} / "
-                f"{fmt_bytes(info.get('memory_total_bytes'))}</td>"
-                f"<td>{'—' if load is None else _esc(load)}</td></tr>"
-            )
-        out.append("</table>")
-        cluster = sysg.get("cluster")
-        if cluster:
-            out.append(
-                f"<p class='muted'>cluster: {cluster['n_nodes']} nodes · host "
-                f"CPU {cluster['cpu_pct_min']:.0f}/"
-                f"{cluster['cpu_pct_median']:.0f}/{cluster['cpu_pct_max']:.0f}% "
-                f"(min/median/max, busiest {_esc(cluster.get('busiest_node'))})</p>"
-            )
-
-    procg = ((payload.get("sections") or {}).get("process") or {}).get("global") or {}
-    pranks = procg.get("per_rank") or {}
-    if pranks:
-        out.append("<h2>Processes</h2><table><tr><th>rank</th><th>pid</th>"
-                   "<th>cpu mean/max</th><th>rss / peak</th><th>threads</th></tr>")
-        for rank, info in sorted(pranks.items(), key=lambda kv: int(kv[0])):
-            cpu_m, cpu_x = info.get("cpu_pct_mean"), info.get("cpu_pct_max")
-            out.append(
-                f"<tr><td>{_esc(rank)}</td><td>{_esc(info.get('pid') or '—')}</td>"
-                f"<td>{'' if cpu_m is None else f'{cpu_m:.0f}%'}/"
-                f"{'' if cpu_x is None else f'{cpu_x:.0f}%'}</td>"
-                f"<td>{fmt_bytes(info.get('rss_bytes'))} / "
-                f"{fmt_bytes(info.get('rss_peak_bytes'))}</td>"
-                f"<td>{_esc(info.get('num_threads') or '—')}</td></tr>"
-            )
-        out.append("</table>")
-
-    out.append("<h2>All findings</h2><table><tr><th>domain</th><th>kind</th>"
-               "<th>severity</th><th>summary</th></tr>")
-    for key, sec in (payload.get("sections") or {}).items():
-        for issue in sec.get("issues") or []:
-            out.append(
-                f"<tr><td>{_esc(key)}</td><td>{_esc(issue.get('kind'))}</td>"
-                f"<td style='color:{_SEV_COLOR.get(issue.get('severity'), '#333')}'>"
-                f"{_esc(issue.get('severity'))}</td>"
-                f"<td>{_esc(issue.get('summary'))}</td></tr>"
-            )
-    out.append("</table>")
     stats = meta.get("telemetry_stats") or {}
     if stats:
         out.append(

@@ -29,6 +29,28 @@ def start_runtime(
         settings = settings or settings_from_env()
         if settings.disabled:
             return NoOpRuntime()
+        if (
+            not settings.aggregator.port
+            and _active_aggregator is not None
+            and getattr(_active_aggregator, "started", False)
+            and getattr(_active_aggregator, "port", None)
+        ):
+            # the symmetric embedding pattern (start_aggregator →
+            # start_runtime, same settings) just works: an in-process
+            # aggregator bound an ephemeral port the caller's settings
+            # can't know yet — wire it automatically
+            import dataclasses
+
+            from traceml_tpu.runtime.settings import AggregatorEndpoint
+
+            settings = dataclasses.replace(
+                settings,
+                aggregator=AggregatorEndpoint(
+                    connect_host=settings.aggregator.connect_host,
+                    bind_host=settings.aggregator.bind_host,
+                    port=int(_active_aggregator.port),
+                ),
+            )
         rt = TraceMLRuntime(settings, identity or resolve_runtime_identity())
         rt.start()
         _active_runtime = rt
@@ -53,16 +75,41 @@ def get_active_runtime():
     return _active_runtime
 
 
+_active_aggregator = None
+
+
 def start_aggregator(settings: Optional[TraceMLSettings] = None):
     """Start an in-process aggregator (the out-of-process entry is
     aggregator/aggregator_main.py).  Returns the aggregator or None."""
+    global _active_aggregator
     try:
         from traceml_tpu.aggregator.trace_aggregator import TraceMLAggregator
 
         settings = settings or settings_from_env()
         agg = TraceMLAggregator(settings)
         agg.start()
+        _active_aggregator = agg
         return agg
     except Exception as exc:
         get_error_log().error("start_aggregator failed", exc)
         return None
+
+
+def stop_aggregator(finalize: bool = True) -> None:
+    """Stop the in-process aggregator started by ``start_aggregator``.
+
+    ``finalize=True`` (default) runs the shutdown under the settings'
+    full finalize budget — settle, SQLite finalize, final-summary
+    artifacts; ``False`` shrinks the budget to ~1 s (best-effort
+    artifacts) for embedders that only wanted live telemetry.  The
+    embedding API's symmetric half: notebooks and examples pair
+    ``start_aggregator``/``stop_aggregator`` like
+    ``start_runtime``/``stop_runtime``."""
+    global _active_aggregator
+    agg = _active_aggregator
+    _active_aggregator = None
+    if agg is not None:
+        try:
+            agg.stop(finalize_timeout=None if finalize else 1.0)
+        except Exception as exc:
+            get_error_log().warning("stop_aggregator failed", exc)
