@@ -151,3 +151,49 @@ def test_window_suffix_alignment():
 def test_diagnose_window_none():
     result = diagnose_window(None, mode="summary")
     assert result.diagnosis.kind == "INSUFFICIENT_STEP_TIME_DATA"
+
+
+# -- evidence-derived confidence (r4) --------------------------------------
+
+def test_confidence_from_formula():
+    from traceml_tpu.diagnostics.common import confidence_from, confidence_label
+
+    # at the bar, full window, single statistic → borderline
+    at_bar = confidence_from(0.30, 0.30)
+    assert 0.5 <= at_bar < 0.60
+    assert confidence_label(at_bar) == "low"
+    # at 2× the bar → high
+    strong = confidence_from(0.60, 0.30)
+    assert strong >= 0.85 and confidence_label(strong) == "high"
+    # thin window scales down; disagreement scales down further
+    assert confidence_from(0.60, 0.30, coverage=0.5) < strong
+    assert confidence_from(0.60, 0.30, agreement=False) < strong
+    # never exceeds 1
+    assert confidence_from(100.0, 0.01) <= 1.0
+    assert confidence_label(None) is None
+
+
+def test_input_bound_confidence_scales_with_margin():
+    def input_issue(input_ms, compute_ms):
+        result = diagnose_rank_rows(
+            {0: _steady_rows(60, 100.0, input_ms=input_ms,
+                             compute_ms=compute_ms)},
+            mode="summary",
+        )
+        return next(i for i in result.issues if i.kind == "INPUT_BOUND")
+
+    weak = input_issue(33.0, 60.0)
+    strong = input_issue(80.0, 15.0)
+    assert weak.confidence is not None and strong.confidence is not None
+    assert strong.confidence > weak.confidence
+    assert strong.to_dict()["confidence_label"] in ("medium", "high")
+
+
+def test_straggler_confidence_carries_agreement():
+    rows = {r: _steady_rows(60, 100.0, compute_ms=95.0) for r in range(3)}
+    rows[3] = _steady_rows(60, 420.0, compute_ms=410.0)
+    diag = diagnose_rank_rows(rows, mode="summary").diagnosis
+    assert diag.kind in ("COMPUTE_STRAGGLER", "STRAGGLER")
+    # a persistent 4× straggler is seen by BOTH statistics → high
+    assert diag.confidence is not None and diag.confidence >= 0.85
+    assert diag.to_dict()["confidence_label"] == "high"

@@ -33,7 +33,8 @@ _EFFICIENCY_KEYS = {
     "device_kind", "flops_per_step", "flops_source", "mfu_median",
     "peak_flops", "peak_tflops",
 }
-_ISSUE_KEYS = {"kind", "severity", "summary", "action", "domain"}
+_ISSUE_KEYS = {"kind", "severity", "summary", "action", "domain",
+               "confidence", "confidence_label"}
 
 _ROOTS = {
     "ts": None,  # scalar in build_web_payload

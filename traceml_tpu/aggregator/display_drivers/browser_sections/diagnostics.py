@@ -32,7 +32,9 @@ function render_diagnostics(d){
     style="background:${SEV[worst.severity]||"#555"}">${esc(worst.severity)}</span>`;
   el.innerHTML=fs.map(f=>`<div class="finding sev-${esc(f.severity)}">
     <b>${esc(f.domain)}/${esc(f.kind)}</b>
-    <span class="muted">[${esc(f.severity)}]</span><br>${esc(f.summary)}
+    <span class="muted">[${esc(f.severity)}]</span>
+    ${f.confidence_label?`<span class="muted">· ${esc(f.confidence_label)} confidence</span>`:""}
+    <br>${esc(f.summary)}
     ${f.action?`<br><span class="muted">→ ${esc(f.action)}</span>`:""}</div>`).join("")}
 """
 
@@ -47,5 +49,6 @@ SECTION = Section(
         "findings.kind",
         "findings.summary",
         "findings.action",
+        "findings.confidence_label",
     ),
 )

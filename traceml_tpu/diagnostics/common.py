@@ -36,10 +36,61 @@ class DiagnosticIssue:
     skew_pct: Optional[float] = None  # cross-rank skew (0..1+)
     ranks: List[int] = dataclasses.field(default_factory=list)
     evidence: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # EVIDENCE-DERIVED confidence (0..1) — from threshold margin,
+    # window coverage, and statistic agreement (confidence_from), not a
+    # per-rule constant (reference carries static confidences;
+    # DIAGNOSIS.md documents our formula).  None = rule predates the
+    # confidence contract or has no meaningful margin.
+    confidence: Optional[float] = None
 
     def to_dict(self) -> Dict[str, Any]:
         d = dataclasses.asdict(self)
+        d["confidence_label"] = confidence_label(self.confidence)
         return d
+
+
+def confidence_label(confidence: Optional[float]) -> Optional[str]:
+    """low / medium / high at the reference's 0.60 / 0.85 breakpoints."""
+    if confidence is None:
+        return None
+    value = float(confidence)
+    if value >= 0.85:
+        return "high"
+    if value >= 0.60:
+        return "medium"
+    return "low"
+
+
+def confidence_from(
+    value: float,
+    warn_threshold: float,
+    *,
+    coverage: float = 1.0,
+    agreement: Optional[bool] = None,
+) -> float:
+    """Evidence-derived confidence for a fired rule.
+
+    Three measurable ingredients, multiplied:
+
+    * **margin** — how far past the warn threshold the statistic landed:
+      at the bar → 0.55, at 2× the bar → ~0.9, asymptote 1.0.  A verdict
+      that barely fired is a verdict that barely fired.
+    * **coverage** — window fullness vs what the policy wanted (0..1):
+      a half-full window scales confidence toward 0.75 (never below —
+      the rule DID meet its minimum to fire at all).
+    * **agreement** — for dual-statistic rules: True (both the median
+      and mean pipelines fired) keeps full confidence; False (only one)
+      scales by 0.85; None (single-statistic rule) is neutral.
+    """
+    if warn_threshold <= 0:
+        margin_conf = 0.75
+    else:
+        ratio = max(0.0, value / warn_threshold - 1.0)
+        margin_conf = 0.55 + 0.45 * min(1.0, ratio)
+    cov = min(1.0, max(0.0, coverage))
+    cov_conf = 0.75 + 0.25 * cov
+    agree_conf = 1.0 if agreement in (True, None) else 0.85
+    return round(min(1.0, margin_conf * cov_conf * agree_conf), 3)
 
 
 def healthy_issue(domain: str, summary: str = "") -> DiagnosticIssue:

@@ -21,6 +21,7 @@ from traceml_tpu.diagnostics.common import (
     SEVERITY_CRITICAL,
     SEVERITY_WARNING,
     DiagnosticIssue,
+    confidence_from,
 )
 from traceml_tpu.diagnostics.step_memory.policy import DEFAULT_POLICY, StepMemoryPolicy
 from traceml_tpu.utils.formatting import fmt_bytes
@@ -95,6 +96,9 @@ class HighPressureRule:
                     metric="memory_pressure",
                     score=pressure,
                     share_pct=pressure,
+                    # pressure is a DIRECT capacity read, not a
+                    # statistic over a window — margin alone drives it
+                    confidence=confidence_from(pressure, p.pressure_warn),
                     ranks=[rank],
                     evidence={"device_id": dev},
                 )
@@ -151,6 +155,7 @@ class ImbalanceRule:
                 metric="memory_skew",
                 score=skew,
                 skew_pct=skew,
+                confidence=confidence_from(skew, p.imbalance_warn),
                 ranks=[worst_rank],
                 evidence={"per_rank_bytes": {str(r): v for r, v in per_rank.items()}},
             )
@@ -239,7 +244,10 @@ _CREEP_ACTION = (
 )
 
 
-def _creep_issue(c: _CreepEvidence, kind: str, severity: str) -> DiagnosticIssue:
+def _creep_issue(
+    c: _CreepEvidence, kind: str, severity: str,
+    growth_warn: float = DEFAULT_POLICY.creep_min_growth_pct,
+) -> DiagnosticIssue:
     scope = "cluster-wide (median rank is growing too)" if c.cluster_wide else (
         f"rank-local (rank {c.rank} only)"
     )
@@ -259,6 +267,13 @@ def _creep_issue(c: _CreepEvidence, kind: str, severity: str) -> DiagnosticIssue
         action=_CREEP_ACTION,
         metric="memory_creep",
         score=c.banded.growth_pct,
+        # CONFIRMED required two independent trend engines to agree
+        # plus monotone bands — that IS the agreement signal; EARLY
+        # passed the screen only
+        confidence=confidence_from(
+            c.banded.growth_pct, growth_warn,
+            agreement=(kind == "MEMORY_CREEP_CONFIRMED"),
+        ),
         ranks=[c.rank],
         evidence={
             "device_id": c.dev,
@@ -275,7 +290,8 @@ class CreepEarlyRule:
 
     def evaluate(self, ctx: MemoryContext) -> List[DiagnosticIssue]:
         return [
-            _creep_issue(c, "MEMORY_CREEP_EARLY", SEVERITY_WARNING)
+            _creep_issue(c, "MEMORY_CREEP_EARLY", SEVERITY_WARNING,
+                         ctx.policy.creep_min_growth_pct)
             for c in _collect_creep_evidence(ctx)
             if not c.confirmed
         ]
@@ -287,7 +303,8 @@ class CreepConfirmedRule:
 
     def evaluate(self, ctx: MemoryContext) -> List[DiagnosticIssue]:
         return [
-            _creep_issue(c, "MEMORY_CREEP_CONFIRMED", SEVERITY_CRITICAL)
+            _creep_issue(c, "MEMORY_CREEP_CONFIRMED", SEVERITY_CRITICAL,
+                         ctx.policy.creep_min_growth_pct)
             for c in _collect_creep_evidence(ctx)
             if c.confirmed
         ]

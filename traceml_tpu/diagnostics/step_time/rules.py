@@ -41,6 +41,7 @@ from traceml_tpu.diagnostics.common import (
     SEVERITY_INFO,
     SEVERITY_WARNING,
     DiagnosticIssue,
+    confidence_from,
 )
 from traceml_tpu.diagnostics.step_time.policy import StepTimePolicy
 from traceml_tpu.utils.step_time_window import RESIDUAL_KEY, STEP_KEY, StepTimeWindow
@@ -77,6 +78,13 @@ def build_context(window: StepTimeWindow, policy: StepTimePolicy,
 
 def _enough_data(ctx: _Ctx) -> bool:
     return ctx.window is not None and ctx.window.n_steps >= ctx.policy.min_steps
+
+
+def _coverage(ctx: _Ctx) -> float:
+    """Window fullness vs 2× the policy minimum (a window at the bare
+    minimum fired legitimately but with less evidence than a full one)."""
+    want = max(1, 2 * ctx.policy.min_steps)
+    return min(1.0, ctx.window.n_steps / want)
 
 
 class InputBoundRule:
@@ -144,6 +152,9 @@ class InputBoundRule:
                 phase="input",
                 score=share,
                 share_pct=share,
+                confidence=confidence_from(
+                    share, p.input_share_warn, coverage=_coverage(ctx)
+                ),
                 ranks=list(ctx.window.ranks),
                 evidence={
                     "input_median_ms": m.median_ms,
@@ -226,6 +237,11 @@ class CleanStragglerRule:
         )
         if score < p.straggler_score_fire:
             return []
+        # statistic agreement: did BOTH per-rank statistics clear the
+        # bar, or only the winner?  (confidence ingredient)
+        both_fired = all(
+            c[0] >= p.straggler_score_fire for c, _ in candidates
+        ) and len(candidates) == 2
 
         # Component attribution on the worst rank: per-phase delta vs the
         # cross-rank median, with the sync phase replaced by its clean
@@ -271,6 +287,10 @@ class CleanStragglerRule:
                 phase=dominant_phase,
                 score=score,
                 skew_pct=score,
+                confidence=confidence_from(
+                    score, p.straggler_score_fire,
+                    coverage=_coverage(ctx), agreement=both_fired,
+                ),
                 ranks=[worst_rank],
                 evidence={
                     "clean_step_ms": {str(r): v for r, v in clean_step.items()},
@@ -322,6 +342,9 @@ class ResidualHeavyRule:
                 phase=RESIDUAL_KEY,
                 score=share,
                 share_pct=share,
+                confidence=confidence_from(
+                    share, p.residual_share_warn, coverage=_coverage(ctx)
+                ),
                 ranks=list(ctx.window.ranks),
             )
         ]
@@ -423,6 +446,9 @@ class CompileBoundRule:
                 phase="compile",
                 score=share,
                 share_pct=share,
+                confidence=confidence_from(
+                    share, p.compile_share_warn, coverage=_coverage(ctx)
+                ),
                 ranks=list(w.ranks),
                 evidence={"compile_steps": n_compile_steps},
             )
@@ -471,6 +497,12 @@ class LowDeviceOccupancyRule:
                 metric="device_occupancy",
                 score=1.0 - occ,
                 share_pct=occ,
+                # inverted threshold (fires BELOW the bar): the margin
+                # ratio is warn/occ − 1, so feed (warn, occ) in
+                confidence=confidence_from(
+                    ctx.policy.occupancy_warn, max(occ, 1e-6),
+                    coverage=_coverage(ctx),
+                ),
                 ranks=[worst_rank],
                 evidence={
                     "occupancy_by_rank": {

@@ -30,11 +30,17 @@ def _computer_for(db_path: Path, window_steps: int) -> LiveComputer:
 
 
 def _issue_dict(issue: Any) -> Dict[str, Any]:
+    from traceml_tpu.diagnostics.common import confidence_label
+
     return {
         "kind": issue.kind,
         "severity": issue.severity,
         "summary": issue.summary,
         "action": issue.action,
+        "confidence": getattr(issue, "confidence", None),
+        "confidence_label": confidence_label(
+            getattr(issue, "confidence", None)
+        ),
     }
 
 

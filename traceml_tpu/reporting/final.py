@@ -583,7 +583,12 @@ def render_text_summary(payload: Dict[str, Any]) -> str:
         f"mode: {meta.get('topology', {}).get('mode', '?')}",
         "",
         f"VERDICT [{str(primary.get('severity', 'info')).upper()}] "
-        f"{primary.get('kind', 'UNKNOWN')}",
+        f"{primary.get('kind', 'UNKNOWN')}"
+        + (
+            f"  ({primary['confidence_label']} confidence)"
+            if primary.get("confidence_label")
+            else ""
+        ),
     ]
     if primary.get("summary"):
         lines.append(primary["summary"])
