@@ -13,6 +13,7 @@ import statistics
 from typing import Any, Dict, List, Mapping, Sequence
 
 from traceml_tpu.diagnostics.common import (
+    confidence_from,
     SEVERITY_CRITICAL,
     SEVERITY_WARNING,
     DiagnosticIssue,
@@ -128,6 +129,7 @@ class RankDeviceMemoryImbalanceRule:
                 action="Check sharding spec symmetry and rank-0-only buffers.",
                 metric="process_device_mem_skew",
                 score=skew,
+                confidence=confidence_from(skew, p.device_mem_skew_warn),
                 skew_pct=skew,
                 ranks=[worst],
             )

@@ -13,6 +13,7 @@ import statistics
 from typing import Any, Dict, List, Mapping, Sequence
 
 from traceml_tpu.diagnostics.common import (
+    confidence_from,
     SEVERITY_CRITICAL,
     SEVERITY_INFO,
     SEVERITY_WARNING,
@@ -92,6 +93,7 @@ class HighHostCPURule:
                     ),
                     metric="host_cpu_pct",
                     score=cpu / 100.0,
+                    confidence=confidence_from(cpu, p.host_cpu_warn),
                     ranks=[node],
                 )
             )
@@ -131,6 +133,7 @@ class HighHostMemoryRule:
                     metric="host_mem_pct",
                     score=frac,
                     share_pct=frac,
+                    confidence=confidence_from(frac, p.host_mem_warn),
                     ranks=[node],
                 )
             )
@@ -171,6 +174,7 @@ class HighDeviceMemoryRule:
                     metric="device_mem_pct",
                     score=frac,
                     share_pct=frac,
+                    confidence=confidence_from(frac, p.device_mem_warn),
                     ranks=[node],
                     evidence={"device_id": dev},
                 )

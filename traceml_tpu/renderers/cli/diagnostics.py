@@ -29,6 +29,8 @@ def diagnostics_panel(payload: Dict[str, Any]) -> Panel:
         return Panel(
             Text("no active findings", style="dim green"), title="diagnostics"
         )
+    from traceml_tpu.diagnostics.common import confidence_label
+
     text = Text()
     for issue in composed.issues[:6]:
         domain = issue.evidence.get("domain", "?")
@@ -36,5 +38,9 @@ def diagnostics_panel(payload: Dict[str, Any]) -> Panel:
             f"[{issue.severity:>8}] {domain}/{issue.kind}: ",
             style=_SEV_STYLE.get(issue.severity, "white"),
         )
-        text.append(issue.summary + "\n")
+        text.append(issue.summary)
+        label = confidence_label(getattr(issue, "confidence", None))
+        if label:
+            text.append(f"  ({label} confidence)", style="dim")
+        text.append("\n")
     return Panel(text, title="diagnostics")
