@@ -197,3 +197,28 @@ def test_straggler_confidence_carries_agreement():
     # a persistent 4× straggler is seen by BOTH statistics → high
     assert diag.confidence is not None and diag.confidence >= 0.85
     assert diag.to_dict()["confidence_label"] == "high"
+
+
+def test_symptom_never_outranks_its_cause():
+    """LOW_DEVICE_UTILIZATION (symptom) must not beat a same-severity
+    INPUT_BOUND (cause) in the severity→score sort, even when
+    1 − occupancy is numerically larger than the input share (found in
+    r4: a long input_bound run promoted the symptom)."""
+    # heavy input, almost no device work → occupancy ~2%, input ~83%
+    rows = {0: _steady_rows(60, 72.0, input_ms=60.0, compute_ms=1.4)}
+    result = diagnose_rank_rows(rows, mode="summary")
+    kinds = [i.kind for i in result.issues]
+    assert "INPUT_BOUND" in kinds and "LOW_DEVICE_UTILIZATION" in kinds
+    assert result.diagnosis.kind == "INPUT_BOUND"
+    occ = next(i for i in result.issues
+               if i.kind == "LOW_DEVICE_UTILIZATION")
+    assert occ.evidence.get("explained_by") == "INPUT_BOUND"
+
+
+def test_symptom_stands_alone_when_no_cause_fired():
+    # low occupancy with NO dominant phase: nothing explains it →
+    # the symptom keeps its own rank
+    rows = {0: _steady_rows(60, 100.0, input_ms=10.0, compute_ms=9.0)}
+    result = diagnose_rank_rows(rows, mode="summary")
+    if result.diagnosis.kind == "LOW_DEVICE_UTILIZATION":
+        assert "explained_by" not in result.diagnosis.evidence

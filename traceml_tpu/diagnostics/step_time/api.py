@@ -44,7 +44,52 @@ def diagnose_window(
             ],
         )
     ctx = build_context(window, policy, efficiency=efficiency)
-    return run_rules(DOMAIN, DEFAULT_RULES, ctx)
+    result = run_rules(DOMAIN, DEFAULT_RULES, ctx)
+    return _prefer_cause_over_symptom(result)
+
+
+#: kinds that EXPLAIN idleness — when one fires at the symptom's
+#: severity or above, it is the actionable verdict and must outrank it
+_CAUSE_KINDS = (
+    "INPUT_BOUND", "COMPILE_BOUND", "RESIDUAL_HEAVY",
+    "INPUT_STRAGGLER", "COMPUTE_STRAGGLER", "H2D_STRAGGLER",
+    "COLLECTIVE_STRAGGLER", "RESIDUAL_STRAGGLER", "STRAGGLER",
+)
+_SYMPTOM_KINDS = ("LOW_DEVICE_UTILIZATION",)
+_SEV_RANK = {"info": 0, "warning": 1, "critical": 2}
+
+
+def _prefer_cause_over_symptom(result: DiagnosticResult) -> DiagnosticResult:
+    """LOW_DEVICE_UTILIZATION is a SYMPTOM (the chip idles); when a
+    same-or-higher-severity cause fired in the same window (the input
+    pipeline, a recompile storm, a straggler), the cause is the
+    actionable verdict — an idle chip with a named reason must not win
+    the severity→score sort just because ``1 − occupancy`` is a big
+    number (found in r4 verification: a 150-step input_bound run
+    promoted the symptom over INPUT_BOUND)."""
+    issues = result.issues
+    causes = [i for i in issues if i.kind in _CAUSE_KINDS]
+    if not causes:
+        return result
+    changed = False
+    for issue in issues:
+        if issue.kind not in _SYMPTOM_KINDS:
+            continue
+        sev = _SEV_RANK.get(issue.severity, 0)
+        peers = [
+            c for c in causes if _SEV_RANK.get(c.severity, 0) >= sev
+        ]
+        if not peers:
+            continue
+        best = max(peers, key=lambda c: c.score or 0.0)
+        # sort is severity → score: nudge the symptom just under its
+        # best explaining cause so the cause leads the result
+        issue.score = min(issue.score, (best.score or 0.0) - 1e-6)
+        issue.evidence.setdefault("explained_by", best.kind)
+        changed = True
+    if not changed:
+        return result
+    return DiagnosticResult(domain=result.domain, issues=issues)
 
 
 def diagnose_rank_rows(
