@@ -564,6 +564,11 @@ class LowMfuRule:
                 phase="compute",
                 score=1.0 - mfu,
                 share_pct=mfu,
+                # inverted threshold (fires BELOW the moderate bar)
+                confidence=confidence_from(
+                    p.mfu_moderate, max(mfu, 1e-6),
+                    coverage=_coverage(ctx),
+                ),
                 ranks=list(ctx.window.ranks),
                 evidence={
                     "mfu_median": mfu,
