@@ -79,7 +79,8 @@ function render_hero(d){
   if(diag&&diag.summary){
     document.getElementById("hero-verdict").textContent=diag.summary;
     document.getElementById("hero-sevrow").innerHTML=
-      `<span class="sevpill" style="background:${SEV[diag.severity]||"#555"}">${esc(diag.kind)}</span>`;
+      `<span class="sevpill" style="background:${SEV[diag.severity]||"#555"}">${esc(diag.kind)}</span>`+
+      (diag.confidence_label?` <span class="cmeta">${esc(diag.confidence_label)} confidence</span>`:"");
   }else{
     document.getElementById("hero-verdict").textContent=
       st?"step composition healthy":"analyzing step composition";
@@ -112,5 +113,6 @@ SECTION = Section(
         "diagnosis.summary",
         "diagnosis.severity",
         "diagnosis.kind",
+        "diagnosis.confidence_label",
     ),
 )
