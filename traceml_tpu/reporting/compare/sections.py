@@ -444,12 +444,20 @@ def compare_diagnoses(
     if b_kind != c_kind:
         regressed = diagnosis_rank(c_kind) > diagnosis_rank(b_kind)
         pathological = c_primary.get("severity") in ("warning", "critical")
+
+        def _lbl(p):
+            lab = p.get("confidence_label")
+            return f" ({lab} confidence)" if lab else ""
+
         findings.append(
             {
                 "kind": "DIAGNOSIS_" + ("REGRESSION" if regressed else "CHANGED"),
                 "section": "diagnosis",
                 "significance": "major" if regressed and pathological else "minor",
-                "summary": f"Primary diagnosis changed: {b_kind} → {c_kind}.",
+                "summary": (
+                    f"Primary diagnosis changed: {b_kind}{_lbl(b_primary)}"
+                    f" → {c_kind}{_lbl(c_primary)}."
+                ),
                 "metric": "primary_diagnosis",
                 "baseline": b_kind,
                 "candidate": c_kind,
