@@ -39,6 +39,15 @@ class ModelConfig:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     remat: bool = False
+    # sequence/context parallelism for long sequences: "dense" runs the
+    # fused jnp path and lets GSPMD partition it; "ring" / "ulysses"
+    # wrap the matching ops/ kernel in shard_map over ``context_axis``
+    # of ``mesh`` (set both), sharding attention BY SEQUENCE with exact
+    # global causality — see ops/ring_attention.py /
+    # ops/ulysses_attention.py for the trade-offs
+    attention_impl: str = "dense"
+    context_axis: Any = None     # mesh axis name, e.g. "context"
+    mesh: Any = None             # jax.sharding.Mesh (shard_map needs it)
 
     @property
     def head_dim(self) -> int:
@@ -108,14 +117,46 @@ class Attention(nn.Module):
         group = cfg.n_heads // cfg.n_kv_heads
         k = jnp.repeat(k, group, axis=2)
         v = jnp.repeat(v, group, axis=2)
-        # attention via the fused-friendly ops path (pallas flash kernel
-        # slot lives in traceml_tpu/ops — jnp reference path here)
-        from traceml_tpu.ops.attention import causal_attention
-
-        out = causal_attention(q, k, v)  # (B, S, heads, hd)
+        out = self._attend(q, k, v)  # (B, S, heads, hd)
         out = out.reshape(B, S, cfg.n_heads * hd)
         return nn.Dense(H, use_bias=False, dtype=cfg.dtype,
                         param_dtype=cfg.param_dtype, name="wo")(out)
+
+    def _attend(self, q, k, v):
+        """Attention kernel dispatch per cfg.attention_impl.
+
+        "dense": the fused jnp path — GSPMD partitions it (the pallas
+        flash kernel substitutes on TPU).  "ring"/"ulysses": the op
+        runs inside shard_map over cfg.context_axis with q/k/v sharded
+        BY SEQUENCE; RoPE was already applied on global positions, and
+        both ops enforce global causality themselves.
+        """
+        cfg = self.cfg
+        if cfg.attention_impl == "dense" or cfg.mesh is None:
+            from traceml_tpu.ops.attention import causal_attention
+
+            return causal_attention(q, k, v)
+        from jax.sharding import PartitionSpec as P
+
+        if cfg.attention_impl == "ring":
+            from traceml_tpu.ops.ring_attention import ring_attention as op
+        elif cfg.attention_impl == "ulysses":
+            from traceml_tpu.ops.ulysses_attention import (
+                ulysses_attention as op,
+            )
+        else:
+            raise ValueError(
+                f"unknown attention_impl {cfg.attention_impl!r} "
+                "(dense | ring | ulysses)"
+            )
+        spec = P(None, cfg.context_axis, None, None)
+        return jax.shard_map(
+            lambda a, b, c: op(a, b, c, cfg.context_axis),
+            mesh=cfg.mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            check_vma=False,
+        )(q, k, v)
 
 
 class MLP(nn.Module):
