@@ -192,3 +192,27 @@ def test_all_views_json_serializable():
     for view in views:
         payload = json.dumps(view.as_dict())  # must not raise
         assert json.loads(payload)  # and round-trips
+
+
+def test_phase_stat_median_rank_attribution():
+    """Every phase names both ends of its spread: the worst rank AND
+    the rank closest to the cross-rank median (report parity, r4)."""
+    from traceml_tpu.utils import timing as T
+    from traceml_tpu.utils.step_time_window import build_step_time_window
+    from traceml_tpu.renderers.views import build_step_time_view
+
+    def row(step, ms):
+        return {"step": step, "clock": "device", "events": {
+            T.STEP_TIME: {"cpu_ms": ms, "device_ms": ms, "count": 1}}}
+
+    rows = {
+        0: [row(s, 100.0) for s in range(1, 31)],
+        1: [row(s, 101.0) for s in range(1, 31)],   # the median-closest
+        2: [row(s, 160.0) for s in range(1, 31)],   # the worst
+    }
+    view = build_step_time_view(build_step_time_window(rows))
+    step = next(p for p in view.phases if p.key == "step_time")
+    assert step.worst_rank == 2
+    assert step.median_rank == 1
+    d = view.as_dict()
+    assert d["phases"][0]["median_rank"] == 1

@@ -24,15 +24,21 @@ def _phase_table(view: StepTimeView) -> Table:
     table.add_column("phase")
     table.add_column("median", justify="right")
     table.add_column("share", justify="right")
-    table.add_column("worst rank", justify="right")
+    # both ends of the spread name a rank: median-closest / worst
+    table.add_column("rank m/w", justify="right")
     table.add_column("skew", justify="right")
     for p in view.phases:
         skew_style = "yellow" if p.skew_pct >= _SKEW_WARN and p.key != RESIDUAL_KEY else ""
+        rank_pair = (
+            f"r{p.median_rank}/r{p.worst_rank}"
+            if p.median_rank is not None
+            else str(p.worst_rank)
+        )
         table.add_row(
             p.key,
             fmt_ms(p.median_ms),
             fmt_pct(p.share) if p.share is not None else "—",
-            str(p.worst_rank),
+            rank_pair,
             Text(fmt_pct(p.skew_pct), style=skew_style),
         )
     return table

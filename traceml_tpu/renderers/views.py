@@ -47,6 +47,9 @@ class PhaseStat:
     worst_rank: int
     skew_pct: float
     share: Optional[float]  # median(phase)/median(step); None for step itself
+    # rank whose window avg sits closest to the cross-rank median —
+    # both ends of the spread name a concrete rank (report parity)
+    median_rank: Optional[int] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -92,17 +95,23 @@ def build_step_time_view(
 ) -> Optional[StepTimeView]:
     if window is None:
         return None
+    from traceml_tpu.utils.rankstats import closest_rank_to_median
+
     phases: List[PhaseStat] = []
     for key in [STEP_KEY] + window.phases_present + [RESIDUAL_KEY]:
         m = window.metric(key)
         if m is None:
             continue
+        med_rank = closest_rank_to_median(m.per_rank_avg_ms)
         phases.append(
             PhaseStat(
                 key=key,
                 median_ms=m.median_ms,
                 worst_ms=m.worst_ms,
                 worst_rank=m.worst_rank,
+                median_rank=(
+                    int(med_rank) if med_rank is not None else None
+                ),
                 skew_pct=m.skew_pct,
                 share=window.share_of_step(key) if key != STEP_KEY else None,
             )

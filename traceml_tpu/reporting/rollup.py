@@ -16,8 +16,14 @@ reference's semantics so compare output is stable run-to-run.
 from __future__ import annotations
 
 import math
-import statistics
 from typing import Any, Dict, Mapping, Optional
+
+# shared with the live views so every surface attributes points the
+# same way (re-exported here for rollup consumers)
+from traceml_tpu.utils.rankstats import (  # noqa: F401
+    closest_rank_to_median,
+    worst_rank,
+)
 
 
 def _finite(value: Any) -> Optional[float]:
@@ -26,31 +32,6 @@ def _finite(value: Any) -> Optional[float]:
     except (TypeError, ValueError):
         return None
     return v if math.isfinite(v) else None
-
-
-def _rank_sort(rank_key: str) -> int:
-    try:
-        return int(rank_key)
-    except (TypeError, ValueError):
-        return 0
-
-
-def closest_rank_to_median(values: Mapping[str, float]) -> Optional[str]:
-    """The rank id whose value sits closest to the cross-rank median."""
-    if not values:
-        return None
-    median_value = statistics.median(values.values())
-    return min(
-        values,
-        key=lambda k: (abs(values[k] - median_value), values[k], _rank_sort(k)),
-    )
-
-
-def worst_rank(values: Mapping[str, float]) -> Optional[str]:
-    """The rank id with the maximum value (ties → smaller rank id)."""
-    if not values:
-        return None
-    return max(values, key=lambda k: (values[k], -_rank_sort(k)))
 
 
 def _point(values: Mapping[str, float], kind: str) -> Dict[str, Any]:
