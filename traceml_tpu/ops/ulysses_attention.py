@@ -36,30 +36,19 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-_NEG = -1e30
-
 
 def _full_causal_attention(q, k, v):
     """Ordinary causal attention on full-sequence local tensors.
 
     q,k,v: (B, S, h, D) → (B, S, h, D); f32 softmax accumulation.
+    After the head-scatter this is PLAIN causal self-attention, so the
+    pallas flash kernel applies unchanged on TPU —
+    ``ops.attention.causal_attention`` dispatches to it (with the jnp
+    reference as the fail-open path) exactly as in the dense model.
     """
-    D = q.shape[-1]
-    scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
-    S = q.shape[1]
-    q_ids = jax.lax.broadcasted_iota(jnp.int32, (S, S), 0)
-    k_ids = jax.lax.broadcasted_iota(jnp.int32, (S, S), 1)
-    s = jnp.where((q_ids >= k_ids)[None, None, :, :], s, _NEG)
-    p = jax.nn.softmax(s, axis=-1)
-    # accumulate the p·v contraction in f32 regardless of input dtype
-    # (matches ring_attention's f32 accumulator; bf16 accumulation
-    # would drift past the ring-agreement tolerance at long S)
-    out = jnp.einsum(
-        "bhqk,bkhd->bqhd", p.astype(v.dtype), v,
-        preferred_element_type=jnp.float32,
-    )
-    return out.astype(v.dtype)
+    from traceml_tpu.ops.attention import causal_attention
+
+    return causal_attention(q, k, v)
 
 
 def ulysses_attention(
