@@ -177,6 +177,7 @@ _VETTED = {
     "hero": {"w>=7?esc(p.key):\"\"", "chips",
              "st.n_steps", "st.clock", "cov.ranks_present", "cov.world_size"},
     "step_time": {"h", "bars", "paths", "stepId", "i",
+                  "rankPair",  # built from esc()'d parts two lines up
                   'rankHidden.has(r)?" off":""'},
     "memory": {"spark", "worst", "hot",
                "g?(g>0?\"+\":\"-\")+fmtB(Math.abs(g)):\"—\"",

@@ -59,13 +59,16 @@ function render_step_time(d){
     let h=`<b>step ${esc(stepId!=null?stepId:i)}</b>`;
     for(const k of ks)if(stk[k][i])h+=`<br><i style="display:inline-block;width:8px;height:8px;border-radius:2px;background:${COLORS[k]||"#888"};margin-right:4px"></i>${esc(k)} ${fmtMs(stk[k][i])}`;
     return h});
-  // phase table
+  // phase table — both ends of the spread name a rank (median-closest
+  // / worst), same pairing as the CLI and report
   let rows=`<table><tr><th>phase</th><th class="num">median</th>
-    <th class="num">share</th><th class="num">worst rank</th>
+    <th class="num">share</th><th class="num">rank m/w</th>
     <th class="num">skew</th></tr>`;
   for(const p of st.phases||[]){
+    const rankPair=p.median_rank!=null
+      ?`r${esc(p.median_rank)}/r${esc(p.worst_rank)}`:esc(p.worst_rank);
     rows+=`<tr><td>${esc(p.key)}</td><td class="num">${fmtMs(p.median_ms)}</td>
-      <td class="num">${pct(p.share)}</td><td class="num">${esc(p.worst_rank)}</td>
+      <td class="num">${pct(p.share)}</td><td class="num">${rankPair}</td>
       <td class="num">${pct(p.skew_pct)}</td></tr>`}
   document.getElementById("st-table").innerHTML=rows+"</table>";
   // per-rank sparkline with rank toggle
@@ -117,6 +120,7 @@ SECTION = Section(
         "step_time.phases.median_ms",
         "step_time.phases.share",
         "step_time.phases.worst_rank",
+        "step_time.phases.median_rank",
         "step_time.phases.skew_pct",
         "step_time.step_series",
     ),
