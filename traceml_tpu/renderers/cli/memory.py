@@ -51,4 +51,24 @@ def step_memory_panel(payload: Dict[str, Any]) -> Panel:
     sub = f"total {fmt_bytes(view.total_current_bytes)}"
     if view.worst_pressure_rank is not None:
         sub += f" · worst pressure rank {view.worst_pressure_rank}"
+    # multi-rank: median/worst peak + skew (reference formatter's
+    # summary rows, step_memory/formatter.py:102-166, as one line)
+    peaks = {
+        s.rank: s.step_peak_bytes
+        for s in view.ranks
+        if s.step_peak_bytes
+    }
+    if len(peaks) > 1:
+        import statistics
+
+        from traceml_tpu.utils.rankstats import worst_rank
+
+        med = statistics.median(peaks.values())
+        wr = worst_rank(peaks)
+        if med > 0:
+            skew = (peaks[wr] - med) / med
+            sub += (
+                f" · peak med {fmt_bytes(int(med))} / worst "
+                f"{fmt_bytes(peaks[wr])} (r{wr}, +{skew * 100:.0f}%)"
+            )
     return Panel(table, title="device memory", subtitle=sub)
