@@ -31,7 +31,7 @@ _PAGE = build_page()
 _EFFICIENCY_KEYS = {
     "achieved_tflops_by_rank", "achieved_tflops_median", "device_count",
     "device_kind", "flops_per_step", "flops_source", "mfu_median",
-    "peak_flops", "peak_tflops",
+    "peak_flops", "peak_tflops", "tokens_per_step", "tokens_per_sec_median",
 }
 _ISSUE_KEYS = {"kind", "severity", "summary", "action", "domain",
                "confidence", "confidence_label"}

@@ -400,3 +400,24 @@ def test_rollup_tie_breaks_deterministic():
     # equal values: worst → smallest rank id; median idx likewise stable
     assert r["worst"]["m"]["idx"] == "1"
     assert r["median"]["m"]["idx"] == "1"
+
+
+def test_tokens_per_step_flows_to_efficiency(tmp_path):
+    """set_step_tokens → model_stats row → SQLite → loader → the
+    efficiency block's tokens_per_sec_median (full pipeline)."""
+    s = _Session(tmp_path)
+    s.inject("step_time",
+             {"step_time": [_step_row(i, step_ms=100.0) for i in range(1, 61)]},
+             s.ident(0))
+    s.inject("step_time", {"model_stats": [
+        {"timestamp": 1.0, "flops_per_step": 50e12,
+         "flops_source": "manual", "device_kind": "TPU v5p",
+         "peak_flops": 459e12, "device_count": 1,
+         "tokens_per_step": 8192.0}
+    ]}, s.ident(0))
+    payload = s.payload()
+    eff = payload["sections"]["step_time"]["global"]["efficiency"]
+    assert eff["tokens_per_step"] == 8192.0
+    # steady-state median step is 100 ms → 81,920 tokens/s
+    assert abs(eff["tokens_per_sec_median"] - 81920.0) < 1.0
+    assert "tokens/s" in payload["sections"]["step_time"]["card"]

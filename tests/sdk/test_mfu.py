@@ -215,3 +215,89 @@ def test_efficiency_uses_each_ranks_own_declaration():
     # a rank with NO declaration falls back to the first declaring rank
     eff = build_efficiency(stats, {0: 1000.0, 1: 1000.0, 2: 500.0})
     assert eff["achieved_tflops_by_rank"]["2"] == 200.0
+
+
+# -- tokens/s (set_step_tokens, r4) ----------------------------------------
+
+def test_tokens_per_sec_in_efficiency_block():
+    from traceml_tpu.analytics.efficiency import build_efficiency
+
+    stats = {
+        0: {"flops_per_step": 100e12, "flops_source": "manual",
+            "device_kind": "TPU v5p", "peak_flops": 459e12,
+            "device_count": 1, "tokens_per_step": 8192.0},
+    }
+    eff = build_efficiency(stats, {0: 1000.0})  # 1 s steps
+    assert eff["tokens_per_sec_median"] == 8192.0
+    assert eff["tokens_per_step"] == 8192.0
+    assert eff["achieved_tflops_median"] == 100.0
+
+
+def test_tokens_only_declaration_still_builds_block():
+    """set_step_tokens without set_step_flops: tokens/s reports,
+    TFLOP/s and MFU stay null — no crash on any surface."""
+    from traceml_tpu.analytics.efficiency import build_efficiency
+
+    stats = {0: {"flops_per_step": None, "flops_source": None,
+                 "device_kind": None, "peak_flops": None,
+                 "device_count": None, "tokens_per_step": 4096.0}}
+    eff = build_efficiency(stats, {0: 500.0})  # 0.5 s steps
+    assert eff["tokens_per_sec_median"] == 8192.0
+    assert eff["achieved_tflops_median"] is None
+    assert eff["mfu_median"] is None
+    # the text card renders without TypeError
+    from traceml_tpu.reporting.final import _step_time_card
+
+    card = _step_time_card({
+        "global": {"clock": "device", "n_steps": 60,
+                   "step_range": [1, 60], "efficiency": eff,
+                   "phases": {"step_time": {"median_ms": 500.0,
+                                            "worst_ms": 500.0,
+                                            "worst_rank": 0,
+                                            "skew_pct": 0.0,
+                                            "share_of_step": None}}},
+    })
+    assert "8,192 tokens/s" in card
+
+
+def test_set_step_tokens_ships_through_sampler(tmp_path):
+    import traceml_tpu
+    from traceml_tpu.sdk import state as state_mod
+
+    state_mod.reset_state_for_tests()
+    traceml_tpu.set_step_tokens(2048)
+    assert state_mod.get_state().tokens_per_step == 2048.0
+
+
+def test_mixed_declarations_report_both_numerators():
+    """One rank flops-only, another tokens-only: both rates populate and
+    both numerators are reported (review r4 — ms0 alone lost one)."""
+    from traceml_tpu.analytics.efficiency import build_efficiency
+
+    stats = {
+        0: {"flops_per_step": 100e12, "flops_source": "manual",
+            "device_kind": "TPU v5p", "peak_flops": 459e12,
+            "device_count": 1, "tokens_per_step": None},
+        1: {"flops_per_step": None, "flops_source": None,
+            "device_kind": None, "peak_flops": None,
+            "device_count": None, "tokens_per_step": 4096.0},
+    }
+    eff = build_efficiency(stats, {0: 1000.0, 1: 1000.0})
+    assert eff["flops_per_step"] == 100e12
+    assert eff["tokens_per_step"] == 4096.0
+    assert eff["tokens_per_sec_median"] is not None
+    assert eff["achieved_tflops_median"] is not None
+    # and the text card renders with no TypeError either way around
+    from traceml_tpu.reporting.final import _step_time_card
+
+    card = _step_time_card({
+        "global": {"clock": "device", "n_steps": 60,
+                   "step_range": [1, 60],
+                   "efficiency": dict(eff, flops_per_step=None),
+                   "phases": {"step_time": {"median_ms": 100.0,
+                                            "worst_ms": 100.0,
+                                            "worst_rank": 0,
+                                            "skew_pct": 0.0,
+                                            "share_of_step": None}}},
+    })
+    assert "TFLOP/s achieved" in card

@@ -36,6 +36,7 @@ _API_SYMBOLS = (
     "enable_ici_stats",
     "request_profile",
     "set_step_flops",
+    "set_step_tokens",
 )
 
 __all__ = list(_API_SYMBOLS) + ["__version__"]

@@ -69,8 +69,13 @@ function render_hero(d){
     setKpi("rank",stepRow!=null&&stepRow.worst_rank!=null?"r"+stepRow.worst_rank:null,"");
     const eff=st.efficiency;
     setKpi("mfu",eff&&eff.mfu_median!=null?(eff.mfu_median*100).toFixed(0):
-      (eff?eff.achieved_tflops_median.toFixed(1):null),
-      eff&&eff.mfu_median!=null?"%":(eff?"TF/s":""));
+      (eff&&eff.achieved_tflops_median!=null?
+        eff.achieved_tflops_median.toFixed(1):
+        (eff&&eff.tokens_per_sec_median!=null?
+          Math.round(eff.tokens_per_sec_median).toLocaleString():null)),
+      eff&&eff.mfu_median!=null?"%":
+        (eff&&eff.achieved_tflops_median!=null?"TF/s":
+          (eff&&eff.tokens_per_sec_median!=null?"tok/s":"")));
   }
   // verdict: verbatim from the diagnosis engine — never derived here,
   // and CLEARED when the engine stops reporting (a resolved diagnosis
@@ -110,6 +115,7 @@ SECTION = Section(
         "step_time.phases.worst_rank",
         "step_time.efficiency.mfu_median",
         "step_time.efficiency.achieved_tflops_median",
+        "step_time.efficiency.tokens_per_sec_median",
         "diagnosis.summary",
         "diagnosis.severity",
         "diagnosis.kind",

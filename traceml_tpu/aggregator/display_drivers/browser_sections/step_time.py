@@ -34,7 +34,10 @@ function render_step_time(d){
   stLast=st;stLastTs=d.ts;
   document.getElementById("st-occ").textContent=
     (st.median_occupancy!=null?`chip busy ${(st.median_occupancy*100).toFixed(0)}%`:"")+
-    (st.efficiency?` · ${st.efficiency.achieved_tflops_median.toFixed(1)} TFLOP/s`:"");
+    (st.efficiency&&st.efficiency.achieved_tflops_median!=null?
+      ` · ${st.efficiency.achieved_tflops_median.toFixed(1)} TFLOP/s`:"")+
+    (st.efficiency&&st.efficiency.tokens_per_sec_median!=null?
+      ` · ${Math.round(st.efficiency.tokens_per_sec_median).toLocaleString()} tok/s`:"");
   // stacked per-step phase chart (cross-rank medians)
   const stack=st.phase_stack||{};const keys=Object.keys(stack);
   const n=keys.length?stack[keys[0]].length:0;
@@ -114,6 +117,7 @@ SECTION = Section(
         "step_time.latest_ts",
         "step_time.median_occupancy",
         "step_time.efficiency.achieved_tflops_median",
+        "step_time.efficiency.tokens_per_sec_median",
         "step_time.phase_stack",
         "step_time.steps",
         "step_time.phases.key",

@@ -39,7 +39,7 @@ function drawSummary(s){
     <div style="margin:.4rem 0">${chips}</div>
     <div class="muted">world ${esc(topo.world_size!=null?topo.world_size:"?")}
       · mode ${esc(topo.mode||"?")}
-      ${eff?` · ${Number(eff.achieved_tflops_median).toFixed(1)} TFLOP/s`+
+      ${eff&&eff.achieved_tflops_median!=null?` · ${Number(eff.achieved_tflops_median).toFixed(1)} TFLOP/s`+
         (eff.mfu_median!=null?` · MFU ${(eff.mfu_median*100).toFixed(0)}%`:""):""}</div>`}
 """
 

@@ -56,7 +56,8 @@ def init_schema(conn) -> None:
             flops_source TEXT,
             device_kind TEXT,
             peak_flops REAL,
-            device_count INTEGER
+            device_count INTEGER,
+            tokens_per_step REAL
         )"""
     )
 
@@ -67,7 +68,8 @@ def insert_sql(table: str) -> str:
             f"INSERT INTO {MODEL_STATS_TABLE} (session_id, global_rank,"
             " local_rank, world_size, local_world_size, node_rank, hostname,"
             " pid, timestamp, flops_per_step, flops_source, device_kind,"
-            " peak_flops, device_count) VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?)"
+            " peak_flops, device_count, tokens_per_step)"
+            " VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)"
         )
     return (
         f"INSERT INTO {TABLE} (session_id, global_rank, local_rank, world_size,"
@@ -102,6 +104,7 @@ def build_rows(env: TelemetryEnvelope) -> Dict[str, List[Tuple]]:
             row.get("device_kind"),
             fnum(row, "peak_flops"),
             inum(row, "device_count"),
+            fnum(row, "tokens_per_step"),
         )
         for row in env.tables.get("model_stats", [])
     ]

@@ -50,18 +50,26 @@ def build_efficiency(
     if not ms0.get("flops_per_step"):
         # the fallback declaration is unusable; require per-rank ones
         ms0 = next(
-            (v for v in stats.values() if v.get("flops_per_step")), None
+            (
+                v for v in stats.values()
+                if v.get("flops_per_step") or v.get("tokens_per_step")
+            ),
+            None,
         )
         if ms0 is None:
             return None
 
     achieved: Dict[str, float] = {}
     mfu: Dict[str, float] = {}
+    tokens_ps: Dict[str, float] = {}
     for rank, step_ms in per_rank_step_ms.items():
         if not step_ms:
             continue
         key = _rank_key(stats, rank)
         decl = stats[key] if key is not None else ms0
+        tokens = decl.get("tokens_per_step") or ms0.get("tokens_per_step")
+        if tokens:
+            tokens_ps[str(rank)] = tokens / (step_ms / 1000.0)
         flops = decl.get("flops_per_step") or ms0.get("flops_per_step")
         if not flops:
             continue
@@ -71,17 +79,40 @@ def build_efficiency(
         if peak:
             n_dev = int(decl.get("device_count") or 1)
             mfu[str(rank)] = tflops * 1e12 / (peak * max(n_dev, 1))
-    if not achieved:
+    if not achieved and not tokens_ps:
         return None
-    med = statistics.median(achieved.values())
     peak0 = ms0.get("peak_flops")
+    # numerators reported from the first declaration that HAS each one:
+    # with mixed declarations (one rank flops-only, another tokens-only)
+    # ms0 alone would report null for a numerator whose per-rank rate IS
+    # populated (review r4)
+    flops0 = next(
+        (v["flops_per_step"] for v in stats.values()
+         if v.get("flops_per_step")),
+        None,
+    )
+    tokens0 = next(
+        (v["tokens_per_step"] for v in stats.values()
+         if v.get("tokens_per_step")),
+        None,
+    )
     return {
-        "flops_per_step": ms0.get("flops_per_step"),
+        "flops_per_step": flops0,
         "flops_source": ms0.get("flops_source"),
         "device_kind": ms0.get("device_kind"),
         "device_count": ms0.get("device_count"),
         "peak_tflops": (peak0 / 1e12) if peak0 else None,
         "achieved_tflops_by_rank": {r: round(v, 3) for r, v in achieved.items()},
-        "achieved_tflops_median": round(med, 3),
+        "achieved_tflops_median": (
+            round(statistics.median(achieved.values()), 3)
+            if achieved else None
+        ),
         "mfu_median": statistics.median(mfu.values()) if mfu else None,
+        # tokens/s (set_step_tokens): per-step declarations × the same
+        # steady-state step time the FLOPs path uses
+        "tokens_per_step": tokens0,
+        "tokens_per_sec_median": (
+            round(statistics.median(tokens_ps.values()), 1)
+            if tokens_ps else None
+        ),
     }

@@ -66,6 +66,17 @@ def set_step_flops(flops: float, device_kind=None, device_count=None) -> None:
             pass
 
 
+def set_step_tokens(tokens: float) -> None:
+    """Declare the tokens consumed by ONE training step (global batch ×
+    sequence length) — the tokens/s numerator.  Optional and
+    independent of ``set_step_flops``; with it declared, the step-time
+    efficiency block reports ``tokens_per_sec_median`` alongside
+    achieved TFLOP/s and MFU."""
+    from traceml_tpu.sdk.state import get_state
+
+    get_state().tokens_per_step = float(tokens)
+
+
 def current_step() -> int:
     """The current trace step counter (0 before the first step)."""
     from traceml_tpu.sdk.state import get_state

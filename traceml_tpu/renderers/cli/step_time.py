@@ -81,9 +81,12 @@ def step_time_panel(payload: Dict[str, Any]) -> Panel:
         sub += f" · chip busy {view.median_occupancy * 100:.0f}%"
     eff = view.efficiency
     if eff:
-        sub += f" · {eff['achieved_tflops_median']:.1f} TFLOP/s"
-        if eff.get("mfu_median") is not None:
-            sub += f" (MFU {eff['mfu_median'] * 100:.0f}%)"
+        if eff.get("achieved_tflops_median") is not None:
+            sub += f" · {eff['achieved_tflops_median']:.1f} TFLOP/s"
+            if eff.get("mfu_median") is not None:
+                sub += f" (MFU {eff['mfu_median'] * 100:.0f}%)"
+        if eff.get("tokens_per_sec_median") is not None:
+            sub += f" · {eff['tokens_per_sec_median']:,.0f} tok/s"
     if cov.incomplete:
         sub += " · INCOMPLETE"
     return Panel(Group(*parts), title="step time", subtitle=sub)

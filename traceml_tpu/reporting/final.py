@@ -420,14 +420,25 @@ def _step_time_card(sec: Dict[str, Any]) -> str:
     out.append(header)
     eff = g.get("efficiency")
     if eff:
-        line = f"model: {eff['flops_per_step'] / 1e12:.2f} TFLOP/step → " \
-               f"{eff['achieved_tflops_median']:.1f} TFLOP/s achieved"
-        if eff.get("mfu_median") is not None:
-            line += (
-                f" = {fmt_pct(eff['mfu_median'])} MFU "
-                f"({eff.get('device_kind')}, peak {eff['peak_tflops']:.0f} TFLOP/s)"
+        bits = []
+        if eff.get("achieved_tflops_median") is not None:
+            flops = eff.get("flops_per_step")
+            bits.append(
+                (f"model: {flops / 1e12:.2f} TFLOP/step → " if flops else "")
+                + f"{eff['achieved_tflops_median']:.1f} TFLOP/s achieved"
             )
-        out.append(line)
+            if eff.get("mfu_median") is not None:
+                peak = eff.get("peak_tflops")
+                bits.append(
+                    f"= {fmt_pct(eff['mfu_median'])} MFU "
+                    f"({eff.get('device_kind')}"
+                    + (f", peak {peak:.0f} TFLOP/s" if peak else "")
+                    + ")"
+                )
+        if eff.get("tokens_per_sec_median") is not None:
+            bits.append(f"{eff['tokens_per_sec_median']:,.0f} tokens/s")
+        if bits:
+            out.append(" ".join(bits))
     for key, p in phases.items():
         share = p.get("share_of_step")
         out.append(
@@ -623,13 +634,19 @@ def render_text_summary(payload: Dict[str, Any]) -> str:
             out.append(line)
         eff = g.get("efficiency")
         if eff:
-            line = (
-                f"  model {eff['flops_per_step'] / 1e12:.2f} TFLOP/step → "
-                f"{eff['achieved_tflops_median']:.1f} TFLOP/s"
-            )
-            if eff.get("mfu_median") is not None:
-                line += f"  MFU {fmt_pct(eff['mfu_median'])}"
-            out.append(line)
+            line = "  "
+            if eff.get("achieved_tflops_median") is not None:
+                flops = eff.get("flops_per_step")
+                line += (
+                    (f"model {flops / 1e12:.2f} TFLOP/step → " if flops else "")
+                    + f"{eff['achieved_tflops_median']:.1f} TFLOP/s"
+                )
+                if eff.get("mfu_median") is not None:
+                    line += f"  MFU {fmt_pct(eff['mfu_median'])}"
+            if eff.get("tokens_per_sec_median") is not None:
+                line += f"  {eff['tokens_per_sec_median']:,.0f} tokens/s"
+            if line.strip():
+                out.append(line)
         for key, p in phases.items():
             if key == STEP_KEY:
                 continue

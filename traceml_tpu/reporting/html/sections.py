@@ -108,6 +108,9 @@ def build_step_time(payload: Dict[str, Any]) -> str:
     if eff.get("mfu_median") is not None:
         tiles.append(kpi("MFU", f"{eff['mfu_median'] * 100:.0f}", "%",
                          "#c0392b"))
+    if eff.get("tokens_per_sec_median") is not None:
+        tiles.append(kpi("tokens", f"{eff['tokens_per_sec_median']:,.0f}",
+                         "tok/s", "#2255a4"))
     if step.get("skew_pct") is not None:
         tiles.append(kpi("rank gap", f"{step['skew_pct'] * 100:.0f}", "%",
                          "#f1c40f"))
@@ -120,9 +123,9 @@ def build_step_time(payload: Dict[str, Any]) -> str:
     out.append(f"<p class='muted'>{sub}</p>")
     if tiles:
         out.append(f"<div class='kpis'>{''.join(tiles)}</div>")
-    if eff:
+    if eff and eff.get("flops_per_step"):
         line = (
-            f"model {(eff.get('flops_per_step') or 0) / 1e12:.2f} TFLOP/step"
+            f"model {eff['flops_per_step'] / 1e12:.2f} TFLOP/step"
             f" ({_esc(eff.get('flops_source'))})"
         )
         if eff.get("peak_tflops"):

@@ -226,22 +226,41 @@ def load_model_stats(
         try:
             rows = conn.execute(
                 "SELECT * FROM (SELECT global_rank, flops_per_step,"
-                " flops_source, device_kind, peak_flops, device_count, id"
+                " flops_source, device_kind, peak_flops, device_count,"
+                " tokens_per_step, id"
                 " FROM model_stats_samples"
                 f" ORDER BY id DESC LIMIT {int(recent_rows)}) ORDER BY id ASC"
             ).fetchall()
         except sqlite3.OperationalError:
-            # archived sessions written before the device_count column
-            rows = conn.execute(
-                "SELECT *, NULL AS device_count FROM (SELECT global_rank,"
-                " flops_per_step, flops_source, device_kind, peak_flops, id"
-                " FROM model_stats_samples"
-                f" ORDER BY id DESC LIMIT {int(recent_rows)}) ORDER BY id ASC"
-            ).fetchall()
+            try:
+                # archived sessions without the tokens column
+                rows = conn.execute(
+                    "SELECT *, NULL AS tokens_per_step FROM (SELECT"
+                    " global_rank, flops_per_step, flops_source,"
+                    " device_kind, peak_flops, device_count, id"
+                    " FROM model_stats_samples"
+                    f" ORDER BY id DESC LIMIT {int(recent_rows)})"
+                    " ORDER BY id ASC"
+                ).fetchall()
+            except sqlite3.OperationalError:
+                # …or before the device_count column either
+                rows = conn.execute(
+                    "SELECT *, NULL AS device_count, NULL AS tokens_per_step"
+                    " FROM (SELECT global_rank, flops_per_step,"
+                    " flops_source, device_kind, peak_flops, id"
+                    " FROM model_stats_samples"
+                    f" ORDER BY id DESC LIMIT {int(recent_rows)})"
+                    " ORDER BY id ASC"
+                ).fetchall()
+    per_rank_tokens: Dict[int, List[float]] = {}
     for r in rows:
         rank = int(r["global_rank"])
         if r["flops_per_step"]:
             per_rank_flops.setdefault(rank, []).append(float(r["flops_per_step"]))
+        if r["tokens_per_step"]:
+            per_rank_tokens.setdefault(rank, []).append(
+                float(r["tokens_per_step"])
+            )
         out[rank] = {  # ascending order → the newest row wins
             "flops_source": r["flops_source"],
             "device_kind": r["device_kind"],
@@ -250,7 +269,12 @@ def load_model_stats(
         }
     for rank, vals in per_rank_flops.items():
         out[rank]["flops_per_step"] = statistics.median(vals)
-    return {r: v for r, v in out.items() if v.get("flops_per_step")}
+    for rank, vals in per_rank_tokens.items():
+        out[rank]["tokens_per_step"] = statistics.median(vals)
+    return {
+        r: v for r, v in out.items()
+        if v.get("flops_per_step") or v.get("tokens_per_step")
+    }
 
 
 def load_stdout_tail(db_path: Path, n: int = 12) -> List[Tuple[str, str]]:

@@ -136,20 +136,25 @@ class StepTimeSampler(BaseSampler):
             # with unchanged FLOPs must still republish
             sent_key = (
                 flops, st.flops_source, st.flops_device_kind,
-                st.flops_device_count,
+                st.flops_device_count, st.tokens_per_step,
             )
-            if flops is None or sent_key == self._flops_sent:
+            if (
+                flops is None and st.tokens_per_step is None
+            ) or sent_key == self._flops_sent:
                 return
             self._flops_sent = sent_key
             self.db.add_record(
                 MODEL_STATS_TABLE,
                 {
                     "timestamp": time.time(),
-                    "flops_per_step": float(flops),
+                    "flops_per_step": (
+                        float(flops) if flops is not None else None
+                    ),
                     "flops_source": st.flops_source,
                     "device_kind": st.flops_device_kind,
                     "peak_flops": peak_flops_for(st.flops_device_kind),
                     "device_count": st.flops_device_count,
+                    "tokens_per_step": st.tokens_per_step,
                 },
             )
         except Exception:

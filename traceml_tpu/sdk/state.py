@@ -67,6 +67,10 @@ class TraceState:
         # program, so with one process driving N chips the MFU
         # denominator must be N × chip peak or the ratio inflates N×
         self.flops_device_count: Optional[int] = None
+        # tokens consumed per training step (set_step_tokens): the
+        # tokens/s numerator — the throughput number LLM capacity plans
+        # quote; optional, independent of FLOPs
+        self.tokens_per_step: Optional[float] = None
         # called with the step number after each flush (max-steps lifecycle)
         self.on_step_flushed: List[Callable[[int], None]] = []
         # called with the StepTimeBatch after each non-empty flush
