@@ -250,7 +250,25 @@ def test_h2d_idioms_and_loop_flags(tmp_path):
     assert h2d["h2d_in_loop"] == 1  # batch.to inside the loop
     assert "blocking_h2d" not in info["input_hints"]
     flags = info["loop_flags"]
-    assert flags["checkpoint_in_loop"] and flags["logging_in_loop"]
+    assert flags["checkpoint_in_loop"] and flags["print_in_loop"]
+    # bare print() is NOT logger traffic (advisor r4)
+    assert "logging_in_loop" not in flags
+
+
+def test_scheduler_step_loop_is_not_training(tmp_path):
+    """A loop whose only marker is .step() (scheduler/env/tqdm) must not
+    be classified as a training loop (advisor r4: false in-loop sync
+    hints feed the INPUT_BOUND guidance surface)."""
+    script = tmp_path / "sched.py"
+    script.write_text(
+        "for epoch in range(10):\n"
+        "    scheduler.step()\n"
+        "    metrics.append(loss.item())\n"
+        "    print(epoch)\n"
+    )
+    info = analyze_script(script)
+    assert info["sync_sites"]["item"]["in_loop"] == 0
+    assert "host_sync_in_loop" not in info.get("input_hints", [])
 
 
 def test_distributed_sampler_without_set_epoch_flagged(tmp_path):
@@ -291,6 +309,62 @@ def test_non_training_loop_not_counted(tmp_path):
     info = analyze_script(script)
     assert info["sync_sites"]["item"]["in_loop"] == 0
     assert "host_sync_in_loop" not in info.get("input_hints", [])
+
+
+def test_bare_step_call_still_marks_training_loop(tmp_path):
+    """`step = jax.jit(make_train_step(...))` then `step(state, batch)`
+    is the canonical jax idiom — the BARE NAME form must still mark the
+    loop as training even though attribute `.step()` no longer does
+    (review r5)."""
+    script = tmp_path / "jax_step.py"
+    script.write_text(
+        "import jax\n"
+        "step = jax.jit(train_step)\n"
+        "for batch in ds:\n"
+        "    state, m = step(state, batch)\n"
+        "    losses.append(m['loss'].item())\n"
+    )
+    info = analyze_script(script)
+    assert info["sync_sites"]["item"]["in_loop"] == 1
+    assert "host_sync_in_loop" in info["input_hints"]
+    # chained receiver (`m['loss'].item()`) must surface in BOTH
+    # sync_sites and sync_call_hints — internally consistent manifest
+    assert "item" in info["sync_call_hints"]
+
+
+def test_optimizer_step_closure_marks_training_loop(tmp_path):
+    """`optimizer.step(closure)` (LBFGS: backward lives inside the
+    closure, defined outside the loop) must still mark the loop as
+    training via the optimizer-named receiver (review r5)."""
+    script = tmp_path / "lbfgs.py"
+    script.write_text(
+        "def closure():\n"
+        "    loss = model(x)\n"
+        "    loss.backward()\n"
+        "    return loss\n"
+        "for epoch in range(10):\n"
+        "    optimizer.step(closure)\n"
+        "    losses.append(loss.item())\n"
+    )
+    info = analyze_script(script)
+    assert info["sync_sites"]["item"]["in_loop"] == 1
+    assert "host_sync_in_loop" in info["input_hints"]
+
+
+def test_subscripted_optimizer_and_chained_cpu_sync(tmp_path):
+    """`optimizers[0].step()` (GAN/Lightning multi-optimizer) still
+    marks the loop as training, and a chained `.cpu()` sync surfaces in
+    BOTH sync_sites and sync_call_hints (review r5)."""
+    script = tmp_path / "gan.py"
+    script.write_text(
+        "for batch in ds:\n"
+        "    optimizers[0].step(closure)\n"
+        "    stats.append(model(batch).cpu())\n"
+    )
+    info = analyze_script(script)
+    assert info["sync_sites"]["cpu"]["in_loop"] == 1
+    assert "cpu" in info["sync_call_hints"]
+    assert "host_sync_in_loop" in info["input_hints"]
 
 
 def test_maybe_pin_cpu_gating(monkeypatch):

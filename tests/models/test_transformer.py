@@ -120,6 +120,104 @@ def test_model_ring_and_ulysses_match_dense():
         )
 
 
+def test_seq_parallel_spec_shards_batch_and_heads():
+    """The shard_map spec must shard batch over the data-parallel axes
+    (not leave it replicated — advisor r4: replication all-gathers the
+    global batch per data group) and heads over tensor when it divides."""
+    import dataclasses
+
+    from traceml_tpu.models.transformer import seq_parallel_spec
+    from traceml_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh({"data": 2, "tensor": 2, "context": 2})
+    cfg = dataclasses.replace(
+        ModelConfig.tiny(), attention_impl="ring",
+        context_axis="context", mesh=mesh)
+    spec = seq_parallel_spec(cfg)
+    assert spec[0] == ("data", "fsdp")   # batch sharded, not replicated
+    assert spec[1] == "context"
+    assert spec[2] == "tensor"           # tiny() n_heads=4 % tensor=2 == 0
+    assert spec[3] is None
+
+    # heads NOT divisible by tensor → heads stay unsharded, rest holds
+    cfg3 = dataclasses.replace(
+        ModelConfig.tiny(), n_heads=3, n_kv_heads=3, hidden=96,
+        attention_impl="ring", context_axis="context", mesh=mesh)
+    spec3 = seq_parallel_spec(cfg3)
+    assert spec3[0] == ("data", "fsdp") and spec3[2] is None
+
+    # batch NOT divisible by the batch axes' product (B=1 eval on a
+    # training mesh) → batch replicates as before instead of erroring
+    spec_b1 = seq_parallel_spec(cfg, batch_size=1)
+    # data (size 2) must drop; size-1 fsdp may stay (no-op shard)
+    assert spec_b1[0] in (None, ("fsdp",), "fsdp") and spec_b1[1] == "context"
+    spec_b4 = seq_parallel_spec(cfg, batch_size=4)
+    assert spec_b4[0] == ("data", "fsdp")
+    # partial divisibility keeps the largest dividing subset: mesh
+    # {data:2, fsdp:2(implicit 1 here)...} — build one where data=2,
+    # fsdp=2 and B=2 shards over 'data' only
+    mesh22 = make_mesh({"data": 2, "fsdp": 2, "context": 2})
+    cfg22 = dataclasses.replace(
+        ModelConfig.tiny(), attention_impl="ring",
+        context_axis="context", mesh=mesh22)
+    assert seq_parallel_spec(cfg22, batch_size=2)[0] in (("data",), "data")
+
+    # ulysses: heads shard over tensor ONLY if the per-shard head count
+    # still divides the context axis (the all-to-all redistributes
+    # heads) — n_heads=8, tensor=4, context=4 → local heads 2 % 4 != 0
+    mesh44 = make_mesh({"tensor": 4, "context": 2})
+    cfg_u = dataclasses.replace(
+        ModelConfig.tiny(), n_heads=8, n_kv_heads=8, hidden=128,
+        attention_impl="ulysses", context_axis="context", mesh=mesh44)
+    assert seq_parallel_spec(cfg_u)[2] == "tensor"  # 8/4=2 % 2 == 0
+    mesh44b = make_mesh({"tensor": 2, "context": 4})
+    cfg_u2 = dataclasses.replace(cfg_u, mesh=mesh44b)
+    assert seq_parallel_spec(cfg_u2)[2] == "tensor"  # 8/2=4 % 4 == 0
+    cfg_u3 = dataclasses.replace(
+        cfg_u, n_heads=4, n_kv_heads=4, hidden=64, mesh=mesh44b)
+    assert seq_parallel_spec(cfg_u3)[2] is None      # 4/2=2 % 4 != 0
+    # ring has no head all-to-all: same shape shards fine
+    assert seq_parallel_spec(
+        dataclasses.replace(cfg_u3, attention_impl="ring"))[2] == "tensor"
+
+
+def test_model_seq_parallel_train_step_on_data_context_mesh():
+    """Full train step with ring attention on a data×context mesh where
+    BOTH axes are >1 — the regime the advisor flagged (batch must shard
+    over 'data' inside the shard_map, not be redundantly recomputed)."""
+    import dataclasses
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    mesh = make_mesh({"data": 2, "context": 2}, devices=jax.devices()[:4])
+    cfg = dataclasses.replace(
+        ModelConfig.tiny(), attention_impl="ring",
+        context_axis="context", mesh=mesh)
+    model, state, tx = init_train_state(cfg, jax.random.PRNGKey(0), mesh=mesh)
+    step = jax.jit(make_train_step(model, tx), donate_argnums=(0,))
+    rng = np.random.default_rng(0)
+    tokens = jax.device_put(
+        jnp.asarray(rng.integers(0, cfg.vocab_size, (4, 33)), jnp.int32),
+        batch_sharding(mesh),
+    )
+    state, metrics = step(state, tokens)
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_model_parallel_impl_without_mesh_raises():
+    """ring/ulysses without a mesh must raise, not silently fall back to
+    dense (advisor r4: silent fallback hides the misconfiguration until
+    the long-context run OOMs)."""
+    import dataclasses
+
+    import pytest as _pytest
+
+    cfg = dataclasses.replace(ModelConfig.tiny(), attention_impl="ring")
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (1, 16), 0, 256)
+    with _pytest.raises(Exception, match="requires cfg.mesh"):
+        DecoderLM(cfg).init(jax.random.PRNGKey(1), tokens)
+
+
 def test_model_unknown_attention_impl_raises():
     import dataclasses
 

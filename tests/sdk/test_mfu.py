@@ -301,3 +301,27 @@ def test_mixed_declarations_report_both_numerators():
                                             "share_of_step": None}}},
     })
     assert "TFLOP/s achieved" in card
+
+
+def test_mixed_declarations_metadata_from_flops_declaration():
+    """Under mixed declarations (rank 0 tokens-only, rank 1 flops), the
+    headline numerator AND its source/chip/peak metadata must come from
+    the SAME declaration — not a real FLOPs value paired with the
+    tokens-only rank's null metadata (advisor r4)."""
+    from traceml_tpu.analytics.efficiency import build_efficiency
+
+    stats = {
+        0: {"flops_per_step": None, "flops_source": None,
+            "device_kind": None, "peak_flops": None,
+            "device_count": None, "tokens_per_step": 4096.0},
+        1: {"flops_per_step": 200e12, "flops_source": "cost_analysis",
+            "device_kind": "TPU v6e", "peak_flops": 918e12,
+            "device_count": 2},
+    }
+    eff = build_efficiency(stats, {0: 1000.0, 1: 1000.0})
+    assert eff["flops_per_step"] == 200e12
+    assert eff["flops_source"] == "cost_analysis"
+    assert eff["device_kind"] == "TPU v6e"
+    assert eff["device_count"] == 2
+    assert eff["peak_tflops"] == 918.0
+    assert eff["tokens_per_step"] == 4096.0

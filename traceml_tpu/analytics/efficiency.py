@@ -81,26 +81,26 @@ def build_efficiency(
             mfu[str(rank)] = tflops * 1e12 / (peak * max(n_dev, 1))
     if not achieved and not tokens_ps:
         return None
-    peak0 = ms0.get("peak_flops")
-    # numerators reported from the first declaration that HAS each one:
+    # report the numerator AND its metadata from the same declaration:
     # with mixed declarations (one rank flops-only, another tokens-only)
     # ms0 alone would report null for a numerator whose per-rank rate IS
-    # populated (review r4)
-    flops0 = next(
-        (v["flops_per_step"] for v in stats.values()
-         if v.get("flops_per_step")),
-        None,
+    # populated (review r4) — and splitting numerator/metadata across
+    # declarations could pair a real FLOPs value with another rank's
+    # source/chip/peak (advisor r4)
+    flops_decl = next(
+        (v for v in stats.values() if v.get("flops_per_step")), ms0
     )
     tokens0 = next(
         (v["tokens_per_step"] for v in stats.values()
          if v.get("tokens_per_step")),
         None,
     )
+    peak0 = flops_decl.get("peak_flops")
     return {
-        "flops_per_step": flops0,
-        "flops_source": ms0.get("flops_source"),
-        "device_kind": ms0.get("device_kind"),
-        "device_count": ms0.get("device_count"),
+        "flops_per_step": flops_decl.get("flops_per_step"),
+        "flops_source": flops_decl.get("flops_source"),
+        "device_kind": flops_decl.get("device_kind"),
+        "device_count": flops_decl.get("device_count"),
         "peak_tflops": (peak0 / 1e12) if peak0 else None,
         "achieved_tflops_by_rank": {r: round(v, 3) for r, v in achieved.items()},
         "achieved_tflops_median": (

@@ -27,9 +27,26 @@ _SYNC_ATTRS = (
 )
 #: calls that mark a loop as training-like (torch AND jax vocabularies)
 _TRAIN_MARKERS = (
-    "backward", "zero_grad", "step", "apply_gradients", "apply_updates",
+    "backward", "zero_grad", "apply_gradients", "apply_updates",
     "trace_step", "train_step",
 )
+#: markers valid only as a BARE NAME call — ``step(state, batch)`` is
+#: the canonical jitted-jax-step idiom, but the attribute form
+#: (scheduler.step(), env.step(), optimizer.step() without backward)
+#: matches far too much non-training code (advisor r4)
+_TRAIN_NAME_MARKERS = _TRAIN_MARKERS + ("step",)
+
+
+def _receiver_is_optimizer(node: ast.AST) -> bool:
+    """Any name/attr along the receiver chain mentions an optimizer —
+    handles `optimizer`, `self.optimizer`, `optimizers[0]`, and
+    `self.optimizers()[0]` receivers alike."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and "opt" in n.id.lower():
+            return True
+        if isinstance(n, ast.Attribute) and "opt" in n.attr.lower():
+            return True
+    return False
 
 
 class _ScriptVisitor(ast.NodeVisitor):
@@ -64,12 +81,18 @@ class _ScriptVisitor(ast.NodeVisitor):
         for child in ast.walk(loop):
             if isinstance(child, ast.Call):
                 f = child.func
-                if (
-                    isinstance(f, ast.Attribute)
-                    and f.attr in _TRAIN_MARKERS
-                ):
-                    return True
-                if isinstance(f, ast.Name) and f.id in _TRAIN_MARKERS:
+                if isinstance(f, ast.Attribute):
+                    if f.attr in _TRAIN_MARKERS:
+                        return True
+                    # attribute .step() counts only on an optimizer-named
+                    # receiver: catches `optimizer.step(closure)` (LBFGS,
+                    # where backward lives in the closure outside the
+                    # loop) and `optimizers[0].step()` without
+                    # re-admitting scheduler/env/tqdm .step false
+                    # positives (review r5)
+                    if f.attr == "step" and _receiver_is_optimizer(f.value):
+                        return True
+                if isinstance(f, ast.Name) and f.id in _TRAIN_NAME_MARKERS:
                     return True
         return False
 
@@ -121,6 +144,14 @@ class _ScriptVisitor(ast.NodeVisitor):
 
     def visit_Call(self, node: ast.Call) -> None:
         name = _dotted(node.func)
+        if name is None and isinstance(node.func, ast.Attribute):
+            # chained / subscripted receivers (`metrics["loss"].item()`,
+            # `model(x).cpu()`) have no resolvable dotted chain but the
+            # leaf attr still classifies the site; record the leaf in
+            # ``calls`` too so sync_call_hints (built from calls) stays
+            # consistent with sync_sites (review r5)
+            self.calls.append(node.func.attr)
+            self._classify_site(node, node.func.attr)
         if name:
             self.calls.append(name)
             tail = name.split(".")[-1]
@@ -168,8 +199,12 @@ class _ScriptVisitor(ast.NodeVisitor):
                 self.loop_flags["checkpoint_in_loop"] = True
             elif leaf in ("eval", "no_grad", "inference_mode"):
                 self.loop_flags["validation_in_loop"] = True
-            elif leaf in ("log", "add_scalar", "print"):
+            elif leaf in ("log", "add_scalar"):
                 self.loop_flags["logging_in_loop"] = True
+            elif leaf == "print":
+                # ordinary progress prints are too common to count as
+                # logger traffic (advisor r4) — separate advisory flag
+                self.loop_flags["print_in_loop"] = True
         if leaf == "DistributedSampler":
             self.distributed_sampler_used = True
         elif leaf == "set_epoch":
@@ -352,7 +387,7 @@ def _extract(v: _ScriptVisitor, out: Dict[str, Any]) -> None:
         out["uses"].append("lora/qlora")
     # host-sync calls inside the loop are a classic TPU/GPU perf trap
     sync_markers = [
-        n for n in ("item", "block_until_ready", "device_get", "tolist")
+        n for n in _SYNC_ATTRS
         if any(name.endswith("." + n) or name == n for name in set(v.calls))
     ]
     for m in sync_markers:

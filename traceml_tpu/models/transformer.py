@@ -94,6 +94,60 @@ def _rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float) -> jnp.ndarray:
     return rotated.astype(x.dtype)
 
 
+def seq_parallel_spec(cfg: "ModelConfig", batch_size: Optional[int] = None):
+    """PartitionSpec for (B, S, heads, hd) q/k/v under seq parallelism.
+
+    Derived from the mesh instead of hardcoded so no axis is silently
+    replicated: batch shards over whichever of ``mesh.BATCH_AXES`` the
+    mesh actually has (without this, every data-parallel group would
+    all-gather the global batch at the shard_map boundary and
+    redundantly compute full-batch attention — advisor r4); heads shard
+    over "tensor" when the mesh has one and the head count divides,
+    matching the column-parallel wq/wk/wv output layout so the
+    shard_map boundary introduces no tensor-axis all-gather either.
+    Attention is independent per batch element and per head, so both
+    shardings are exact.
+
+    Fallbacks keep previously-valid configs running (review r5): a
+    ``batch_size`` not divisible by the batch axes' product (e.g. B=1
+    eval on a training mesh) replicates batch as before, and heads stay
+    unsharded when the ulysses all-to-all could not redistribute the
+    per-shard head count over the context axis.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from traceml_tpu.parallel.mesh import BATCH_AXES
+
+    mesh_axes = tuple(cfg.mesh.axis_names)
+    batch_axes = tuple(
+        ax for ax in BATCH_AXES
+        if ax in mesh_axes and ax != cfg.context_axis
+    )
+    if batch_axes and batch_size is not None:
+        # keep the largest dividing subset rather than all-or-nothing:
+        # mesh {data:4, fsdp:2} with B=4 still shards over 'data'
+        kept, dp = [], 1
+        for ax in batch_axes:
+            size = cfg.mesh.shape[ax]
+            if batch_size % (dp * size) == 0:
+                kept.append(ax)
+                dp *= size
+        batch_axes = tuple(kept)
+    heads_axis = None
+    if (
+        "tensor" in mesh_axes
+        and cfg.context_axis != "tensor"
+        and cfg.n_heads % cfg.mesh.shape["tensor"] == 0
+    ):
+        local_heads = cfg.n_heads // cfg.mesh.shape["tensor"]
+        if (
+            cfg.attention_impl != "ulysses"
+            or local_heads % cfg.mesh.shape[cfg.context_axis] == 0
+        ):
+            heads_axis = "tensor"
+    return P(batch_axes or None, cfg.context_axis, heads_axis, None)
+
+
 class Attention(nn.Module):
     cfg: ModelConfig
 
@@ -128,16 +182,15 @@ class Attention(nn.Module):
         "dense": the fused jnp path — GSPMD partitions it (the pallas
         flash kernel substitutes on TPU).  "ring"/"ulysses": the op
         runs inside shard_map over cfg.context_axis with q/k/v sharded
-        BY SEQUENCE; RoPE was already applied on global positions, and
-        both ops enforce global causality themselves.
+        BY SEQUENCE (and by batch over the data-parallel axes — see
+        seq_parallel_spec); RoPE was already applied on global
+        positions, and both ops enforce global causality themselves.
         """
         cfg = self.cfg
-        if cfg.attention_impl == "dense" or cfg.mesh is None:
+        if cfg.attention_impl == "dense":
             from traceml_tpu.ops.attention import causal_attention
 
             return causal_attention(q, k, v)
-        from jax.sharding import PartitionSpec as P
-
         if cfg.attention_impl == "ring":
             from traceml_tpu.ops.ring_attention import ring_attention as op
         elif cfg.attention_impl == "ulysses":
@@ -149,7 +202,14 @@ class Attention(nn.Module):
                 f"unknown attention_impl {cfg.attention_impl!r} "
                 "(dense | ring | ulysses)"
             )
-        spec = P(None, cfg.context_axis, None, None)
+        if cfg.mesh is None or cfg.context_axis is None:
+            raise ValueError(
+                f"attention_impl={cfg.attention_impl!r} requires cfg.mesh "
+                "and cfg.context_axis (sequence-parallel attention runs "
+                "inside shard_map); use attention_impl='dense' for "
+                "single-mesh GSPMD partitioning"
+            )
+        spec = seq_parallel_spec(cfg, batch_size=q.shape[0])
         return jax.shard_map(
             lambda a, b, c: op(a, b, c, cfg.context_axis),
             mesh=cfg.mesh,

@@ -25,6 +25,10 @@ import numpy as np
 
 AXES = ("data", "fsdp", "tensor", "context")
 EXTRA_AXES = ("expert", "stage")  # MoE ep / pipeline pp (see docstring)
+#: the axes that carry the batch dim — single authority consumed by
+#: batch_sharding, local_batch_size AND the model's shard_map specs
+#: (models/transformer.py seq_parallel_spec), so they cannot drift
+BATCH_AXES = ("data", "fsdp")
 
 
 def make_mesh(
@@ -83,7 +87,7 @@ def batch_sharding(mesh) -> "object":
     """Batch arrays are sharded over the data-parallel axes."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    return NamedSharding(mesh, P(("data", "fsdp")))
+    return NamedSharding(mesh, P(BATCH_AXES))
 
 
 def replicated(mesh) -> "object":
@@ -93,7 +97,7 @@ def replicated(mesh) -> "object":
 
 
 def local_batch_size(global_batch: int, mesh) -> Tuple[int, int]:
-    dp = mesh.shape["data"] * mesh.shape["fsdp"]
+    dp = int(np.prod([mesh.shape[ax] for ax in BATCH_AXES]))
     if global_batch % dp:
         raise ValueError(f"global batch {global_batch} not divisible by dp={dp}")
     return global_batch // dp, dp
