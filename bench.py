@@ -54,6 +54,14 @@ WARMUP_STEPS = 6
 ROUNDS = 10          # in-process (TPU) mode
 N_PAIRS = 10         # CPU mode: pair children (see module docstring)
 STEPS_PER_ROUND = 16
+# short-step lane (VERDICT r4 item 1b): ~10-15 ms steps are where
+# tracer overhead is proportionally largest (the reference warns
+# overhead is "highest on very short steps", ref architecture.md:73,89
+# — and a ~10 ms TPU step with the resolver polling at ms cadence is
+# the actual on-chip risk).  More steps per arm + more pairs beat the
+# 1-core noise floor at this scale.
+N_PAIRS_SHORT = 12
+STEPS_PER_ROUND_SHORT = 128
 _PROBE_TIMEOUT_S = 90
 _READY_TIMEOUT_S = 240  # import + first compile
 _ROUND_TIMEOUT_S = 120
@@ -174,7 +182,7 @@ def _cpu_env(env: dict) -> dict:
 # model / loop (shared by both arms)
 # --------------------------------------------------------------------------
 
-def _build():
+def _build(short: bool = False):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -182,7 +190,16 @@ def _build():
     from traceml_tpu.models import ModelConfig, init_train_state, make_train_step
 
     platform = jax.default_backend()
-    if platform != "cpu":  # tpu (incl. tunneled backends)
+    if short:
+        # ~10-15 ms steps on both backends: the short-step stress lane
+        # (calibrated on the 1-core CPU host; a real chip lands in the
+        # same regime on this size via dispatch overheads)
+        cfg = ModelConfig(
+            vocab_size=1024, hidden=128, n_layers=2, n_heads=4,
+            n_kv_heads=2, max_seq_len=64,
+        )
+        batch, seq = 2, 64
+    elif platform != "cpu":  # tpu (incl. tunneled backends)
         # sized so one fwd+bwd+opt step is ~7 TFLOP — tens of ms on a
         # real single chip, comfortably above the tracer's µs-scale
         # per-step cost and the measurement noise floor
@@ -265,29 +282,62 @@ def _run_loop(step_fn, state, batches, n_steps, bracket=None, stat=None):
 
 
 def _start_traced_stack():
-    """Bring up the FULL traced stack (aggregator sink + runtime agent +
-    auto patches); returns (traceml_tpu module, stop callable).  Shared
-    by every live bench mode so they all measure the same configuration.
+    """Bring up the FULL traced stack exactly as the product deploys it:
+    the aggregator in its OWN process (the launcher always spawns it
+    standalone — launcher/commands.py), the per-rank runtime agent +
+    auto patches in this one.  Returns (traceml_tpu module, runtime,
+    stop callable).  Shared by every live bench mode so they all
+    measure the same configuration.
+
+    The aggregator must NOT share the training process here: its event
+    loop / sqlite writer / TCP drain threads are infrastructure that the
+    launcher architecture puts out of the training process, and hosting
+    them in-process inflates the measured per-step cost with GIL
+    contention the product never pays (visible on the short-step lane:
+    ~1 ms/step on a 1-core host).
     """
     import tempfile
 
     import traceml_tpu
-    from traceml_tpu.aggregator.trace_aggregator import TraceMLAggregator
+    from traceml_tpu.launcher.process import wait_for_ready_file
     from traceml_tpu.runtime.identity import RuntimeIdentity
     from traceml_tpu.runtime.runtime import TraceMLRuntime
-    from traceml_tpu.runtime.settings import AggregatorEndpoint, TraceMLSettings
+    from traceml_tpu.runtime.settings import (
+        AggregatorEndpoint,
+        TraceMLSettings,
+        settings_to_env,
+    )
 
     tmp = Path(tempfile.mkdtemp(prefix="traceml_bench_"))
-    agg = TraceMLAggregator(TraceMLSettings(
+    agg_settings = TraceMLSettings(
         session_id="bench", logs_dir=tmp, mode="summary",
         aggregator=AggregatorEndpoint(port=0), expected_world_size=1,
         finalize_timeout_sec=10.0,
-    ))
-    agg.start()
+    )
+    env = dict(os.environ)
+    # the same env contract the launcher uses for its aggregator spawn
+    # (launcher/commands.py) — hand-rolled keys would silently drift
+    env.update(settings_to_env(agg_settings))
+    # the aggregator child must never touch the device backend
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    agg_proc = subprocess.Popen(
+        [sys.executable, "-m", "traceml_tpu.aggregator.aggregator_main"],
+        env=env,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    session_dir = tmp / "bench"
+    ready = wait_for_ready_file(
+        session_dir / "aggregator_ready.json", timeout=30.0
+    )
+    if ready is None:
+        agg_proc.kill()
+        raise RuntimeError("bench aggregator failed to become ready")
     runtime = TraceMLRuntime(
         TraceMLSettings(
             session_id="bench", logs_dir=tmp, mode="summary",
-            aggregator=AggregatorEndpoint(port=agg.port or 0),
+            aggregator=AggregatorEndpoint(port=int(ready["port"])),
             sampler_interval_sec=1.0,
         ),
         RuntimeIdentity(global_rank=0),
@@ -297,12 +347,16 @@ def _start_traced_stack():
 
     def stop():
         runtime.stop()
-        agg.stop(finalize_timeout=5.0)
+        agg_proc.terminate()
+        try:
+            agg_proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            agg_proc.kill()
 
     return traceml_tpu, runtime, stop
 
 
-def _pair_child(steps: int, out_path: Path) -> int:
+def _pair_child(steps: int, out_path: Path, short: bool = False) -> int:
     """One FULL pair in one process, untraced arm first.
 
     Isolation holds because no tracer component is initialized until
@@ -327,13 +381,13 @@ def _pair_child(steps: int, out_path: Path) -> int:
     # enforce the strongest checkable precondition: the bench process
     # reached this point without anything preloading traceml
     assert "traceml_tpu" not in sys.modules
-    model, state, tx, train_step, batches = _build()
+    model, state, tx, train_step, batches = _build(short)
     plain = jax.jit(train_step, donate_argnums=(0,))
     _, state = _run_loop(plain, state, batches, WARMUP_STEPS)
     u, state = _run_loop(plain, state, batches, steps, stat=min)
 
     traceml_tpu, runtime, stop = _start_traced_stack()
-    model2, state2, tx2, train_step2, batches2 = _build()
+    model2, state2, tx2, train_step2, batches2 = _build(short)
     traced = traceml_tpu.wrap_step_fn(train_step2, donate_argnums=(0,))
     _, state2 = _run_loop(
         traced, state2, batches2, WARMUP_STEPS, bracket=traceml_tpu.trace_step
@@ -360,39 +414,78 @@ def _bootstrap_ci(deltas, n=2000, seed=0):
     return meds[int(0.025 * n)], meds[int(0.975 * n)]
 
 
-def _orchestrate() -> int:
-    import tempfile
-
-    work = Path(tempfile.mkdtemp(prefix="traceml_bench_"))
-    env = dict(os.environ)
-    env["TRACEML_BENCH_CACHE"] = str(work / "xla_cache")
+def _orchestrate_lane(work: Path, env: dict, n_pairs: int, steps: int,
+                      short: bool, label: str):
+    """Run one pair-child lane; returns (u_all, t_all, deltas)."""
     u_all, t_all, deltas = [], [], []
-    for i in range(N_PAIRS):
-        out = work / f"pair{i}.json"
+    for i in range(n_pairs):
+        out = work / f"pair_{label}_{i}.json"
+        cmd = [
+            sys.executable, __file__, "--pair",
+            "--steps", str(steps), "--out", str(out),
+        ]
+        if short:
+            cmd.append("--short")
         proc = subprocess.run(
-            [
-                sys.executable, __file__, "--pair",
-                "--steps", str(STEPS_PER_ROUND), "--out", str(out),
-            ],
-            env=env,
-            timeout=_READY_TIMEOUT_S + 2 * _ROUND_TIMEOUT_S,
+            cmd, env=env, timeout=_READY_TIMEOUT_S + 2 * _ROUND_TIMEOUT_S,
         )
         if proc.returncode != 0 or not out.exists():
-            raise RuntimeError(f"pair {i} failed rc={proc.returncode}")
+            raise RuntimeError(f"{label} pair {i} failed rc={proc.returncode}")
         pair = json.loads(out.read_text())
         u, t = pair["u"], pair["t"]
         u_all.append(u)
         t_all.append(t)
         deltas.append((t - u) / u * 100.0)
         print(
-            f"[bench] pair {i}: untraced {u * 1000:.2f} traced "
+            f"[bench] {label} pair {i}: untraced {u * 1000:.2f} traced "
             f"{t * 1000:.2f} ms/step ({deltas[-1]:+.2f}%)",
             file=sys.stderr,
         )
+    return u_all, t_all, deltas
+
+
+def _orchestrate() -> int:
+    import tempfile
+
+    work = Path(tempfile.mkdtemp(prefix="traceml_bench_"))
+    env = dict(os.environ)
+    env["TRACEML_BENCH_CACHE"] = str(work / "xla_cache")
+    u_all, t_all, deltas = _orchestrate_lane(
+        work, env, N_PAIRS, STEPS_PER_ROUND, short=False, label="std"
+    )
     # backend is known without importing jax here: this path only runs
     # on the cpu backend (device backends use _run_interleaved)
     extra = {"backend": "cpu"}
     extra.update(_watch_stats())
+    # short-step stress lane (~10-15 ms steps): published beside the
+    # headline number — if the tracer survives 10 ms steps on a 1-core
+    # host, the on-chip <2% claim is engineering, not hope
+    try:
+        su, st, sd = _orchestrate_lane(
+            work, env, N_PAIRS_SHORT, STEPS_PER_ROUND_SHORT,
+            short=True, label="short",
+        )
+        lo, hi = _bootstrap_ci(sd)
+        extra["short_step"] = {
+            "untraced_ms": round(statistics.median(su) * 1000, 3),
+            "traced_ms": round(statistics.median(st) * 1000, 3),
+            "median_delta_pct": round(statistics.median(sd), 3),
+            "ci95_pct": [round(lo, 3), round(hi, 3)],
+            "pairs": len(sd),
+            "steps_per_arm": STEPS_PER_ROUND_SHORT,
+        }
+        print(
+            f"[bench] short-step lane: untraced "
+            f"{extra['short_step']['untraced_ms']:.2f} ms/step, delta "
+            f"{extra['short_step']['median_delta_pct']:+.2f}% "
+            f"(95% CI [{lo:+.2f}, {hi:+.2f}], {len(sd)} pairs)",
+            file=sys.stderr,
+        )
+    except (RuntimeError, subprocess.TimeoutExpired) as exc:
+        # the short lane is evidence, not the contract — the headline
+        # JSON line must still be emitted if it fails
+        print(f"[bench] short-step lane failed: {exc}", file=sys.stderr)
+        extra["short_step"] = {"error": str(exc)}
     return _report(u_all, t_all, deltas, "cpu", "pair-child", extra=extra)
 
 
@@ -581,13 +674,14 @@ def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--pair", action="store_true")
     parser.add_argument("--interleaved", action="store_true")
+    parser.add_argument("--short", action="store_true")
     parser.add_argument("--rounds", type=int, default=ROUNDS)
     parser.add_argument("--steps", type=int, default=STEPS_PER_ROUND)
     parser.add_argument("--out", type=str)
     args = parser.parse_args()
 
     if args.pair:
-        return _pair_child(args.steps, Path(args.out))
+        return _pair_child(args.steps, Path(args.out), short=args.short)
     if args.interleaved:
         return _run_interleaved(args.rounds, args.steps)
 

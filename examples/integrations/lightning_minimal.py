@@ -1,0 +1,73 @@
+"""Minimal PyTorch Lightning training run under TraceML-TPU.
+
+The TraceML callback owns per-phase timing (forward / backward /
+optimizer) because Lightning controls the loop — see
+traceml_tpu/integrations/lightning.py for the hook → region mapping
+(reference parity: src/traceml_ai/integrations/lightning.py).
+
+Run (with lightning or pytorch_lightning installed):
+
+    traceml-tpu run --mode cli examples/integrations/lightning_minimal.py
+
+Without Lightning installed this script exits with a clear message
+instead of crashing (the integration is import-gated, fail-open like
+every other surface).
+"""
+
+import sys
+
+import torch
+import torch.nn as nn
+
+import traceml_tpu
+from traceml_tpu.integrations.lightning import make_traceml_callback
+
+try:
+    try:
+        from lightning.pytorch import LightningModule, Trainer
+    except ImportError:
+        from pytorch_lightning import LightningModule, Trainer
+except ImportError:
+    sys.exit("lightning / pytorch_lightning not installed — "
+             "`pip install lightning` to run this example")
+
+
+class TinyRegressor(LightningModule):
+    def __init__(self) -> None:
+        super().__init__()
+        self.net = nn.Sequential(
+            nn.Linear(64, 256), nn.Tanh(), nn.Linear(256, 1)
+        )
+        self.loss_fn = nn.MSELoss()
+
+    def forward(self, x):
+        return self.net(x)
+
+    def training_step(self, batch, batch_idx):
+        x, y = batch
+        return self.loss_fn(self(x), y)
+
+    def configure_optimizers(self):
+        return torch.optim.Adam(self.parameters(), lr=1e-3)
+
+
+def main() -> None:
+    traceml_tpu.init(mode="auto")
+    dataset = torch.utils.data.TensorDataset(
+        torch.randn(2048, 64), torch.randn(2048, 1)
+    )
+    loader = torch.utils.data.DataLoader(dataset, batch_size=16)
+
+    callback_cls = make_traceml_callback()
+    trainer = Trainer(
+        max_epochs=1,
+        callbacks=[callback_cls()],
+        enable_checkpointing=False,
+        logger=False,
+    )
+    trainer.fit(TinyRegressor(), loader)
+    print(traceml_tpu.summary())
+
+
+if __name__ == "__main__":
+    main()

@@ -25,7 +25,19 @@ _HOOKS = (
 
 
 def make_layout(layout_name: str):
-    """Fresh (Callback, Trainer) pair for one package layout."""
+    """Fresh (Callback, Trainer, LightningModule) triple for one layout."""
+    import torch
+
+    class LightningModule(torch.nn.Module):
+        """Real-API subset: user modules override ``training_step`` and
+        ``configure_optimizers`` (lightning.pytorch.core.module); the
+        fake Trainer drives those when present."""
+
+        def training_step(self, batch, batch_idx):  # pragma: no cover
+            raise NotImplementedError
+
+        def configure_optimizers(self):  # pragma: no cover
+            raise NotImplementedError
 
     class Callback:
         _fake_lightning_layout = layout_name
@@ -65,12 +77,23 @@ def make_layout(layout_name: str):
         def __init__(
             self,
             callbacks: Optional[List[Any]] = None,
-            max_steps: int = 10,
+            max_steps: int = -1,  # real Lightning's "unset" sentinel
+            max_epochs: Optional[int] = None,
             num_sanity_val_steps: int = 2,
+            enable_checkpointing: bool = True,
+            logger: Any = None,
         ) -> None:
             self.callbacks = list(callbacks or [])
-            self.max_steps = int(max_steps)
+            self.max_epochs = max_epochs
+            if max_steps == -1:
+                # unset: epochs bound the run when given, else the
+                # legacy fake default of 10 steps
+                self.max_steps = 10**9 if max_epochs is not None else 10
+            else:
+                self.max_steps = int(max_steps)
             self.num_sanity_val_steps = int(num_sanity_val_steps)
+            self.enable_checkpointing = enable_checkpointing
+            self.logger = logger
             self.sanity_checking = False
 
         def _hook(self, name: str, *args: Any, **kwargs: Any) -> None:
@@ -81,7 +104,10 @@ def make_layout(layout_name: str):
             import torch
 
             self._hook("setup", self, model, stage="fit")
-            optimizer = torch.optim.SGD(model.parameters(), lr=0.01)
+            if isinstance(model, LightningModule):
+                optimizer = model.configure_optimizers()
+            else:
+                optimizer = torch.optim.SGD(model.parameters(), lr=0.01)
             batches = iter(train_dataloader)
 
             # sanity-check pass: hooks fire with sanity_checking=True and
@@ -98,13 +124,12 @@ def make_layout(layout_name: str):
                 )
             self.sanity_checking = False
 
-            for idx in range(self.max_steps):
-                try:
-                    batch = next(batches)
-                except StopIteration:
-                    break
+            def _train_one(batch, idx) -> None:
                 self._hook("on_train_batch_start", self, model, batch, idx)
-                loss = model(batch).pow(2).mean()  # "training_step"
+                if isinstance(model, LightningModule):
+                    loss = model.training_step(batch, idx)
+                else:
+                    loss = model(batch).pow(2).mean()  # "training_step"
                 self._hook("on_before_zero_grad", self, model, optimizer)
                 optimizer.zero_grad()
                 self._hook("on_before_backward", self, model, loss)
@@ -115,7 +140,16 @@ def make_layout(layout_name: str):
                 self._hook(
                     "on_train_batch_end", self, model, loss.detach(), batch, idx
                 )
+
+            done = 0
+            for epoch in range(self.max_epochs or 1):
+                it = batches if epoch == 0 else iter(train_dataloader)
+                for idx, batch in enumerate(it):
+                    if done >= self.max_steps:
+                        break
+                    _train_one(batch, idx)
+                    done += 1
             self._hook("on_train_end", self, model)
             self._hook("teardown", self, model, stage="fit")
 
-    return Callback, Trainer
+    return Callback, Trainer, LightningModule

@@ -4,4 +4,5 @@ from lightning import pytorch  # noqa: F401
 
 Trainer = pytorch.Trainer
 Callback = pytorch.Callback
+LightningModule = pytorch.LightningModule
 __version__ = "2.0-fake"

@@ -1,3 +1,3 @@
 from _fake_lightning_impl import make_layout
 
-Callback, Trainer = make_layout("lightning.pytorch")
+Callback, Trainer, LightningModule = make_layout("lightning.pytorch")

@@ -2,5 +2,5 @@
 
 from _fake_lightning_impl import make_layout
 
-Callback, Trainer = make_layout("pytorch_lightning")
+Callback, Trainer, LightningModule = make_layout("pytorch_lightning")
 __version__ = "1.9-fake"

@@ -1,0 +1,60 @@
+"""The runnable Lightning example, smoke-run against the fake packages
+(VERDICT r4 item 8: the only integration without a runnable example).
+
+The example itself targets real lightning; here the fake layout proves
+the script's API usage (LightningModule subclass, Trainer(max_epochs=1,
+callbacks=[...]), fit over a DataLoader) drives the TraceML callback
+end-to-end and produces one timed batch per training step.
+"""
+
+import runpy
+import sys
+from pathlib import Path
+
+import pytest
+
+from traceml_tpu.utils import timing as T
+
+REPO = Path(__file__).resolve().parents[2]
+FAKES = Path(__file__).resolve().parents[1] / "fakes"
+EXAMPLE = REPO / "examples" / "integrations" / "lightning_minimal.py"
+
+
+@pytest.fixture()
+def fake_lightning(monkeypatch):
+    import traceml_tpu.integrations.lightning as L
+
+    monkeypatch.syspath_prepend(str(FAKES))
+    monkeypatch.setattr(L, "_cached_callback_cls", None)
+    yield
+    for name in [
+        m for m in sys.modules
+        if m == "_fake_lightning_impl"
+        or m.startswith(("lightning", "pytorch_lightning"))
+    ]:
+        del sys.modules[name]
+
+
+def test_lightning_example_runs_against_fake(fake_lightning, monkeypatch):
+    from traceml_tpu.sdk.state import get_state
+
+    captured = []
+    st = get_state()
+    st.on_batch_flushed.append(captured.append)
+    # keep the smoke fast: 2048/16 = 128 batches is overkill here
+    import torch
+
+    real_dataset = torch.utils.data.TensorDataset
+    monkeypatch.setattr(
+        torch.utils.data, "TensorDataset",
+        lambda x, y: real_dataset(x[:64], y[:64]),
+    )
+    try:
+        runpy.run_path(str(EXAMPLE), run_name="__main__")
+    finally:
+        st.on_batch_flushed.remove(captured.append)
+    assert captured, "no timed batches — callback never drove a step"
+    names = [e.name for e in captured[0].events]
+    assert T.FORWARD_TIME in names
+    assert T.BACKWARD_TIME in names
+    assert T.OPTIMIZER_STEP in names

@@ -161,6 +161,13 @@ def test_seq_parallel_spec_shards_batch_and_heads():
         ModelConfig.tiny(), attention_impl="ring",
         context_axis="context", mesh=mesh22)
     assert seq_parallel_spec(cfg22, batch_size=2)[0] in (("data",), "data")
+    # ...and is truly the LARGEST subset, not a greedy prefix: with
+    # data=2, fsdp=4 and B=4, fsdp alone (4-way) beats data (2-way)
+    mesh24 = make_mesh({"data": 2, "fsdp": 4})
+    cfg24 = dataclasses.replace(
+        ModelConfig.tiny(), attention_impl="ring",
+        context_axis="context", mesh=mesh24)
+    assert seq_parallel_spec(cfg24, batch_size=4)[0] in (("fsdp",), "fsdp")
 
     # ulysses: heads shard over tensor ONLY if the per-shard head count
     # still divides the context axis (the all-to-all redistributes

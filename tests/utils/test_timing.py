@@ -134,3 +134,64 @@ def test_marker_resolver_submit_resolved_is_noop():
     r.submit(m)
     assert r.pending_count() == 0
     r.stop()
+
+
+def test_marker_resolver_quiet_mode_after_inline_wins():
+    """After consecutive sweep_inline wins, step-end submits stop waking
+    the resolver thread (the training thread stamps markers itself in a
+    bracketed hot loop — waking the thread per submit only preempts the
+    trainer); a marker the THREAD resolves decays the counter so eager
+    wakes return (review r5 short-step lane)."""
+    from traceml_tpu.utils.marker_resolver import _QUIET_AFTER_WINS
+
+    r = MarkerResolver(poll_interval=0.001)
+    # accumulate inline wins (hot-loop pattern: submit, then sweep from
+    # the caller thread before the resolver runs)
+    for _ in range(_QUIET_AFTER_WINS + 1):
+        h = FakeHandle(ready=True)
+        m = DeviceMarker([h])
+        m.submitted = True  # pending without waking the thread
+        r._pending.append(m)
+        assert r.sweep_inline() >= 1
+    assert r._inline_wins >= _QUIET_AFTER_WINS
+
+    # quiet: a step-end submit must not set the wake event
+    r._wake.clear()
+    m2 = DeviceMarker([FakeHandle(ready=False)])
+    m2.step_end_hint = True
+    r.submit(m2)
+    assert not r._wake.is_set()
+
+    # non-step-end markers always wake (intra-step phase edges need the
+    # fine cadence)
+    m3 = DeviceMarker([FakeHandle(ready=False)])
+    r.submit(m3)
+    assert r._wake.is_set()
+    r.stop()
+
+
+def test_marker_resolver_thread_resolution_decays_quiet():
+    r = MarkerResolver(poll_interval=0.001)
+    r._inline_wins = 10
+    h = FakeHandle(ready=True)
+    m = DeviceMarker([h])
+    m.step_end_hint = True
+    r.submit(m)  # quiet submit (no wake) — idle scan must still stamp it
+    deadline = time.monotonic() + 2
+    while not m.resolved and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert m.resolved
+    assert r._inline_wins < 10  # thread win decayed the counter
+    r.stop()
+
+
+def test_step_fn_path_getter_extracts_and_falls_back():
+    from traceml_tpu.sdk.step_fn import _path_getter
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(
+        {"state": [1, 2], "metrics": {"loss": 3}}
+    )
+    path = next(p for p, v in flat if v == 3)
+    g = _path_getter(path)
+    assert g({"state": [1, 2], "metrics": {"loss": 42}}) == 42

@@ -124,15 +124,24 @@ def seq_parallel_spec(cfg: "ModelConfig", batch_size: Optional[int] = None):
         if ax in mesh_axes and ax != cfg.context_axis
     )
     if batch_axes and batch_size is not None:
-        # keep the largest dividing subset rather than all-or-nothing:
-        # mesh {data:4, fsdp:2} with B=4 still shards over 'data'
-        kept, dp = [], 1
-        for ax in batch_axes:
-            size = cfg.mesh.shape[ax]
-            if batch_size % (dp * size) == 0:
-                kept.append(ax)
-                dp *= size
-        batch_axes = tuple(kept)
+        # keep the LARGEST dividing subset rather than all-or-nothing
+        # (mesh {data:4, fsdp:2} with B=4 still shards over 'data') —
+        # exhaustive over the ≤2 batch axes, because a greedy in-order
+        # scan lets an earlier small axis block a later larger one
+        # (mesh {data:2, fsdp:4} with B=4 must pick fsdp, not data)
+        best, best_dp = (), 1
+        for mask in range(1, 1 << len(batch_axes)):
+            subset = tuple(
+                ax for i, ax in enumerate(batch_axes) if mask >> i & 1
+            )
+            dp = 1
+            for ax in subset:
+                dp *= cfg.mesh.shape[ax]
+            if batch_size % dp == 0 and (
+                dp > best_dp or (dp == best_dp and len(subset) > len(best))
+            ):
+                best, best_dp = subset, dp
+        batch_axes = best
     heads_axis = None
     if (
         "tensor" in mesh_axes
