@@ -444,14 +444,20 @@ def _orchestrate_lane(work: Path, env: dict, n_pairs: int, steps: int,
     return u_all, t_all, deltas
 
 
-def _orchestrate() -> int:
+def _orchestrate(n_pairs: int | None = None, steps: int | None = None) -> int:
+    """CPU pair-child bench, both lanes.  ``n_pairs``/``steps`` override
+    the lane defaults when the caller passed explicit --rounds/--steps
+    (the CI contract lane runs `--rounds 2 --steps 4` for a fast
+    one-JSON-line smoke, not the full measurement schedule)."""
     import tempfile
 
     work = Path(tempfile.mkdtemp(prefix="traceml_bench_"))
     env = dict(os.environ)
     env["TRACEML_BENCH_CACHE"] = str(work / "xla_cache")
+    std_steps = steps or STEPS_PER_ROUND
     u_all, t_all, deltas = _orchestrate_lane(
-        work, env, N_PAIRS, STEPS_PER_ROUND, short=False, label="std"
+        work, env, n_pairs or N_PAIRS, std_steps,
+        short=False, label="std",
     )
     # backend is known without importing jax here: this path only runs
     # on the cpu backend (device backends use _run_interleaved)
@@ -461,8 +467,9 @@ def _orchestrate() -> int:
     # headline number — if the tracer survives 10 ms steps on a 1-core
     # host, the on-chip <2% claim is engineering, not hope
     try:
+        short_steps = steps or STEPS_PER_ROUND_SHORT
         su, st, sd = _orchestrate_lane(
-            work, env, N_PAIRS_SHORT, STEPS_PER_ROUND_SHORT,
+            work, env, n_pairs or N_PAIRS_SHORT, short_steps,
             short=True, label="short",
         )
         lo, hi = _bootstrap_ci(sd)
@@ -472,7 +479,7 @@ def _orchestrate() -> int:
             "median_delta_pct": round(statistics.median(sd), 3),
             "ci95_pct": [round(lo, 3), round(hi, 3)],
             "pairs": len(sd),
-            "steps_per_arm": STEPS_PER_ROUND_SHORT,
+            "steps_per_arm": short_steps,
         }
         print(
             f"[bench] short-step lane: untraced "
@@ -486,7 +493,8 @@ def _orchestrate() -> int:
         # JSON line must still be emitted if it fails
         print(f"[bench] short-step lane failed: {exc}", file=sys.stderr)
         extra["short_step"] = {"error": str(exc)}
-    return _report(u_all, t_all, deltas, "cpu", "pair-child", extra=extra)
+    return _report(u_all, t_all, deltas, "cpu", "pair-child",
+                   steps=std_steps, extra=extra)
 
 
 def _report(u_all, t_all, deltas, backend: str, mode: str,
@@ -675,15 +683,20 @@ def main() -> int:
     parser.add_argument("--pair", action="store_true")
     parser.add_argument("--interleaved", action="store_true")
     parser.add_argument("--short", action="store_true")
-    parser.add_argument("--rounds", type=int, default=ROUNDS)
-    parser.add_argument("--steps", type=int, default=STEPS_PER_ROUND)
+    # None = lane defaults; explicit values size BOTH lanes (CI smoke)
+    parser.add_argument("--rounds", type=int, default=None)
+    parser.add_argument("--steps", type=int, default=None)
     parser.add_argument("--out", type=str)
     args = parser.parse_args()
 
     if args.pair:
-        return _pair_child(args.steps, Path(args.out), short=args.short)
+        return _pair_child(
+            args.steps or STEPS_PER_ROUND, Path(args.out), short=args.short
+        )
     if args.interleaved:
-        return _run_interleaved(args.rounds, args.steps)
+        return _run_interleaved(
+            args.rounds or ROUNDS, args.steps or STEPS_PER_ROUND
+        )
 
     if os.environ.get("TRACEML_BENCH_NO_PROBE") != "1":
         cached = _cached_probe()
@@ -715,13 +728,14 @@ def main() -> int:
             # device path runs in a BOUNDED child: a tunnel that probes
             # healthy can still wedge mid-run inside C++ (unkillable from
             # threads), and the one-JSON-line contract must survive that
-            if _run_device_child(args.rounds, args.steps):
+            if _run_device_child(args.rounds or ROUNDS,
+                                 args.steps or STEPS_PER_ROUND):
                 return 0
             if _emit_persisted_tpu():
                 return 0
             return _cpu_proxy_fallback()
     try:
-        return _orchestrate()
+        return _orchestrate(args.rounds, args.steps)
     except Exception as exc:
         # the one-JSON-line contract holds even if a child wedges:
         # fall back to the in-process method rather than traceback out

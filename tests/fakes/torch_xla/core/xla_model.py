@@ -24,8 +24,24 @@ def xla_device(n=None, devkind=None):
 
 
 def get_memory_info(dev):
+    """Two real return shapes, selected by FAKE_XLA_MEMORY_SHAPE:
+
+    * ``kb`` (default) — the XRT-era/documented shape:
+      ``{"kb_total", "kb_free"}`` (torch_xla API docs,
+      xla_model.get_memory_info);
+    * ``bytes`` — the PJRT-era shape observed from torch_xla 2.x:
+      ``{"bytes_used", "bytes_limit", "peak_bytes"}``.
+
+    traceml's XlaMemoryBackend must read BOTH (FAKES.md rows M1-M2).
+    """
     global _used_kb
     _used_kb += 1024  # +1 MiB per sample: growth is observable
+    if os.environ.get("FAKE_XLA_MEMORY_SHAPE", "kb") == "bytes":
+        return {
+            "bytes_used": _used_kb * 1024,
+            "bytes_limit": _KB_TOTAL * 1024,
+            "peak_bytes": _used_kb * 1024,
+        }
     return {"kb_total": _KB_TOTAL, "kb_free": _KB_TOTAL - _used_kb}
 
 

@@ -1,0 +1,24 @@
+"""Fake ``torch_xla.runtime`` — the PJRT-era identity API
+(``import torch_xla.runtime as xr``): ``xr.world_size()`` /
+``xr.global_ordinal()`` supersede the deprecated
+``xm.xrt_world_size()`` / ``xm.get_ordinal()`` (torch_xla 2.x
+deprecation warnings name these exact replacements — FAKES.md I1-I2).
+"""
+
+import os
+
+
+def world_size() -> int:
+    return int(os.environ.get("WORLD_SIZE", 1))
+
+
+def global_ordinal() -> int:
+    return int(os.environ.get("RANK", 0))
+
+
+def local_ordinal() -> int:
+    return int(os.environ.get("LOCAL_RANK", os.environ.get("RANK", 0)))
+
+
+def device_type() -> str:
+    return "TPU"

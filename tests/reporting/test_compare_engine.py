@@ -226,3 +226,71 @@ def test_classify_tiers():
     assert classify(0.5, 1, 2) == "negligible"
     assert classify(-1.5, 1, 2) == "minor"
     assert classify(2.5, 1, 2) == "major"
+
+
+# -- confidence-weighted ladder (VERDICT r4 item 9) -------------------------
+
+def _summary_conf(step_ms, kind, severity, conf):
+    s = _summary(step_ms=step_ms, diagnosis=(kind, severity))
+    if conf is not None:
+        from traceml_tpu.diagnostics.common import confidence_label
+
+        s["primary_diagnosis"]["confidence"] = conf
+        s["primary_diagnosis"]["confidence_label"] = confidence_label(conf)
+    return s
+
+
+def test_low_confidence_diagnosis_regression_loses_to_major_improvement():
+    """A low-confidence DIAGNOSIS_REGRESSION must not outrank a solid
+    STEP_TIME_IMPROVEMENT: verdict IMPROVEMENT, transition still listed."""
+    p = build_compare_payload(
+        _summary_conf(120.0, "HEALTHY", "info", 0.9),
+        _summary_conf(100.0, "INPUT_STRAGGLER", "warning", 0.4),
+    )
+    kinds = [f["kind"] for f in p["findings"]]
+    assert "DIAGNOSIS_REGRESSION" in kinds
+    assert "STEP_TIME_IMPROVEMENT" in kinds
+    trans = next(f for f in p["findings"] if f["kind"] == "DIAGNOSIS_REGRESSION")
+    assert trans["confidence_label"] == "low"  # min of both sides
+    assert p["verdict"] == "IMPROVEMENT"
+
+
+def test_high_confidence_diagnosis_regression_forces_mixed():
+    """The same transition held with HIGH confidence on both sides keeps
+    its weight: major improvement + major regression = MIXED."""
+    p = build_compare_payload(
+        _summary_conf(120.0, "HEALTHY", "info", 0.95),
+        _summary_conf(100.0, "INPUT_STRAGGLER", "warning", 0.9),
+    )
+    assert p["verdict"] == "MIXED"
+
+
+def test_unlabeled_diagnosis_regression_keeps_full_weight():
+    """No confidence recorded → pre-confidence behavior (MIXED)."""
+    p = build_compare_payload(
+        _summary_conf(120.0, "HEALTHY", "info", None),
+        _summary_conf(100.0, "INPUT_STRAGGLER", "warning", None),
+    )
+    assert p["verdict"] == "MIXED"
+
+
+def test_low_confidence_pathological_transition_is_likely_not_regression():
+    p = build_compare_payload(
+        _summary_conf(100.0, "HEALTHY", "info", 0.5),
+        _summary_conf(100.0, "INPUT_STRAGGLER", "warning", 0.5),
+    )
+    assert p["verdict"] == "LIKELY_REGRESSION"
+
+
+def test_rank_findings_orders_low_confidence_last():
+    from traceml_tpu.reporting.compare.verdict import rank_findings
+
+    low = {"kind": "DIAGNOSIS_REGRESSION", "significance": "major",
+           "confidence_label": "low", "section": "diagnosis"}
+    high = {"kind": "MEMORY_REGRESSION", "significance": "major",
+            "confidence_label": "high", "section": "step_memory"}
+    minor = {"kind": "PROCESS_RSS_GREW", "significance": "minor",
+             "section": "process"}
+    ranked = rank_findings([low, minor, high])
+    assert ranked[0] is high     # confident major first
+    assert ranked[-1] is not high

@@ -1,0 +1,232 @@
+#!/usr/bin/env python
+"""Execute .github/workflows/ci.yml's lanes locally and write CI_RUN.md
+(VERDICT r4 item 5: the workflow had never demonstrably run green).
+
+Each lane's `run:` steps execute verbatim where the tool exists
+offline; documented substitutions otherwise (this host has no network):
+
+* lint — ruff is not installed: the E9 class (syntax errors) is
+  covered by ``compileall`` over the same paths; F63/F7/F82
+  (undefined names / comparison bugs) have no offline substitute and
+  are marked SKIPPED-OFFLINE.
+* test-fast — the 4-version matrix needs setup-python; the host's
+  3.12 runs the exact pytest command (one matrix cell).
+* smoke-install — ``python -m build`` is not installed: the wheel is
+  produced by ``pip wheel --no-build-isolation`` (same setuptools
+  backend, same artifact), installed into a fresh venv with
+  ``--no-index`` (offline), and the documented CLI surface asserted
+  with the workflow's exact greps.
+
+Usage::
+
+    python -m traceml_tpu.dev.ci_local [--out CI_RUN.md]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[2]
+
+
+ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+}
+
+
+def _env(clean: bool = False) -> dict:
+    """Lane env.  ``clean`` drops PYTHONPATH — the smoke lane's venv
+    must not see the repo (with it, pip finds traceml_tpu.egg-info via
+    the path entry, declares the wheel already installed, and skips
+    the console-script generation the lane exists to verify)."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.update(ENV)
+    if clean:
+        env.pop("PYTHONPATH", None)
+    else:
+        env["PYTHONPATH"] = str(REPO)
+    return env
+
+
+def _run(cmd, timeout=3600, clean_env=False, **kw):
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        cmd, env=_env(clean=clean_env), cwd=str(REPO), timeout=timeout,
+        capture_output=True, text=True, **kw,
+    )
+    return proc, time.monotonic() - t0
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--out", default=str(REPO / "CI_RUN.md"))
+    parser.add_argument("--skip", default="",
+                        help="comma-separated lane names to skip")
+    args = parser.parse_args()
+    skip = set(filter(None, args.skip.split(",")))
+
+    rows = []  # (lane, step, status, seconds, note)
+
+    def record(lane, step, proc, dt, note=""):
+        ok = proc is None or proc.returncode == 0
+        status = "PASS" if ok else f"FAIL rc={proc.returncode}"
+        rows.append((lane, step, status, dt, note))
+        print(f"[ci-local] {lane:14s} {step:34s} {status:10s} {dt:7.1f}s",
+              file=sys.stderr)
+        if not ok:
+            tail = (proc.stdout or "")[-2000:] + (proc.stderr or "")[-2000:]
+            print(tail, file=sys.stderr)
+        return ok
+
+    all_ok = True
+
+    # -- lane: lint -------------------------------------------------------
+    if "lint" not in skip:
+        targets = ["traceml_tpu/", "tests/", "bench.py", "__graft_entry__.py"]
+        if shutil.which("ruff"):
+            proc, dt = _run(
+                ["ruff", "check", "--select", "E9,F63,F7,F82", *targets]
+            )
+            all_ok &= record("lint", "ruff E9,F63,F7,F82", proc, dt)
+        else:
+            proc, dt = _run(
+                [sys.executable, "-m", "compileall", "-q", *targets]
+            )
+            all_ok &= record(
+                "lint", "compileall (E9 substitute)", proc, dt,
+                "ruff offline-unavailable; F63/F7/F82 skipped",
+            )
+
+    # -- lane: test-fast --------------------------------------------------
+    if "test-fast" not in skip:
+        proc, dt = _run([
+            sys.executable, "-m", "pytest", "tests/", "-q",
+            "--ignore=tests/launcher",
+            "--ignore=tests/integrations",
+            "--ignore=tests/benchmarks",
+        ])
+        all_ok &= record(
+            "test-fast", "pytest unit+contract (py3.12 cell)", proc, dt,
+            "matrix versions need setup-python",
+        )
+
+    # -- lane: test-e2e ---------------------------------------------------
+    if "test-e2e" not in skip:
+        proc, dt = _run(
+            [sys.executable, "-m", "pytest", "tests/launcher",
+             "tests/integrations", "-q"],
+            timeout=2700,
+        )
+        all_ok &= record("test-e2e", "pytest launcher+integrations", proc, dt)
+        proc, dt = _run([
+            sys.executable, "-c",
+            "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)",
+        ], timeout=600)
+        all_ok &= record("test-e2e", "dryrun_multichip(8)", proc, dt)
+        env = _env()
+        env["TRACEML_BENCH_NO_PROBE"] = "1"
+        t0 = time.monotonic()
+        proc = subprocess.run(
+            [sys.executable, "bench.py", "--rounds", "2", "--steps", "4"],
+            env=env, cwd=str(REPO), timeout=1200,
+            capture_output=True, text=True,
+        )
+        dt = time.monotonic() - t0
+        ok = proc.returncode == 0
+        if ok:
+            import json as _json
+
+            try:
+                row = _json.loads(proc.stdout.strip().splitlines()[-1])
+                ok = "metric" in row and "value" in row
+            except (IndexError, ValueError):
+                # empty/non-JSON stdout must record a RED row, not
+                # crash before CI_RUN.md is written
+                ok = False
+        all_ok &= record("test-e2e", "bench contract (one JSON line)",
+                         proc if not ok else None, dt,
+                         "" if ok else "JSON contract violated")
+
+    # -- lane: smoke-install ---------------------------------------------
+    if "smoke-install" not in skip:
+        dist = REPO / "dist"
+        shutil.rmtree(dist, ignore_errors=True)
+        # pyproject-build exists on some hosts but needs network for its
+        # isolated build env; the offline-capable path is pip wheel with
+        # isolation off (same setuptools backend, same artifact)
+        proc, dt = _run([
+            sys.executable, "-m", "pip", "wheel", ".", "-w", "dist",
+            "--no-deps", "--no-build-isolation", "--quiet",
+        ])
+        all_ok &= record("smoke-install", "build wheel", proc, dt,
+                         "pip wheel substitute (python -m build needs net)")
+        wheels = sorted(dist.glob("*.whl"))
+        if wheels:
+            venv = REPO / ".ci_smoke_env"
+            shutil.rmtree(venv, ignore_errors=True)
+            proc, dt = _run([sys.executable, "-m", "venv", str(venv)])
+            all_ok &= record("smoke-install", "create venv", proc, dt)
+            vpy = venv / "bin" / "python"
+            proc, dt = _run([
+                str(vpy), "-m", "pip", "install", "--no-index",
+                "--no-deps", str(wheels[0]), "--quiet",
+            ], clean_env=True)
+            all_ok &= record("smoke-install", "install wheel (offline)",
+                             proc, dt)
+            vcli = venv / "bin" / "traceml-tpu"
+            checks = (
+                f"{vcli} --help | grep -q compare && "
+                f"{vcli} run --help | grep -q mode && "
+                f"{vpy} -c 'import traceml_tpu, traceml'"
+            )
+            t0 = time.monotonic()
+            proc = subprocess.run(
+                ["bash", "-c", checks], env=_env(clean=True),
+                cwd=str(REPO), capture_output=True, text=True,
+                timeout=120,
+            )
+            dt = time.monotonic() - t0
+            all_ok &= record("smoke-install", "documented CLI surface",
+                             proc, dt)
+            shutil.rmtree(venv, ignore_errors=True)
+        else:
+            rows.append(("smoke-install", "install wheel", "FAIL", 0.0,
+                         "no wheel built"))
+            all_ok = False
+
+    # -- write CI_RUN.md --------------------------------------------------
+    lines = [
+        "# CI_RUN — local execution of .github/workflows/ci.yml",
+        "",
+        f"Host: 1-core CPU, Python {sys.version.split()[0]}, "
+        "offline (no package installs).  Every lane's `run:` steps were "
+        "executed; substitutions (tooling unavailable offline) are noted "
+        "per step and in traceml_tpu/dev/ci_local.py's docstring.",
+        "",
+        "| lane | step | status | time |  note |",
+        "|---|---|---|---|---|",
+    ]
+    for lane, step, status, dt, note in rows:
+        lines.append(f"| {lane} | {step} | {status} | {dt:.1f}s | {note} |")
+    lines += [
+        "",
+        f"Overall: {'GREEN' if all_ok else 'RED'} "
+        f"({time.strftime('%Y-%m-%d %H:%M UTC', time.gmtime())})",
+        "",
+        "Reproduce: `python -m traceml_tpu.dev.ci_local`",
+    ]
+    Path(args.out).write_text("\n".join(lines) + "\n")
+    print(f"[ci-local] wrote {args.out}", file=sys.stderr)
+    return 0 if all_ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

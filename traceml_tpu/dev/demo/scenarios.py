@@ -188,6 +188,14 @@ def run_scenario(name: str, steps: int = 80) -> None:
 
     elif name == "memory_creep":
         leak = []  # grows forever — the classic retained-arrays leak
+        # a REAL leak outlives the loop — stash on the module so the
+        # forced end-of-run memory sample still sees it.  Without this,
+        # `leak` is GC'd when this function returns; under full-core
+        # contention the sampler can starve down to (first, forced-
+        # final) samples only, and a freed leak then reads as ~-3 MiB
+        # "growth" (first sample carries step transients) — observed
+        # as the loaded-lane recall flake in the r5 precision run.
+        sys.modules[__name__]._memory_creep_leak = leak
         loader = _batches(steps)
         for i, (x, y) in enumerate(traceml_tpu.wrap_dataloader(loader)):
             with traceml_tpu.trace_step():

@@ -29,6 +29,7 @@ from traceml_tpu.utils.error_log import get_error_log
 from traceml_tpu.utils.timing import COLLECTIVE_TIME, timed_region
 
 _original_mark_step: Optional[Any] = None
+_original_sync: Optional[Any] = None
 _hook: Any = None
 
 
@@ -77,31 +78,56 @@ def remove_torch_xla_hook() -> None:
 
 
 def patch_mark_step() -> bool:
-    """Time the lazy-execution barrier.  Idempotent; False when gated."""
-    global _original_mark_step
+    """Time the lazy-execution barrier.  Idempotent; False when gated.
+
+    Patches BOTH public spellings: ``xm.mark_step`` and the 2.x
+    top-level ``torch_xla.sync`` — the newer function does NOT route
+    through the ``xm.mark_step`` module attribute on real torch-xla,
+    so patching only one would leave modern loops untimed (FAKES.md
+    B1-B2).
+    """
+    global _original_mark_step, _original_sync
     if _original_mark_step is not None:
         return True
     try:
+        import torch_xla
         import torch_xla.core.xla_model as xm
     except Exception:
         return False
+
+    def _timed(original):
+        def timed_barrier(*args: Any, **kwargs: Any):
+            st = get_state()
+            # reentrancy guard: the two public barrier spellings
+            # delegate to each other (xm.mark_step ↔ torch_xla.sync,
+            # direction depends on version) — without the guard one
+            # user barrier would sink TWO collective samples
+            if not st.tls.in_step or getattr(st.tls, "in_xla_barrier", False):
+                return original(*args, **kwargs)
+            st.tls.in_xla_barrier = True
+            try:
+                with timed_region(
+                    COLLECTIVE_TIME, st.current_step, sink=st.buffer.add
+                ):
+                    return original(*args, **kwargs)
+            finally:
+                st.tls.in_xla_barrier = False
+
+        timed_barrier._traceml_original = original  # type: ignore[attr-defined]
+        return timed_barrier
+
     original = xm.mark_step
-
-    def timed_mark_step(*args: Any, **kwargs: Any):
-        st = get_state()
-        if not st.tls.in_step:
-            return original(*args, **kwargs)
-        with timed_region(COLLECTIVE_TIME, st.current_step, sink=st.buffer.add):
-            return original(*args, **kwargs)
-
-    timed_mark_step._traceml_original = original  # type: ignore[attr-defined]
-    xm.mark_step = timed_mark_step
+    xm.mark_step = _timed(original)
     _original_mark_step = original
+    sync = getattr(torch_xla, "sync", None)
+    if callable(sync) and not hasattr(sync, "_traceml_original"):
+        torch_xla.sync = _timed(sync)
+        _original_sync = sync
     return True
 
 
 def unpatch_mark_step() -> None:
-    global _original_mark_step
+    global _original_mark_step, _original_sync
     if _original_mark_step is None:
         return
     try:
@@ -110,6 +136,14 @@ def unpatch_mark_step() -> None:
         xm.mark_step = _original_mark_step
     except Exception:
         pass
+    if _original_sync is not None:
+        try:
+            import torch_xla
+
+            torch_xla.sync = _original_sync
+        except Exception:
+            pass
+        _original_sync = None
     _original_mark_step = None
 
 
@@ -135,15 +169,24 @@ class XlaMemoryBackend:
             except Exception as exc:
                 get_error_log().warning(f"xla memory info failed for {dev}", exc)
                 continue
-            total = int(info.get("kb_total", 0)) * 1024
-            free = int(info.get("kb_free", 0)) * 1024
-            used = max(0, total - free)
+            # two real return shapes (FAKES.md M1-M2): the documented
+            # XRT-era {"kb_total", "kb_free"} and the PJRT-era
+            # {"bytes_used", "bytes_limit"[, "peak_bytes"]}
+            if "bytes_used" in info or "bytes_limit" in info:
+                used = int(info.get("bytes_used", 0))
+                total = int(info.get("bytes_limit", 0))
+                peak = int(info.get("peak_bytes", used))
+            else:
+                total = int(info.get("kb_total", 0)) * 1024
+                free = int(info.get("kb_free", 0)) * 1024
+                used = max(0, total - free)
+                peak = used
             out.append(
                 {
                     "device_id": i,
                     "device_kind": str(dev),
                     "current_bytes": used,
-                    "peak_bytes": used,
+                    "peak_bytes": peak,
                     "limit_bytes": total or None,
                 }
             )

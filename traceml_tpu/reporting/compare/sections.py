@@ -449,20 +449,32 @@ def compare_diagnoses(
             lab = p.get("confidence_label")
             return f" ({lab} confidence)" if lab else ""
 
-        findings.append(
-            {
-                "kind": "DIAGNOSIS_" + ("REGRESSION" if regressed else "CHANGED"),
-                "section": "diagnosis",
-                "significance": "major" if regressed and pathological else "minor",
-                "summary": (
-                    f"Primary diagnosis changed: {b_kind}{_lbl(b_primary)}"
-                    f" → {c_kind}{_lbl(c_primary)}."
-                ),
-                "metric": "primary_diagnosis",
-                "baseline": b_kind,
-                "candidate": c_kind,
-            }
-        )
+        finding = {
+            "kind": "DIAGNOSIS_" + ("REGRESSION" if regressed else "CHANGED"),
+            "section": "diagnosis",
+            "significance": "major" if regressed and pathological else "minor",
+            "summary": (
+                f"Primary diagnosis changed: {b_kind}{_lbl(b_primary)}"
+                f" → {c_kind}{_lbl(c_primary)}."
+            ),
+            "metric": "primary_diagnosis",
+            "baseline": b_kind,
+            "candidate": c_kind,
+        }
+        # the transition is only as trustworthy as its weaker side: the
+        # MIN of the two evidence-derived confidences rides along so the
+        # verdict ladder can weight it (VERDICT r4 item 9)
+        confs = [
+            p.get("confidence")
+            for p in (b_primary, c_primary)
+            if isinstance(p.get("confidence"), (int, float))
+        ]
+        if confs:
+            from traceml_tpu.diagnostics.common import confidence_label
+
+            finding["confidence"] = min(confs)
+            finding["confidence_label"] = confidence_label(min(confs))
+        findings.append(finding)
     return findings
 
 

@@ -15,6 +15,14 @@ Ladder (first matching rung wins):
 5. ``IMPROVEMENT``        — major improvement with no regression signal;
 6. ``MIXED``              — significant findings pulling both ways;
 7. ``EQUIVALENT``         — nothing significant anywhere.
+
+Confidence weighting (VERDICT r4 item 9): findings that carry an
+evidence-derived confidence label argue at reduced strength when that
+label is "low" — a low-confidence major counts as minor in the ladder,
+and ONLY regressions held with ≥medium confidence (or statistical
+findings, which carry no label) can force MIXED against a major
+improvement.  The demoted findings still appear in the ranked list,
+sorted below confident peers of the same tier.
 """
 
 from __future__ import annotations
@@ -41,7 +49,7 @@ _REGRESSION_KINDS = (
 _IMPROVEMENT_KINDS = ("STEP_TIME_IMPROVEMENT", "MEMORY_IMPROVEMENT", "PROCESS_RSS_SHRANK")
 
 # findings are ranked for display: regressions > improvements > context,
-# major before minor within each class
+# major before minor within each class, low confidence last within a tier
 _CLASS_ORDER = {"regression": 0, "improvement": 1, "context": 2}
 
 
@@ -54,12 +62,26 @@ def _finding_class(f: Dict[str, Any]) -> str:
     return "context"
 
 
+def _effective_significance(f: Dict[str, Any]) -> str:
+    """Significance weighted by evidence confidence: a major finding the
+    engine itself only holds with LOW confidence argues like a minor one
+    in the ladder (VERDICT r4 item 9 — an uncertain
+    DIAGNOSIS_REGRESSION must not outrank a solid
+    STEP_TIME_IMPROVEMENT).  Findings without a confidence label
+    (statistical delta findings) keep their significance untouched."""
+    sig = f.get("significance", "minor")
+    if sig == "major" and f.get("confidence_label") == "low":
+        return "minor"
+    return sig
+
+
 def rank_findings(findings: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
     return sorted(
         findings,
         key=lambda f: (
             _CLASS_ORDER[_finding_class(f)],
-            f.get("significance") != "major",
+            _effective_significance(f) != "major",
+            f.get("confidence_label") == "low",
             f.get("section", ""),
         ),
     )
@@ -96,22 +118,32 @@ def decide_verdict(
     majors_reg = [
         f
         for f in ranked
-        if _finding_class(f) == "regression" and f.get("significance") == "major"
+        if _finding_class(f) == "regression"
+        and _effective_significance(f) == "major"
     ]
     minors_reg = [f for f in ranked if _finding_class(f) == "regression"]
+    # regressions the engine holds with at least medium confidence (or
+    # no label at all — statistical findings): only these can force
+    # MIXED against a major improvement
+    confident_reg = [
+        f for f in minors_reg if f.get("confidence_label") != "low"
+    ]
     majors_imp = [
         f
         for f in ranked
-        if _finding_class(f) == "improvement" and f.get("significance") == "major"
+        if _finding_class(f) == "improvement"
+        and _effective_significance(f) == "major"
     ]
     improvements = [f for f in ranked if _finding_class(f) == "improvement"]
 
     step_major_reg = any(
-        f.get("kind") == "STEP_TIME_REGRESSION" and f.get("significance") == "major"
+        f.get("kind") == "STEP_TIME_REGRESSION"
+        and _effective_significance(f) == "major"
         for f in ranked
     )
     step_major_imp = any(
-        f.get("kind") == "STEP_TIME_IMPROVEMENT" and f.get("significance") == "major"
+        f.get("kind") == "STEP_TIME_IMPROVEMENT"
+        and _effective_significance(f) == "major"
         for f in ranked
     )
     # the primary signal (step time) dominates; majors pulling against
@@ -122,6 +154,12 @@ def decide_verdict(
         verdict = "MIXED"
     elif majors_reg:
         verdict = "REGRESSION"
+    elif confident_reg and improvements:
+        verdict = "MIXED"
+    elif minors_reg and majors_imp:
+        # only low-confidence regressions oppose a major improvement:
+        # the improvement wins, the regressions stay listed below it
+        verdict = "IMPROVEMENT"
     elif minors_reg and improvements:
         verdict = "MIXED"
     elif minors_reg:
