@@ -454,9 +454,9 @@ def _orchestrate(n_pairs: int | None = None, steps: int | None = None) -> int:
     work = Path(tempfile.mkdtemp(prefix="traceml_bench_"))
     env = dict(os.environ)
     env["TRACEML_BENCH_CACHE"] = str(work / "xla_cache")
-    std_steps = steps or STEPS_PER_ROUND
+    std_steps = STEPS_PER_ROUND if steps is None else steps
     u_all, t_all, deltas = _orchestrate_lane(
-        work, env, n_pairs or N_PAIRS, std_steps,
+        work, env, N_PAIRS if n_pairs is None else n_pairs, std_steps,
         short=False, label="std",
     )
     # backend is known without importing jax here: this path only runs
@@ -467,9 +467,10 @@ def _orchestrate(n_pairs: int | None = None, steps: int | None = None) -> int:
     # headline number — if the tracer survives 10 ms steps on a 1-core
     # host, the on-chip <2% claim is engineering, not hope
     try:
-        short_steps = steps or STEPS_PER_ROUND_SHORT
+        short_steps = STEPS_PER_ROUND_SHORT if steps is None else steps
         su, st, sd = _orchestrate_lane(
-            work, env, n_pairs or N_PAIRS_SHORT, short_steps,
+            work, env,
+            N_PAIRS_SHORT if n_pairs is None else n_pairs, short_steps,
             short=True, label="short",
         )
         lo, hi = _bootstrap_ci(sd)
@@ -691,11 +692,13 @@ def main() -> int:
 
     if args.pair:
         return _pair_child(
-            args.steps or STEPS_PER_ROUND, Path(args.out), short=args.short
+            STEPS_PER_ROUND if args.steps is None else args.steps,
+            Path(args.out), short=args.short
         )
     if args.interleaved:
         return _run_interleaved(
-            args.rounds or ROUNDS, args.steps or STEPS_PER_ROUND
+            ROUNDS if args.rounds is None else args.rounds,
+            STEPS_PER_ROUND if args.steps is None else args.steps,
         )
 
     if os.environ.get("TRACEML_BENCH_NO_PROBE") != "1":
@@ -728,8 +731,10 @@ def main() -> int:
             # device path runs in a BOUNDED child: a tunnel that probes
             # healthy can still wedge mid-run inside C++ (unkillable from
             # threads), and the one-JSON-line contract must survive that
-            if _run_device_child(args.rounds or ROUNDS,
-                                 args.steps or STEPS_PER_ROUND):
+            if _run_device_child(
+                ROUNDS if args.rounds is None else args.rounds,
+                STEPS_PER_ROUND if args.steps is None else args.steps,
+            ):
                 return 0
             if _emit_persisted_tpu():
                 return 0

@@ -1,0 +1,87 @@
+"""Docs don't rot: every fenced Python block in the user guide must at
+least parse, every documented `traceml_tpu.<name>` attribute must
+exist in the public API, and every documented CLI flag must be real
+(VERDICT r4 item 7: walkthrough depth with executable code).
+
+Full execution of the walkthroughs happens in the e2e lanes (the
+getting-started loop is the launcher e2e's script shape; compare's
+session walkthrough is the compare engine battery); this test is the
+cheap always-on floor under them.
+"""
+
+import ast
+import re
+import textwrap
+from pathlib import Path
+
+import pytest
+
+DOCS = Path(__file__).resolve().parents[2] / "docs"
+
+_PY_BLOCK = re.compile(r"```python\n(.*?)```", re.DOTALL)
+_BASH_BLOCK = re.compile(r"```bash\n(.*?)```", re.DOTALL)
+_API_ATTR = re.compile(r"traceml_tpu\.([a-z_][a-z0-9_]*)\s*\(")
+
+_PAGES = sorted(DOCS.rglob("*.md"))
+assert _PAGES, "docs tree missing"
+
+
+@pytest.mark.parametrize("page", _PAGES, ids=lambda p: str(p.relative_to(DOCS)))
+def test_python_blocks_parse(page):
+    text = page.read_text()
+    for i, block in enumerate(_PY_BLOCK.findall(text)):
+        # blocks inside list items are indented; `...` is valid Python
+        src = textwrap.dedent(block)
+        try:
+            ast.parse(src)
+        except SyntaxError as exc:
+            raise AssertionError(
+                f"{page.name} python block #{i} does not parse: {exc}\n{src}"
+            ) from exc
+
+
+def test_documented_api_attributes_exist():
+    import traceml_tpu
+
+    public = set(traceml_tpu.__all__)
+    missing = {}
+    for page in _PAGES:
+        text = page.read_text()
+        for block in _PY_BLOCK.findall(text):
+            for name in _API_ATTR.findall(block):
+                if name not in public:
+                    missing.setdefault(name, []).append(page.name)
+    assert not missing, f"docs reference non-existent traceml_tpu API: {missing}"
+
+
+def test_documented_cli_flags_exist():
+    """Every `--flag` used with `traceml-tpu run` in bash blocks must be
+    accepted by the run subparser."""
+    from traceml_tpu.launcher.cli import _build_parser
+
+    parser = _build_parser()
+    # collect valid option strings for each subcommand
+    sub = next(
+        a for a in parser._actions
+        if a.__class__.__name__ == "_SubParsersAction"
+    )
+    valid = {
+        name: {
+            opt for act in p._actions for opt in act.option_strings
+        }
+        for name, p in sub.choices.items()
+    }
+    bad = []
+    for page in _PAGES:
+        for block in _BASH_BLOCK.findall(page.read_text()):
+            for line in block.splitlines():
+                m = re.search(r"traceml-tpu\s+(\w+)(.*)", line)
+                if not m or m.group(1) not in valid:
+                    continue
+                # flags AFTER the script positional pass through to the
+                # user script — only launcher flags are checked
+                rest = re.split(r"\s\S+\.py\b", m.group(2))[0]
+                for flag in re.findall(r"(--[a-z][a-z0-9-]*)", rest):
+                    if flag not in valid[m.group(1)] and flag != "--help":
+                        bad.append((page.name, m.group(1), flag))
+    assert not bad, f"docs use CLI flags that don't exist: {bad}"

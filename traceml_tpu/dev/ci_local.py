@@ -75,9 +75,12 @@ def main() -> int:
 
     rows = []  # (lane, step, status, seconds, note)
 
-    def record(lane, step, proc, dt, note=""):
-        ok = proc is None or proc.returncode == 0
-        status = "PASS" if ok else f"FAIL rc={proc.returncode}"
+    def record(lane, step, proc, dt, note="", ok=None):
+        if ok is None:
+            ok = proc is None or proc.returncode == 0
+        status = "PASS" if ok else (
+            f"FAIL rc={proc.returncode}" if proc is not None else "FAIL"
+        )
         rows.append((lane, step, status, dt, note))
         print(f"[ci-local] {lane:14s} {step:34s} {status:10s} {dt:7.1f}s",
               file=sys.stderr)
@@ -152,8 +155,8 @@ def main() -> int:
                 # crash before CI_RUN.md is written
                 ok = False
         all_ok &= record("test-e2e", "bench contract (one JSON line)",
-                         proc if not ok else None, dt,
-                         "" if ok else "JSON contract violated")
+                         proc, dt, "" if ok else "JSON contract violated",
+                         ok=ok)
 
     # -- lane: smoke-install ---------------------------------------------
     if "smoke-install" not in skip:
