@@ -235,6 +235,9 @@ def _build(short: bool = False):
 # into tunnel-latency noise).  Such a run must not be certified.
 _PHYSICAL_PEAK_FLOPS = 1.2e15
 _DEVICE_MIN_STEP_S = 3e-3
+# short-lane floor: the tiny model's real on-chip step is dispatch-
+# bound (~1 ms); a "step" under this is readiness-on-enqueue noise
+_SHORT_DEVICE_MIN_STEP_S = 5e-4
 
 
 def _step_flops(state, batches) -> float:
@@ -404,6 +407,19 @@ def _pair_child(steps: int, out_path: Path, short: bool = False) -> int:
     return 0
 
 
+def _short_step_summary(su_all, st_all, sd_all, steps_per_arm: int) -> dict:
+    """The short-lane block both backends publish (one shape, one site)."""
+    lo, hi = _bootstrap_ci(sd_all)
+    return {
+        "untraced_ms": round(statistics.median(su_all) * 1000, 3),
+        "traced_ms": round(statistics.median(st_all) * 1000, 3),
+        "median_delta_pct": round(statistics.median(sd_all), 3),
+        "ci95_pct": [round(lo, 3), round(hi, 3)],
+        "pairs": len(sd_all),
+        "steps_per_arm": steps_per_arm,
+    }
+
+
 def _bootstrap_ci(deltas, n=2000, seed=0):
     import random
 
@@ -473,20 +489,14 @@ def _orchestrate(n_pairs: int | None = None, steps: int | None = None) -> int:
             N_PAIRS_SHORT if n_pairs is None else n_pairs, short_steps,
             short=True, label="short",
         )
-        lo, hi = _bootstrap_ci(sd)
-        extra["short_step"] = {
-            "untraced_ms": round(statistics.median(su) * 1000, 3),
-            "traced_ms": round(statistics.median(st) * 1000, 3),
-            "median_delta_pct": round(statistics.median(sd), 3),
-            "ci95_pct": [round(lo, 3), round(hi, 3)],
-            "pairs": len(sd),
-            "steps_per_arm": short_steps,
-        }
+        extra["short_step"] = _short_step_summary(su, st, sd, short_steps)
+        ss = extra["short_step"]
         print(
             f"[bench] short-step lane: untraced "
-            f"{extra['short_step']['untraced_ms']:.2f} ms/step, delta "
-            f"{extra['short_step']['median_delta_pct']:+.2f}% "
-            f"(95% CI [{lo:+.2f}, {hi:+.2f}], {len(sd)} pairs)",
+            f"{ss['untraced_ms']:.2f} ms/step, delta "
+            f"{ss['median_delta_pct']:+.2f}% "
+            f"(95% CI [{ss['ci95_pct'][0]:+.2f}, {ss['ci95_pct'][1]:+.2f}], "
+            f"{ss['pairs']} pairs)",
             file=sys.stderr,
         )
     except (RuntimeError, subprocess.TimeoutExpired) as exc:
@@ -589,6 +599,53 @@ def _run_interleaved(rounds: int = ROUNDS, steps: int = STEPS_PER_ROUND) -> int:
         u_all.append(u)
         t_all.append(t)
         deltas.append((t - u) / u * 100.0)
+
+    # short-step lane ON DEVICE too (the ~10 ms regime is the actual
+    # on-chip risk the CPU proxy approximates) — same alternating-arm
+    # schedule on the short model, reported beside the headline
+    short_err: str | None = None
+    su_all, st_all, sd_all = [], [], []
+    # default schedule: more steps per arm (short steps are cheap, the
+    # noise floor isn't) — but an EXPLICIT --steps sizes this lane too,
+    # same contract as the CPU path (CI smoke); an explicit value that
+    # EQUALS the default is indistinguishable and gets the long lane
+    short_steps = steps if steps != STEPS_PER_ROUND else 64
+    try:
+        s_model, s_state, s_tx, s_step_fn, s_batches = _build(short=True)
+        s_plain = jax.jit(s_step_fn, donate_argnums=(0,))
+        _, s_state = _run_loop(s_plain, s_state, s_batches, WARMUP_STEPS)
+        s_model2, s_state2, s_tx2, s_step2, s_batches2 = _build(short=True)
+        s_traced = traceml_tpu.wrap_step_fn(s_step2, donate_argnums=(0,))
+        _, s_state2 = _run_loop(
+            s_traced, s_state2, s_batches2, WARMUP_STEPS,
+            bracket=traceml_tpu.trace_step,
+        )
+        for r in range(rounds):
+            if r % 2 == 0:
+                runtime.pause()
+                su, s_state = _run_loop(
+                    s_plain, s_state, s_batches, short_steps, stat=min
+                )
+                runtime.resume()
+                st_, s_state2 = _run_loop(
+                    s_traced, s_state2, s_batches2, short_steps,
+                    bracket=traceml_tpu.trace_step, stat=min,
+                )
+            else:
+                st_, s_state2 = _run_loop(
+                    s_traced, s_state2, s_batches2, short_steps,
+                    bracket=traceml_tpu.trace_step, stat=min,
+                )
+                runtime.pause()
+                su, s_state = _run_loop(
+                    s_plain, s_state, s_batches, short_steps, stat=min
+                )
+                runtime.resume()
+            su_all.append(su)
+            st_all.append(st_)
+            sd_all.append((st_ - su) / su * 100.0)
+    except Exception as exc:  # evidence lane, not the contract
+        short_err = str(exc)
     stop()
     backend = jax.default_backend()
     flops = _step_flops(state, batches)
@@ -604,6 +661,33 @@ def _run_interleaved(rounds: int = ROUNDS, steps: int = STEPS_PER_ROUND) -> int:
         )
         return 3
     extra: dict = {"backend": backend}
+    if sd_all and backend != "cpu" and min(su_all) < _SHORT_DEVICE_MIN_STEP_S:
+        # certification bar for the short lane (it runs LAST, exactly
+        # when a degrading tunnel is most likely to stop waiting in
+        # block_until_ready): the generic flops-implied bound is vacuous
+        # on the tiny model, but a real per-step dispatch+completion
+        # round trip cannot beat this floor — fake-readiness "steps"
+        # (dispatch throughput) land well under it
+        print(
+            "[bench] short-step device timing non-physical; dropping the "
+            "short lane from the certified result",
+            file=sys.stderr,
+        )
+        sd_all, short_err = [], "non-physical device timing"
+    if sd_all:
+        extra["short_step"] = _short_step_summary(
+            su_all, st_all, sd_all, short_steps
+        )
+        if short_err is not None:
+            # partial lane: an exception ended it early — say so
+            # instead of reporting a clean-looking smaller sample
+            extra["short_step"]["error"] = short_err
+            print(f"[bench] short-step lane partial: {short_err}",
+                  file=sys.stderr)
+    elif short_err is not None:
+        extra["short_step"] = {"error": short_err}
+        print(f"[bench] short-step lane failed: {short_err}",
+              file=sys.stderr)
     if backend != "cpu":
         # on-chip provenance the judge asked for: device kind, achieved
         # model FLOP/s on the untraced arm, and MFU against chip peak
@@ -638,8 +722,9 @@ def _run_device_child(rounds: int, steps: int) -> bool:
     leave a first JSON line for the fallback to contradict.
     """
     # generous budget derived from the requested schedule, not a magic
-    # number: startup/compile + both arms' rounds
-    budget = _READY_TIMEOUT_S + 2 * rounds * _ROUND_TIMEOUT_S
+    # number: startup/compile + both arms' rounds, ×2 for the second
+    # (short-step) lane's builds, compiles, and rounds
+    budget = 2 * (_READY_TIMEOUT_S + 2 * rounds * _ROUND_TIMEOUT_S)
     proc = subprocess.Popen(
         [
             sys.executable, __file__, "--interleaved",
