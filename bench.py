@@ -407,6 +407,18 @@ def _pair_child(steps: int, out_path: Path, short: bool = False) -> int:
     return 0
 
 
+def _short_lane_certified(su_all, backend: str) -> bool:
+    """Certification for the device short-step lane: it runs LAST,
+    exactly when a degrading tunnel is most likely to stop waiting in
+    ``block_until_ready``.  The generic flops-implied bound is vacuous
+    on the tiny model, but a real per-step dispatch+completion round
+    trip cannot beat the dispatch-latency floor — fake-readiness
+    "steps" (dispatch throughput) land well under it."""
+    if backend == "cpu":
+        return True
+    return bool(su_all) and min(su_all) >= _SHORT_DEVICE_MIN_STEP_S
+
+
 def _short_step_summary(su_all, st_all, sd_all, steps_per_arm: int) -> dict:
     """The short-lane block both backends publish (one shape, one site)."""
     lo, hi = _bootstrap_ci(sd_all)
@@ -661,13 +673,7 @@ def _run_interleaved(rounds: int = ROUNDS, steps: int = STEPS_PER_ROUND) -> int:
         )
         return 3
     extra: dict = {"backend": backend}
-    if sd_all and backend != "cpu" and min(su_all) < _SHORT_DEVICE_MIN_STEP_S:
-        # certification bar for the short lane (it runs LAST, exactly
-        # when a degrading tunnel is most likely to stop waiting in
-        # block_until_ready): the generic flops-implied bound is vacuous
-        # on the tiny model, but a real per-step dispatch+completion
-        # round trip cannot beat this floor — fake-readiness "steps"
-        # (dispatch throughput) land well under it
+    if sd_all and not _short_lane_certified(su_all, backend):
         print(
             "[bench] short-step device timing non-physical; dropping the "
             "short lane from the certified result",

@@ -46,3 +46,33 @@ def test_sub_floor_steps_rejected_even_if_flops_ok():
 class _Batch:
     def __init__(self, b, s):
         self.shape = (b, s)
+
+
+def test_short_step_summary_shape():
+    """Both backends publish the short lane through one helper — the
+    schema (and steps_per_arm bookkeeping) cannot diverge (review r5)."""
+    su = [0.0120, 0.0121, 0.0119]
+    st = [0.0123, 0.0124, 0.0122]
+    sd = [(t - u) / u * 100.0 for u, t in zip(su, st)]
+    out = bench._short_step_summary(su, st, sd, steps_per_arm=128)
+    assert set(out) == {
+        "untraced_ms", "traced_ms", "median_delta_pct", "ci95_pct",
+        "pairs", "steps_per_arm",
+    }
+    assert out["pairs"] == 3 and out["steps_per_arm"] == 128
+    assert out["untraced_ms"] == 12.0
+    assert out["ci95_pct"][0] <= out["median_delta_pct"] <= out["ci95_pct"][1]
+
+
+def test_short_lane_gate_drops_fake_readiness():
+    """The short lane's certification gate: dispatch-throughput 'steps'
+    from a non-waiting tunnel (observed ~60 µs) are dropped; real
+    dispatch-bound on-chip steps (~1 ms) and the CPU proxy pass."""
+    # fake-readiness: one sub-floor sample poisons the lane
+    assert not bench._short_lane_certified([1.2e-3, 60e-6, 1.1e-3], "tpu")
+    # real on-chip dispatch-bound steps certify
+    assert bench._short_lane_certified([1.2e-3, 1.0e-3, 1.1e-3], "tpu")
+    # empty lane never certifies on device
+    assert not bench._short_lane_certified([], "tpu")
+    # the CPU proxy has no tunnel to lie to it — always certified
+    assert bench._short_lane_certified([60e-6], "cpu")
