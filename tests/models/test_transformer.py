@@ -240,3 +240,27 @@ def test_model_unknown_attention_impl_raises():
     model = DecoderLM(cfg)
     with _pytest.raises(Exception, match="attention_impl"):
         model.init(jax.random.PRNGKey(1), tokens)
+
+
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+def test_model_gqa_seq_parallel_matches_dense(impl):
+    """GQA (n_kv_heads < n_heads) through BOTH sequence-parallel paths:
+    the kv-repeat happens BEFORE the shard_map boundary, so grouped
+    heads must produce identical logits to the dense path — ulysses is
+    the riskier interaction (its all-to-all redistributes the repeated
+    heads across devices)."""
+    import dataclasses
+
+    mesh = make_mesh({"context": 4}, devices=jax.devices()[:4])
+    base = ModelConfig(vocab_size=128, hidden=64, n_layers=1, n_heads=4,
+                       n_kv_heads=2, max_seq_len=64, dtype=jnp.float32)
+    tokens = jax.random.randint(jax.random.PRNGKey(5), (2, 64), 0, 128)
+    model = DecoderLM(base)
+    params = model.init(jax.random.PRNGKey(6), tokens)
+    dense = model.apply(params, tokens)
+    cfg = dataclasses.replace(
+        base, attention_impl=impl, context_axis="context", mesh=mesh)
+    out = DecoderLM(cfg).apply(params, tokens)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(dense), atol=2e-4, rtol=2e-4,
+        err_msg=impl)
