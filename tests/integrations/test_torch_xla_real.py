@@ -1,0 +1,71 @@
+"""Real-package torch-xla smoke — runs ONLY where torch_xla is actually
+installed (the CI e2e lane attempts a guarded CPU-wheel install; this
+image has no network, so locally these skip).  The fake-backed e2e and
+the FAKES.md contract tests carry the behavior coverage; this file
+exists so the day a real wheel is present, the patch surfaces are
+exercised against it with zero extra wiring (VERDICT r4 item 4).
+"""
+
+import importlib.util
+import os
+import sys
+from pathlib import Path
+
+import pytest
+
+FAKES = str(Path(__file__).resolve().parents[1] / "fakes")
+
+
+def _real_torch_xla_present() -> bool:
+    spec = importlib.util.find_spec("torch_xla")
+    if spec is None or spec.origin is None:
+        return False
+    return not spec.origin.startswith(FAKES)
+
+
+pytestmark = pytest.mark.skipif(
+    not _real_torch_xla_present(),
+    reason="real torch_xla not installed (guarded CI install only)",
+)
+
+
+def test_real_patch_mark_step_installs_and_reverts():
+    from traceml_tpu.instrumentation.torch_xla_support import (
+        patch_mark_step,
+        unpatch_mark_step,
+    )
+
+    import torch_xla.core.xla_model as xm
+
+    assert patch_mark_step()
+    assert hasattr(xm.mark_step, "_traceml_original")
+    unpatch_mark_step()
+    assert not hasattr(xm.mark_step, "_traceml_original")
+
+
+def test_real_memory_backend_shape(monkeypatch):
+    # the CI lane sets jax-CPU knobs, not torch-xla's; point the PJRT
+    # runtime at CPU before the first device op initializes it
+    monkeypatch.setenv("PJRT_DEVICE", os.environ.get("PJRT_DEVICE", "CPU"))
+    from traceml_tpu.instrumentation.torch_xla_support import XlaMemoryBackend
+
+    try:
+        rows = XlaMemoryBackend().sample()
+    except RuntimeError as exc:
+        pytest.skip(f"torch_xla runtime exposes no devices here: {exc}")
+    assert rows, "no xla devices visible"
+    for row in rows:
+        assert row["current_bytes"] >= 0
+        assert {"device_id", "device_kind", "peak_bytes"} <= set(row)
+
+
+def test_real_identity_calls():
+    import torch_xla.core.xla_model as xm
+
+    assert isinstance(xm.get_ordinal(), int)
+    if "torch_xla.runtime" in sys.modules or importlib.util.find_spec(
+        "torch_xla.runtime"
+    ):
+        import torch_xla.runtime as xr
+
+        assert isinstance(xr.world_size(), int)
