@@ -1,0 +1,109 @@
+"""Mixed wire-schema ingest: one aggregator fed interleaved v1 row-list,
+v2 columnar, and legacy flat envelopes from different "ranks" over a real
+TCPServer must land byte-for-byte the same SQLite contents as an all-v1
+run — the back-compat guarantee of schema v2
+(docs/developer_guide/wire-schema-v2.md)."""
+
+import sqlite3
+
+from traceml_tpu.aggregator.trace_aggregator import TraceMLAggregator
+from traceml_tpu.runtime.settings import AggregatorEndpoint, TraceMLSettings
+from traceml_tpu.telemetry.control import build_rank_finished
+from traceml_tpu.telemetry.envelope import (
+    SenderIdentity,
+    build_columnar_envelope,
+    build_telemetry_envelope,
+)
+from traceml_tpu.transport import TCPClient
+
+N_STEPS = 25
+
+
+def _settings(tmp_path, name):
+    return TraceMLSettings(
+        session_id=f"mixed-{name}",
+        logs_dir=tmp_path / name,
+        mode="summary",
+        aggregator=AggregatorEndpoint(port=0),
+        expected_world_size=3,
+        finalize_timeout_sec=3.0,
+    )
+
+
+def _ident(rank):
+    return SenderIdentity(
+        session_id="mixed", global_rank=rank, local_rank=rank, world_size=3,
+        hostname=f"host-{rank}", pid=1000 + rank,
+    )
+
+
+def _tables(rank):
+    return {
+        "step_time": [
+            {"step": s, "timestamp": float(s), "clock": "device",
+             "late_markers": 0,
+             "events": {"phase": {"cpu_ms": 1.0 * s + rank,
+                                  "device_ms": 2.0 * s, "count": 1}}}
+            for s in range(1, N_STEPS + 1)
+        ],
+        "model_stats": [
+            {"timestamp": 1.0, "flops_per_step": 1e9 * (rank + 1),
+             "flops_source": "provided", "device_kind": "tpu",
+             "peak_flops": 1e14, "device_count": 3, "tokens_per_step": 512.0}
+        ],
+    }
+
+
+def _payload(rank, schema):
+    ident = _ident(rank)
+    tables = _tables(rank)
+    if schema == "v1":
+        return build_telemetry_envelope("step_time", tables, ident).to_wire()
+    if schema == "v2":
+        return build_columnar_envelope("step_time", tables, ident).to_wire()
+    # legacy flat shape, as a pre-envelope sender would emit it
+    flat = {"sampler": "step_time", "tables": tables, "timestamp": 1.0}
+    flat.update(ident.to_meta())
+    flat.pop("schema", None)
+    return flat
+
+
+def _run_session(tmp_path, name, schemas):
+    settings = _settings(tmp_path, name)
+    agg = TraceMLAggregator(settings)
+    agg.start()
+    try:
+        client = TCPClient("127.0.0.1", agg.port)
+        # interleave: every rank's telemetry in ONE batch frame, mixed forms
+        batch = [_payload(rank, schema) for rank, schema in enumerate(schemas)]
+        batch.extend(build_rank_finished(_ident(r).to_meta()) for r in range(3))
+        assert client.send_batch(batch)
+        client.close()
+    finally:
+        agg.stop()
+    return settings.session_dir / "telemetry.sqlite"
+
+
+def _dump(db_path):
+    conn = sqlite3.connect(db_path)
+    out = {}
+    for table in ("step_time_samples", "model_stats_samples"):
+        cols = [
+            r[1]
+            for r in conn.execute(f"PRAGMA table_info({table})")
+            if r[1] != "id"  # autoincrement id depends on arrival order
+        ]
+        rows = conn.execute(
+            f"SELECT {', '.join(cols)} FROM {table}"
+        ).fetchall()
+        out[table] = sorted(rows)
+    conn.close()
+    return out
+
+
+def test_mixed_schema_ingest_matches_all_v1(tmp_path):
+    mixed = _dump(_run_session(tmp_path, "mixed", ("v1", "v2", "legacy")))
+    allv1 = _dump(_run_session(tmp_path, "allv1", ("v1", "v1", "v1")))
+    assert mixed["step_time_samples"], "no step_time rows ingested"
+    assert len(mixed["step_time_samples"]) == 3 * N_STEPS
+    assert mixed == allv1

@@ -1,0 +1,25 @@
+"""Shared output harness for the micro-benchmarks in this directory.
+
+Every bench emits one JSON line per measurement via :func:`emit` so runs
+can be diffed/collected uniformly (the BENCH_LOCAL_* records at the repo
+root are built from these lines)::
+
+    {"bench": "<suite>", "metric": "<name>", "value": <float>,
+     "unit": "<unit>", "ts": <unix time>, ...extra}
+"""
+
+import json
+import time
+
+
+def emit(bench: str, metric: str, value: float, unit: str, **extra) -> dict:
+    record = {
+        "bench": bench,
+        "metric": metric,
+        "value": round(float(value), 4),
+        "unit": unit,
+        "ts": round(time.time(), 3),
+    }
+    record.update(extra)
+    print(json.dumps(record, sort_keys=True), flush=True)
+    return record

@@ -1,0 +1,111 @@
+"""Micro-benchmark: telemetry wire schema v1 (row-list) vs v2 (columnar).
+
+Reports encoded bytes/row and encode+decode µs/row for a representative
+256-row step_time batch, in the shared JSON-line format (bench_common).
+Runs as a slow-marked test (asserting the v2 wire-size win) or as a
+script: ``python tests/benchmarks/bench_envelope_codec.py``.
+"""
+
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+import pytest
+
+from tests.benchmarks.bench_common import emit
+from traceml_tpu.telemetry.envelope import (
+    SenderIdentity,
+    build_columnar_envelope,
+    build_telemetry_envelope,
+    normalize_telemetry_envelope,
+)
+from traceml_tpu.utils import msgpack_codec
+
+pytestmark = pytest.mark.slow
+
+N_ROWS = 256
+_REPEATS = 30
+
+
+def make_step_time_rows(n: int = N_ROWS):
+    """A realistic per-tick step_time batch: host+device clocks and two
+    traced phases per step (the shape the step-time sampler ships)."""
+    return [
+        {
+            "step": s,
+            "timestamp": 1721000000.0 + s * 0.1,
+            "clock": "device",
+            "late_markers": 0,
+            "events": {
+                "_traceml_internal:step_time": {
+                    "cpu_ms": 100.0 + s, "device_ms": 101.0 + s, "count": 1,
+                },
+                "_traceml_internal:compute_time": {
+                    "cpu_ms": 1.0 + s, "device_ms": 92.0 + s, "count": 1,
+                },
+            },
+        }
+        for s in range(n)
+    ]
+
+
+def _ident():
+    return SenderIdentity(
+        session_id="bench", global_rank=0, world_size=256,
+        hostname="bench-host", pid=1, platform="tpu", device_kind="TPU v5p",
+    )
+
+
+def _measure(build, rows):
+    wire = build("step_time", {"step_time": rows}, _ident()).to_wire()
+    blob = msgpack_codec.encode(wire)
+    t0 = time.perf_counter()
+    for _ in range(_REPEATS):
+        blob = msgpack_codec.encode(build(
+            "step_time", {"step_time": rows}, _ident()).to_wire())
+    encode_s = (time.perf_counter() - t0) / _REPEATS
+    t0 = time.perf_counter()
+    for _ in range(_REPEATS):
+        env = normalize_telemetry_envelope(msgpack_codec.decode(blob))
+        tables = env.tables  # include row materialization in decode cost
+    decode_s = (time.perf_counter() - t0) / _REPEATS
+    assert len(tables["step_time"]) == len(rows)
+    return len(blob), encode_s, decode_s, env
+
+
+def run(n_rows: int = N_ROWS):
+    rows = make_step_time_rows(n_rows)
+    results = {}
+    for name, build in (("v1", build_telemetry_envelope),
+                        ("v2", build_columnar_envelope)):
+        nbytes, enc_s, dec_s, env = _measure(build, rows)
+        # both schemas must reproduce the batch exactly
+        assert env.tables["step_time"] == rows, f"{name} roundtrip mismatch"
+        results[name] = {
+            "bytes_per_row": nbytes / n_rows,
+            "encode_us_per_row": enc_s * 1e6 / n_rows,
+            "decode_us_per_row": dec_s * 1e6 / n_rows,
+        }
+        for metric, value in results[name].items():
+            emit("envelope_codec", metric, value,
+                 "B/row" if metric == "bytes_per_row" else "us/row",
+                 schema=name, rows=n_rows, codec=msgpack_codec.codec_name())
+    delta = 1.0 - results["v2"]["bytes_per_row"] / results["v1"]["bytes_per_row"]
+    emit("envelope_codec", "v2_wire_savings", delta * 100.0, "%", rows=n_rows)
+    return results
+
+
+def test_v2_columnar_is_smaller_on_the_wire():
+    results = run()
+    v1, v2 = results["v1"]["bytes_per_row"], results["v2"]["bytes_per_row"]
+    assert v2 < v1, "v2 must be strictly smaller on the wire"
+    assert v2 <= 0.7 * v1, (
+        f"expected ≥30% fewer wire bytes/row, got v1={v1:.1f} v2={v2:.1f} "
+        f"({100 * (1 - v2 / v1):.1f}% savings)"
+    )
+
+
+if __name__ == "__main__":
+    run()

@@ -3,7 +3,9 @@ run manually: python tests/benchmarks/bench_tcp_drain.py).
 
 Counterpart of the reference's tests/benchmarks/bench_tcp_drain.py —
 illustrative numbers comparing the native C drain, the Python rolling-
-offset drain, and a naive O(N²) del-prefix drain.
+offset drain, and a naive O(N²) del-prefix drain.  Results are emitted
+in the shared JSON-line format (bench_common.emit), same as
+bench_envelope_codec.py.
 """
 
 import struct
@@ -12,6 +14,8 @@ import time
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+from tests.benchmarks.bench_common import emit
 
 _LEN = struct.Struct(">I")
 
@@ -52,25 +56,25 @@ def main() -> None:
     native = get_framing()
     blob = make_blob()
     n = len(python_rolling(blob))
-    print(f"{n} frames of 128 B")
 
     t0 = time.perf_counter()
     python_rolling(blob)
-    print(f"python rolling-offset : {(time.perf_counter() - t0) * 1000:8.1f} ms")
+    emit("tcp_drain", "python_rolling_ms", (time.perf_counter() - t0) * 1000,
+         "ms", frames=n, frame_bytes=128)
 
     if native is not None:
         t0 = time.perf_counter()
         native.drain_frames(blob, 0, 1 << 20)
-        print(f"native C drain        : {(time.perf_counter() - t0) * 1000:8.1f} ms")
-    else:
-        print("native C drain        : (not built)")
+        emit("tcp_drain", "native_c_ms", (time.perf_counter() - t0) * 1000,
+             "ms", frames=n, frame_bytes=128)
 
     small = make_blob(10_000)
     t0 = time.perf_counter()
     python_naive(small)
     # quadratic in total bytes: 10x the frames costs ~100x the time
-    naive_ms = (time.perf_counter() - t0) * 1000 * 100
-    print(f"naive O(N^2) (x100 extrapolated to 100k frames): {naive_ms:8.1f} ms")
+    emit("tcp_drain", "naive_quadratic_extrapolated_ms",
+         (time.perf_counter() - t0) * 1000 * 100, "ms",
+         frames=n, frame_bytes=128, note="x100 extrapolation from 10k frames")
 
 
 if __name__ == "__main__":

@@ -2,7 +2,12 @@ from pathlib import Path
 
 from traceml_tpu.database import Database, DBIncrementalSender, DatabaseWriter
 from traceml_tpu.database.database_writer import iter_backup_file
-from traceml_tpu.telemetry import SenderIdentity
+from traceml_tpu.telemetry import SenderIdentity, normalize_telemetry_envelope
+
+
+def _rows(payload, table):
+    """Materialize a wire table (schema-2 columnar) back to row dicts."""
+    return normalize_telemetry_envelope(payload).tables[table]
 
 
 def test_bounded_append_and_tail():
@@ -39,14 +44,14 @@ def test_incremental_sender_ships_only_new():
     assert p1 is not None
     assert p1["meta"]["sampler"] == "step_time"
     assert p1["meta"]["global_rank"] == 1
-    assert p1["body"]["tables"]["steps"] == [{"step": 1}]
+    assert _rows(p1, "steps") == [{"step": 1}]
     # nothing new → None
     assert sender.collect_payload() is None
     db.add_record("steps", {"step": 2})
     db.add_record("other", {"x": 1})
     p2 = sender.collect_payload()
-    assert p2["body"]["tables"]["steps"] == [{"step": 2}]
-    assert p2["body"]["tables"]["other"] == [{"x": 1}]
+    assert _rows(p2, "steps") == [{"step": 2}]
+    assert _rows(p2, "other") == [{"x": 1}]
 
 
 def test_incremental_sender_cursor_sequence_with_eviction():
@@ -59,12 +64,12 @@ def test_incremental_sender_cursor_sequence_with_eviction():
     sender.set_identity(SenderIdentity(session_id="s", global_rank=0))
 
     db.add_records("t", [{"i": 0}, {"i": 1}])
-    assert [r["i"] for r in sender.collect_payload()["body"]["tables"]["t"]] == [0, 1]
+    assert [r["i"] for r in _rows(sender.collect_payload(), "t")] == [0, 1]
 
     # burst past the retention bound between ticks: rows 2..8 appended,
     # only the newest 4 retained — the sender ships what survived
     db.add_records("t", [{"i": i} for i in range(2, 9)])
-    got = [r["i"] for r in sender.collect_payload()["body"]["tables"]["t"]]
+    got = [r["i"] for r in _rows(sender.collect_payload(), "t")]
     assert got == [5, 6, 7, 8]
 
     # cursor is at the append head now: silence means None, repeatedly
@@ -73,7 +78,7 @@ def test_incremental_sender_cursor_sequence_with_eviction():
 
     # resumes cleanly after silence
     db.add_record("t", {"i": 9})
-    assert [r["i"] for r in sender.collect_payload()["body"]["tables"]["t"]] == [9]
+    assert [r["i"] for r in _rows(sender.collect_payload(), "t")] == [9]
 
 
 def test_incremental_sender_multi_table_independent_cursors():
@@ -89,8 +94,8 @@ def test_incremental_sender_multi_table_independent_cursors():
     db.add_record("a", {"i": 1})
     db.add_record("b", {"j": 1})
     p = sender.collect_payload()
-    assert [r["i"] for r in p["body"]["tables"]["a"]] == [1]
-    assert [r["j"] for r in p["body"]["tables"]["b"]] == [1]
+    assert [r["i"] for r in _rows(p, "a")] == [1]
+    assert [r["j"] for r in _rows(p, "b")] == [1]
 
 
 def test_incremental_sender_reset_reships_retained_rows():
@@ -101,8 +106,27 @@ def test_incremental_sender_reset_reships_retained_rows():
     sender.collect_payload()
     assert sender.collect_payload() is None
     sender.reset()  # reconnect semantics: replay what's still retained
-    got = [r["i"] for r in sender.collect_payload()["body"]["tables"]["t"]]
+    got = [r["i"] for r in _rows(sender.collect_payload(), "t")]
     assert got == [2, 3, 4, 5]
+
+
+def test_collect_since_lock_copy_bounded():
+    """collect_since must copy O(new rows) under the lock, not the whole
+    retained deque — 2000 single-row collections against a 100k-row table
+    would cost ~200M element copies with a full-deque copy."""
+    import time
+
+    db = Database(max_rows_per_table=100_000)
+    db.add_records("t", [{"i": i} for i in range(100_000)])
+    rows, cursor = db.collect_since("t", 0)
+    assert len(rows) == 100_000
+    t0 = time.perf_counter()
+    for i in range(2000):
+        db.add_record("t", {"i": 100_000 + i})
+        rows, cursor = db.collect_since("t", cursor)
+        assert [r["i"] for r in rows] == [100_000 + i]
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 1.0, f"tail collection took {elapsed:.2f}s — O(deque) copy?"
 
 
 def test_disk_writer_roundtrip(tmp_path):

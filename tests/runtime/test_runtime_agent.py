@@ -72,7 +72,7 @@ def _run_runtime_session(tmp_path, max_steps=None, steps=4):
     got = []
     while time.monotonic() < deadline:
         server.wait_for_data(0.05)
-        got.extend(server.drain())
+        got.extend(server.drain_decoded())
         if any(is_control_message(p) for p in got):
             break
     server.stop()

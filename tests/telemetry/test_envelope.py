@@ -1,11 +1,16 @@
 from traceml_tpu.telemetry import (
+    SCHEMA_V2,
     SenderIdentity,
+    build_columnar_envelope,
     build_rank_finished,
     build_telemetry_envelope,
+    columns_to_rows,
     control_kind,
     is_control_message,
     normalize_telemetry_envelope,
+    rows_to_columns,
 )
+from traceml_tpu.utils import msgpack_codec
 
 
 def _identity(rank=3):
@@ -52,6 +57,116 @@ def test_normalize_rejects_garbage():
     assert normalize_telemetry_envelope([1, 2]) is None
     assert normalize_telemetry_envelope({"meta": {}, "body": {}}) is None
     assert normalize_telemetry_envelope({"nope": 1}) is None
+
+
+def test_v1_wire_roundtrip_bit_identical():
+    rows = [{"step": s, "timestamp": float(s), "clock": "device"} for s in range(8)]
+    env = build_telemetry_envelope("step_time", {"step_time": rows}, _identity())
+    wire = msgpack_codec.decode(msgpack_codec.encode(env.to_wire()))
+    norm = normalize_telemetry_envelope(wire)
+    assert norm.tables["step_time"] == rows
+    assert norm.schema == 1
+
+
+def test_columnar_envelope_wire_shape():
+    rows = [{"a": 1, "b": "x"}, {"a": 2, "b": "y"}]
+    env = build_columnar_envelope("system", {"t": rows}, _identity())
+    wire = env.to_wire()
+    assert wire["meta"]["schema"] == SCHEMA_V2
+    table = wire["body"]["tables"]["t"]
+    assert table["cols"] == ["a", "b"]
+    assert table["vals"] == [[1, 2], ["x", "y"]]
+    assert table["n"] == 2
+
+
+def test_columnar_roundtrip_and_lazy_materialization():
+    rows = [
+        {"step": s, "timestamp": float(s),
+         "events": {"phase_a": {"cpu_ms": 1.0 * s, "count": 1},
+                    "phase_b": {"cpu_ms": 2.0 * s, "count": 1}}}
+        for s in range(16)
+    ]
+    env = build_columnar_envelope("step_time", {"step_time": rows}, _identity())
+    wire = msgpack_codec.decode(msgpack_codec.encode(env.to_wire()))
+    norm = normalize_telemetry_envelope(wire)
+    assert norm is not None
+    assert norm.schema == SCHEMA_V2
+    # columnar access without materializing rows
+    view = norm.column_view("step_time")
+    assert len(view) == 16
+    assert view.ints("step") == list(range(16))
+    assert view.col("events")[3] == rows[3]["events"]
+    assert view.col("missing") == [None] * 16
+    # lazy row materialization matches the original batch exactly
+    assert norm.tables["step_time"] == rows
+
+
+def test_columnar_missing_keys_none_filled():
+    rows = [{"a": 1}, {"a": 2, "b": 9}]
+    ct = rows_to_columns(rows)
+    assert ct["cols"] == ["a", "b"]
+    assert ct["vals"] == [[1, 2], [None, 9]]
+    assert columns_to_rows(ct) == [{"a": 1, "b": None}, {"a": 2, "b": 9}]
+
+
+def test_nested_dict_columns_transposed_only_when_uniform():
+    uniform = [{"m": {"x": i, "y": i}} for i in range(3)]
+    ragged = [{"m": {"x": 1}}, {"m": {"z": 2}}]
+    ct_u = rows_to_columns(uniform)
+    ct_r = rows_to_columns(ragged)
+    assert isinstance(ct_u["vals"][0], dict)  # nested SoA marker
+    assert isinstance(ct_r["vals"][0], list)  # ragged keys stay row-form
+    assert columns_to_rows(ct_u) == uniform
+    assert columns_to_rows(ct_r) == ragged
+
+
+def test_mixed_table_encodings_in_one_envelope():
+    wire = {
+        "meta": {"schema": 2, "sampler": "s", "rank": 1},
+        "body": {"tables": {
+            "rowy": [{"i": 1}],
+            "colly": {"cols": ["i"], "vals": [[2, 3]], "n": 2},
+        }},
+    }
+    norm = normalize_telemetry_envelope(wire)
+    assert norm.tables["rowy"] == [{"i": 1}]
+    assert norm.tables["colly"] == [{"i": 2}, {"i": 3}]
+    assert sorted(norm.table_names()) == ["colly", "rowy"]
+
+
+def test_malformed_columnar_table_dropped():
+    wire = {
+        "meta": {"sampler": "s", "rank": 0},
+        "body": {"tables": {
+            "bad_len": {"cols": ["a", "b"], "vals": [[1]]},          # cols≠vals
+            "bad_col": {"cols": ["a"], "vals": [[1], [2]]},          # cols≠vals
+            "ragged": {"cols": ["a", "b"], "vals": [[1], [2, 3]]},   # lengths differ
+            "good": {"cols": ["a"], "vals": [[7]], "n": 1},
+        }},
+    }
+    norm = normalize_telemetry_envelope(wire)
+    assert norm.tables == {"good": [{"a": 7}]}
+
+
+def test_legacy_flat_shape_with_columnar_table():
+    legacy = {
+        "sampler": "system",
+        "rank": 4,
+        "tables": {"t": {"cols": ["a"], "vals": [[1, 2]], "n": 2}},
+    }
+    norm = normalize_telemetry_envelope(legacy)
+    assert norm.global_rank == 4
+    assert norm.tables["t"] == [{"a": 1}, {"a": 2}]
+
+
+def test_build_envelope_copy_false_shares_lists():
+    rows = [{"i": 0}]
+    tables = {"t": rows}
+    env_copy = build_telemetry_envelope("s", tables, _identity())
+    env_share = build_telemetry_envelope("s", tables, _identity(), copy=False)
+    rows.append({"i": 1})
+    assert env_copy.tables["t"] == [{"i": 0}]       # defensive copy
+    assert env_share.tables["t"] is rows            # trusted internal path
 
 
 def test_control_messages():

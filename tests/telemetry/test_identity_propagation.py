@@ -23,10 +23,10 @@ def test_sender_sequence_no_loss_no_duplication():
         if i % 7 == 0:
             payload = sender.collect_payload()
             if payload:
-                shipped.extend(payload["body"]["tables"]["t"])
+                shipped.extend(normalize_telemetry_envelope(payload).tables["t"])
     payload = sender.collect_payload()
     if payload:
-        shipped.extend(payload["body"]["tables"]["t"])
+        shipped.extend(normalize_telemetry_envelope(payload).tables["t"])
     assert [r["i"] for r in shipped] == list(range(50))
     assert sender.collect_payload() is None
 

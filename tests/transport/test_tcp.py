@@ -9,7 +9,7 @@ def _drain_until(server, n, timeout=5.0):
     deadline = time.monotonic() + timeout
     while len(out) < n and time.monotonic() < deadline:
         server.wait_for_data(0.1)
-        out.extend(server.drain())
+        out.extend(server.drain_decoded())
     return out
 
 
@@ -47,6 +47,76 @@ def test_client_never_raises_when_server_down():
     assert client.send_batch([{"x": 1}]) is False
     assert client.batches_dropped == 1
     client.close()
+
+
+def test_stalled_connect_does_not_block_close():
+    """create_connection runs OUTSIDE the send lock: close() must return
+    immediately even while another thread is stuck dialing."""
+    import threading
+    import socket as socket_mod
+    from traceml_tpu.transport import tcp_transport
+
+    dial_started = threading.Event()
+    release_dial = threading.Event()
+
+    def slow_connect(addr, timeout=None):
+        dial_started.set()
+        release_dial.wait(5)
+        raise OSError("dial aborted")
+
+    client = TCPClient("127.0.0.1", 1, reconnect_backoff=0.0)
+    orig = socket_mod.create_connection
+    tcp_transport.socket.create_connection = slow_connect
+    try:
+        sender = threading.Thread(
+            target=client.send_batch, args=([{"x": 1}],), daemon=True
+        )
+        sender.start()
+        assert dial_started.wait(5)
+        t0 = time.perf_counter()
+        client.close()  # must not wait for the in-flight dial
+        assert time.perf_counter() - t0 < 1.0
+    finally:
+        release_dial.set()
+        tcp_transport.socket.create_connection = orig
+        sender.join(timeout=5)
+    assert client.batches_dropped == 1
+
+
+def test_close_during_connect_discards_dialed_socket():
+    """A dial that completes after close() must not resurrect the client
+    with a live socket."""
+    import threading
+    import socket as socket_mod
+    from traceml_tpu.transport import tcp_transport
+
+    server = TCPServer()
+    server.start()
+    dial_started = threading.Event()
+    release_dial = threading.Event()
+    orig = socket_mod.create_connection
+
+    def gated_connect(addr, timeout=None):
+        dial_started.set()
+        release_dial.wait(5)
+        return orig(addr, timeout=timeout)
+
+    client = TCPClient("127.0.0.1", server.port, reconnect_backoff=0.0)
+    tcp_transport.socket.create_connection = gated_connect
+    try:
+        sender = threading.Thread(
+            target=client.send_batch, args=([{"x": 1}],), daemon=True
+        )
+        sender.start()
+        assert dial_started.wait(5)
+        client.close()
+        release_dial.set()
+        sender.join(timeout=5)
+        assert client._sock is None  # the late socket was discarded
+    finally:
+        tcp_transport.socket.create_connection = orig
+        client.close()
+        server.stop()
 
 
 def test_partial_frame_reassembly():

@@ -24,7 +24,7 @@ def _collect(server, n, timeout=5.0):
     deadline = time.monotonic() + timeout
     while len(got) < n and time.monotonic() < deadline:
         server.wait_for_data(0.1)
-        got.extend(server.drain())
+        got.extend(server.drain_decoded())
     return got
 
 

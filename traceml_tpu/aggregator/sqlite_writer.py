@@ -16,7 +16,7 @@ import sqlite3
 import threading
 import time
 from pathlib import Path
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from traceml_tpu.aggregator.sqlite_writers import ALL_WRITERS, writer_for
 from traceml_tpu.telemetry.envelope import TelemetryEnvelope
@@ -159,23 +159,30 @@ class SQLiteWriter:
             self._finalized.set()
 
     def _write_batch(self, conn: sqlite3.Connection, batch: List[TelemetryEnvelope]) -> None:
+        # Build parameter tuples for the WHOLE batch first, grouped by
+        # insert statement, so each (table, batch) costs exactly one
+        # executemany inside one transaction — never per-row, and never
+        # per-envelope when many ranks ship the same table.
+        grouped: Dict[str, List[tuple]] = {}
+        for env in batch:
+            writer = writer_for(env.sampler)
+            if writer is None:
+                continue
+            try:
+                table_rows = writer.build_rows(env)
+            except Exception as exc:
+                get_error_log().warning(
+                    f"projection build failed for {env.sampler}", exc
+                )
+                continue
+            for table, rows in table_rows.items():
+                if rows:
+                    grouped.setdefault(writer.insert_sql(table), []).extend(rows)
         try:
             conn.execute("BEGIN")
-            for env in batch:
-                writer = writer_for(env.sampler)
-                if writer is None:
-                    continue
-                try:
-                    table_rows = writer.build_rows(env)
-                except Exception as exc:
-                    get_error_log().warning(
-                        f"projection build failed for {env.sampler}", exc
-                    )
-                    continue
-                for table, rows in table_rows.items():
-                    if rows:
-                        conn.executemany(writer.insert_sql(table), rows)
-                        self.written += len(rows)
+            for sql, rows in grouped.items():
+                conn.executemany(sql, rows)
+                self.written += len(rows)
             conn.commit()
         except sqlite3.Error as exc:
             get_error_log().warning("sqlite batch write failed", exc)

@@ -1,6 +1,13 @@
 """Shared identity-column helpers for projection writers
 (reference: aggregator/sqlite_writers/step_time.py:131-419 shows the
-stable-identity-columns + payload-json pattern)."""
+stable-identity-columns + payload-json pattern).
+
+Writers consume tables through ``TelemetryEnvelope.column_view`` — a
+:class:`~traceml_tpu.telemetry.envelope.ColumnView` whose ``ints`` /
+``floats`` / ``strs`` accessors mirror the row-dict coercions below
+(``fnum``/``inum`` are kept for row-oriented callers), so schema-v2
+columnar envelopes build executemany parameter tuples without ever
+materializing per-row dicts."""
 
 from __future__ import annotations
 

@@ -7,9 +7,7 @@ from typing import Dict, List, Tuple
 
 from traceml_tpu.aggregator.sqlite_writers.common import (
     IDENTITY_SCHEMA,
-    fnum,
     identity_tuple,
-    inum,
 )
 from traceml_tpu.telemetry.envelope import TelemetryEnvelope
 
@@ -75,33 +73,27 @@ def insert_sql(table: str) -> str:
 def build_rows(env: TelemetryEnvelope) -> Dict[str, List[Tuple]]:
     ident = identity_tuple(env)
     out: Dict[str, List[Tuple]] = {}
-    rows = []
-    for row in env.tables.get("process", []):
-        rows.append(
-            ident
-            + (
-                fnum(row, "timestamp"),
-                fnum(row, "cpu_pct"),
-                inum(row, "rss_bytes"),
-                inum(row, "vms_bytes"),
-                inum(row, "num_threads"),
-            )
-        )
-    if rows:
-        out[TABLE] = rows
-    dev = []
-    for row in env.tables.get("process_device", []):
-        dev.append(
-            ident
-            + (
-                fnum(row, "timestamp"),
-                inum(row, "device_id"),
-                str(row.get("device_kind", "unknown")),
-                inum(row, "memory_used_bytes"),
-                inum(row, "memory_peak_bytes"),
-                inum(row, "memory_total_bytes"),
-            )
-        )
-    if dev:
-        out[TABLE_DEVICE] = dev
+    v = env.column_view("process")
+    if v:
+        ts = v.floats("timestamp")
+        cpu = v.floats("cpu_pct")
+        rss = v.ints("rss_bytes")
+        vms = v.ints("vms_bytes")
+        threads = v.ints("num_threads")
+        out[TABLE] = [
+            ident + (ts[i], cpu[i], rss[i], vms[i], threads[i])
+            for i in range(len(v))
+        ]
+    v = env.column_view("process_device")
+    if v:
+        ts = v.floats("timestamp")
+        dev_id = v.ints("device_id")
+        kind = v.strs("device_kind", "unknown")
+        used = v.ints("memory_used_bytes")
+        peak = v.ints("memory_peak_bytes")
+        total = v.ints("memory_total_bytes")
+        out[TABLE_DEVICE] = [
+            ident + (ts[i], dev_id[i], kind[i], used[i], peak[i], total[i])
+            for i in range(len(v))
+        ]
     return out

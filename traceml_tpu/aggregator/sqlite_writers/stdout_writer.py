@@ -7,7 +7,6 @@ from typing import Dict, List, Tuple
 
 from traceml_tpu.aggregator.sqlite_writers.common import (
     IDENTITY_SCHEMA,
-    fnum,
     identity_tuple,
 )
 from traceml_tpu.telemetry.envelope import TelemetryEnvelope
@@ -41,15 +40,15 @@ def insert_sql(table: str) -> str:
 
 
 def build_rows(env: TelemetryEnvelope) -> Dict[str, List[Tuple]]:
+    v = env.column_view("stdout_stderr")
+    if not v:
+        return {}
     ident = identity_tuple(env)
-    out = []
-    for row in env.tables.get("stdout_stderr", []):
-        out.append(
-            ident
-            + (
-                fnum(row, "timestamp"),
-                str(row.get("stream", "stdout")),
-                str(row.get("line", ""))[:4096],
-            )
-        )
-    return {TABLE: out} if out else {}
+    ts = v.floats("timestamp")
+    streams = v.strs("stream", "stdout")
+    lines = v.strs("line", "")
+    out = [
+        ident + (ts[i], streams[i], lines[i][:4096])
+        for i in range(len(v))
+    ]
+    return {TABLE: out}

@@ -7,9 +7,7 @@ from typing import Dict, List, Tuple
 
 from traceml_tpu.aggregator.sqlite_writers.common import (
     IDENTITY_SCHEMA,
-    fnum,
     identity_tuple,
-    inum,
 )
 from traceml_tpu.telemetry.envelope import TelemetryEnvelope
 
@@ -53,21 +51,32 @@ def insert_sql(table: str) -> str:
 
 
 def build_rows(env: TelemetryEnvelope) -> Dict[str, List[Tuple]]:
+    v = env.column_view("step_memory")
+    if not v:
+        return {}
     ident = identity_tuple(env)
-    out = []
-    for row in env.tables.get("step_memory", []):
-        out.append(
-            ident
-            + (
-                inum(row, "step"),
-                fnum(row, "timestamp"),
-                inum(row, "device_id"),
-                str(row.get("device_kind", "unknown")),
-                inum(row, "current_bytes"),
-                inum(row, "peak_bytes"),
-                inum(row, "step_peak_bytes"),
-                inum(row, "limit_bytes"),
-                str(row.get("backend", "unknown")),
-            )
+    steps = v.ints("step")
+    ts = v.floats("timestamp")
+    dev_id = v.ints("device_id")
+    kind = v.strs("device_kind", "unknown")
+    current = v.ints("current_bytes")
+    peak = v.ints("peak_bytes")
+    step_peak = v.ints("step_peak_bytes")
+    limit = v.ints("limit_bytes")
+    backend = v.strs("backend", "unknown")
+    out = [
+        ident
+        + (
+            steps[i],
+            ts[i],
+            dev_id[i],
+            kind[i],
+            current[i],
+            peak[i],
+            step_peak[i],
+            limit[i],
+            backend[i],
         )
-    return {TABLE: out} if out else {}
+        for i in range(len(v))
+    ]
+    return {TABLE: out}

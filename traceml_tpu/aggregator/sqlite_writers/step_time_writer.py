@@ -13,9 +13,7 @@ from typing import Dict, List, Tuple
 from traceml_tpu.aggregator.sqlite_writers.common import (
     IDENTITY_SCHEMA,
     dumps,
-    fnum,
     identity_tuple,
-    inum,
 )
 from traceml_tpu.telemetry.envelope import TelemetryEnvelope
 
@@ -80,34 +78,36 @@ def insert_sql(table: str) -> str:
 
 def build_rows(env: TelemetryEnvelope) -> Dict[str, List[Tuple]]:
     ident = identity_tuple(env)
-    out = []
-    for row in env.tables.get("step_time", []):
-        out.append(
+    tables: Dict[str, List[Tuple]] = {}
+    v = env.column_view("step_time")
+    if v:
+        steps = v.ints("step")
+        ts = v.floats("timestamp")
+        clocks = v.strs("clock", "host")
+        late = v.ints("late_markers")
+        events = v.col("events")
+        tables[TABLE] = [
             ident
             + (
-                inum(row, "step"),
-                fnum(row, "timestamp"),
-                str(row.get("clock", "host")),
-                inum(row, "late_markers") or 0,
-                dumps(row.get("events", {})),
+                steps[i],
+                ts[i],
+                clocks[i],
+                late[i] or 0,
+                dumps(events[i] if events[i] is not None else {}),
             )
-        )
-    tables: Dict[str, List[Tuple]] = {}
-    if out:
-        tables[TABLE] = out
-    stats_rows = [
-        ident
-        + (
-            fnum(row, "timestamp"),
-            fnum(row, "flops_per_step"),
-            row.get("flops_source"),
-            row.get("device_kind"),
-            fnum(row, "peak_flops"),
-            inum(row, "device_count"),
-            fnum(row, "tokens_per_step"),
-        )
-        for row in env.tables.get("model_stats", [])
-    ]
-    if stats_rows:
-        tables[MODEL_STATS_TABLE] = stats_rows
+            for i in range(len(v))
+        ]
+    v = env.column_view("model_stats")
+    if v:
+        ts = v.floats("timestamp")
+        flops = v.floats("flops_per_step")
+        source = v.col("flops_source")
+        kind = v.col("device_kind")
+        peak = v.floats("peak_flops")
+        count = v.ints("device_count")
+        tokens = v.floats("tokens_per_step")
+        tables[MODEL_STATS_TABLE] = [
+            ident + (ts[i], flops[i], source[i], kind[i], peak[i], count[i], tokens[i])
+            for i in range(len(v))
+        ]
     return tables

@@ -7,9 +7,7 @@ from typing import Dict, List, Tuple
 
 from traceml_tpu.aggregator.sqlite_writers.common import (
     IDENTITY_SCHEMA,
-    fnum,
     identity_tuple,
-    inum,
 )
 from traceml_tpu.telemetry.envelope import TelemetryEnvelope
 
@@ -82,39 +80,44 @@ def insert_sql(table: str) -> str:
 def build_rows(env: TelemetryEnvelope) -> Dict[str, List[Tuple]]:
     ident = identity_tuple(env)
     out: Dict[str, List[Tuple]] = {}
-    host = []
-    for row in env.tables.get("system", []):
-        host.append(
+    v = env.column_view("system")
+    if v:
+        ts = v.floats("timestamp")
+        cpu = v.floats("cpu_pct")
+        used = v.ints("memory_used_bytes")
+        total = v.ints("memory_total_bytes")
+        pct = v.floats("memory_pct")
+        l1 = v.floats("load_1m")
+        l5 = v.floats("load_5m")
+        l15 = v.floats("load_15m")
+        out[TABLE_HOST] = [
+            ident + (ts[i], cpu[i], used[i], total[i], pct[i], l1[i], l5[i], l15[i])
+            for i in range(len(v))
+        ]
+    v = env.column_view("system_device")
+    if v:
+        ts = v.floats("timestamp")
+        dev_id = v.ints("device_id")
+        kind = v.strs("device_kind", "unknown")
+        used = v.ints("memory_used_bytes")
+        peak = v.ints("memory_peak_bytes")
+        total = v.ints("memory_total_bytes")
+        util = v.floats("utilization_pct")
+        temp = v.floats("temperature_c")
+        power = v.floats("power_w")
+        out[TABLE_DEVICE] = [
             ident
             + (
-                fnum(row, "timestamp"),
-                fnum(row, "cpu_pct"),
-                inum(row, "memory_used_bytes"),
-                inum(row, "memory_total_bytes"),
-                fnum(row, "memory_pct"),
-                fnum(row, "load_1m"),
-                fnum(row, "load_5m"),
-                fnum(row, "load_15m"),
+                ts[i],
+                dev_id[i],
+                kind[i],
+                used[i],
+                peak[i],
+                total[i],
+                util[i],
+                temp[i],
+                power[i],
             )
-        )
-    if host:
-        out[TABLE_HOST] = host
-    dev = []
-    for row in env.tables.get("system_device", []):
-        dev.append(
-            ident
-            + (
-                fnum(row, "timestamp"),
-                inum(row, "device_id"),
-                str(row.get("device_kind", "unknown")),
-                inum(row, "memory_used_bytes"),
-                inum(row, "memory_peak_bytes"),
-                inum(row, "memory_total_bytes"),
-                fnum(row, "utilization_pct"),
-                fnum(row, "temperature_c"),
-                fnum(row, "power_w"),
-            )
-        )
-    if dev:
-        out[TABLE_DEVICE] = dev
+            for i in range(len(v))
+        ]
     return out

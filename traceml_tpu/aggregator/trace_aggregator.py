@@ -139,7 +139,10 @@ class TraceMLAggregator:
     # -- ingest ----------------------------------------------------------
     def _drain_once(self) -> int:
         with self._drain_lock:
-            payloads = self.server.drain()
+            # drain() hands over raw frames; msgpack decode runs HERE on
+            # the aggregator thread, never on the TCP selector thread.
+            frames = self.server.drain()
+            payloads = self.server.decode_frames(frames) if frames else []
             n = 0
             for p in payloads:
                 if is_control_message(p):

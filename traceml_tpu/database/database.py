@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import threading
 from collections import deque
+from itertools import islice
 from typing import Any, Deque, Dict, List, Optional
 
 DEFAULT_MAX_ROWS = 3000
@@ -89,8 +90,13 @@ class Database:
             new_cursor = t.appended
             if new <= 0:
                 return [], new_cursor
-            rows = list(t.rows)
-        return (rows[-new:] if new < len(rows) else rows), new_cursor
+            take = min(new, len(t.rows))
+            # Slice from the tail via reversed() so the lock-held work is
+            # O(new rows), not O(retained rows) — a sender collecting a
+            # handful of fresh rows must not copy the whole deque.
+            rows = list(islice(reversed(t.rows), take))
+        rows.reverse()
+        return rows, new_cursor
 
     def clear(self) -> None:
         with self._lock:

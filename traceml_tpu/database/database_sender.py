@@ -5,6 +5,11 @@ Keeps a per-table cursor on the append counter; ``collect_payload`` ships
 only rows appended since the previous call, wrapped in a canonical
 telemetry envelope.  Returns ``None`` when there is nothing new (so the
 publisher can skip the network entirely on idle ticks).
+
+Envelopes go out as **schema v2 (columnar)** — each table transposed to
+struct-of-arrays so table keys are encoded once per batch instead of
+once per row (see docs/developer_guide/wire-schema-v2.md).  The
+aggregator still accepts v1 row-lists from older senders.
 """
 
 from __future__ import annotations
@@ -15,7 +20,7 @@ from traceml_tpu.database.database import Database
 from traceml_tpu.telemetry.envelope import (
     SenderIdentity,
     TelemetryEnvelope,
-    build_telemetry_envelope,
+    build_columnar_envelope,
 )
 
 
@@ -43,7 +48,7 @@ class DBIncrementalSender:
             self._cursors[table] = new_cursor
         if not tables:
             return None
-        env: TelemetryEnvelope = build_telemetry_envelope(
+        env: TelemetryEnvelope = build_columnar_envelope(
             self._sampler, tables, identity=self._identity
         )
         return env.to_wire()

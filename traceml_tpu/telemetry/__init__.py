@@ -2,10 +2,17 @@
 (reference: src/traceml_ai/telemetry/)."""
 
 from traceml_tpu.telemetry.envelope import (  # noqa: F401
+    SCHEMA_V2,
+    SCHEMA_VERSION,
+    ColumnView,
     SenderIdentity,
     TelemetryEnvelope,
+    build_columnar_envelope,
     build_telemetry_envelope,
+    columns_to_rows,
+    is_columnar_table,
     normalize_telemetry_envelope,
+    rows_to_columns,
 )
 from traceml_tpu.telemetry.control import (  # noqa: F401
     CONTROL_KEY,

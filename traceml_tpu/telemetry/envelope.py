@@ -4,7 +4,7 @@ Canonical shape on the wire::
 
     {
       "meta": {
-        "schema": 1,
+        "schema": 1 | 2,
         "session_id": str,
         "sampler": str,                # e.g. "step_time"
         "timestamp": float,            # sender host unix time
@@ -19,12 +19,28 @@ Canonical shape on the wire::
         "platform": str,               # "tpu" | "cpu" | "gpu"
         "device_kind": str,            # e.g. "TPU v5p"
       },
-      "body": {"tables": {table_name: [row, ...]}}
+      "body": {"tables": {table_name: <table>}}
     }
 
-``normalize_telemetry_envelope`` accepts the canonical shape and a legacy
-flat shape ``{"sampler":..., "tables":...}`` and always returns the
-canonical one — the aggregator only ever sees canonical envelopes.
+Two table encodings are negotiated per-envelope via ``meta.schema``
+(see docs/developer_guide/wire-schema-v2.md for the full layout):
+
+* **schema 1 (row-list)** — ``[ {k: v, ...}, ... ]``: one dict per row,
+  every string key repeated per row.
+* **schema 2 (columnar / struct-of-arrays)** —
+  ``{"cols": [k1, k2, ...], "vals": [[...], [...], ...]}``: keys encoded
+  once per batch; ``vals[j]`` is the value array for column ``cols[j]``
+  (missing keys are ``None``-filled).  This is what
+  ``DBIncrementalSender`` ships — it removes the dominant per-row key
+  bytes from the wire.
+
+``normalize_telemetry_envelope`` accepts the canonical shape (either
+table encoding, even mixed per-table), plus a legacy flat shape
+``{"sampler":..., "tables":...}`` and always returns a canonical
+:class:`TelemetryEnvelope`.  Columnar tables are kept columnar — the
+``tables`` property materializes row dicts lazily, and the aggregator's
+SQLite writers consume :meth:`TelemetryEnvelope.column_view` directly
+without ever building per-row dicts.
 """
 
 from __future__ import annotations
@@ -33,9 +49,10 @@ import dataclasses
 import socket
 import os
 import time
-from typing import Any, Dict, List, Mapping, Optional
+from typing import Any, Dict, List, Mapping, Optional, Tuple
 
 SCHEMA_VERSION = 1
+SCHEMA_V2 = 2
 
 
 @dataclasses.dataclass(frozen=True)
@@ -71,10 +88,221 @@ class SenderIdentity:
         }
 
 
-@dataclasses.dataclass
+# -- columnar (struct-of-arrays) table helpers ---------------------------
+
+# Reserved marker key for a nested struct-of-arrays column: a column whose
+# rows are dicts with an IDENTICAL key set (e.g. step_time "events") is
+# encoded as {"\x00soa": [keys, [subcol, ...]]}, recursively — the inner
+# keys hit the wire once per batch instead of once per row.  A single-key
+# dict with this NUL-prefixed key cannot occur in sampler rows.
+SOA_KEY = "\x00soa"
+
+
+def _same_key_dicts(cells: List[Any]) -> Optional[List[str]]:
+    """Key list when every cell is a dict with the same key set, else None."""
+    if not cells or not isinstance(cells[0], dict):
+        return None
+    first = cells[0]
+    for c in cells[1:]:
+        if not isinstance(c, dict) or c.keys() != first.keys():
+            return None
+    return [str(k) for k in first]
+
+
+def _encode_cells(cells: List[Any]) -> Any:
+    keys = _same_key_dicts(cells)
+    if keys is None:
+        return cells
+    return {
+        SOA_KEY: [keys, [_encode_cells([c[k] for c in cells]) for k in keys]]
+    }
+
+
+def _decode_cells(col: Any, n: int) -> List[Any]:
+    if isinstance(col, dict):
+        marker = col.get(SOA_KEY)
+        if (
+            isinstance(marker, (list, tuple))
+            and len(marker) == 2
+            and isinstance(marker[0], list)
+            and isinstance(marker[1], list)
+        ):
+            keys, subcols = marker
+            if len(keys) == len(subcols):
+                decoded = [_decode_cells(s, n) for s in subcols]
+                return [
+                    {keys[j]: decoded[j][i] for j in range(len(keys))}
+                    for i in range(n)
+                ]
+        return [None] * n  # malformed nested column → null it out
+    return col
+
+
+def rows_to_columns(rows: List[Mapping[str, Any]]) -> Dict[str, Any]:
+    """``[{k: v}, ...]`` → ``{"cols": [...], "vals": [...], "n": N}``.
+
+    Column order is first-appearance order across the batch; rows missing
+    a key get ``None`` in that column (telemetry consumers treat absent
+    and ``None`` identically).  Dict-valued columns with a uniform key
+    set are recursively transposed (see :data:`SOA_KEY`).
+    """
+    cols: List[str] = []
+    index: Dict[str, int] = {}
+    for row in rows:
+        for k in row:
+            if k not in index:
+                index[k] = len(cols)
+                cols.append(k)
+    n = len(rows)
+    vals: List[Any] = [[None] * n for _ in cols]
+    for i, row in enumerate(rows):
+        for k, v in row.items():
+            vals[index[k]][i] = v
+    return {"cols": cols, "vals": [_encode_cells(col) for col in vals], "n": n}
+
+
+def columns_to_rows(table: Mapping[str, Any]) -> List[Dict[str, Any]]:
+    """Materialize row dicts from a columnar table (inverse of
+    :func:`rows_to_columns` for batches with uniform keys)."""
+    cols = table.get("cols") or []
+    vals = table.get("vals") or []
+    n = _columnar_n(table)
+    decoded = [_decode_cells(col, n) for col in vals]
+    return [{cols[j]: decoded[j][i] for j in range(len(cols))} for i in range(n)]
+
+
+def _columnar_n(table: Mapping[str, Any]) -> int:
+    n = table.get("n")
+    if isinstance(n, int) and n >= 0:
+        return n
+    for col in table.get("vals") or ():
+        if isinstance(col, list):
+            return len(col)
+    return 0
+
+
+def is_columnar_table(obj: Any) -> bool:
+    return (
+        isinstance(obj, Mapping)
+        and isinstance(obj.get("cols"), list)
+        and isinstance(obj.get("vals"), list)
+    )
+
+
+def _validate_columnar(obj: Mapping[str, Any]) -> Optional[Dict[str, Any]]:
+    """Sanitize a wire columnar table; None when structurally invalid."""
+    cols = obj.get("cols")
+    vals = obj.get("vals")
+    if not isinstance(cols, list) or not isinstance(vals, list):
+        return None
+    if len(cols) != len(vals):
+        return None
+    n = obj.get("n") if isinstance(obj.get("n"), int) else None
+    for col in vals:
+        if isinstance(col, list):
+            if n is None:
+                n = len(col)
+            elif len(col) != n:
+                return None
+        elif not isinstance(col, Mapping):
+            return None  # nested SoA columns are dicts; anything else is junk
+    if n is None:
+        n = 0 if not vals else None
+    if n is None or n < 0:
+        return None
+    return {"cols": [str(c) for c in cols], "vals": vals, "n": n}
+
+
+def _to_float(v: Any) -> Optional[float]:
+    if v is None:
+        return None
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return None
+
+
+def _to_int(v: Any) -> Optional[int]:
+    if v is None:
+        return None
+    try:
+        return int(v)
+    except (TypeError, ValueError):
+        return None
+
+
+class ColumnView:
+    """Read-only columnar view over one table, regardless of how it
+    arrived on the wire (v2 columns directly; v1 row dicts via a single
+    transpose).  SQLite writers build parameter tuples from these column
+    lists instead of per-row dict lookups.
+
+    Truthiness is "has at least one row", so writers can guard with a
+    plain ``if view:``.
+    """
+
+    __slots__ = ("_idx", "_vals", "_n")
+
+    def __init__(
+        self, cols: List[str], vals: List[Any], n: Optional[int] = None
+    ) -> None:
+        self._idx = {k: j for j, k in enumerate(cols)}
+        self._vals = vals
+        if n is None:
+            n = 0
+            for col in vals:
+                if isinstance(col, list):
+                    n = len(col)
+                    break
+        self._n = n
+
+    @classmethod
+    def from_rows(cls, rows: List[Mapping[str, Any]]) -> "ColumnView":
+        ct = rows_to_columns(rows)
+        return cls(ct["cols"], ct["vals"], ct["n"])
+
+    def __len__(self) -> int:
+        return self._n
+
+    def col(self, key: str) -> List[Any]:
+        """Raw value column (nested SoA columns are materialized back to
+        per-row dicts); ``None``-filled when the column is absent."""
+        j = self._idx.get(key)
+        if j is None:
+            return [None] * self._n
+        return _decode_cells(self._vals[j], self._n)
+
+    def floats(self, key: str) -> List[Optional[float]]:
+        return [_to_float(v) for v in self.col(key)]
+
+    def ints(self, key: str) -> List[Optional[int]]:
+        return [_to_int(v) for v in self.col(key)]
+
+    def strs(self, key: str, default: str = "") -> List[str]:
+        return [default if v is None else str(v) for v in self.col(key)]
+
+
 class TelemetryEnvelope:
-    meta: Dict[str, Any]
-    tables: Dict[str, List[Dict[str, Any]]]
+    """Canonical in-memory envelope.
+
+    Holds tables as row-lists (``tables=``), columnar tables
+    (``columns=``), or both (a mixed canonical wire payload).  ``tables``
+    materializes row dicts lazily and caches; :meth:`column_view` serves
+    the aggregator hot path without materializing rows for v2 input.
+    """
+
+    __slots__ = ("meta", "_rows", "_columns", "_cache")
+
+    def __init__(
+        self,
+        meta: Dict[str, Any],
+        tables: Optional[Dict[str, List[Dict[str, Any]]]] = None,
+        columns: Optional[Dict[str, Dict[str, Any]]] = None,
+    ) -> None:
+        self.meta = meta
+        self._rows = tables
+        self._columns = columns
+        self._cache: Optional[Dict[str, List[Dict[str, Any]]]] = None
 
     @property
     def sampler(self) -> str:
@@ -84,8 +312,60 @@ class TelemetryEnvelope:
     def global_rank(self) -> int:
         return int(self.meta.get("global_rank", self.meta.get("rank", 0)))
 
+    @property
+    def schema(self) -> int:
+        try:
+            return int(self.meta.get("schema", SCHEMA_VERSION))
+        except (TypeError, ValueError):
+            return SCHEMA_VERSION
+
+    @property
+    def tables(self) -> Dict[str, List[Dict[str, Any]]]:
+        if self._cache is None:
+            if not self._columns:
+                self._cache = self._rows if self._rows is not None else {}
+            else:
+                merged = {k: columns_to_rows(v) for k, v in self._columns.items()}
+                if self._rows:
+                    merged.update(self._rows)
+                self._cache = merged
+        return self._cache
+
+    def table_names(self) -> List[str]:
+        names = list(self._rows or ())
+        for k in self._columns or ():
+            if k not in names:
+                names.append(k)
+        return names
+
+    def column_view(self, name: str) -> Optional[ColumnView]:
+        """Columnar view of one table, or None when absent.  v2 tables
+        are served zero-copy; v1 row-lists pay one transpose."""
+        if self._columns is not None:
+            ct = self._columns.get(name)
+            if ct is not None:
+                return ColumnView(ct["cols"], ct["vals"], _columnar_n(ct))
+        rows = (self._rows or {}).get(name)
+        if rows is None:
+            return None
+        return ColumnView.from_rows(rows)
+
+    def table_columns(self, name: str) -> Optional[Tuple[List[str], List[List[Any]]]]:
+        """Raw ``(cols, vals)`` when the table arrived columnar, else None."""
+        if self._columns is None:
+            return None
+        ct = self._columns.get(name)
+        if ct is None:
+            return None
+        return ct["cols"], ct["vals"]
+
     def to_wire(self) -> Dict[str, Any]:
-        return {"meta": dict(self.meta), "body": {"tables": self.tables}}
+        tables: Dict[str, Any] = {}
+        if self._columns:
+            tables.update(self._columns)
+        if self._rows:
+            tables.update(self._rows)
+        return {"meta": dict(self.meta), "body": {"tables": tables}}
 
 
 def build_telemetry_envelope(
@@ -93,19 +373,63 @@ def build_telemetry_envelope(
     tables: Mapping[str, List[Dict[str, Any]]],
     identity: Optional[SenderIdentity] = None,
     timestamp: Optional[float] = None,
+    copy: bool = True,
 ) -> TelemetryEnvelope:
+    """Schema-1 (row-list) envelope.  ``copy=False`` is for trusted
+    internal callers whose row lists are already fresh snapshots — it
+    skips the defensive per-table list copy."""
     identity = identity or SenderIdentity()
     meta = identity.to_meta()
     meta["sampler"] = sampler
     meta["timestamp"] = time.time() if timestamp is None else timestamp
-    return TelemetryEnvelope(meta=meta, tables={k: list(v) for k, v in tables.items()})
+    if copy:
+        body = {str(k): list(v) for k, v in tables.items()}
+    else:
+        body = dict(tables)
+    return TelemetryEnvelope(meta=meta, tables=body)
+
+
+def build_columnar_envelope(
+    sampler: str,
+    tables: Mapping[str, List[Dict[str, Any]]],
+    identity: Optional[SenderIdentity] = None,
+    timestamp: Optional[float] = None,
+) -> TelemetryEnvelope:
+    """Schema-2 (columnar) envelope: each table transposed to
+    struct-of-arrays so string keys hit the wire once per batch."""
+    identity = identity or SenderIdentity()
+    meta = identity.to_meta()
+    meta["schema"] = SCHEMA_V2
+    meta["sampler"] = sampler
+    meta["timestamp"] = time.time() if timestamp is None else timestamp
+    return TelemetryEnvelope(
+        meta=meta,
+        columns={str(k): rows_to_columns(v) for k, v in tables.items()},
+    )
+
+
+def _split_wire_tables(
+    tables: Mapping[str, Any],
+) -> Tuple[Dict[str, List[Dict[str, Any]]], Optional[Dict[str, Dict[str, Any]]]]:
+    rows_t: Dict[str, List[Dict[str, Any]]] = {}
+    cols_t: Dict[str, Dict[str, Any]] = {}
+    for k, v in tables.items():
+        if isinstance(v, list):
+            rows_t[str(k)] = list(v)
+        elif is_columnar_table(v):
+            ct = _validate_columnar(v)
+            if ct is not None:
+                cols_t[str(k)] = ct
+    return rows_t, (cols_t or None)
 
 
 def normalize_telemetry_envelope(payload: Any) -> Optional[TelemetryEnvelope]:
     """Coerce a decoded wire payload into a canonical envelope.
 
-    Returns None for payloads that are not telemetry (e.g. control
-    messages, garbage) — the caller decides what to do with those.
+    Accepts schema-1 row-list tables, schema-2 columnar tables (even
+    mixed within one envelope), and the legacy flat shape.  Returns None
+    for payloads that are not telemetry (e.g. control messages, garbage)
+    — the caller decides what to do with those.
     """
     if not isinstance(payload, Mapping):
         return None
@@ -121,10 +445,8 @@ def normalize_telemetry_envelope(payload: Any) -> Optional[TelemetryEnvelope]:
         meta.setdefault("schema", SCHEMA_VERSION)
         meta.setdefault("global_rank", meta.get("rank", 0))
         meta.setdefault("rank", meta.get("global_rank", 0))
-        return TelemetryEnvelope(
-            meta=meta,
-            tables={str(k): list(v) for k, v in tables.items() if isinstance(v, list)},
-        )
+        rows_t, cols_t = _split_wire_tables(tables)
+        return TelemetryEnvelope(meta=meta, tables=rows_t, columns=cols_t)
     # Legacy flat shape: {"sampler": ..., "tables": {...}, **identity}
     if "tables" in payload and "sampler" in payload:
         tables = payload.get("tables")
@@ -139,8 +461,6 @@ def normalize_telemetry_envelope(payload: Any) -> Optional[TelemetryEnvelope]:
         meta.setdefault("global_rank", meta.get("rank", 0))
         meta.setdefault("rank", meta.get("global_rank", 0))
         meta.setdefault("timestamp", time.time())
-        return TelemetryEnvelope(
-            meta=meta,
-            tables={str(k): list(v) for k, v in tables.items() if isinstance(v, list)},
-        )
+        rows_t, cols_t = _split_wire_tables(tables)
+        return TelemetryEnvelope(meta=meta, tables=rows_t, columns=cols_t)
     return None

@@ -13,7 +13,15 @@ Differences from the reference, chosen for the TPU build:
   must not mean hundreds of threads in the aggregator;
 * the receive path drains complete frames in O(bytes) with a rolling
   buffer offset (the reference ships an O(N) drain too, proved by its
-  bench tests/benchmarks/bench_tcp_drain.py).
+  bench tests/benchmarks/bench_tcp_drain.py);
+* the selector thread only **splits frames** — msgpack decode happens on
+  the consumer's thread (``drain()`` returns raw frames;
+  ``decode_frames``/``drain_decoded`` do the decode), so one rank sending
+  a huge batch can never stall accepts/reads for every other rank.
+
+Frame bodies carry telemetry envelopes in schema v1 (row-list) or
+schema v2 (columnar struct-of-arrays) — layout and negotiation are
+documented in docs/developer_guide/wire-schema-v2.md.
 
 The client is best-effort and NEVER raises into training code: lazy
 connect, drop-on-failure, bounded reconnect backoff
@@ -95,9 +103,11 @@ def encode_frame(payload: Any) -> bytes:
 class TCPServer:
     """Aggregator-side ingest server.
 
-    Decoded payloads are appended to an internal thread-safe queue; the
-    aggregator loop calls :meth:`drain` and blocks on :meth:`wait_for_data`
-    for low-latency ingest (reference: tcp_transport.py:119-178).
+    Raw frames are appended to an internal thread-safe queue; the
+    aggregator loop blocks on :meth:`wait_for_data`, pulls frames with
+    :meth:`drain`, and decodes them on its own thread via
+    :meth:`decode_frames` (reference: tcp_transport.py:119-178).  Callers
+    that don't care about the split can use :meth:`drain_decoded`.
     """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
@@ -185,11 +195,27 @@ class TCPServer:
             self._data_event.clear()
         return fired
 
-    def drain(self) -> List[Any]:
+    def drain(self) -> List[bytes]:
+        """Pull raw frames accumulated by the selector thread."""
         with self._lock:
             out = self._pending
             self._pending = []
         return out
+
+    def decode_frames(self, frames: List[bytes]) -> List[Any]:
+        """Decode raw frames into a flat payload list on the CALLER's
+        thread (batch frames are flattened); bumps ``decode_errors``."""
+        payloads, errors = msgpack_codec.decode_batch(frames)
+        if errors:
+            self.decode_errors += errors
+            get_error_log().warning(
+                f"dropped {errors} undecodable frame(s) during drain"
+            )
+        return payloads
+
+    def drain_decoded(self) -> List[Any]:
+        """Convenience: :meth:`drain` + :meth:`decode_frames`."""
+        return self.decode_frames(self.drain())
 
     # -- server thread -------------------------------------------------
     def _serve(self) -> None:
@@ -264,25 +290,12 @@ class TCPServer:
             return
         if not frames:
             return
-        decoded: List[Any] = []
-        for frame in frames:
-            try:
-                payload = msgpack_codec.decode(frame)
-            except msgpack_codec.CodecError as exc:
-                self.decode_errors += 1
-                get_error_log().warning(f"undecodable frame: {exc}")
-                continue
-            # A batch frame is a list of payloads; flatten here so the
-            # aggregator sees individual messages.
-            if isinstance(payload, list):
-                decoded.extend(payload)
-            else:
-                decoded.append(payload)
+        # NO decode here: this is the selector thread, shared by every
+        # client.  Frames are handed to the consumer as-is.
         self.frames_received += len(frames)
-        if decoded:
-            with self._lock:
-                self._pending.extend(decoded)
-            self._data_event.set()
+        with self._lock:
+            self._pending.extend(frames)
+        self._data_event.set()
 
 
 class TCPClient:
@@ -302,38 +315,73 @@ class TCPClient:
         self._sock: Optional[socket.socket] = None
         self._last_fail = 0.0
         self._lock = threading.Lock()
+        # Serializes dialers; held WITHOUT self._lock during the blocking
+        # create_connection so close() / a concurrent sender on an
+        # established socket never waits behind a stalled connect.
+        self._connect_lock = threading.Lock()
+        self._gen = 0  # bumped by close(); a dial that straddles it is discarded
         self.batches_sent = 0
         self.batches_dropped = 0
 
-    def _connect_locked(self) -> bool:
-        if self._sock is not None:
-            return True
-        now = time.monotonic()
-        if now - self._last_fail < self._backoff:
-            return False
-        try:
-            sock = socket.create_connection(
-                (self._host, self._port), timeout=self._timeout
-            )
-            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            sock.settimeout(self._timeout)
-            self._sock = sock
-            return True
-        except OSError:
-            self._last_fail = now
-            return False
+    def _ensure_connected(self) -> Optional[socket.socket]:
+        with self._lock:
+            if self._sock is not None:
+                return self._sock
+            if time.monotonic() - self._last_fail < self._backoff:
+                return None
+            gen = self._gen
+        with self._connect_lock:
+            with self._lock:
+                if self._sock is not None:
+                    return self._sock
+                if self._gen != gen:
+                    return None
+            try:
+                sock = socket.create_connection(
+                    (self._host, self._port), timeout=self._timeout
+                )
+            except OSError:
+                with self._lock:
+                    self._last_fail = time.monotonic()
+                return None
+            try:
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                sock.settimeout(self._timeout)
+            except OSError:
+                pass
+            with self._lock:
+                if self._gen != gen:
+                    # close() raced the dial; don't resurrect the socket
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    return None
+                self._sock = sock
+                return sock
 
     def send_batch(self, payloads: List[Any]) -> bool:
-        """Encode ``payloads`` as ONE frame, one sendall. True on success."""
+        """Encode ``payloads`` as ONE frame, one sendall. True on success.
+
+        Encoding happens before any lock is taken — a large batch being
+        msgpack'd must not block a concurrent close() or sender.
+        """
         if not payloads:
             return True
+        try:
+            frame = encode_frame(payloads)
+        except Exception:
+            self.batches_dropped += 1
+            return False
+        if self._ensure_connected() is None:
+            self.batches_dropped += 1
+            return False
         with self._lock:
-            if not self._connect_locked():
+            if self._sock is None:  # torn down between connect and send
                 self.batches_dropped += 1
                 return False
             try:
-                assert self._sock is not None
-                self._sock.sendall(encode_frame(payloads))
+                self._sock.sendall(frame)
                 self.batches_sent += 1
                 return True
             except Exception:
@@ -351,5 +399,7 @@ class TCPClient:
             self._last_fail = time.monotonic()
 
     def close(self) -> None:
+        """Drop the current socket (a later send_batch may redial)."""
         with self._lock:
+            self._gen += 1
             self._teardown_locked()

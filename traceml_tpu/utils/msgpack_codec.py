@@ -103,5 +103,31 @@ def decode(data: bytes) -> Any:
         raise CodecError(f"undecodable frame (prefix={prefix!r}): {exc}") from exc
 
 
+def decode_batch(frames) -> "tuple[list, int]":
+    """Decode a list of wire frames into a flat payload list.
+
+    A frame whose body is a top-level list is a sender batch — its
+    elements are flattened into the output.  Undecodable frames are
+    skipped and counted.  Returns ``(payloads, n_decode_errors)``.
+
+    This is the consumer-side half of the ingest path: the TCP selector
+    thread only splits frames; whoever drains them calls this on its own
+    thread (see transport.tcp_transport.TCPServer.decode_frames).
+    """
+    payloads: list = []
+    errors = 0
+    for frame in frames:
+        try:
+            payload = decode(frame)
+        except CodecError:
+            errors += 1
+            continue
+        if isinstance(payload, list):
+            payloads.extend(payload)
+        else:
+            payloads.append(payload)
+    return payloads, errors
+
+
 def codec_name() -> str:
     return "msgpack" if _HAVE_MSGPACK else "json"
