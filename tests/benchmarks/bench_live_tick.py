@@ -1,0 +1,374 @@
+"""Live tick cost: full-reload baseline vs incremental snapshot store.
+
+The pre-change live path re-read the whole session DB every tick —
+``SELECT DISTINCT global_rank`` + one query per rank (N+1), a fresh
+``json.loads`` of every events_json blob, and a second window build
+inside ``diagnose_rank_rows`` — even when nothing changed.  The
+incremental path (``LiveSnapshotStore`` + dirty-gated ``LiveComputer``)
+must beat it by construction:
+
+* warm no-new-data tick: ≥ 10× faster (one ``PRAGMA data_version``);
+* warm incremental tick (one new step per rank): ≥ 3× faster;
+* identical window / diagnosis / per-domain output (golden comparison
+  against the vendored pre-change loader path).
+
+Asserted at 256 ranks × 120 steps; 64 ranks is emitted for scaling
+context.  Results print as bench_common JSON lines (collected into the
+BENCH_LOCAL_* records at the repo root).
+"""
+
+import json
+import sqlite3
+import statistics
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent))
+import bench_common  # noqa: E402
+
+from traceml_tpu.aggregator.sqlite_writer import SQLiteWriter  # noqa: E402
+from traceml_tpu.diagnostics.step_memory.api import (  # noqa: E402
+    diagnose_rank_rows as diagnose_memory,
+)
+from traceml_tpu.diagnostics.process.api import (  # noqa: E402
+    diagnose as diagnose_process,
+)
+from traceml_tpu.diagnostics.step_time.api import diagnose_rank_rows  # noqa: E402
+from traceml_tpu.diagnostics.system.api import (  # noqa: E402
+    diagnose as diagnose_system,
+)
+from traceml_tpu.renderers import views as V  # noqa: E402
+from traceml_tpu.renderers.compute import LiveComputer  # noqa: E402
+from traceml_tpu.reporting import loaders  # noqa: E402
+from traceml_tpu.telemetry.envelope import (  # noqa: E402
+    SenderIdentity,
+    build_telemetry_envelope,
+)
+from traceml_tpu.utils import timing as T  # noqa: E402
+from traceml_tpu.utils.step_time_window import build_step_time_window  # noqa: E402
+
+pytestmark = pytest.mark.slow
+
+BENCH = "live_tick"
+WINDOW = 120
+RANKS_PER_NODE = 8
+
+
+# -- synthetic session -----------------------------------------------------
+
+
+def _ident(rank, world):
+    node = rank // RANKS_PER_NODE
+    return SenderIdentity(
+        session_id="bench",
+        global_rank=rank,
+        local_rank=rank % RANKS_PER_NODE,
+        world_size=world,
+        node_rank=node,
+        hostname=f"host-{node}",
+        pid=1000 + rank,
+    )
+
+
+def _step_rows(rank, start, n):
+    rows = []
+    for s in range(start, start + n):
+        # deterministic variation so the window/diagnosis is non-trivial
+        base = 50.0 + (s % 7) * 0.5 + (rank % 5) * 0.3
+        rows.append({
+            "step": s,
+            "timestamp": float(s),
+            "clock": "device",
+            "events": {
+                T.STEP_TIME: {"cpu_ms": base, "device_ms": base, "count": 1},
+                T.COMPUTE_TIME: {
+                    "cpu_ms": 1.0, "device_ms": base * 0.8, "count": 1,
+                },
+                T.DATALOADER_NEXT: {
+                    "cpu_ms": base * 0.1, "device_ms": 0.0, "count": 1,
+                },
+            },
+        })
+    return rows
+
+
+def _mem_rows(start, n):
+    return [
+        {"step": s, "timestamp": float(s), "device_id": 0, "device_kind": "tpu",
+         "current_bytes": 1 << 30, "peak_bytes": (1 << 30) + s,
+         "step_peak_bytes": 1 << 30, "limit_bytes": 16 << 30, "backend": "fake"}
+        for s in range(start, start + n)
+    ]
+
+
+def _seed_db(db, ranks, steps):
+    w = SQLiteWriter(db)
+    w.start()
+    for rank in range(ranks):
+        ident = _ident(rank, ranks)
+        w.ingest(build_telemetry_envelope(
+            "step_time",
+            {
+                "step_time": _step_rows(rank, 1, steps),
+                "model_stats": [{
+                    "timestamp": 1.0, "flops_per_step": 1.2e12,
+                    "flops_source": "estimated", "device_kind": "tpu",
+                    "peak_flops": 1.97e14, "device_count": 1,
+                    "tokens_per_step": 4096.0,
+                }],
+            },
+            ident,
+        ))
+        w.ingest(build_telemetry_envelope(
+            "step_memory", {"step_memory": _mem_rows(1, steps)}, ident,
+        ))
+        w.ingest(build_telemetry_envelope(
+            "process",
+            {"process": [
+                {"timestamp": float(i), "cpu_pct": 40.0, "rss_bytes": 2 << 30,
+                 "vms_bytes": 4 << 30, "num_threads": 8}
+                for i in range(2)
+            ]},
+            ident,
+        ))
+        if rank % RANKS_PER_NODE == 0:
+            w.ingest(build_telemetry_envelope(
+                "system",
+                {"system": [
+                    {"timestamp": float(i), "cpu_pct": 30.0,
+                     "memory_used_bytes": 8 << 30,
+                     "memory_total_bytes": 32 << 30, "memory_pct": 25.0}
+                    for i in range(4)
+                ],
+                 "system_device": [
+                    {"timestamp": float(i), "device_id": 0,
+                     "device_kind": "tpu", "memory_used_bytes": 4 << 30,
+                     "memory_peak_bytes": 5 << 30,
+                     "memory_total_bytes": 16 << 30}
+                    for i in range(4)
+                ]},
+                ident,
+            ))
+    w.ingest(build_telemetry_envelope(
+        "stdout_stderr",
+        {"stdout_stderr": [
+            {"timestamp": float(i), "stream": "stdout", "line": f"log {i}"}
+            for i in range(64)
+        ]},
+        _ident(0, ranks),
+    ))
+    assert w.force_flush()
+    return w
+
+
+# -- vendored pre-change read path -----------------------------------------
+# The seed loaders (commit 27c2b0c): DISTINCT global_rank scan + one
+# query per rank + per-tick json decode of every blob.  Kept verbatim so
+# the baseline stays honest after the shipped loaders were collapsed.
+
+
+def _seed_load_step_time_rows(db_path, max_steps_per_rank):
+    out = {}
+    with sqlite3.connect(f"file:{db_path}?mode=ro", uri=True) as conn:
+        conn.row_factory = sqlite3.Row
+        ranks = [
+            r[0]
+            for r in conn.execute(
+                "SELECT DISTINCT global_rank FROM step_time_samples"
+            )
+        ]
+        for rank in ranks:
+            rows = conn.execute(
+                "SELECT step, timestamp, clock, late_markers, events_json "
+                "FROM step_time_samples WHERE global_rank=? "
+                "ORDER BY step DESC LIMIT ?",
+                (rank, max_steps_per_rank),
+            ).fetchall()
+            decoded = []
+            for r in reversed(rows):
+                try:
+                    events = json.loads(r["events_json"] or "{}")
+                except ValueError:
+                    events = {}
+                decoded.append({
+                    "step": r["step"],
+                    "timestamp": r["timestamp"],
+                    "clock": r["clock"],
+                    "late_markers": r["late_markers"],
+                    "events": events,
+                })
+            out[int(rank)] = decoded
+    return out
+
+
+def _seed_load_step_memory_rows(db_path, max_rows_per_rank):
+    out = {}
+    with sqlite3.connect(f"file:{db_path}?mode=ro", uri=True) as conn:
+        conn.row_factory = sqlite3.Row
+        ranks = [
+            r[0]
+            for r in conn.execute(
+                "SELECT DISTINCT global_rank FROM step_memory_samples"
+            )
+        ]
+        for rank in ranks:
+            rows = conn.execute(
+                "SELECT step, timestamp, device_id, device_kind, current_bytes,"
+                " peak_bytes, step_peak_bytes, limit_bytes FROM"
+                " step_memory_samples WHERE global_rank=?"
+                " ORDER BY step DESC LIMIT ?",
+                (rank, max_rows_per_rank),
+            ).fetchall()
+            out[int(rank)] = [dict(r) for r in reversed(rows)]
+    return out
+
+
+def _baseline_tick(db):
+    """The pre-change ``LiveComputer.payload()`` body: fresh connection
+    per loader, full re-read + re-decode of every domain, second window
+    build inside ``diagnose_rank_rows`` (max_steps=200)."""
+    out = {"views": {}}
+    out["topology"] = loaders.load_topology(db)
+    world = int(out["topology"].get("world_size") or 0)
+    nodes = int(out["topology"].get("nodes") or 0)
+    rank_rows = _seed_load_step_time_rows(db, WINDOW)
+    window = build_step_time_window(rank_rows, max_steps=WINDOW)
+    latest = max(
+        (row.get("timestamp") or 0.0
+         for rows in rank_rows.values() for row in rows[-1:]),
+        default=None,
+    )
+    model_stats = loaders.load_model_stats(db)
+    out["views"]["step_time"] = V.build_step_time_view(
+        window, world_size=world, latest_ts=latest, model_stats=model_stats,
+    )
+    out["step_time"] = {
+        "window": window,
+        "diagnosis": diagnose_rank_rows(rank_rows, mode="live"),
+    }
+    mem_rows = _seed_load_step_memory_rows(db, WINDOW * 4)
+    out["views"]["memory"] = V.build_memory_view(mem_rows)
+    out["step_memory"] = mem_rows
+    out["step_memory_diagnosis"] = diagnose_memory(mem_rows) if mem_rows else None
+    host, devices = loaders.load_system_rows(db, max_rows=300)
+    out["views"]["system"] = V.build_system_view(host, devices, expected_nodes=nodes)
+    out["system"] = {"host": host, "devices": devices}
+    out["system_diagnosis"] = (
+        diagnose_system(host, devices) if host or devices else None
+    )
+    procs, pdevs = loaders.load_process_rows(db, max_rows=300)
+    out["views"]["process"] = V.build_process_view(procs)
+    out["process"] = {"procs": procs, "devices": pdevs}
+    out["process_diagnosis"] = (
+        diagnose_process(procs, pdevs) if procs or pdevs else None
+    )
+    out["stdout"] = loaders.load_stdout_tail(db)
+    return out
+
+
+def _kinds(diag):
+    return [] if diag is None else sorted(i.kind for i in diag.issues)
+
+
+def _golden_compare(inc, base):
+    """Incremental payload must match the pre-change path: same window,
+    same diagnosis verdicts, same per-domain row data."""
+    assert inc["step_time"]["window"] == base["step_time"]["window"]
+    assert _kinds(inc["step_time"]["diagnosis"]) == _kinds(
+        base["step_time"]["diagnosis"]
+    )
+    assert inc["step_memory"] == base["step_memory"]
+    assert _kinds(inc["step_memory_diagnosis"]) == _kinds(
+        base["step_memory_diagnosis"]
+    )
+    assert inc["system"] == base["system"]
+    assert inc["process"] == base["process"]
+    assert inc["stdout"] == base["stdout"]
+    assert inc["topology"] == base["topology"]
+
+
+# -- timing ----------------------------------------------------------------
+
+
+def _best_of(fn, reps):
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best * 1000.0
+
+
+def _run_case(tmp_path, ranks, steps):
+    db = tmp_path / f"bench_{ranks}.sqlite"
+    w = _seed_db(db, ranks, steps)
+
+    full_ms = _best_of(lambda: _baseline_tick(db), 3)
+    base = _baseline_tick(db)
+
+    computer = LiveComputer(db, window_steps=WINDOW)
+    t0 = time.perf_counter()
+    inc = computer.payload()
+    cold_ms = (time.perf_counter() - t0) * 1000.0
+    _golden_compare(inc, base)
+
+    # warm idle tick: no commits since the last refresh
+    noop = [
+        _best_of(computer.payload, 1)
+        for _ in range(50)
+    ]
+    noop_ms = statistics.median(noop)
+
+    # warm incremental tick: one new step per rank lands, then one tick
+    incr = []
+    next_step = steps + 1
+    for _ in range(5):
+        for rank in range(ranks):
+            w.ingest(build_telemetry_envelope(
+                "step_time",
+                {"step_time": _step_rows(rank, next_step, 1)},
+                _ident(rank, ranks),
+            ))
+        assert w.force_flush()
+        t0 = time.perf_counter()
+        p = computer.payload()
+        incr.append((time.perf_counter() - t0) * 1000.0)
+        assert p["step_time"]["window"].steps[-1] == next_step
+        next_step += 1
+    incr_ms = statistics.median(incr)
+
+    extra = {"ranks": ranks, "steps": steps, "window": WINDOW}
+    bench_common.emit(BENCH, "full_reload_tick", full_ms, "ms", **extra)
+    bench_common.emit(BENCH, "cold_tick", cold_ms, "ms", **extra)
+    bench_common.emit(BENCH, "warm_noop_tick", noop_ms, "ms", **extra)
+    bench_common.emit(BENCH, "warm_incr_tick", incr_ms, "ms", **extra)
+    bench_common.emit(
+        BENCH, "speedup_noop", full_ms / max(noop_ms, 1e-6), "x", **extra
+    )
+    bench_common.emit(
+        BENCH, "speedup_incr", full_ms / max(incr_ms, 1e-6), "x", **extra
+    )
+
+    w.finalize()
+    computer.close()
+    return full_ms, noop_ms, incr_ms
+
+
+@pytest.mark.parametrize("ranks", [64, 256])
+def test_live_tick_bench(tmp_path, ranks):
+    full_ms, noop_ms, incr_ms = _run_case(tmp_path, ranks, WINDOW)
+    if ranks == 256:
+        # the acceptance floors (ISSUE: perf_opt PR 2)
+        assert full_ms / noop_ms >= 10.0, (full_ms, noop_ms)
+        assert full_ms / incr_ms >= 3.0, (full_ms, incr_ms)
+
+
+if __name__ == "__main__":
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        for ranks in (64, 256):
+            _run_case(Path(d), ranks, WINDOW)

@@ -1,0 +1,204 @@
+"""LiveSnapshotStore: cursor semantics, trim lockstep, data_version.
+
+The store's contract (docs/developer_guide/live-read-path.md): after any
+sequence of incremental refreshes its accessors return EXACTLY what a
+fresh full load through ``reporting/loaders.py`` would — including after
+the writer's retention trim deleted rows the store still held — and
+``data_version`` only ever moves forward.
+"""
+
+from traceml_tpu.aggregator.sqlite_writer import SQLiteWriter
+from traceml_tpu.reporting import loaders
+from traceml_tpu.reporting.snapshot_store import LiveSnapshotStore
+from traceml_tpu.telemetry.envelope import SenderIdentity, build_telemetry_envelope
+from traceml_tpu.utils import timing as T
+
+
+def _ident(rank=0, node=0, world=2):
+    return SenderIdentity(
+        session_id="s1",
+        global_rank=rank,
+        local_rank=rank % 4,
+        world_size=world,
+        node_rank=node,
+        hostname=f"host-{node}",
+        pid=100 + rank,
+    )
+
+
+def _step_rows(start, n, base_ms=50.0):
+    return [
+        {
+            "step": s,
+            "timestamp": float(s),
+            "clock": "device",
+            "events": {
+                T.STEP_TIME: {"cpu_ms": base_ms, "device_ms": base_ms, "count": 1},
+                T.COMPUTE_TIME: {
+                    "cpu_ms": 1.0, "device_ms": base_ms * 0.9, "count": 1,
+                },
+            },
+        }
+        for s in range(start, start + n)
+    ]
+
+
+def _mem_rows(start, n):
+    return [
+        {"step": s, "timestamp": float(s), "device_id": 0, "device_kind": "tpu",
+         "current_bytes": 100 + s, "peak_bytes": 200 + s,
+         "step_peak_bytes": 150 + s, "limit_bytes": 1000, "backend": "fake"}
+        for s in range(start, start + n)
+    ]
+
+
+def _assert_matches_full_load(store, db):
+    assert store.step_time_rows() == loaders.load_step_time_rows(
+        db, max_steps_per_rank=store.window_steps
+    )
+    assert store.step_memory_rows() == loaders.load_step_memory_rows(
+        db, max_rows_per_rank=store.memory_rows_per_rank
+    )
+    assert store.system_rows() == loaders.load_system_rows(
+        db, max_rows=store.max_system_rows
+    )
+    assert store.process_rows() == loaders.load_process_rows(
+        db, max_rows=store.max_process_rows
+    )
+    assert store.stdout_tail() == loaders.load_stdout_tail(db)
+    assert store.model_stats() == loaders.load_model_stats(db)
+    assert store.topology() == loaders.load_topology(db)
+
+
+def test_incremental_refreshes_match_full_load(tmp_path):
+    db = tmp_path / "t.sqlite"
+    w = SQLiteWriter(db)
+    w.start()
+    store = LiveSnapshotStore(db, window_steps=60)
+
+    versions_seen = [store.data_version]
+    for batch in range(4):
+        start = 1 + batch * 10
+        for rank, node in ((0, 0), (1, 1)):
+            w.ingest(build_telemetry_envelope(
+                "step_time", {"step_time": _step_rows(start, 10)},
+                _ident(rank, node),
+            ))
+            w.ingest(build_telemetry_envelope(
+                "step_memory", {"step_memory": _mem_rows(start, 10)},
+                _ident(rank, node),
+            ))
+        w.ingest(build_telemetry_envelope(
+            "system",
+            {"system": [{"timestamp": float(batch), "cpu_pct": 10.0 + batch,
+                         "memory_used_bytes": 1, "memory_total_bytes": 2,
+                         "memory_pct": 50.0}],
+             "system_device": [{"timestamp": float(batch), "device_id": 0,
+                                "device_kind": "tpu", "memory_used_bytes": 5,
+                                "memory_peak_bytes": 6,
+                                "memory_total_bytes": 10}]},
+            _ident(0, 0),
+        ))
+        w.ingest(build_telemetry_envelope(
+            "process",
+            {"process": [{"timestamp": float(batch), "cpu_pct": 5.0,
+                          "rss_bytes": 10 + batch, "vms_bytes": 20,
+                          "num_threads": 3}]},
+            _ident(1, 1),
+        ))
+        w.ingest(build_telemetry_envelope(
+            "stdout_stderr",
+            {"stdout_stderr": [{"timestamp": float(batch), "stream": "stdout",
+                                "line": f"batch {batch}"}]},
+            _ident(0, 0),
+        ))
+        assert w.force_flush()
+        changed = store.refresh()
+        assert changed
+        versions_seen.append(store.data_version)
+
+    # strictly monotonic across changed refreshes
+    assert versions_seen == sorted(set(versions_seen))
+    # idle refresh: nothing changed, versions stable
+    assert store.refresh() is False
+    assert store.data_version == versions_seen[-1]
+
+    _assert_matches_full_load(store, db)
+    assert w.finalize()
+    store.close()
+
+
+def test_cursor_semantics_under_retention_trim(tmp_path):
+    db = tmp_path / "t.sqlite"
+    # tiny retention: keep 1.5 × 10 = 15 rows per (session, rank)
+    w = SQLiteWriter(db, summary_window_rows=10, retention_factor=1.5)
+    w.start()
+    # store window larger than the retained row count, so matching the
+    # fresh load REQUIRES trim-lockstep eviction from the deques
+    store = LiveSnapshotStore(db, window_steps=50)
+
+    versions = []
+    for start in (1, 26, 51, 76):
+        for rank in (0, 1):
+            w.ingest(build_telemetry_envelope(
+                "step_time", {"step_time": _step_rows(start, 25)},
+                _ident(rank),
+            ))
+            w.ingest(build_telemetry_envelope(
+                "step_memory", {"step_memory": _mem_rows(start, 25)},
+                _ident(rank),
+            ))
+        assert w.force_flush()
+        store.refresh()
+        versions.append(store.data_version)
+
+    # finalize runs the retention prune: only the newest 15 rows per
+    # rank survive in SQLite, while the store still holds up to 50
+    assert w.finalize()
+    assert store.refresh() is True  # trim detected (eviction, no new rows)
+    versions.append(store.data_version)
+    assert versions == sorted(versions)
+
+    st = store.step_time_rows()
+    fresh = loaders.load_step_time_rows(db, max_steps_per_rank=50)
+    assert st == fresh
+    for rank, rows in st.items():
+        steps = [r["step"] for r in rows]
+        assert steps == sorted(set(steps)), "duplicate or unordered steps"
+        assert len(rows) == 15  # exactly the retained rows, none resurrected
+        assert steps[-1] == 100
+        assert steps[0] == 86
+    assert store.step_memory_rows() == loaders.load_step_memory_rows(
+        db, max_rows_per_rank=store.memory_rows_per_rank
+    )
+
+    # a rank seen before the trim stays visible in topology even though
+    # DISTINCT over the trimmed table would still return it here
+    assert store.topology() == loaders.load_topology(db)
+
+    # idle after trim: no further version movement
+    assert store.refresh() is False
+    assert store.data_version == versions[-1]
+    store.close()
+
+
+def test_store_connects_lazily_and_survives_missing_db(tmp_path):
+    db = tmp_path / "nope.sqlite"
+    store = LiveSnapshotStore(db)
+    assert store.refresh() is False
+    assert not store.connected
+    assert store.step_time_rows() == {}
+    assert store.topology() == {"mode": "unknown", "world_size": 0, "nodes": 0}
+
+    # DB appears later: the same store picks it up
+    w = SQLiteWriter(db)
+    w.start()
+    w.ingest(build_telemetry_envelope(
+        "step_time", {"step_time": _step_rows(1, 5)}, _ident(0),
+    ))
+    assert w.force_flush()
+    assert store.refresh() is True
+    assert store.connected
+    assert sorted(store.step_time_rows()) == [0]
+    assert w.finalize()
+    store.close()

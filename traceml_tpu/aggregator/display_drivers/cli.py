@@ -57,3 +57,9 @@ class CLIDisplayDriver(BaseDisplayDriver):
             except Exception:
                 pass
             self._live = None
+        if self._computer is not None:
+            try:
+                self._computer.close()  # release the store's read connection
+            except Exception:
+                pass
+            self._computer = None

@@ -3,21 +3,71 @@
 The full Rich dashboard lives in the CLI display driver; watch is the
 detached flavor: it polls ``telemetry.sqlite`` read-only and redraws a
 compact status (reference: `traceml watch`, launcher/cli.py).
+
+The poll loop holds ONE :class:`LiveSnapshotStore` across ticks, so an
+idle second costs a single ``PRAGMA data_version`` read and the
+step-time window + diagnosis recompute only when new rows arrived
+(dirty-gated on the store's step_time version).
 """
 
 from __future__ import annotations
 
 import time
 from pathlib import Path
+from typing import List, Optional
 
 from traceml_tpu.utils.atomic_io import read_json
 
 
-def _snapshot(session_dir: Path) -> str:
-    from traceml_tpu.reporting import loaders
-    from traceml_tpu.diagnostics.step_time.api import diagnose_rank_rows
-    from traceml_tpu.utils.formatting import fmt_ms
+class _WatchState:
+    """Per-loop snapshot cache: store + the step-time lines rendered at
+    the store's current step_time version."""
 
+    def __init__(self, db_path: Path) -> None:
+        from traceml_tpu.reporting.snapshot_store import LiveSnapshotStore
+
+        self.store = LiveSnapshotStore(db_path, window_steps=120)
+        self._lines: List[str] = []
+        self._version: Optional[int] = None
+
+    def close(self) -> None:
+        self.store.close()
+
+    def step_time_lines(self) -> List[str]:
+        from traceml_tpu.diagnostics.step_time.api import diagnose_window
+        from traceml_tpu.utils.formatting import fmt_ms
+        from traceml_tpu.utils.step_time_window import build_step_time_window
+
+        self.store.refresh()
+        version = self.store.versions["step_time"]
+        if version == self._version:
+            return self._lines
+        lines: List[str] = []
+        rank_rows = self.store.step_time_rows()
+        if rank_rows:
+            w = build_step_time_window(rank_rows, max_steps=120)
+            if w:
+                step = w.metric("step_time")
+                lines.append(
+                    f"steps {w.steps[0]}–{w.steps[-1]} ({w.clock} clock)  "
+                    f"median {fmt_ms(step.median_ms)}  worst {fmt_ms(step.worst_ms)} "
+                    f"(rank {step.worst_rank})"
+                )
+                # one window build feeds both the stats line and the
+                # diagnosis (the seed built it twice per poll)
+                result = diagnose_window(w, mode="live")
+                d = result.diagnosis
+                lines.append(
+                    f"diagnosis: [{d.severity}] {d.kind} — {d.summary}"
+                )
+        else:
+            lines.append("no step telemetry yet")
+        self._lines = lines
+        self._version = version
+        return lines
+
+
+def _snapshot(session_dir: Path, state: Optional[_WatchState] = None) -> str:
     db = session_dir / "telemetry.sqlite"
     lines = [f"session: {session_dir.name}"]
     manifest = read_json(session_dir / "manifest.json") or {}
@@ -28,27 +78,16 @@ def _snapshot(session_dir: Path) -> str:
     if not db.exists():
         lines.append("waiting for telemetry…")
         return "\n".join(lines)
+    if state is None:
+        state = _WatchState(db)  # one-shot caller: fresh store
+        try:
+            return "\n".join(lines + state.step_time_lines())
+        finally:
+            state.close()
     try:
-        rank_rows = loaders.load_step_time_rows(db, max_steps_per_rank=120)
+        lines.extend(state.step_time_lines())
     except Exception as exc:
         lines.append(f"(db busy: {exc})")
-        return "\n".join(lines)
-    if rank_rows:
-        from traceml_tpu.utils.step_time_window import build_step_time_window
-
-        w = build_step_time_window(rank_rows, max_steps=120)
-        if w:
-            step = w.metric("step_time")
-            lines.append(
-                f"steps {w.steps[0]}–{w.steps[-1]} ({w.clock} clock)  "
-                f"median {fmt_ms(step.median_ms)}  worst {fmt_ms(step.worst_ms)} "
-                f"(rank {step.worst_rank})"
-            )
-            result = diagnose_rank_rows(rank_rows, mode="live")
-            d = result.diagnosis
-            lines.append(f"diagnosis: [{d.severity}] {d.kind} — {d.summary}")
-    else:
-        lines.append("no step telemetry yet")
     return "\n".join(lines)
 
 
@@ -61,9 +100,13 @@ def run_watch(
         return 1
     if browser:
         return _run_watch_browser(session_dir)
+    state: Optional[_WatchState] = None
     try:
         while True:
-            print("\x1b[2J\x1b[H" + _snapshot(session_dir), flush=True)
+            db = session_dir / "telemetry.sqlite"
+            if state is None and db.exists():
+                state = _WatchState(db)
+            print("\x1b[2J\x1b[H" + _snapshot(session_dir, state), flush=True)
             manifest = read_json(session_dir / "manifest.json") or {}
             if manifest.get("status") in ("completed", "failed"):
                 summary = session_dir / "final_summary.txt"
@@ -73,6 +116,9 @@ def run_watch(
             time.sleep(interval)
     except KeyboardInterrupt:
         return 0
+    finally:
+        if state is not None:
+            state.close()
 
 
 def _run_watch_browser(session_dir: Path) -> int:

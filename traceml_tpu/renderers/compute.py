@@ -6,123 +6,210 @@ cached per tick so multiple panels share one read).
 (``views.*``, the schema every surface renders from — see views.py) and
 the per-domain diagnosis results.  Raw loader output is only kept where a
 diagnostic consumes it directly.
+
+Incremental read path: data comes from a :class:`LiveSnapshotStore`
+(persistent read-only connection, per-table id cursors, decode-once
+bounded deques) and each domain's views + diagnosis recompute ONLY when
+the store's per-domain ``data_version`` advanced — replacing the seed's
+blind 0.4 s TTL cache.  An idle tick (no new envelopes) performs zero
+SQLite row reads and returns the identical cached payload object (only
+``ts`` is refreshed in place).
 """
 
 from __future__ import annotations
 
+import threading
 import time
 from pathlib import Path
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
-from traceml_tpu.diagnostics.step_time.api import diagnose_rank_rows
+from traceml_tpu.diagnostics.step_time.api import diagnose_window
 from traceml_tpu.renderers import views as V
-from traceml_tpu.reporting import loaders
+from traceml_tpu.reporting.snapshot_store import LiveSnapshotStore
 from traceml_tpu.utils.step_time_window import build_step_time_window
 
-_CACHE_TTL = 0.4
+# payload domain → (store versions it depends on, views key or None)
+_DOMAIN_DEPS: Dict[str, Tuple[Tuple[str, ...], Optional[str]]] = {
+    "topology": (("topology",), None),
+    "step_time": (("step_time", "model_stats", "topology"), "step_time"),
+    "memory": (("step_memory",), "memory"),
+    "system": (("system", "topology"), "system"),
+    "process": (("process",), "process"),
+    "stdout": (("stdout",), None),
+}
 
 
 class LiveComputer:
-    """Reads the session SQLite and produces the per-domain payloads the
-    renderers consume; one read per tick (TTL-cached)."""
+    """Reads the session SQLite through an incremental snapshot store
+    and produces the per-domain payloads the renderers consume; each
+    domain recomputes only when its tables changed (dirty-gated)."""
 
     def __init__(self, db_path: Path, window_steps: int = 120) -> None:
         self.db_path = Path(db_path)
         self.window_steps = window_steps
-        self._cache: Dict[str, Any] = {}
-        self._cached_at = 0.0
+        self._store = LiveSnapshotStore(self.db_path, window_steps=window_steps)
+        self._lock = threading.RLock()
+        self._cache: Optional[Dict[str, Any]] = None
+        # domain → versions tuple the cached fragment was computed at
+        self._computed_at: Dict[str, Tuple[int, ...]] = {}
+        # domain → (payload updates, view object or None)
+        self._fragments: Dict[str, Tuple[Dict[str, Any], Any]] = {}
+
+    @property
+    def store(self) -> LiveSnapshotStore:
+        return self._store
+
+    def close(self) -> None:
+        self._store.close()
 
     def payload(self) -> Dict[str, Any]:
-        now = time.monotonic()
-        if now - self._cached_at < _CACHE_TTL and self._cache:
-            return self._cache
-        out: Dict[str, Any] = {"ts": time.time(), "db_exists": self.db_path.exists()}
-        out["views"] = {}
-        if out["db_exists"]:
+        with self._lock:
             try:
-                out["topology"] = loaders.load_topology(self.db_path)
+                self._store.refresh()
             except Exception:
-                out["topology"] = {}
-            world = int((out.get("topology") or {}).get("world_size") or 0)
-            nodes = int((out.get("topology") or {}).get("nodes") or 0)
+                pass
+            if not self._store.connected:
+                # DB not there yet (or vanished): cheap constant payload
+                return {
+                    "ts": time.time(),
+                    "db_exists": self.db_path.exists(),
+                    "views": {},
+                }
+            versions = self._store.versions
+            dirty = [
+                domain
+                for domain, (deps, _) in _DOMAIN_DEPS.items()
+                if self._computed_at.get(domain)
+                != tuple(versions[d] for d in deps)
+            ]
+            if not dirty and self._cache is not None:
+                self._cache["ts"] = time.time()  # idle tick: same object
+                return self._cache
+            for domain in dirty:
+                deps, _ = _DOMAIN_DEPS[domain]
+                self._fragments[domain] = self._compute_domain(domain)
+                self._computed_at[domain] = tuple(versions[d] for d in deps)
+            out: Dict[str, Any] = {
+                "ts": time.time(),
+                "db_exists": True,
+                "views": {},
+            }
+            for domain, (_, view_key) in _DOMAIN_DEPS.items():
+                updates, view = self._fragments.get(domain, ({}, None))
+                out.update(updates)
+                if view is not None and view_key is not None:
+                    out["views"][view_key] = view
+            self._cache = out
+            return out
+
+    # -- per-domain builders ---------------------------------------------
+    # Each returns (top-level payload updates, typed view or None) and
+    # mirrors the seed's error contract: a failing domain degrades to an
+    # {"error": ...} marker without poisoning the other domains.
+
+    def _compute_domain(self, domain: str) -> Tuple[Dict[str, Any], Any]:
+        return getattr(self, f"_compute_{domain}")()
+
+    def _compute_topology(self) -> Tuple[Dict[str, Any], Any]:
+        try:
+            return {"topology": self._store.topology()}, None
+        except Exception:
+            return {"topology": {}}, None
+
+    def _compute_step_time(self) -> Tuple[Dict[str, Any], Any]:
+        world = int((self._store.topology() or {}).get("world_size") or 0)
+        try:
+            rank_rows = self._store.step_time_rows()
+            window = build_step_time_window(
+                rank_rows, max_steps=self.window_steps
+            )
+            # newest telemetry timestamp drives the staleness badge
+            latest = max(
+                (
+                    row.get("timestamp") or 0.0
+                    for rows in rank_rows.values()
+                    for row in rows[-1:]
+                ),
+                default=None,
+            )
             try:
-                rank_rows = loaders.load_step_time_rows(
-                    self.db_path, max_steps_per_rank=self.window_steps
-                )
-                window = build_step_time_window(rank_rows, max_steps=self.window_steps)
-                # newest telemetry timestamp drives the staleness badge
-                latest = max(
-                    (
-                        row.get("timestamp") or 0.0
-                        for rows in rank_rows.values()
-                        for row in rows[-1:]
-                    ),
-                    default=None,
-                )
-                out["latest_row_ts"] = latest
-                try:
-                    model_stats = loaders.load_model_stats(self.db_path)
-                except Exception:
-                    model_stats = {}
-                out["views"]["step_time"] = V.build_step_time_view(
-                    window, world_size=world, latest_ts=latest,
-                    model_stats=model_stats,
-                )
-                out["step_time"] = {
+                model_stats = self._store.model_stats()
+            except Exception:
+                model_stats = {}
+            view = V.build_step_time_view(
+                window, world_size=world, latest_ts=latest,
+                model_stats=model_stats,
+            )
+            updates = {
+                "latest_row_ts": latest,
+                "step_time": {
                     "window": window,
-                    "diagnosis": diagnose_rank_rows(rank_rows, mode="live")
+                    "diagnosis": diagnose_window(window, mode="live")
                     if rank_rows
                     else None,
-                }
-            except Exception as exc:
-                out["step_time"] = {"error": str(exc)}
-            try:
-                mem_rows = loaders.load_step_memory_rows(
-                    self.db_path, max_rows_per_rank=self.window_steps * 4
-                )
-                out["views"]["memory"] = V.build_memory_view(mem_rows)
-                from traceml_tpu.diagnostics.step_memory.api import (
-                    diagnose_rank_rows as diagnose_memory,
-                )
+                },
+            }
+            return updates, view
+        except Exception as exc:
+            return {"step_time": {"error": str(exc)}}, None
 
-                out["step_memory"] = mem_rows
-                out["step_memory_diagnosis"] = (
-                    diagnose_memory(mem_rows) if mem_rows else None
-                )
-            except Exception as exc:
-                out["step_memory"] = {"error": str(exc)}
-            try:
-                host, devices = loaders.load_system_rows(self.db_path, max_rows=300)
-                out["views"]["system"] = V.build_system_view(
-                    host, devices, expected_nodes=nodes
-                )
-                from traceml_tpu.diagnostics.system.api import (
-                    diagnose as diagnose_system,
-                )
+    def _compute_memory(self) -> Tuple[Dict[str, Any], Any]:
+        try:
+            mem_rows = self._store.step_memory_rows()
+            view = V.build_memory_view(mem_rows)
+            from traceml_tpu.diagnostics.step_memory.api import (
+                diagnose_rank_rows as diagnose_memory,
+            )
 
-                out["system"] = {"host": host, "devices": devices}
-                out["system_diagnosis"] = (
-                    diagnose_system(host, devices) if host or devices else None
-                )
-            except Exception as exc:
-                out["system"] = {"error": str(exc)}
-            try:
-                procs, pdevs = loaders.load_process_rows(self.db_path, max_rows=300)
-                out["views"]["process"] = V.build_process_view(procs)
-                from traceml_tpu.diagnostics.process.api import (
-                    diagnose as diagnose_process,
-                )
+            updates = {
+                "step_memory": mem_rows,
+                "step_memory_diagnosis": diagnose_memory(mem_rows)
+                if mem_rows
+                else None,
+            }
+            return updates, view
+        except Exception as exc:
+            return {"step_memory": {"error": str(exc)}}, None
 
-                out["process"] = {"procs": procs, "devices": pdevs}
-                out["process_diagnosis"] = (
-                    diagnose_process(procs, pdevs) if procs or pdevs else None
-                )
-            except Exception as exc:
-                out["process"] = {"error": str(exc)}
-            try:
-                out["stdout"] = loaders.load_stdout_tail(self.db_path)
-            except Exception:
-                out["stdout"] = []
-        self._cache = out
-        self._cached_at = now
-        return out
+    def _compute_system(self) -> Tuple[Dict[str, Any], Any]:
+        nodes = int((self._store.topology() or {}).get("nodes") or 0)
+        try:
+            host, devices = self._store.system_rows()
+            view = V.build_system_view(host, devices, expected_nodes=nodes)
+            from traceml_tpu.diagnostics.system.api import (
+                diagnose as diagnose_system,
+            )
+
+            updates = {
+                "system": {"host": host, "devices": devices},
+                "system_diagnosis": diagnose_system(host, devices)
+                if host or devices
+                else None,
+            }
+            return updates, view
+        except Exception as exc:
+            return {"system": {"error": str(exc)}}, None
+
+    def _compute_process(self) -> Tuple[Dict[str, Any], Any]:
+        try:
+            procs, pdevs = self._store.process_rows()
+            view = V.build_process_view(procs)
+            from traceml_tpu.diagnostics.process.api import (
+                diagnose as diagnose_process,
+            )
+
+            updates = {
+                "process": {"procs": procs, "devices": pdevs},
+                "process_diagnosis": diagnose_process(procs, pdevs)
+                if procs or pdevs
+                else None,
+            }
+            return updates, view
+        except Exception as exc:
+            return {"process": {"error": str(exc)}}, None
+
+    def _compute_stdout(self) -> Tuple[Dict[str, Any], Any]:
+        try:
+            return {"stdout": self._store.stdout_tail()}, None
+        except Exception:
+            return {"stdout": []}, None

@@ -24,7 +24,9 @@ def _computer_for(db_path: Path, window_steps: int) -> LiveComputer:
     key = str(db_path)
     comp = _computers.get(key)
     if comp is None or comp.window_steps != window_steps:
-        _computers.clear()  # one session per aggregator process
+        for old in _computers.values():  # one session per aggregator process
+            old.close()  # the computer holds a live sqlite connection now
+        _computers.clear()
         comp = _computers[key] = LiveComputer(db_path, window_steps=window_steps)
     return comp
 

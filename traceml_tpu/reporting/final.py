@@ -90,7 +90,7 @@ def _steady_state(window: StepTimeWindow) -> Dict[str, Any]:
     }
 
 
-def _efficiency_block(db_path: Path, window: StepTimeWindow, steady) -> Optional[Dict[str, Any]]:
+def _efficiency_block(store, window: StepTimeWindow, steady) -> Optional[Dict[str, Any]]:
     """MFU: achieved model FLOP/s per rank over the chip's peak
     (TPU-first metric — no reference counterpart).  Steady-state
     medians when available: warmup compile stalls are not a statement
@@ -106,17 +106,17 @@ def _efficiency_block(db_path: Path, window: StepTimeWindow, steady) -> Optional
             for r, w in window.rank_windows.items()
         }
     )
-    return build_efficiency(loaders.load_model_stats(db_path), per_rank_step)
+    return build_efficiency(store.model_stats(), per_rank_step)
 
 
-def _build_step_time_section(db_path: Path, mode: str, identities=None):
-    rank_rows = loaders.load_step_time_rows(db_path)
+def _build_step_time_section(store, mode: str, identities=None):
+    rank_rows = store.step_time_rows()
     if not rank_rows:
         return _no_data_section("step_time"), None
     window: Optional[StepTimeWindow] = build_step_time_window(rank_rows)
     steady = _steady_state(window) if window else {}
     efficiency = (
-        _efficiency_block(db_path, window, steady) if window else None
+        _efficiency_block(store, window, steady) if window else None
     )
     result = diagnose_window(window, mode=mode, efficiency=efficiency)
     section: Dict[str, Any] = {
@@ -196,8 +196,8 @@ def _build_step_time_section(db_path: Path, mode: str, identities=None):
     return section, result
 
 
-def _build_step_memory_section(db_path: Path, identities=None):
-    rank_rows = loaders.load_step_memory_rows(db_path)
+def _build_step_memory_section(store, identities=None):
+    rank_rows = store.step_memory_rows()
     if not rank_rows:
         return _no_data_section("step_memory"), None
     result = diagnose_memory(rank_rows)
@@ -270,8 +270,8 @@ def _build_step_memory_section(db_path: Path, identities=None):
     return section, result
 
 
-def _build_system_section(db_path: Path):
-    host, devices = loaders.load_system_rows(db_path)
+def _build_system_section(store):
+    host, devices = store.system_rows()
     if not host and not devices:
         return _no_data_section("system"), None
     result = diagnose_system(host, devices)
@@ -335,8 +335,8 @@ def _build_system_section(db_path: Path):
     return section, result
 
 
-def _build_process_section(db_path: Path, identities=None):
-    procs, devices = loaders.load_process_rows(db_path)
+def _build_process_section(store, identities=None):
+    procs, devices = store.process_rows()
     if not procs and not devices:
         return _no_data_section("process"), None
     result = diagnose_process(procs, devices)
@@ -741,28 +741,43 @@ def generate_summary(
 
     results: Dict[str, Optional[DiagnosticResult]] = {}
 
+    # one-shot read through the same incremental snapshot store the live
+    # path uses: one shared read connection, one ordered query per table
+    # (no DISTINCT + per-rank N+1), each events_json decoded once —
+    # sized to the report's historic loader bounds
+    from traceml_tpu.reporting.snapshot_store import LiveSnapshotStore
+
+    store = LiveSnapshotStore(
+        db_path,
+        window_steps=600,
+        memory_rows_per_rank=20000,
+        system_rows=2000,
+        process_rows=2000,
+    )
+    store.refresh()
+
     try:
-        identities = loaders.load_rank_identities(db_path)
+        identities = loaders.load_rank_identities(db_path, conn=store.connection)
     except Exception:
         identities = {}
 
     def run_step_time():
-        section, result = _build_step_time_section(db_path, mode, identities)
+        section, result = _build_step_time_section(store, mode, identities)
         results["step_time"] = result
         return section
 
     def run_step_memory():
-        section, result = _build_step_memory_section(db_path, identities)
+        section, result = _build_step_memory_section(store, identities)
         results["step_memory"] = result
         return section
 
     def run_system():
-        section, result = _build_system_section(db_path)
+        section, result = _build_system_section(store)
         results["system"] = result
         return section
 
     def run_process():
-        section, result = _build_process_section(db_path, identities)
+        section, result = _build_process_section(store, identities)
         results["process"] = result
         return section
 
@@ -773,9 +788,10 @@ def generate_summary(
         "step_memory": _safe_section("step_memory", run_step_memory),
     }
     try:
-        topology = loaders.load_topology(db_path)
+        topology = store.topology()
     except Exception:
         topology = {"mode": "unknown", "world_size": 0}
+    store.close()
     primary = build_primary_diagnosis(
         results.get("step_time"),
         results.get("step_memory"),

@@ -1,20 +1,48 @@
 """SQLite read paths for reporting
 (reference: reporting/sections/*/loader.py, e.g. step_time/loader.py:41-90
 pulls bounded events_json rows per global rank).
+
+These are the ONE-SHOT readers (final report, compare, ad-hoc view
+commands).  The live tick path reads through
+:class:`~traceml_tpu.reporting.snapshot_store.LiveSnapshotStore`
+instead, which keeps cursors and decodes incrementally; the loaders
+here stay full-load but single-query — per-rank bounding happens via a
+``ROW_NUMBER() OVER (PARTITION BY global_rank …)`` window instead of
+the former ``SELECT DISTINCT global_rank`` + one query per rank (N+1).
+
+Every loader accepts an optional ``conn`` to reuse a shared read
+connection (e.g. the snapshot store's) instead of opening a fresh
+``sqlite3.connect`` per call.
 """
 
 from __future__ import annotations
 
 import json
 import sqlite3
+from contextlib import contextmanager
 from pathlib import Path
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 
 def _connect_ro(db_path: Path) -> sqlite3.Connection:
     conn = sqlite3.connect(f"file:{db_path}?mode=ro", uri=True)
     conn.row_factory = sqlite3.Row
     return conn
+
+
+@contextmanager
+def _reading(db_path: Path, conn: Optional[sqlite3.Connection] = None):
+    """Yield a usable read connection: the caller-provided shared one
+    (left open) or a fresh one (closed on exit — the seed's
+    ``with sqlite3.connect(...)`` only committed, it never closed)."""
+    if conn is not None:
+        yield conn
+        return
+    fresh = _connect_ro(db_path)
+    try:
+        yield fresh
+    finally:
+        fresh.close()
 
 
 def _table_exists(conn: sqlite3.Connection, table: str) -> bool:
@@ -25,84 +53,86 @@ def _table_exists(conn: sqlite3.Connection, table: str) -> bool:
 
 
 def load_step_time_rows(
-    db_path: Path, max_steps_per_rank: int = 600
+    db_path: Path,
+    max_steps_per_rank: int = 600,
+    conn: Optional[sqlite3.Connection] = None,
 ) -> Dict[int, List[Dict[str, Any]]]:
     """global_rank → step rows (events decoded), ascending by step."""
     out: Dict[int, List[Dict[str, Any]]] = {}
-    with _connect_ro(db_path) as conn:
-        if not _table_exists(conn, "step_time_samples"):
+    with _reading(db_path, conn) as c:
+        if not _table_exists(c, "step_time_samples"):
             return out
-        ranks = [
-            r[0]
-            for r in conn.execute(
-                "SELECT DISTINCT global_rank FROM step_time_samples"
-            )
-        ]
-        for rank in ranks:
-            rows = conn.execute(
-                "SELECT step, timestamp, clock, late_markers, events_json "
-                "FROM step_time_samples WHERE global_rank=? "
-                "ORDER BY step DESC LIMIT ?",
-                (rank, max_steps_per_rank),
-            ).fetchall()
-            decoded = []
-            for r in reversed(rows):
-                try:
-                    events = json.loads(r["events_json"] or "{}")
-                except ValueError:
-                    events = {}
-                decoded.append(
-                    {
-                        "step": r["step"],
-                        "timestamp": r["timestamp"],
-                        "clock": r["clock"],
-                        "late_markers": r["late_markers"],
-                        "events": events,
-                    }
-                )
-            out[int(rank)] = decoded
+        rows = c.execute(
+            "SELECT global_rank, step, timestamp, clock, late_markers,"
+            " events_json FROM ("
+            "  SELECT global_rank, step, timestamp, clock, late_markers,"
+            "   events_json, ROW_NUMBER() OVER ("
+            "    PARTITION BY global_rank ORDER BY step DESC, id DESC"
+            "   ) AS rn FROM step_time_samples"
+            " ) WHERE rn <= ? ORDER BY global_rank, step, rn DESC",
+            (int(max_steps_per_rank),),
+        ).fetchall()
+    for r in rows:
+        try:
+            events = json.loads(r["events_json"] or "{}")
+        except ValueError:
+            events = {}
+        out.setdefault(int(r["global_rank"]), []).append(
+            {
+                "step": r["step"],
+                "timestamp": r["timestamp"],
+                "clock": r["clock"],
+                "late_markers": r["late_markers"],
+                "events": events,
+            }
+        )
     return out
 
 
 def load_step_memory_rows(
-    db_path: Path, max_rows_per_rank: int = 20000
+    db_path: Path,
+    max_rows_per_rank: int = 20000,
+    conn: Optional[sqlite3.Connection] = None,
 ) -> Dict[int, List[Dict[str, Any]]]:
     out: Dict[int, List[Dict[str, Any]]] = {}
-    with _connect_ro(db_path) as conn:
-        if not _table_exists(conn, "step_memory_samples"):
+    with _reading(db_path, conn) as c:
+        if not _table_exists(c, "step_memory_samples"):
             return out
-        ranks = [
-            r[0]
-            for r in conn.execute(
-                "SELECT DISTINCT global_rank FROM step_memory_samples"
-            )
-        ]
-        for rank in ranks:
-            rows = conn.execute(
-                "SELECT step, timestamp, device_id, device_kind, current_bytes,"
-                " peak_bytes, step_peak_bytes, limit_bytes FROM"
-                " step_memory_samples WHERE global_rank=?"
-                " ORDER BY step DESC LIMIT ?",
-                (rank, max_rows_per_rank),
-            ).fetchall()
-            out[int(rank)] = [dict(r) for r in reversed(rows)]
+        rows = c.execute(
+            "SELECT global_rank, step, timestamp, device_id, device_kind,"
+            " current_bytes, peak_bytes, step_peak_bytes, limit_bytes FROM ("
+            "  SELECT global_rank, step, timestamp, device_id, device_kind,"
+            "   current_bytes, peak_bytes, step_peak_bytes, limit_bytes,"
+            "   ROW_NUMBER() OVER ("
+            "    PARTITION BY global_rank ORDER BY step DESC, id DESC"
+            "   ) AS rn FROM step_memory_samples"
+            " ) WHERE rn <= ? ORDER BY global_rank, step, rn DESC",
+            (int(max_rows_per_rank),),
+        ).fetchall()
+    for r in rows:
+        rank = int(r["global_rank"])
+        row = dict(r)
+        del row["global_rank"]
+        out.setdefault(rank, []).append(row)
     return out
 
 
 def load_system_rows(
-    db_path: Path, max_rows: int = 2000
+    db_path: Path,
+    max_rows: int = 2000,
+    conn: Optional[sqlite3.Connection] = None,
 ) -> Tuple[Dict[int, List[Dict[str, Any]]], Dict[tuple, List[Dict[str, Any]]]]:
     host: Dict[int, List[Dict[str, Any]]] = {}
     devices: Dict[tuple, List[Dict[str, Any]]] = {}
-    with _connect_ro(db_path) as conn:
-        if _table_exists(conn, "system_samples"):
-            for r in conn.execute(
+    with _reading(db_path, conn) as c:
+        if _table_exists(c, "system_samples"):
+            for r in c.execute(
                 "SELECT * FROM (SELECT * FROM system_samples ORDER BY id DESC"
                 f" LIMIT {int(max_rows)}) ORDER BY id ASC"
             ):
                 host.setdefault(int(r["node_rank"]), []).append(dict(r))
-        if _table_exists(conn, "system_device_samples"):
-            for r in conn.execute(
+        if _table_exists(c, "system_device_samples"):
+            for r in c.execute(
                 "SELECT * FROM (SELECT * FROM system_device_samples ORDER BY id"
                 f" DESC LIMIT {int(max_rows)}) ORDER BY id ASC"
             ):
@@ -113,19 +143,21 @@ def load_system_rows(
 
 
 def load_process_rows(
-    db_path: Path, max_rows: int = 2000
+    db_path: Path,
+    max_rows: int = 2000,
+    conn: Optional[sqlite3.Connection] = None,
 ) -> Tuple[Dict[int, List[Dict[str, Any]]], Dict[tuple, List[Dict[str, Any]]]]:
     procs: Dict[int, List[Dict[str, Any]]] = {}
     devices: Dict[tuple, List[Dict[str, Any]]] = {}
-    with _connect_ro(db_path) as conn:
-        if _table_exists(conn, "process_samples"):
-            for r in conn.execute(
+    with _reading(db_path, conn) as c:
+        if _table_exists(c, "process_samples"):
+            for r in c.execute(
                 "SELECT * FROM (SELECT * FROM process_samples ORDER BY id DESC"
                 f" LIMIT {int(max_rows)}) ORDER BY id ASC"
             ):
                 procs.setdefault(int(r["global_rank"]), []).append(dict(r))
-        if _table_exists(conn, "process_device_samples"):
-            for r in conn.execute(
+        if _table_exists(c, "process_device_samples"):
+            for r in c.execute(
                 "SELECT * FROM (SELECT * FROM process_device_samples ORDER BY"
                 f" id DESC LIMIT {int(max_rows)}) ORDER BY id ASC"
             ):
@@ -135,21 +167,23 @@ def load_process_rows(
     return procs, devices
 
 
-def load_topology(db_path: Path) -> Dict[str, Any]:
+def load_topology(
+    db_path: Path, conn: Optional[sqlite3.Connection] = None
+) -> Dict[str, Any]:
     """Run topology from identity columns (reference: reporting/topology.py:63)."""
-    with _connect_ro(db_path) as conn:
-        if not _table_exists(conn, "step_time_samples"):
+    with _reading(db_path, conn) as c:
+        if not _table_exists(c, "step_time_samples"):
             tables = [
                 t
                 for t in ("process_samples", "system_samples")
-                if _table_exists(conn, t)
+                if _table_exists(c, t)
             ]
             if not tables:
                 return {"mode": "unknown", "world_size": 0, "nodes": 0}
             table = tables[0]
         else:
             table = "step_time_samples"
-        rows = conn.execute(
+        rows = c.execute(
             f"SELECT DISTINCT global_rank, node_rank, hostname, world_size"
             f" FROM {table}"
         ).fetchall()
@@ -165,7 +199,9 @@ def load_topology(db_path: Path) -> Dict[str, Any]:
     }
 
 
-def load_rank_identities(db_path: Path) -> Dict[int, Dict[str, Any]]:
+def load_rank_identities(
+    db_path: Path, conn: Optional[sqlite3.Connection] = None
+) -> Dict[int, Dict[str, Any]]:
     """global_rank → identity block (reference contract:
     ``groups.rows[*].identity`` — SCHEMA.md field rules).  Pulled from
     whichever projection tables exist; across tables the row with the
@@ -174,14 +210,14 @@ def load_rank_identities(db_path: Path) -> Dict[int, Dict[str, Any]]:
     rows live in a different sampler's table."""
     identity: Dict[int, Dict[str, Any]] = {}
     newest: Dict[int, float] = {}
-    with _connect_ro(db_path) as conn:
+    with _reading(db_path, conn) as c:
         for table in ("step_time_samples", "process_samples",
                       "step_memory_samples"):
-            if not _table_exists(conn, table):
+            if not _table_exists(c, table):
                 continue
             # SQLite bare-column semantics: with MAX(id) the other
             # selected columns come from that same max-id row
-            rows = conn.execute(
+            rows = c.execute(
                 f"SELECT global_rank, local_rank, node_rank, hostname, pid,"
                 f" world_size, local_world_size, timestamp, MAX(id)"
                 f" FROM {table} GROUP BY global_rank"
@@ -205,7 +241,9 @@ def load_rank_identities(db_path: Path) -> Dict[int, Dict[str, Any]]:
 
 
 def load_model_stats(
-    db_path: Path, recent_rows: int = 64
+    db_path: Path,
+    recent_rows: int = 64,
+    conn: Optional[sqlite3.Connection] = None,
 ) -> Dict[int, Dict[str, Any]]:
     """global_rank → model-FLOPs declaration (the MFU numerator + the
     chip peak captured at estimation time).
@@ -220,11 +258,11 @@ def load_model_stats(
 
     out: Dict[int, Dict[str, Any]] = {}
     per_rank_flops: Dict[int, List[float]] = {}
-    with _connect_ro(db_path) as conn:
-        if not _table_exists(conn, "model_stats_samples"):
+    with _reading(db_path, conn) as c:
+        if not _table_exists(c, "model_stats_samples"):
             return out
         try:
-            rows = conn.execute(
+            rows = c.execute(
                 "SELECT * FROM (SELECT global_rank, flops_per_step,"
                 " flops_source, device_kind, peak_flops, device_count,"
                 " tokens_per_step, id"
@@ -234,7 +272,7 @@ def load_model_stats(
         except sqlite3.OperationalError:
             try:
                 # archived sessions without the tokens column
-                rows = conn.execute(
+                rows = c.execute(
                     "SELECT *, NULL AS tokens_per_step FROM (SELECT"
                     " global_rank, flops_per_step, flops_source,"
                     " device_kind, peak_flops, device_count, id"
@@ -244,7 +282,7 @@ def load_model_stats(
                 ).fetchall()
             except sqlite3.OperationalError:
                 # …or before the device_count column either
-                rows = conn.execute(
+                rows = c.execute(
                     "SELECT *, NULL AS device_count, NULL AS tokens_per_step"
                     " FROM (SELECT global_rank, flops_per_step,"
                     " flops_source, device_kind, peak_flops, id"
@@ -277,12 +315,14 @@ def load_model_stats(
     }
 
 
-def load_stdout_tail(db_path: Path, n: int = 12) -> List[Tuple[str, str]]:
+def load_stdout_tail(
+    db_path: Path, n: int = 12, conn: Optional[sqlite3.Connection] = None
+) -> List[Tuple[str, str]]:
     """Last n (stream, line) pairs from the stdout projection."""
-    with _connect_ro(db_path) as conn:
-        if not _table_exists(conn, "stdout_samples"):
+    with _reading(db_path, conn) as c:
+        if not _table_exists(c, "stdout_samples"):
             return []
-        rows = conn.execute(
+        rows = c.execute(
             "SELECT stream, line FROM stdout_samples ORDER BY id DESC LIMIT ?",
             (int(n),),
         ).fetchall()

@@ -1,0 +1,672 @@
+"""Incremental live read path: cursor-based snapshot store.
+
+The seed live path re-read the entire SQLite session every UI tick —
+~7 fresh connections, a ``SELECT DISTINCT global_rank`` full scan plus
+one query per rank (N+1), and a fresh ``json.loads`` of every
+``events_json`` blob, even when zero new rows had arrived.  At target
+scale (hundreds of ranks × 120-step windows) that is O(ranks × window)
+of redundant I/O and decode per tick, charged to the same host the
+training job runs on.
+
+:class:`LiveSnapshotStore` sits between SQLite and the renderers /
+diagnostics and makes the tick cost proportional to *what changed*:
+
+* one persistent read-only connection (read-tuning PRAGMAs, shared by
+  every table and reusable by the one-shot loaders);
+* a per-table ``max(id)`` cursor — each refresh fetches only
+  ``id > cursor`` rows in a single query ordered by
+  ``(global_rank, step)``, killing the DISTINCT + per-rank N+1 pattern;
+* each ``events_json`` blob is decoded exactly once, into bounded
+  per-rank deques mirroring the loader windows;
+* ``PRAGMA data_version`` gates the whole refresh: an idle tick (no
+  commits since the last one) performs zero table reads;
+* a monotonically increasing :attr:`data_version` plus per-domain
+  versions let callers (``LiveComputer``) dirty-gate window
+  construction and diagnosis instead of blind TTL caching.
+
+Retention interaction: the writer's periodic trim (``DELETE`` of old
+rows per ``(session_id, global_rank)`` partition,
+``aggregator/sqlite_writer.py``) only ever removes ids *below* every
+cursor, so cursors survive trims.  Trims are detected by watching the
+table's global ``MIN(id)``; on movement the deques evict in lockstep
+against per-rank minima (the trim is per-rank partitioned, so a global
+minimum alone would resurrect one rank's trimmed rows behind another
+rank's surviving ones).
+
+Contract note: accumulated identity sets (topology) never shrink on
+trim — a rank observed once stays in ``ranks_seen`` even if all its
+rows age out, which is the desired live semantic (the loader's DISTINCT
+scan would forget it).
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+from collections import deque
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+from traceml_tpu.utils.error_log import get_error_log
+
+_READ_PRAGMAS = (
+    "PRAGMA busy_timeout=200",
+    "PRAGMA cache_size=-8192",      # 8 MiB page cache
+    "PRAGMA temp_store=MEMORY",
+    "PRAGMA mmap_size=134217728",   # 128 MiB, no-op where unsupported
+)
+
+# domains exposed through per-domain versions (what dirty-gating keys on)
+DOMAINS = (
+    "step_time",
+    "step_memory",
+    "system",
+    "process",
+    "stdout",
+    "model_stats",
+    "topology",
+)
+
+
+class _RankBuffer:
+    """Bounded row window: ids / ranks / decoded rows evict in lockstep
+    (same ``maxlen`` on all three deques), so a retention trim can drop
+    exactly the rows SQLite dropped."""
+
+    __slots__ = ("ids", "ranks", "rows")
+
+    def __init__(self, maxlen: int) -> None:
+        self.ids: deque = deque(maxlen=maxlen)
+        self.ranks: deque = deque(maxlen=maxlen)
+        self.rows: deque = deque(maxlen=maxlen)
+
+    def append(self, row_id: int, rank: Optional[int], row: Any) -> None:
+        self.ids.append(row_id)
+        self.ranks.append(rank)
+        self.rows.append(row)
+
+    def clear(self) -> bool:
+        had = bool(self.ids)
+        self.ids.clear()
+        self.ranks.clear()
+        self.rows.clear()
+        return had
+
+    def evict_below(self, min_id: int) -> bool:
+        """Prefix eviction for single-rank buffers (ids ascending)."""
+        changed = False
+        while self.ids and self.ids[0] < min_id:
+            self.ids.popleft()
+            self.ranks.popleft()
+            self.rows.popleft()
+            changed = True
+        return changed
+
+    def filter_trimmed(self, per_rank_min: Dict[int, int]) -> bool:
+        """Drop every held row the writer's PER-RANK retention trim
+        deleted: a row survives iff its id is >= its rank's current
+        MIN(id) in the table (a rank absent from the table lost all its
+        rows).  Mixed-rank buffers need this full filter — a trim can
+        delete mid-buffer rows of one rank while older rows of another
+        rank survive."""
+        keep = [
+            (i, rk, rw)
+            for i, rk, rw in zip(self.ids, self.ranks, self.rows)
+            if rk in per_rank_min and i >= per_rank_min[rk]
+        ]
+        if len(keep) == len(self.ids):
+            return False
+        self.ids.clear()
+        self.ranks.clear()
+        self.rows.clear()
+        for i, rk, rw in keep:
+            self.append(i, rk, rw)
+        return True
+
+
+class _TopologySource:
+    """Accumulated identity sets for one projection table."""
+
+    __slots__ = ("ranks", "nodes", "hostnames", "world")
+
+    def __init__(self) -> None:
+        self.ranks: set = set()
+        self.nodes: set = set()
+        self.hostnames: set = set()
+        self.world: int = 0
+
+    def update(self, rank, node, hostname, world) -> bool:
+        before = (len(self.ranks), len(self.nodes), len(self.hostnames), self.world)
+        if rank is not None:
+            self.ranks.add(int(rank))
+        if node is not None:
+            self.nodes.add(int(node))
+        if hostname is not None:
+            self.hostnames.add(str(hostname))
+        if world:
+            self.world = max(self.world, int(world))
+        return before != (
+            len(self.ranks), len(self.nodes), len(self.hostnames), self.world
+        )
+
+
+class LiveSnapshotStore:
+    """Incremental, bounded, decode-once snapshot of a session DB.
+
+    ``refresh()`` advances the snapshot; accessors return loader-shaped
+    structures (same keys/grouping as ``reporting/loaders.py``) so the
+    window builders, views and diagnostics consume them unchanged.
+    Thread-safe: one lock serializes refresh and accessors (the
+    connection is shared across display-driver threads).
+    """
+
+    def __init__(
+        self,
+        db_path: Path,
+        window_steps: int = 120,
+        memory_rows_per_rank: Optional[int] = None,
+        system_rows: int = 300,
+        process_rows: int = 300,
+        stdout_rows: int = 64,
+        model_stats_rows: int = 64,
+    ) -> None:
+        self.db_path = Path(db_path)
+        self.window_steps = int(window_steps)
+        self.memory_rows_per_rank = int(
+            memory_rows_per_rank
+            if memory_rows_per_rank is not None
+            else window_steps * 4
+        )
+        self.max_system_rows = int(system_rows)
+        self.max_process_rows = int(process_rows)
+        self.max_stdout_rows = int(stdout_rows)
+        self.max_model_stats_rows = int(model_stats_rows)
+
+        self._lock = threading.RLock()
+        self._conn: Optional[sqlite3.Connection] = None
+        self._primed = False
+        self._last_db_dv: Optional[int] = None
+        self._data_version = 0
+        self._versions: Dict[str, int] = {d: 0 for d in DOMAINS}
+        self._cursors: Dict[str, int] = {}
+        self._min_seen: Dict[str, Optional[int]] = {}
+        self._tables_seen: set = set()
+
+        # step_time / step_memory: per-rank bounded windows
+        self._step_time: Dict[int, _RankBuffer] = {}
+        self._step_memory: Dict[int, _RankBuffer] = {}
+        # system / process: globally-bounded (loader semantics), keyed rows
+        self._system_host = _RankBuffer(self.max_system_rows)
+        self._system_dev = _RankBuffer(self.max_system_rows)
+        self._process = _RankBuffer(self.max_process_rows)
+        self._process_dev = _RankBuffer(self.max_process_rows)
+        self._stdout = _RankBuffer(self.max_stdout_rows)
+        self._model_stats = _RankBuffer(self.max_model_stats_rows)
+        self._model_stats_cols: Optional[List[str]] = None
+
+        self._topology: Dict[str, _TopologySource] = {
+            "step_time_samples": _TopologySource(),
+            "process_samples": _TopologySource(),
+            "system_samples": _TopologySource(),
+        }
+        self._topology_cache: Optional[Dict[str, Any]] = None
+        self._topology_cache_version = -1
+
+    # -- connection ------------------------------------------------------
+
+    @property
+    def connection(self) -> Optional[sqlite3.Connection]:
+        """The shared read-only connection (None until the DB exists).
+        One-shot loaders may reuse it via their ``conn=`` parameter;
+        hold no expectations about transactions — autocommit reads."""
+        return self._conn
+
+    @property
+    def connected(self) -> bool:
+        return self._conn is not None
+
+    def _connect(self) -> Optional[sqlite3.Connection]:
+        if self._conn is not None:
+            return self._conn
+        if not self.db_path.exists():
+            return None
+        try:
+            conn = sqlite3.connect(
+                f"file:{self.db_path}?mode=ro",
+                uri=True,
+                check_same_thread=False,
+            )
+        except sqlite3.Error:
+            return None
+        conn.row_factory = sqlite3.Row
+        for pragma in _READ_PRAGMAS:
+            try:
+                conn.execute(pragma)
+            except sqlite3.Error:
+                pass
+        self._conn = conn
+        return conn
+
+    def close(self) -> None:
+        with self._lock:
+            if self._conn is not None:
+                try:
+                    self._conn.close()
+                except sqlite3.Error:
+                    pass
+                self._conn = None
+
+    # -- versions --------------------------------------------------------
+
+    @property
+    def data_version(self) -> int:
+        """Monotonically increasing; bumps once per refresh that
+        observed any change (new rows or a retention trim)."""
+        return self._data_version
+
+    @property
+    def versions(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._versions)
+
+    # -- refresh ---------------------------------------------------------
+
+    def refresh(self) -> bool:
+        """Advance the snapshot.  Returns True when anything changed.
+
+        Idle fast path: ``PRAGMA data_version`` is a header-counter
+        read — when it matches the last refresh, no table is queried at
+        all and the call is near-free.
+        """
+        with self._lock:
+            conn = self._connect()
+            if conn is None:
+                return False
+            try:
+                db_dv = conn.execute("PRAGMA data_version").fetchone()[0]
+            except sqlite3.Error:
+                return False
+            if self._primed and db_dv == self._last_db_dv:
+                return False
+
+            dirty: set = set()
+            clean_scan = True
+            readers = (
+                ("step_time_samples", self._read_step_time, "step_time"),
+                ("step_memory_samples", self._read_step_memory, "step_memory"),
+                ("system_samples", self._read_system_host, "system"),
+                ("system_device_samples", self._read_system_dev, "system"),
+                ("process_samples", self._read_process, "process"),
+                ("process_device_samples", self._read_process_dev, "process"),
+                ("stdout_samples", self._read_stdout, "stdout"),
+                ("model_stats_samples", self._read_model_stats, "model_stats"),
+            )
+            for table, reader, domain in readers:
+                try:
+                    if not self._table_exists(conn, table):
+                        continue
+                    if reader(conn, table, dirty):
+                        dirty.add(domain)
+                except sqlite3.Error as exc:
+                    get_error_log().warning(
+                        f"snapshot refresh failed for {table}", exc
+                    )
+                    clean_scan = False
+            if clean_scan:
+                # only mark the DB state consumed when every table
+                # scanned cleanly — a busy/locked table retries next tick
+                # (cursors make the retry incremental, not a re-read)
+                self._last_db_dv = db_dv
+                self._primed = True
+            if dirty:
+                self._data_version += 1
+                for domain in dirty:
+                    self._versions[domain] = self._data_version
+            return bool(dirty)
+
+    def _table_exists(self, conn: sqlite3.Connection, table: str) -> bool:
+        if table in self._tables_seen:
+            return True
+        row = conn.execute(
+            "SELECT name FROM sqlite_master WHERE type='table' AND name=?",
+            (table,),
+        ).fetchone()
+        if row is not None:
+            self._tables_seen.add(table)
+            return True
+        return False
+
+    def _advance_cursor(self, table: str, rows) -> None:
+        if rows:
+            self._cursors[table] = max(
+                max(r["id"] for r in rows), self._cursors.get(table, 0)
+            )
+
+    def _observe_min(self, conn: sqlite3.Connection, table: str) -> bool:
+        """Record the table's current ``MIN(id)`` and report whether a
+        retention trim happened since the last refresh (the minimum
+        moved forward, or the table emptied while we hold rows).
+
+        Called BEFORE the incremental row fetch so a trim racing the
+        fetch is observed at the latest on the next refresh.  Detection
+        bound: a prune that deletes rows WITHOUT moving the global
+        minimum (only possible when the globally-oldest rank is under
+        its retention cap while another rank trims) is caught at the
+        next minimum-moving prune; until then the store may briefly
+        hold more per-rank history than a cold reload would — benign
+        for live windows (see docs/developer_guide/live-read-path.md).
+        """
+        row = conn.execute(f"SELECT MIN(id) FROM {table}").fetchone()
+        min_id = row[0] if row and row[0] is not None else None
+        last = self._min_seen.get(table)
+        self._min_seen[table] = min_id
+        if min_id is None:
+            return last is not None
+        return last is not None and min_id > last
+
+    def _reconcile_trim(
+        self,
+        conn: sqlite3.Connection,
+        table: str,
+        rank_bufs: Optional[Dict[int, _RankBuffer]] = None,
+        flat_bufs: Tuple[_RankBuffer, ...] = (),
+    ) -> bool:
+        """Evict exactly the rows the writer's retention prune deleted.
+
+        The prune partitions by ``(session_id, global_rank)``, so a
+        single global ``MIN(id)`` prefix eviction is NOT sufficient:
+        with rank-interleaved inserts, one rank's deleted ids sit above
+        another rank's surviving minimum.  On trim detection we fetch
+        per-rank minima (one indexed aggregate, amortized over trim
+        events — never on idle or new-rows-only ticks) and evict each
+        rank's rows below its own minimum; ranks absent from the table
+        lost all their rows.
+        """
+        mins: Dict[int, int] = {}
+        for r in conn.execute(
+            f"SELECT global_rank, MIN(id) FROM {table} GROUP BY global_rank"
+        ):
+            if r[0] is not None:
+                mins[int(r[0])] = int(r[1])
+        changed = False
+        if rank_bufs is not None:
+            for rank, buf in rank_bufs.items():
+                m = mins.get(rank)
+                if m is None:
+                    changed |= buf.clear()
+                else:
+                    changed |= buf.evict_below(m)
+        for buf in flat_bufs:
+            changed |= buf.filter_trimmed(mins)
+        return changed
+
+    # -- per-table readers ----------------------------------------------
+
+    def _read_step_time(self, conn, table, dirty) -> bool:
+        trimmed = self._observe_min(conn, table)
+        cur = self._cursors.get(table, 0)
+        rows = conn.execute(
+            "SELECT id, global_rank, node_rank, hostname, world_size,"
+            " step, timestamp, clock, late_markers, events_json"
+            f" FROM {table} WHERE id > ? ORDER BY global_rank, step, id",
+            (cur,),
+        ).fetchall()
+        topo = self._topology["step_time_samples"]
+        for r in rows:
+            if topo.update(
+                r["global_rank"], r["node_rank"], r["hostname"], r["world_size"]
+            ):
+                dirty.add("topology")
+            try:
+                events = json.loads(r["events_json"] or "{}")
+            except ValueError:
+                events = {}
+            rank = int(r["global_rank"])
+            buf = self._step_time.get(rank)
+            if buf is None:
+                buf = self._step_time[rank] = _RankBuffer(self.window_steps)
+            buf.append(
+                r["id"],
+                rank,
+                {
+                    "step": r["step"],
+                    "timestamp": r["timestamp"],
+                    "clock": r["clock"],
+                    "late_markers": r["late_markers"],
+                    "events": events,
+                },
+            )
+        self._advance_cursor(table, rows)
+        evicted = trimmed and self._reconcile_trim(
+            conn, table, rank_bufs=self._step_time
+        )
+        return bool(rows) or evicted
+
+    def _read_step_memory(self, conn, table, dirty) -> bool:
+        trimmed = self._observe_min(conn, table)
+        cur = self._cursors.get(table, 0)
+        rows = conn.execute(
+            "SELECT id, global_rank, step, timestamp, device_id, device_kind,"
+            " current_bytes, peak_bytes, step_peak_bytes, limit_bytes"
+            f" FROM {table} WHERE id > ? ORDER BY global_rank, step, id",
+            (cur,),
+        ).fetchall()
+        for r in rows:
+            rank = int(r["global_rank"])
+            buf = self._step_memory.get(rank)
+            if buf is None:
+                buf = self._step_memory[rank] = _RankBuffer(
+                    self.memory_rows_per_rank
+                )
+            row = dict(r)
+            del row["id"], row["global_rank"]
+            buf.append(r["id"], rank, row)
+        self._advance_cursor(table, rows)
+        evicted = trimmed and self._reconcile_trim(
+            conn, table, rank_bufs=self._step_memory
+        )
+        return bool(rows) or evicted
+
+    def _read_keyed(self, conn, table, buf, key_fn, topo_source=None, dirty=None):
+        trimmed = self._observe_min(conn, table)
+        cur = self._cursors.get(table, 0)
+        rows = conn.execute(
+            f"SELECT * FROM {table} WHERE id > ? ORDER BY id", (cur,)
+        ).fetchall()
+        for r in rows:
+            if topo_source is not None:
+                if topo_source.update(
+                    r["global_rank"], r["node_rank"], r["hostname"],
+                    r["world_size"],
+                ) and dirty is not None:
+                    dirty.add("topology")
+            buf.append(r["id"], int(r["global_rank"]), (key_fn(r), dict(r)))
+        self._advance_cursor(table, rows)
+        evicted = trimmed and self._reconcile_trim(conn, table, flat_bufs=(buf,))
+        return bool(rows) or evicted
+
+    def _read_system_host(self, conn, table, dirty) -> bool:
+        return self._read_keyed(
+            conn, table, self._system_host,
+            lambda r: int(r["node_rank"]),
+            topo_source=self._topology["system_samples"], dirty=dirty,
+        )
+
+    def _read_system_dev(self, conn, table, dirty) -> bool:
+        return self._read_keyed(
+            conn, table, self._system_dev,
+            lambda r: (int(r["node_rank"]), int(r["device_id"] or 0)),
+        )
+
+    def _read_process(self, conn, table, dirty) -> bool:
+        return self._read_keyed(
+            conn, table, self._process,
+            lambda r: int(r["global_rank"]),
+            topo_source=self._topology["process_samples"], dirty=dirty,
+        )
+
+    def _read_process_dev(self, conn, table, dirty) -> bool:
+        return self._read_keyed(
+            conn, table, self._process_dev,
+            lambda r: (int(r["global_rank"]), int(r["device_id"] or 0)),
+        )
+
+    def _read_stdout(self, conn, table, dirty) -> bool:
+        trimmed = self._observe_min(conn, table)
+        cur = self._cursors.get(table, 0)
+        rows = conn.execute(
+            f"SELECT id, global_rank, stream, line FROM {table}"
+            " WHERE id > ? ORDER BY id",
+            (cur,),
+        ).fetchall()
+        for r in rows:
+            self._stdout.append(
+                r["id"], int(r["global_rank"]), (r["stream"], r["line"])
+            )
+        self._advance_cursor(table, rows)
+        evicted = trimmed and self._reconcile_trim(
+            conn, table, flat_bufs=(self._stdout,)
+        )
+        return bool(rows) or evicted
+
+    def _model_stats_select(self, conn, table) -> str:
+        """Column list probed once — archived sessions may predate the
+        tokens_per_step / device_count columns (same back-compat as
+        ``loaders.load_model_stats``)."""
+        if self._model_stats_cols is None:
+            have = {
+                r[1] for r in conn.execute(f"PRAGMA table_info({table})")
+            }
+            cols = []
+            for c in (
+                "global_rank", "flops_per_step", "flops_source",
+                "device_kind", "peak_flops", "device_count",
+                "tokens_per_step",
+            ):
+                cols.append(c if c in have else f"NULL AS {c}")
+            self._model_stats_cols = cols
+        return ", ".join(self._model_stats_cols)
+
+    def _read_model_stats(self, conn, table, dirty) -> bool:
+        trimmed = self._observe_min(conn, table)
+        cur = self._cursors.get(table, 0)
+        rows = conn.execute(
+            f"SELECT id, {self._model_stats_select(conn, table)}"
+            f" FROM {table} WHERE id > ? ORDER BY id",
+            (cur,),
+        ).fetchall()
+        for r in rows:
+            self._model_stats.append(r["id"], int(r["global_rank"]), dict(r))
+        self._advance_cursor(table, rows)
+        evicted = trimmed and self._reconcile_trim(
+            conn, table, flat_bufs=(self._model_stats,)
+        )
+        return bool(rows) or evicted
+
+    # -- accessors (loader-shaped) --------------------------------------
+
+    def step_time_rows(self) -> Dict[int, List[Dict[str, Any]]]:
+        """global_rank → decoded step rows, loader-shaped
+        (``loaders.load_step_time_rows``)."""
+        with self._lock:
+            return {
+                rank: list(buf.rows)
+                for rank, buf in sorted(self._step_time.items())
+                if buf.rows
+            }
+
+    def step_memory_rows(self) -> Dict[int, List[Dict[str, Any]]]:
+        with self._lock:
+            return {
+                rank: list(buf.rows)
+                for rank, buf in sorted(self._step_memory.items())
+                if buf.rows
+            }
+
+    @staticmethod
+    def _group(buf: _RankBuffer) -> Dict[Any, List[Dict[str, Any]]]:
+        out: Dict[Any, List[Dict[str, Any]]] = {}
+        for key, row in buf.rows:
+            out.setdefault(key, []).append(row)
+        return out
+
+    def system_rows(self) -> Tuple[Dict, Dict]:
+        with self._lock:
+            return self._group(self._system_host), self._group(self._system_dev)
+
+    def process_rows(self) -> Tuple[Dict, Dict]:
+        with self._lock:
+            return self._group(self._process), self._group(self._process_dev)
+
+    def stdout_tail(self, n: int = 12) -> List[Tuple[str, str]]:
+        with self._lock:
+            rows = list(self._stdout.rows)
+        return rows[-int(n):]
+
+    def model_stats(self) -> Dict[int, Dict[str, Any]]:
+        """Same aggregation contract as ``loaders.load_model_stats``:
+        median flops/tokens over the recent declarations, newest row
+        wins for source/device_kind/peak."""
+        import statistics
+
+        with self._lock:
+            rows = list(self._model_stats.rows)
+        out: Dict[int, Dict[str, Any]] = {}
+        per_rank_flops: Dict[int, List[float]] = {}
+        per_rank_tokens: Dict[int, List[float]] = {}
+        for r in rows:
+            rank = int(r["global_rank"])
+            if r["flops_per_step"]:
+                per_rank_flops.setdefault(rank, []).append(
+                    float(r["flops_per_step"])
+                )
+            if r["tokens_per_step"]:
+                per_rank_tokens.setdefault(rank, []).append(
+                    float(r["tokens_per_step"])
+                )
+            out[rank] = {  # ascending id order → the newest row wins
+                "flops_source": r["flops_source"],
+                "device_kind": r["device_kind"],
+                "peak_flops": r["peak_flops"],
+                "device_count": r["device_count"],
+            }
+        for rank, vals in per_rank_flops.items():
+            out[rank]["flops_per_step"] = statistics.median(vals)
+        for rank, vals in per_rank_tokens.items():
+            out[rank]["tokens_per_step"] = statistics.median(vals)
+        return {
+            r: v for r, v in out.items()
+            if v.get("flops_per_step") or v.get("tokens_per_step")
+        }
+
+    def topology(self) -> Dict[str, Any]:
+        """Same source-preference contract as ``loaders.load_topology``:
+        step_time identity columns when that table exists, else
+        process, else system."""
+        with self._lock:
+            if self._topology_cache_version == self._versions["topology"] and (
+                self._topology_cache is not None
+            ):
+                return self._topology_cache
+            src = None
+            for table in (
+                "step_time_samples", "process_samples", "system_samples"
+            ):
+                if table in self._tables_seen:
+                    src = self._topology[table]
+                    break
+            if src is None:
+                out = {"mode": "unknown", "world_size": 0, "nodes": 0}
+            else:
+                ranks = sorted(src.ranks)
+                out = {
+                    "mode": "multi_node" if len(src.nodes) > 1 else "single_node",
+                    "world_size": max(src.world, len(ranks)),
+                    "ranks_seen": ranks,
+                    "nodes": len(src.nodes),
+                    "hostnames": sorted(src.hostnames),
+                }
+            self._topology_cache = out
+            self._topology_cache_version = self._versions["topology"]
+            return out
