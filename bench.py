@@ -770,17 +770,42 @@ def _run_device_child(rounds: int, steps: int) -> bool:
         return False
 
 
+def _run_micro_benches() -> int:
+    """The slow-marker micro-bench lane (tests/benchmarks/bench_*.py):
+    aggregator/read-path component benches with built-in golden
+    comparisons — live tick, window compute, codec, TCP drain.  They run
+    under pytest so their assertions (speedup floors, payload equality)
+    gate the same way CI's slow lane runs them; ``-s`` keeps the
+    bench_common JSON lines on stdout for collection into BENCH_LOCAL_*
+    records."""
+    env = _cpu_env(os.environ)  # component benches never need the chip
+    return subprocess.run(
+        [
+            sys.executable, "-m", "pytest", str(REPO / "tests" / "benchmarks"),
+            "-m", "slow", "-q", "-s", "-p", "no:cacheprovider",
+        ],
+        env=env,
+    ).returncode
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--pair", action="store_true")
     parser.add_argument("--interleaved", action="store_true")
     parser.add_argument("--short", action="store_true")
+    parser.add_argument(
+        "--micro", action="store_true",
+        help="run the slow-marker component benches (tests/benchmarks) "
+        "instead of the tracer-overhead measurement",
+    )
     # None = lane defaults; explicit values size BOTH lanes (CI smoke)
     parser.add_argument("--rounds", type=int, default=None)
     parser.add_argument("--steps", type=int, default=None)
     parser.add_argument("--out", type=str)
     args = parser.parse_args()
 
+    if args.micro:
+        return _run_micro_benches()
     if args.pair:
         return _pair_child(
             STEPS_PER_ROUND if args.steps is None else args.steps,

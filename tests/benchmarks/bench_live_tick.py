@@ -275,8 +275,16 @@ def _kinds(diag):
 
 def _golden_compare(inc, base):
     """Incremental payload must match the pre-change path: same window,
-    same diagnosis verdicts, same per-domain row data."""
-    assert inc["step_time"]["window"] == base["step_time"]["window"]
+    same diagnosis verdicts, same per-domain row data.
+
+    window_to_plain canonicalizes both sides: the incremental path now
+    returns a ColumnarStepTimeWindow whose dataclass __eq__ would reject
+    the scalar window on class identity alone."""
+    from traceml_tpu.utils.columnar import window_to_plain
+
+    assert window_to_plain(inc["step_time"]["window"]) == window_to_plain(
+        base["step_time"]["window"]
+    )
     assert _kinds(inc["step_time"]["diagnosis"]) == _kinds(
         base["step_time"]["diagnosis"]
     )

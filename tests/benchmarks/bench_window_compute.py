@@ -1,0 +1,131 @@
+"""Window compute cost: scalar reference builder vs columnar engine.
+
+Isolates the pure window math from SQLite/transport (bench_live_tick
+measures the whole tick): per-rank rows are preloaded into both
+representations, then each engine builds the aligned cross-rank window
+from scratch.  The columnar engine must produce a payload
+``window_to_plain``-identical to the scalar reference at every size —
+speed means nothing if the numbers moved.
+
+Emits bench_common JSON lines (collected into BENCH_LOCAL_* records):
+
+* ``scalar_build`` / ``columnar_build``: best-of build latency, ms;
+* ``speedup``: scalar / columnar;
+* ``columnar_incr``: append one step per rank + rebuild, the live
+  warm-tick shape.
+"""
+
+import statistics
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent))
+import bench_common  # noqa: E402
+
+from traceml_tpu.utils import timing as T  # noqa: E402
+from traceml_tpu.utils.columnar import (  # noqa: E402
+    StepTimeColumns,
+    build_columnar_step_time_window,
+    window_to_plain,
+)
+from traceml_tpu.utils.step_time_window import (  # noqa: E402
+    build_step_time_window,
+)
+
+pytestmark = pytest.mark.slow
+
+BENCH = "window_compute"
+STEPS = 120
+
+
+def _step_row(rank, step):
+    base = 50.0 + (step % 7) * 0.5 + (rank % 5) * 0.3
+    return {
+        "step": step,
+        "timestamp": float(step),
+        "clock": "device",
+        "late_markers": 0,
+        "events": {
+            T.STEP_TIME: {"cpu_ms": base, "device_ms": base, "count": 1},
+            T.COMPUTE_TIME: {
+                "cpu_ms": 1.0, "device_ms": base * 0.8, "count": 1,
+            },
+            T.DATALOADER_NEXT: {
+                "cpu_ms": base * 0.1, "device_ms": 0.0, "count": 1,
+            },
+        },
+    }
+
+
+def _best_of(fn, reps):
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best * 1000.0
+
+
+def _run_case(ranks, steps=STEPS):
+    rank_rows = {
+        r: [_step_row(r, s) for s in range(1, steps + 1)] for r in range(ranks)
+    }
+    cols = {}
+    for r, rows in rank_rows.items():
+        c = StepTimeColumns(steps + 16)
+        for row in rows:
+            c.append(row)
+        cols[r] = c
+
+    # golden first: equal payloads or the timings are meaningless
+    scalar = build_step_time_window(rank_rows, max_steps=steps)
+    columnar = build_columnar_step_time_window(cols, steps)
+    assert window_to_plain(scalar) == window_to_plain(columnar)
+
+    scalar_ms = _best_of(
+        lambda: build_step_time_window(rank_rows, max_steps=steps), 3
+    )
+    columnar_ms = _best_of(
+        lambda: build_columnar_step_time_window(cols, steps), 5
+    )
+
+    # live warm-tick shape: one appended step per rank, then a rebuild
+    incr = []
+    next_step = steps + 1
+    for _ in range(5):
+        for r in range(ranks):
+            row = _step_row(r, next_step)
+            rank_rows[r].append(row)
+            cols[r].append(row)
+        t0 = time.perf_counter()
+        w = build_columnar_step_time_window(cols, steps)
+        incr.append((time.perf_counter() - t0) * 1000.0)
+        assert w.steps[-1] == next_step
+        next_step += 1
+    incr_ms = statistics.median(incr)
+
+    extra = {"ranks": ranks, "steps": steps}
+    bench_common.emit(BENCH, "scalar_build", scalar_ms, "ms", **extra)
+    bench_common.emit(BENCH, "columnar_build", columnar_ms, "ms", **extra)
+    bench_common.emit(BENCH, "columnar_incr", incr_ms, "ms", **extra)
+    bench_common.emit(
+        BENCH, "speedup", scalar_ms / max(columnar_ms, 1e-6), "x", **extra
+    )
+    return scalar_ms, columnar_ms, incr_ms
+
+
+@pytest.mark.parametrize("ranks", [64, 256])
+def test_window_compute_bench(ranks):
+    scalar_ms, columnar_ms, _ = _run_case(ranks)
+    if ranks == 256:
+        # the engine must not merely match the scalar path — it must
+        # leave it far behind (ISSUE 3 acceptance: ≥5× on the warm tick)
+        assert scalar_ms / columnar_ms >= 5.0, (scalar_ms, columnar_ms)
+
+
+if __name__ == "__main__":
+    for ranks in (64, 256):
+        _run_case(ranks)

@@ -1,0 +1,380 @@
+"""Columnar window engine: golden equivalence vs the scalar path.
+
+The contract (docs/developer_guide/columnar-window-engine.md): for any
+input the scalar builder accepts, the columnar engine either produces a
+byte-identical window (``window_to_plain`` on both sides compares the
+full payload — steps, series, averages, medians, metrics, occupancy) or
+raises ``ColumnarFallback`` so the caller reruns the scalar reference.
+Edge cases exercised here are the ones that historically bend alignment
+math: ragged suffixes, single-rank worlds, a phase missing on one rank
+only, and a host/device clock flip mid-window.
+"""
+
+import random
+from collections import deque
+
+from traceml_tpu.aggregator.sqlite_writer import SQLiteWriter
+from traceml_tpu.diagnostics.step_memory.api import (
+    diagnose_columns,
+    diagnose_rank_rows,
+)
+from traceml_tpu.reporting.snapshot_store import LiveSnapshotStore
+from traceml_tpu.telemetry.envelope import SenderIdentity, build_telemetry_envelope
+from traceml_tpu.utils import timing as T
+from traceml_tpu.utils.columnar import (
+    ColumnarFallback,
+    MemoryColumns,
+    StepTimeColumns,
+    build_columnar_step_time_window,
+    columnar_window_enabled,
+    window_to_plain,
+)
+from traceml_tpu.utils.step_time_window import (
+    PHASES,
+    build_step_time_metrics,
+    build_step_time_window,
+)
+
+import pytest
+
+
+# -- row factories -------------------------------------------------------
+
+
+def _step_row(step, rng, clock="device", missing_phases=()):
+    step_ms = rng.uniform(40.0, 150.0)
+    events = {
+        T.STEP_TIME: {
+            "cpu_ms": step_ms,
+            "device_ms": step_ms * 0.97 if clock == "device" else None,
+            "count": 1,
+        }
+    }
+    for key, name in PHASES.items():
+        if key in missing_phases:
+            continue
+        v = rng.uniform(0.0, 25.0)
+        events[name] = {
+            "cpu_ms": v,
+            # input has no device side (host-only phase), like real rows
+            "device_ms": v * 0.95 if key != "input" else None,
+            "count": 1,
+        }
+    return {
+        "step": step,
+        "timestamp": 100.0 + step,
+        "clock": clock,
+        "late_markers": 0,
+        "events": events,
+    }
+
+
+def _mem_row(step, sp, cur, lim=16_000_000_000, dev=0):
+    return {
+        "step": step,
+        "timestamp": 10.0 + step,
+        "device_id": dev,
+        "device_kind": "tpu-v4",
+        "current_bytes": cur,
+        "peak_bytes": sp + 128,
+        "step_peak_bytes": sp,
+        "limit_bytes": lim,
+    }
+
+
+def _cols_for(rank_rows, cap=256):
+    out = {}
+    for rank, rows in rank_rows.items():
+        c = StepTimeColumns(cap)
+        for row in rows:
+            c.append(row)
+        out[rank] = c
+    return out
+
+
+def _assert_golden(rank_rows, max_steps, cap=256):
+    scalar = build_step_time_window(rank_rows, max_steps=max_steps)
+    columnar = build_columnar_step_time_window(_cols_for(rank_rows, cap), max_steps)
+    assert window_to_plain(scalar) == window_to_plain(columnar)
+    return columnar
+
+
+# -- golden edge cases ---------------------------------------------------
+
+
+def test_ragged_suffixes_identical():
+    rng = random.Random(11)
+    rank_rows = {
+        r: [_step_row(s, rng) for s in range(rng.randint(0, 7), 48)]
+        for r in range(8)
+    }
+    w = _assert_golden(rank_rows, max_steps=30)
+    assert w is not None and w.n_steps == 30 and w.clock == "device"
+
+
+def test_single_rank_world():
+    rng = random.Random(12)
+    rank_rows = {0: [_step_row(s, rng) for s in range(25)]}
+    w = _assert_golden(rank_rows, max_steps=10)
+    assert w.ranks == [0] and w.n_steps == 10
+
+
+def test_phase_missing_on_one_rank_only():
+    rng = random.Random(13)
+    rank_rows = {
+        0: [_step_row(s, rng, missing_phases=("collective",)) for s in range(20)],
+        1: [_step_row(s, rng) for s in range(20)],
+    }
+    w = _assert_golden(rank_rows, max_steps=30)
+    # the phase still counts as present (rank 1 reports it)
+    assert "collective" in w.phases_present
+
+
+def test_clock_flip_mid_window_selects_host():
+    rng = random.Random(14)
+    rank_rows = {
+        0: [
+            _step_row(s, rng, clock="device" if s < 20 else "host")
+            for s in range(40)
+        ],
+        1: [_step_row(s, rng) for s in range(40)],
+    }
+    w = _assert_golden(rank_rows, max_steps=30)
+    assert w.clock == "host"
+
+
+def test_no_overlap_and_empty_inputs():
+    rng = random.Random(15)
+    # disjoint step ranges: no common suffix on either path
+    rank_rows = {
+        0: [_step_row(s, rng) for s in range(0, 10)],
+        1: [_step_row(s, rng) for s in range(20, 30)],
+    }
+    assert build_step_time_window(rank_rows, max_steps=30) is None
+    assert build_columnar_step_time_window(_cols_for(rank_rows), 30) is None
+    assert build_columnar_step_time_window({}, 30) is None
+    # satellite guard: metrics over zero ranks must not call median([])
+    assert build_step_time_metrics({}) == {}
+
+
+def test_ring_eviction_matches_deque_maxlen():
+    rng = random.Random(16)
+    cap = 16
+    cols = StepTimeColumns(cap)
+    rows = deque(maxlen=cap)
+    for s in range(3 * cap + 5):  # force several compactions
+        row = _step_row(s, rng)
+        cols.append(row)
+        rows.append(row)
+        scalar = build_step_time_window({0: list(rows)}, max_steps=12)
+        columnar = build_columnar_step_time_window({0: cols}, 12)
+        assert window_to_plain(scalar) == window_to_plain(columnar)
+    assert len(cols) == cap
+
+
+# -- fallback flagging ---------------------------------------------------
+
+
+def test_duplicate_step_flags_fallback():
+    rng = random.Random(17)
+    cols = StepTimeColumns(32)
+    cols.append(_step_row(5, rng))
+    cols.append(_step_row(5, rng))  # duplicate
+    assert not cols.columnar_ok
+    with pytest.raises(ColumnarFallback):
+        build_columnar_step_time_window({0: cols}, 10)
+
+
+def test_out_of_order_and_malformed_rows_flag_fallback():
+    rng = random.Random(18)
+    for bad in (
+        [_step_row(5, rng), _step_row(3, rng)],  # out of order
+        [{"step": None, "events": {}}],  # no step id
+        [{"step": 1, "events": {T.STEP_TIME: {"cpu_ms": "NaN-ish"}}}],
+    ):
+        cols = StepTimeColumns(32)
+        for row in bad:
+            cols.append(row)
+        assert not cols.columnar_ok
+        with pytest.raises(ColumnarFallback):
+            build_columnar_step_time_window({0: cols}, 10)
+
+
+def test_memory_negative_or_huge_values_flag_fallback():
+    good = MemoryColumns(8)
+    good.append(_mem_row(1, 100, 90))
+    assert good.columnar_ok
+    for row in (
+        _mem_row(1, -5, 90),  # negative would alias the NULL sentinel
+        _mem_row(1, 2**60, 90),  # beyond float64-exact integers
+        dict(_mem_row(1, 100, 90), device_id=None),
+    ):
+        cols = MemoryColumns(8)
+        cols.append(row)
+        assert not cols.columnar_ok
+
+
+# -- memory diagnosis equality -------------------------------------------
+
+
+def _diag_plain(result):
+    import dataclasses
+
+    return (
+        dataclasses.asdict(result.diagnosis),
+        [dataclasses.asdict(i) for i in result.issues],
+    )
+
+
+def _mem_cols_for(rank_rows, cap=256):
+    out = {}
+    for rank, rows in rank_rows.items():
+        c = MemoryColumns(cap)
+        for row in rows:
+            c.append(row)
+        out[rank] = c
+    return out
+
+
+@pytest.mark.parametrize(
+    "scenario",
+    ["healthy", "pressure", "imbalance", "multi_device", "null_fields"],
+)
+def test_memory_diagnosis_rows_vs_columns(scenario):
+    G = 1_000_000_000
+    if scenario == "healthy":
+        rank_rows = {
+            r: [_mem_row(s, 8 * G, 7 * G) for s in range(30)] for r in range(3)
+        }
+    elif scenario == "pressure":
+        rank_rows = {
+            0: [_mem_row(s, int(15.6 * G), 15 * G) for s in range(30)],
+            1: [_mem_row(s, 9 * G, 8 * G) for s in range(30)],
+        }
+    elif scenario == "imbalance":
+        rank_rows = {
+            0: [_mem_row(s, 14 * G, 13 * G) for s in range(30)],
+            1: [_mem_row(s, 4 * G, 3 * G) for s in range(30)],
+        }
+    elif scenario == "multi_device":
+        rows = [_mem_row(s, 8 * G, 7 * G, dev=0) for s in range(30)]
+        rows += [_mem_row(s, 6 * G, 5 * G, dev=1) for s in range(30)]
+        rows.sort(key=lambda r: r["step"])
+        rank_rows = {0: rows, 1: [_mem_row(s, 8 * G, 7 * G) for s in range(30)]}
+    else:  # null_fields: Nones scattered through optional columns
+        rank_rows = {
+            0: [
+                dict(
+                    _mem_row(s, 9 * G, 7 * G),
+                    limit_bytes=None,
+                    step_peak_bytes=None if s % 3 else 9 * G,
+                )
+                for s in range(20)
+            ]
+        }
+    a = diagnose_rank_rows(rank_rows)
+    b = diagnose_columns(_mem_cols_for(rank_rows))
+    assert _diag_plain(a) == _diag_plain(b)
+
+
+# -- store-level integration ---------------------------------------------
+
+
+def _ident(rank=0, node=0, world=2):
+    return SenderIdentity(
+        session_id="s1",
+        global_rank=rank,
+        local_rank=rank % 4,
+        world_size=world,
+        node_rank=node,
+        hostname=f"host-{node}",
+        pid=100 + rank,
+    )
+
+
+def _ingest_step_time(w, rank, rows):
+    w.ingest(
+        build_telemetry_envelope("step_time", {"step_time": rows}, _ident(rank))
+    )
+
+
+def test_store_columnar_window_matches_scalar_rows(tmp_path):
+    rng = random.Random(19)
+    db = tmp_path / "t.sqlite"
+    w = SQLiteWriter(db)
+    w.start()
+    store = LiveSnapshotStore(db, window_steps=40)
+    for rank in (0, 1):
+        _ingest_step_time(
+            w, rank, [_step_row(s, rng) for s in range(1, 31)]
+        )
+    assert w.force_flush()
+    store.refresh()
+
+    win = store.build_step_time_window(max_steps=20)
+    assert getattr(win, "col", None) is not None  # columnar path taken
+    scalar = build_step_time_window(store.step_time_rows(), max_steps=20)
+    assert window_to_plain(win) == window_to_plain(scalar)
+
+    # incremental append advances the window identically
+    for rank in (0, 1):
+        _ingest_step_time(w, rank, [_step_row(s, rng) for s in range(31, 41)])
+    assert w.force_flush()
+    store.refresh()
+    win2 = store.build_step_time_window(max_steps=20)
+    scalar2 = build_step_time_window(store.step_time_rows(), max_steps=20)
+    assert window_to_plain(win2) == window_to_plain(scalar2)
+    assert win2.steps[-1] == 40
+
+    assert store.latest_step_time_ts() == pytest.approx(140.0)
+    assert store.has_step_time_rows()
+    assert w.finalize()
+    store.close()
+
+
+def test_store_trim_keeps_ring_in_lockstep(tmp_path):
+    rng = random.Random(20)
+    db = tmp_path / "t.sqlite"
+    w = SQLiteWriter(db, summary_window_rows=10, retention_factor=1.5)
+    w.start()
+    store = LiveSnapshotStore(db, window_steps=50)
+    for start in (1, 26, 51, 76):
+        for rank in (0, 1):
+            _ingest_step_time(
+                w, rank, [_step_row(s, rng) for s in range(start, start + 25)]
+            )
+        assert w.force_flush()
+        store.refresh()
+    # finalize runs the retention prune; refresh must evict the ring
+    # prefix in lockstep with the row deques
+    assert w.finalize()
+    assert store.refresh() is True
+    win = store.build_step_time_window(max_steps=50)
+    scalar = build_step_time_window(store.step_time_rows(), max_steps=50)
+    assert window_to_plain(win) == window_to_plain(scalar)
+    assert win.steps[0] == 86 and win.steps[-1] == 100
+    store.close()
+
+
+def test_env_kill_switch_forces_scalar(tmp_path, monkeypatch):
+    rng = random.Random(21)
+    db = tmp_path / "t.sqlite"
+    w = SQLiteWriter(db)
+    w.start()
+    store = LiveSnapshotStore(db, window_steps=40)
+    _ingest_step_time(w, 0, [_step_row(s, rng) for s in range(1, 11)])
+    assert w.force_flush()
+    store.refresh()
+
+    monkeypatch.setenv("TRACEML_COLUMNAR_WINDOW", "0")
+    assert not columnar_window_enabled()
+    win = store.build_step_time_window(max_steps=20)
+    assert getattr(win, "col", None) is None  # plain scalar window
+    assert store.step_memory_columns() is None
+
+    monkeypatch.setenv("TRACEML_COLUMNAR_WINDOW", "1")
+    win2 = store.build_step_time_window(max_steps=20)
+    assert getattr(win2, "col", None) is not None
+    assert window_to_plain(win) == window_to_plain(win2)
+    assert w.finalize()
+    store.close()

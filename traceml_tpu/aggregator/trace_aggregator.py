@@ -31,6 +31,10 @@ from traceml_tpu.utils.error_log import get_error_log
 
 _RENDER_INTERVAL = 0.5
 _SETTLE_POLL = 0.1
+# max frames decoded per _drain_once call: a backlog burst (slow UI
+# tick, hundreds of ranks reconnecting) is worked off in bounded slices
+# so the loop can interleave UI ticks instead of decoding for seconds
+_DRAIN_BATCH_FRAMES = 512
 
 
 class TraceMLAggregator:
@@ -54,6 +58,7 @@ class TraceMLAggregator:
         self._finished_ranks: Set[int] = set()
         self._seen_ranks: Set[int] = set()
         self._drain_lock = threading.Lock()
+        self._last_drain_frames = 0
         self._last_ui_tick = 0.0
         self.envelopes_ingested = 0
         self.started = False
@@ -137,11 +142,13 @@ class TraceMLAggregator:
             )
 
     # -- ingest ----------------------------------------------------------
-    def _drain_once(self) -> int:
+    def _drain_once(self, max_frames: Optional[int] = _DRAIN_BATCH_FRAMES) -> int:
         with self._drain_lock:
             # drain() hands over raw frames; msgpack decode runs HERE on
             # the aggregator thread, never on the TCP selector thread.
-            frames = self.server.drain()
+            # Bounded batch: leftover frames stay queued in the server
+            # (the caller re-loops — see _drain_all / _loop).
+            frames = self.server.drain(max_frames)
             payloads = self.server.decode_frames(frames) if frames else []
             n = 0
             for p in payloads:
@@ -155,16 +162,32 @@ class TraceMLAggregator:
                 self.writer.ingest(env)
                 n += 1
             self.envelopes_ingested += n
+            self._last_drain_frames = len(frames)
             return n
+
+    def _drain_all(self) -> int:
+        """Drain to empty in bounded slices (settle/shutdown path: no UI
+        between batches, but each slice stays interruptible by the GIL)."""
+        total = self._drain_once()
+        while self._last_drain_frames >= _DRAIN_BATCH_FRAMES:
+            total += self._drain_once()
+        return total
 
     def _handle_control(self, payload: Dict[str, Any]) -> None:
         kind = control_kind(payload)
         if kind == RANK_FINISHED:
             meta = payload.get("meta") or {}
+            rank = meta.get("global_rank", meta.get("rank"))
             try:
-                rank = int(meta.get("global_rank", meta.get("rank", 0)))
+                rank = int(rank)
             except (TypeError, ValueError):
-                rank = 0
+                # a garbled marker must NOT default to rank 0 — that
+                # falsely settles rank 0 and can unblock shutdown with
+                # real telemetry still in flight; drop it loudly instead
+                get_error_log().warning(
+                    f"rank_finished with invalid global_rank {rank!r}; dropped"
+                )
+                return
             self._finished_ranks.add(rank)
 
     # -- loop ------------------------------------------------------------
@@ -172,15 +195,24 @@ class TraceMLAggregator:
         while not self._stop_evt.is_set():
             try:
                 self.server.wait_for_data(_RENDER_INTERVAL)
-                self._drain_once()
-                now = time.monotonic()
-                if now - self._last_ui_tick >= _RENDER_INTERVAL:
-                    self._last_ui_tick = now
-                    self.summary_service.poll()
-                    try:
-                        self.display.tick(self)
-                    except Exception as exc:
-                        get_error_log().warning("display tick failed", exc)
+                # re-loop until the backlog is gone, giving the UI a
+                # chance to tick between bounded decode batches — the
+                # loop never parks in wait_for_data with frames pending
+                while True:
+                    self._drain_once()
+                    now = time.monotonic()
+                    if now - self._last_ui_tick >= _RENDER_INTERVAL:
+                        self._last_ui_tick = now
+                        self.summary_service.poll()
+                        try:
+                            self.display.tick(self)
+                        except Exception as exc:
+                            get_error_log().warning("display tick failed", exc)
+                    if (
+                        self._last_drain_frames < _DRAIN_BATCH_FRAMES
+                        or self._stop_evt.is_set()
+                    ):
+                        break
             except Exception as exc:  # keep the loop alive
                 get_error_log().warning("aggregator loop error", exc)
                 time.sleep(0.1)
@@ -195,7 +227,7 @@ class TraceMLAggregator:
         """Drain whatever is in flight and wait for it to be committed
         (reference: trace_aggregator.py:518)."""
         deadline = time.monotonic() + timeout
-        self._drain_once()
+        self._drain_all()
         self.writer.force_flush(timeout=max(0.5, deadline - time.monotonic()))
 
     def _settle_end_of_run(self, deadline: float) -> None:
@@ -203,11 +235,11 @@ class TraceMLAggregator:
         (reference: trace_aggregator.py:440-499)."""
         expected = self.expected_world_size()
         while time.monotonic() < deadline:
-            self._drain_once()
+            self._drain_all()
             if len(self._finished_ranks) >= expected:
                 break
             time.sleep(_SETTLE_POLL)
-        self._drain_once()
+        self._drain_all()
         self.writer.force_flush(timeout=max(1.0, deadline - time.monotonic()))
         missing = sorted(
             set(range(expected)) - self._finished_ranks

@@ -10,7 +10,9 @@ from traceml_tpu.diagnostics.step_memory.policy import DEFAULT_POLICY, StepMemor
 from traceml_tpu.diagnostics.step_memory.rules import (
     DEFAULT_RULES,
     build_memory_context,
+    build_memory_context_from_columns,
 )
+from traceml_tpu.utils.columnar import MemoryColumns
 
 DOMAIN = "step_memory"
 
@@ -20,4 +22,14 @@ def diagnose_rank_rows(
     policy: StepMemoryPolicy = DEFAULT_POLICY,
 ) -> DiagnosticResult:
     ctx = build_memory_context(rank_rows, policy)
+    return run_rules(DOMAIN, DEFAULT_RULES, ctx)
+
+
+def diagnose_columns(
+    rank_columns: Mapping[int, MemoryColumns],
+    policy: StepMemoryPolicy = DEFAULT_POLICY,
+) -> DiagnosticResult:
+    """Columnar fast path: diagnose straight from the snapshot store's
+    per-rank memory ring buffers (no row-dict walk)."""
+    ctx = build_memory_context_from_columns(rank_columns, policy)
     return run_rules(DOMAIN, DEFAULT_RULES, ctx)

@@ -2,8 +2,12 @@
 (reference: src/traceml_ai/diagnostics/step_memory/rules.py:60-196,
 trend.py:31-376).
 
-Context shape: per-rank per-device step series of
-``{step, current_bytes, step_peak_bytes, limit_bytes}``.
+Context shape: per-rank per-device :class:`MemorySeries` (sorted
+columnar step series of ``{step, current_bytes, step_peak_bytes,
+limit_bytes}``), built either from row dicts or directly from the
+snapshot store's :class:`~traceml_tpu.utils.columnar.MemoryColumns`
+ring buffers — both paths yield identical series, so every rule has a
+single implementation.
 """
 
 from __future__ import annotations
@@ -11,6 +15,8 @@ from __future__ import annotations
 import dataclasses
 import statistics
 from typing import Any, Dict, List, Mapping, Optional, Sequence
+
+import numpy as np
 
 from traceml_tpu.analytics.trends.core import (
     compute_trend_evidence,
@@ -24,13 +30,14 @@ from traceml_tpu.diagnostics.common import (
     confidence_from,
 )
 from traceml_tpu.diagnostics.step_memory.policy import DEFAULT_POLICY, StepMemoryPolicy
+from traceml_tpu.utils.columnar import MemoryColumns, MemorySeries
 from traceml_tpu.utils.formatting import fmt_bytes
 
 
 @dataclasses.dataclass
 class MemoryContext:
-    # (rank, device_id) → ordered step rows
-    series: Dict[tuple, List[Dict[str, Any]]]
+    # (rank, device_id) → sorted columnar series
+    series: Dict[tuple, MemorySeries]
     policy: StepMemoryPolicy = DEFAULT_POLICY
     # per-context creep-evidence cache: both creep rules share one scan
     creep_cache: Optional[List["_CreepEvidence"]] = None
@@ -44,31 +51,48 @@ def build_memory_context(
     rank_rows: Mapping[int, Sequence[Mapping[str, Any]]],
     policy: StepMemoryPolicy = DEFAULT_POLICY,
 ) -> MemoryContext:
-    series: Dict[tuple, List[Dict[str, Any]]] = {}
+    groups: Dict[tuple, List[Mapping[str, Any]]] = {}
     for rank, rows in rank_rows.items():
         for row in rows:
             key = (int(rank), int(row.get("device_id", 0)))
-            series.setdefault(key, []).append(dict(row))
-    for rows in series.values():
-        rows.sort(key=lambda r: (r.get("step") or 0))
+            groups.setdefault(key, []).append(row)
+    series = {
+        key: MemorySeries.from_rows(key[0], key[1], rows)
+        for key, rows in groups.items()
+    }
     return MemoryContext(series=series, policy=policy)
 
 
-def _latest_pressure(rows: List[Dict[str, Any]]) -> Optional[float]:
-    for row in reversed(rows):
-        used = row.get("step_peak_bytes") or row.get("current_bytes")
-        limit = row.get("limit_bytes")
-        if used and limit:
-            return float(used) / float(limit)
-    return None
+def build_memory_context_from_columns(
+    rank_columns: Mapping[int, MemoryColumns],
+    policy: StepMemoryPolicy = DEFAULT_POLICY,
+) -> MemoryContext:
+    """Columnar context build: splits each rank's ring buffer by device
+    (first-encounter order, matching the row path's insertion order)
+    with no per-row dict copies."""
+    series: Dict[tuple, MemorySeries] = {}
+    for rank, cols in rank_columns.items():
+        data = cols.data_view()
+        if data.shape[0] == 0:
+            continue
+        devs = data[:, 1]  # C_DEV
+        uniq, first_idx = np.unique(devs, return_index=True)
+        for d in uniq[np.argsort(first_idx, kind="stable")].tolist():
+            key = (int(rank), int(d))
+            series[key] = MemorySeries.from_int_columns(
+                key[0], key[1], data[devs == d]
+            )
+    return MemoryContext(series=series, policy=policy)
 
 
 class HighPressureRule:
     def evaluate(self, ctx: MemoryContext) -> List[DiagnosticIssue]:
         issues = []
         p = ctx.policy
-        for (rank, dev), rows in ctx.series.items():
-            pressure = _latest_pressure(rows)
+        for (rank, dev), s in ctx.series.items():
+            if not len(s):
+                continue
+            pressure = s.latest_pressure()
             if pressure is None or pressure < p.pressure_warn:
                 continue
             severity = (
@@ -76,7 +100,7 @@ class HighPressureRule:
                 if pressure >= p.pressure_critical
                 else SEVERITY_WARNING
             )
-            last = rows[-1]
+            last_sp, last_cur, last_lim = s.last_values()
             issues.append(
                 DiagnosticIssue(
                     kind="HIGH_MEMORY_PRESSURE",
@@ -84,8 +108,8 @@ class HighPressureRule:
                     summary=(
                         f"Rank {rank} device {dev} at {pressure * 100:.0f}% of "
                         f"HBM capacity "
-                        f"({fmt_bytes(last.get('step_peak_bytes') or last.get('current_bytes'))}"
-                        f" / {fmt_bytes(last.get('limit_bytes'))})."
+                        f"({fmt_bytes(last_sp or last_cur)}"
+                        f" / {fmt_bytes(last_lim)})."
                     ),
                     action=(
                         "Reduce per-chip footprint: smaller microbatch, "
@@ -112,13 +136,11 @@ class ImbalanceRule:
         # latest used bytes per rank (max over that rank's devices)
         per_rank: Dict[int, float] = {}
         per_rank_pressure: Dict[int, float] = {}
-        for (rank, _dev), rows in ctx.series.items():
-            if not rows:
+        for (rank, _dev), s in ctx.series.items():
+            if not len(s):
                 continue
-            last = rows[-1]
-            used = last.get("step_peak_bytes") or last.get("current_bytes") or 0
-            per_rank[rank] = max(per_rank.get(rank, 0.0), float(used))
-            pres = _latest_pressure(rows)
+            per_rank[rank] = max(per_rank.get(rank, 0.0), s.last_used())
+            pres = s.latest_pressure()
             if pres is not None:
                 per_rank_pressure[rank] = max(
                     per_rank_pressure.get(rank, 0.0), pres
@@ -184,13 +206,13 @@ def _collect_creep_evidence(ctx: MemoryContext) -> List[_CreepEvidence]:
     growth_by_key: Dict[tuple, float] = {}
     banded_by_key: Dict[tuple, Any] = {}
     window_by_key: Dict[tuple, Any] = {}
-    for (rank, dev), rows in ctx.series.items():
+    for (rank, dev), s in ctx.series.items():
         # the row gate applies to EVERYTHING, including the cluster-wide
         # median — a freshly restarted rank's warmup growth over 60 rows
         # must not vote that the whole cluster is creeping
-        if len(rows) < p.creep_min_steps:
+        if len(s) < p.creep_min_steps:
             continue
-        series = [float(r.get("current_bytes") or 0) for r in rows]
+        series = s.current_list()
         banded = compute_trend_evidence(series)
         windowed = compute_window_trend(
             series,

@@ -36,6 +36,8 @@ from __future__ import annotations
 import statistics
 from typing import Dict, List, Optional
 
+import numpy as np
+
 from traceml_tpu.diagnostics.common import (
     SEVERITY_CRITICAL,
     SEVERITY_INFO,
@@ -44,6 +46,7 @@ from traceml_tpu.diagnostics.common import (
     confidence_from,
 )
 from traceml_tpu.diagnostics.step_time.policy import StepTimePolicy
+from traceml_tpu.utils.columnar import KEY_INDEX
 from traceml_tpu.utils.step_time_window import RESIDUAL_KEY, STEP_KEY, StepTimeWindow
 
 _STRAGGLER_KIND_BY_PHASE = {
@@ -102,15 +105,25 @@ class InputBoundRule:
         step: median input ≈ 0 on every rank) cannot be suppressed by
         a statistic mismatch."""
         w = ctx.window
-        shares = []
-        for r in w.ranks:
-            avg = w.rank_windows[r].averages
-            step = avg.get(STEP_KEY, 0.0)
-            if step > 0:
-                shares.append(avg.get("input", 0.0) / step)
-        if not shares:
-            return None
-        shares.sort()
+        col = getattr(w, "col", None)
+        if col is not None:
+            step = col.averages[:, KEY_INDEX[STEP_KEY]]
+            mask = step > 0
+            if not bool(mask.any()):
+                return None
+            shares = np.sort(
+                col.averages[:, KEY_INDEX["input"]][mask] / step[mask]
+            ).tolist()
+        else:
+            shares = []
+            for r in w.ranks:
+                avg = w.rank_windows[r].averages
+                step = avg.get(STEP_KEY, 0.0)
+                if step > 0:
+                    shares.append(avg.get("input", 0.0) / step)
+            if not shares:
+                return None
+            shares.sort()
         if len(shares) <= 4:
             return shares[0]
         return shares[max(0, (len(shares) - 1) // 4)]
@@ -190,9 +203,39 @@ class CleanStragglerRule:
         only statistic that can SEE spiky per-rank pathologies (a rank
         checkpointing/recompiling on 1-in-10 steps has median ≈ healthy;
         cf. CompileBoundRule's means-over-medians rationale)."""
+        col = getattr(w, "col", None)
+        if col is not None:
+            stats = col.medians if stat_name == "medians" else col.averages
+            step_a = stats[:, KEY_INDEX[STEP_KEY]]
+            if step_a.size == 0:
+                return None
+            sync_a = (
+                stats[:, KEY_INDEX[sync_phase]]
+                if sync_phase
+                else np.zeros_like(step_a)
+            )
+            non_sync_a = np.maximum(0.0, step_a - sync_a)
+            max_non_sync = float(np.max(non_sync_a))
+            clean_sync_a = np.maximum(
+                0.0, sync_a - np.maximum(0.0, max_non_sync - non_sync_a)
+            )
+            clean_step_a = non_sync_a + clean_sync_a
+            med_clean = float(np.median(clean_step_a))
+            med_actual = float(np.median(step_a))
+            if med_actual <= 0:
+                return None
+            ranks = col.ranks
+            clean_step = dict(zip(ranks, clean_step_a.tolist()))
+            clean_sync = dict(zip(ranks, clean_sync_a.tolist()))
+            step_stat = dict(zip(ranks, step_a.tolist()))
+            worst_rank = ranks[int(np.argmax(clean_step_a))]
+            score = (clean_step[worst_rank] - med_clean) / med_actual
+            return score, worst_rank, clean_step, clean_sync, step_stat
         step_stat = {
             r: getattr(w.rank_windows[r], stat_name)[STEP_KEY] for r in w.ranks
         }
+        if not step_stat:  # empty-window early-out (satellite guard)
+            return None
         sync_stat = {
             r: (
                 getattr(w.rank_windows[r], stat_name).get(sync_phase, 0.0)
@@ -407,19 +450,35 @@ class CompileBoundRule:
         if step is None or step.mean_ms <= 0:
             return []
         p = ctx.policy
-        recompile_ms_per_rank = []
-        n_compile_steps = 0
-        for rw in w.rank_windows.values():
-            series = rw.series.get("compile", [])
-            recompile_total = 0.0
-            for step_id, v in zip(rw.steps, series):
-                if v > 0 and step_id > p.compile_warmup_steps:
-                    recompile_total += v
-                    n_compile_steps += 1
-            recompile_ms_per_rank.append(recompile_total / max(1, len(series)))
-        if n_compile_steps == 0:
-            return []
-        mean_recompile = sum(recompile_ms_per_rank) / len(recompile_ms_per_rank)
+        col = getattr(w, "col", None)
+        if col is not None:
+            comp = col.series_cube[:, KEY_INDEX["compile"], :]  # (R, S)
+            mask = (comp > 0) & (col.steps > p.compile_warmup_steps)
+            n_compile_steps = int(mask.sum())
+            if n_compile_steps == 0:
+                return []
+            # cumsum[-1] == the scalar left-fold accumulation, exactly
+            totals = np.cumsum(np.where(mask, comp, 0.0), axis=1)[:, -1]
+            per_rank = totals / max(1, comp.shape[1])
+            mean_recompile = float(np.cumsum(per_rank)[-1]) / per_rank.shape[0]
+        else:
+            recompile_ms_per_rank = []
+            n_compile_steps = 0
+            for rw in w.rank_windows.values():
+                series = rw.series.get("compile", [])
+                recompile_total = 0.0
+                for step_id, v in zip(rw.steps, series):
+                    if v > 0 and step_id > p.compile_warmup_steps:
+                        recompile_total += v
+                        n_compile_steps += 1
+                recompile_ms_per_rank.append(
+                    recompile_total / max(1, len(series))
+                )
+            if n_compile_steps == 0 or not recompile_ms_per_rank:
+                return []
+            mean_recompile = sum(recompile_ms_per_rank) / len(
+                recompile_ms_per_rank
+            )
         share = mean_recompile / step.mean_ms
         if share < p.compile_share_warn:
             return []

@@ -36,16 +36,14 @@ class _WatchState:
     def step_time_lines(self) -> List[str]:
         from traceml_tpu.diagnostics.step_time.api import diagnose_window
         from traceml_tpu.utils.formatting import fmt_ms
-        from traceml_tpu.utils.step_time_window import build_step_time_window
 
         self.store.refresh()
         version = self.store.versions["step_time"]
         if version == self._version:
             return self._lines
         lines: List[str] = []
-        rank_rows = self.store.step_time_rows()
-        if rank_rows:
-            w = build_step_time_window(rank_rows, max_steps=120)
+        if self.store.has_step_time_rows():
+            w = self.store.build_step_time_window(max_steps=120)
             if w:
                 step = w.metric("step_time")
                 lines.append(

@@ -26,7 +26,6 @@ from typing import Any, Dict, Optional, Tuple
 from traceml_tpu.diagnostics.step_time.api import diagnose_window
 from traceml_tpu.renderers import views as V
 from traceml_tpu.reporting.snapshot_store import LiveSnapshotStore
-from traceml_tpu.utils.step_time_window import build_step_time_window
 
 # payload domain → (store versions it depends on, views key or None)
 _DOMAIN_DEPS: Dict[str, Tuple[Tuple[str, ...], Optional[str]]] = {
@@ -119,19 +118,14 @@ class LiveComputer:
     def _compute_step_time(self) -> Tuple[Dict[str, Any], Any]:
         world = int((self._store.topology() or {}).get("world_size") or 0)
         try:
-            rank_rows = self._store.step_time_rows()
-            window = build_step_time_window(
-                rank_rows, max_steps=self.window_steps
+            # columnar window build straight off the store's ring
+            # buffers (scalar fallback inside the store when a rank's
+            # buffer is flagged); no per-tick row-dict walk
+            window = self._store.build_step_time_window(
+                max_steps=self.window_steps
             )
             # newest telemetry timestamp drives the staleness badge
-            latest = max(
-                (
-                    row.get("timestamp") or 0.0
-                    for rows in rank_rows.values()
-                    for row in rows[-1:]
-                ),
-                default=None,
-            )
+            latest = self._store.latest_step_time_ts()
             try:
                 model_stats = self._store.model_stats()
             except Exception:
@@ -145,7 +139,7 @@ class LiveComputer:
                 "step_time": {
                     "window": window,
                     "diagnosis": diagnose_window(window, mode="live")
-                    if rank_rows
+                    if self._store.has_step_time_rows()
                     else None,
                 },
             }
@@ -156,16 +150,20 @@ class LiveComputer:
     def _compute_memory(self) -> Tuple[Dict[str, Any], Any]:
         try:
             mem_rows = self._store.step_memory_rows()
-            view = V.build_memory_view(mem_rows)
+            mem_cols = self._store.step_memory_columns()
+            view = V.build_memory_view(mem_rows, columns=mem_cols)
             from traceml_tpu.diagnostics.step_memory.api import (
+                diagnose_columns as diagnose_memory_columns,
                 diagnose_rank_rows as diagnose_memory,
             )
 
+            if mem_cols is not None:
+                diagnosis = diagnose_memory_columns(mem_cols)
+            else:
+                diagnosis = diagnose_memory(mem_rows) if mem_rows else None
             updates = {
                 "step_memory": mem_rows,
-                "step_memory_diagnosis": diagnose_memory(mem_rows)
-                if mem_rows
-                else None,
+                "step_memory_diagnosis": diagnosis,
             }
             return updates, view
         except Exception as exc:

@@ -18,7 +18,11 @@ import statistics
 import time
 from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
 
+import numpy as np
+
+from traceml_tpu.utils.columnar import KEY_INDEX, MemoryColumns
 from traceml_tpu.utils.step_time_window import (
+    ALL_KEYS,
     RESIDUAL_KEY,
     STEP_KEY,
     StepTimeWindow,
@@ -118,25 +122,46 @@ def build_step_time_view(
         )
     tail = window.steps[-series_tail:]
     offset = len(window.steps) - len(tail)
-    step_series = {
-        str(r): [round(v, 4) for v in w.series[STEP_KEY][offset:]]
-        for r, w in window.rank_windows.items()
-    }
-    # cross-rank median per phase per step — the stacking series the
-    # dashboard charts consume (reference: StepCombinedTimeSeries)
+    col = getattr(window, "col", None)
     phase_stack: Dict[str, List[float]] = {}
-    rw = list(window.rank_windows.values())
-    for key in window.phases_present + [RESIDUAL_KEY]:
-        per_step = []
-        for i in range(offset, len(window.steps)):
-            vals = [w.series[key][i] for w in rw if i < len(w.series[key])]
-            per_step.append(round(statistics.median(vals), 4) if vals else 0.0)
-        phase_stack[key] = per_step
+    if col is not None:
+        # columnar fast path: series / per-phase cross-rank medians /
+        # per-rank averages straight off the cube (tolist() BEFORE
+        # round() so the values are native floats, identical to scalar)
+        step_series = {
+            str(r): [round(v, 4) for v in row]
+            for r, row in zip(
+                col.ranks, col.series_cube[:, KEY_INDEX[STEP_KEY], offset:].tolist()
+            )
+        }
+        for key in window.phases_present + [RESIDUAL_KEY]:
+            med = np.median(col.series_cube[:, KEY_INDEX[key], offset:], axis=0)
+            phase_stack[key] = [round(v, 4) for v in med.tolist()]
+        per_rank_avg = {
+            r: {k: round(v, 4) for k, v in zip(ALL_KEYS, row)}
+            for r, row in zip(col.ranks, col.averages.tolist())
+        }
+    else:
+        step_series = {
+            str(r): [round(v, 4) for v in w.series[STEP_KEY][offset:]]
+            for r, w in window.rank_windows.items()
+        }
+        # cross-rank median per phase per step — the stacking series the
+        # dashboard charts consume (reference: StepCombinedTimeSeries)
+        rw = list(window.rank_windows.values())
+        for key in window.phases_present + [RESIDUAL_KEY]:
+            per_step = []
+            for i in range(offset, len(window.steps)):
+                vals = [w.series[key][i] for w in rw if i < len(w.series[key])]
+                per_step.append(
+                    round(statistics.median(vals), 4) if vals else 0.0
+                )
+            phase_stack[key] = per_step
+        per_rank_avg = {
+            r: {k: round(v, 4) for k, v in w.averages.items()}
+            for r, w in window.rank_windows.items()
+        }
     world = max(world_size or 0, len(window.ranks))
-    per_rank_avg = {
-        r: {k: round(v, 4) for k, v in w.averages.items()}
-        for r, w in window.rank_windows.items()
-    }
     return StepTimeView(
         clock=window.clock,
         n_steps=window.n_steps,
@@ -207,6 +232,7 @@ def build_memory_view(
     rows_by_rank: Mapping[int, Sequence[Mapping[str, Any]]],
     *,
     history_tail: int = 60,
+    columns: Optional[Mapping[int, MemoryColumns]] = None,
 ) -> Optional[MemoryView]:
     if not isinstance(rows_by_rank, Mapping) or not rows_by_rank:
         return None
@@ -220,10 +246,27 @@ def build_memory_view(
         cur = last.get("current_bytes")
         step_peak = last.get("step_peak_bytes")
         limit = last.get("limit_bytes")
-        first_cur = next(
-            (r.get("current_bytes") for r in rows if r.get("current_bytes") is not None),
-            None,
-        )
+        # the per-row walk (first non-null current + history tail) has a
+        # columnar fast path over the rank's ring buffer; -1 == NULL,
+        # arrival order matches the row list exactly
+        col = columns.get(rank) if columns is not None else None
+        if col is not None and len(col) == len(rows) and col.columnar_ok:
+            cur_col = col.column(2)  # C_CUR
+            nn = np.flatnonzero(cur_col >= 0)
+            first_cur = int(cur_col[nn[0]]) if nn.size else None
+            history = np.maximum(cur_col[-history_tail:], 0).tolist()
+        else:
+            first_cur = next(
+                (
+                    r.get("current_bytes")
+                    for r in rows
+                    if r.get("current_bytes") is not None
+                ),
+                None,
+            )
+            history = [
+                int(r.get("current_bytes") or 0) for r in rows[-history_tail:]
+            ]
         ts = last.get("timestamp")
         if ts is not None:
             latest_ts = max(latest_ts or 0.0, float(ts))
@@ -240,9 +283,7 @@ def build_memory_view(
                 growth_bytes=(cur - first_cur)
                 if cur is not None and first_cur is not None
                 else None,
-                history=[
-                    int(r.get("current_bytes") or 0) for r in rows[-history_tail:]
-                ],
+                history=history,
             )
         )
     if not stats:

@@ -38,7 +38,6 @@ from traceml_tpu.utils.step_time_window import (
     RESIDUAL_KEY,
     STEP_KEY,
     StepTimeWindow,
-    build_step_time_window,
 )
 
 SCHEMA_VERSION = "traceml-tpu/1"
@@ -72,10 +71,22 @@ def _steady_state(window: StepTimeWindow) -> Dict[str, Any]:
         return {}
     cut = max(3, window.n_steps // 4)
     per_rank_steady = {}
-    for r, w in window.rank_windows.items():
-        vals = w.series[STEP_KEY][cut:]
-        if vals:
-            per_rank_steady[str(r)] = statistics.median(vals)
+    col = getattr(window, "col", None)
+    if col is not None:
+        import numpy as np
+
+        from traceml_tpu.utils.columnar import KEY_INDEX
+
+        # columnar: one median over the (rank × steady-suffix) slab
+        steady_slab = col.series_cube[:, KEY_INDEX[STEP_KEY], cut:]
+        if steady_slab.shape[1]:
+            meds = np.median(steady_slab, axis=1).tolist()
+            per_rank_steady = {str(r): m for r, m in zip(col.ranks, meds)}
+    else:
+        for r, w in window.rank_windows.items():
+            vals = w.series[STEP_KEY][cut:]
+            if vals:
+                per_rank_steady[str(r)] = statistics.median(vals)
     if not per_rank_steady:
         return {}
     overall = statistics.median(per_rank_steady.values())
@@ -110,10 +121,14 @@ def _efficiency_block(store, window: StepTimeWindow, steady) -> Optional[Dict[st
 
 
 def _build_step_time_section(store, mode: str, identities=None):
-    rank_rows = store.step_time_rows()
-    if not rank_rows:
+    if not store.has_step_time_rows():
         return _no_data_section("step_time"), None
-    window: Optional[StepTimeWindow] = build_step_time_window(rank_rows)
+    # columnar build off the store's ring buffers (scalar fallback
+    # inside the store); the report keeps its historic 200-step window
+    # even though the store retains 600 rows per rank
+    window: Optional[StepTimeWindow] = store.build_step_time_window(
+        max_steps=200
+    )
     steady = _steady_state(window) if window else {}
     efficiency = (
         _efficiency_block(store, window, steady) if window else None
@@ -142,10 +157,22 @@ def _build_step_time_section(store, mode: str, identities=None):
             }
         # short per-rank step series (downsampled) for charts/compare
         tail = 120
-        series = {
-            str(r): [round(v, 3) for v in w.series[STEP_KEY][-tail:]]
-            for r, w in window.rank_windows.items()
-        }
+        col = getattr(window, "col", None)
+        if col is not None:
+            from traceml_tpu.utils.columnar import KEY_INDEX
+
+            series = {
+                str(r): [round(v, 3) for v in row]
+                for r, row in zip(
+                    col.ranks,
+                    col.series_cube[:, KEY_INDEX[STEP_KEY], -tail:].tolist(),
+                )
+            }
+        else:
+            series = {
+                str(r): [round(v, 3) for v in w.series[STEP_KEY][-tail:]]
+                for r, w in window.rank_windows.items()
+            }
         # per-rank cards: the per-rank group view the renderers and
         # compare consume (reference: per-rank groups with identity
         # blocks, SCHEMA.md groups.rows[*].identity)

@@ -48,7 +48,18 @@ from collections import deque
 from pathlib import Path
 from typing import Any, Dict, List, Optional, Tuple
 
+from traceml_tpu.utils.columnar import (
+    ColumnarFallback,
+    MemoryColumns,
+    StepTimeColumns,
+    build_columnar_step_time_window,
+    columnar_window_enabled,
+)
 from traceml_tpu.utils.error_log import get_error_log
+from traceml_tpu.utils.step_time_window import (
+    StepTimeWindow,
+    build_step_time_window as _build_window_from_rows,
+)
 
 _READ_PRAGMAS = (
     "PRAGMA busy_timeout=200",
@@ -125,6 +136,56 @@ class _RankBuffer:
         return True
 
 
+class _StepTimeBuffer(_RankBuffer):
+    """Row deque + columnar ring in lockstep: every append lands in
+    both, ``clear``/``evict_below`` keep the ring's live span 1:1 with
+    the deque (the ring self-evicts on overflow exactly like the
+    deque's ``maxlen``), so the columnar window build always sees the
+    same rows the scalar fallback would."""
+
+    __slots__ = ("cols",)
+
+    def __init__(self, maxlen: int) -> None:
+        super().__init__(maxlen)
+        self.cols = StepTimeColumns(maxlen)
+
+    def append(self, row_id: int, rank: Optional[int], row: Any) -> None:
+        super().append(row_id, rank, row)
+        self.cols.append(row)
+
+    def clear(self) -> bool:
+        had = super().clear()
+        self.cols.clear()
+        return had
+
+    def evict_below(self, min_id: int) -> bool:
+        changed = super().evict_below(min_id)
+        self.cols.evict_head(len(self.cols) - len(self.ids))
+        return changed
+
+
+class _MemoryBuffer(_RankBuffer):
+    __slots__ = ("cols",)
+
+    def __init__(self, maxlen: int) -> None:
+        super().__init__(maxlen)
+        self.cols = MemoryColumns(maxlen)
+
+    def append(self, row_id: int, rank: Optional[int], row: Any) -> None:
+        super().append(row_id, rank, row)
+        self.cols.append(row)
+
+    def clear(self) -> bool:
+        had = super().clear()
+        self.cols.clear()
+        return had
+
+    def evict_below(self, min_id: int) -> bool:
+        changed = super().evict_below(min_id)
+        self.cols.evict_head(len(self.cols) - len(self.ids))
+        return changed
+
+
 class _TopologySource:
     """Accumulated identity sets for one projection table."""
 
@@ -193,9 +254,10 @@ class LiveSnapshotStore:
         self._min_seen: Dict[str, Optional[int]] = {}
         self._tables_seen: set = set()
 
-        # step_time / step_memory: per-rank bounded windows
-        self._step_time: Dict[int, _RankBuffer] = {}
-        self._step_memory: Dict[int, _RankBuffer] = {}
+        # step_time / step_memory: per-rank bounded windows (row deque
+        # + columnar ring per rank, kept in lockstep)
+        self._step_time: Dict[int, _StepTimeBuffer] = {}
+        self._step_memory: Dict[int, _MemoryBuffer] = {}
         # system / process: globally-bounded (loader semantics), keyed rows
         self._system_host = _RankBuffer(self.max_system_rows)
         self._system_dev = _RankBuffer(self.max_system_rows)
@@ -425,7 +487,7 @@ class LiveSnapshotStore:
             rank = int(r["global_rank"])
             buf = self._step_time.get(rank)
             if buf is None:
-                buf = self._step_time[rank] = _RankBuffer(self.window_steps)
+                buf = self._step_time[rank] = _StepTimeBuffer(self.window_steps)
             buf.append(
                 r["id"],
                 rank,
@@ -456,7 +518,7 @@ class LiveSnapshotStore:
             rank = int(r["global_rank"])
             buf = self._step_memory.get(rank)
             if buf is None:
-                buf = self._step_memory[rank] = _RankBuffer(
+                buf = self._step_memory[rank] = _MemoryBuffer(
                     self.memory_rows_per_rank
                 )
             row = dict(r)
@@ -583,6 +645,68 @@ class LiveSnapshotStore:
                 for rank, buf in sorted(self._step_memory.items())
                 if buf.rows
             }
+
+    def has_step_time_rows(self) -> bool:
+        with self._lock:
+            return any(buf.rows for buf in self._step_time.values())
+
+    def latest_step_time_ts(self) -> Optional[float]:
+        """max over ranks of the newest row's timestamp (the freshness
+        stamp the live step-time view displays)."""
+        with self._lock:
+            vals = [
+                buf.rows[-1].get("timestamp") or 0.0
+                for buf in self._step_time.values()
+                if buf.rows
+            ]
+        return max(vals) if vals else None
+
+    def build_step_time_window(
+        self, max_steps: Optional[int] = None
+    ) -> Optional[StepTimeWindow]:
+        """Build the aligned cross-rank window straight from the store.
+
+        Fast path: the vectorized columnar engine over the per-rank ring
+        buffers.  Falls back to the scalar reference
+        (``step_time_window.build_step_time_window`` over the row
+        deques) when any rank's buffer is flagged un-columnar — or when
+        ``TRACEML_COLUMNAR_WINDOW=0``.  Both paths produce identical
+        windows (golden-pinned by tests/utils/test_columnar_window.py).
+        """
+        limit = self.window_steps if max_steps is None else int(max_steps)
+        with self._lock:
+            if columnar_window_enabled():
+                try:
+                    cols = {
+                        rank: buf.cols
+                        for rank, buf in self._step_time.items()
+                        if buf.rows
+                    }
+                    return build_columnar_step_time_window(cols, limit)
+                except ColumnarFallback:
+                    pass
+            rank_rows = {
+                rank: list(buf.rows)
+                for rank, buf in sorted(self._step_time.items())
+                if buf.rows
+            }
+        return _build_window_from_rows(rank_rows, max_steps=limit)
+
+    def step_memory_columns(self) -> Optional[Dict[int, MemoryColumns]]:
+        """rank → memory ring buffer, or None when any rank's buffer is
+        flagged (caller must use ``step_memory_rows`` instead) or the
+        columnar engine is disabled."""
+        if not columnar_window_enabled():
+            return None
+        with self._lock:
+            out: Dict[int, MemoryColumns] = {}
+            for rank, buf in sorted(self._step_memory.items()):
+                if not buf.rows:
+                    continue
+                if not buf.cols.columnar_ok:
+                    return None
+                out[rank] = buf.cols
+            return out or None
 
     @staticmethod
     def _group(buf: _RankBuffer) -> Dict[Any, List[Dict[str, Any]]]:

@@ -195,12 +195,27 @@ class TCPServer:
             self._data_event.clear()
         return fired
 
-    def drain(self) -> List[bytes]:
-        """Pull raw frames accumulated by the selector thread."""
+    def drain(self, max_frames: Optional[int] = None) -> List[bytes]:
+        """Pull raw frames accumulated by the selector thread.
+
+        With ``max_frames`` set, hands over at most that many frames and
+        leaves the rest pending (the data event stays observable via
+        :meth:`pending_frames`), so one drain call can't hold the caller
+        hostage decoding an unbounded backlog.
+        """
         with self._lock:
-            out = self._pending
-            self._pending = []
+            if max_frames is None or len(self._pending) <= max_frames:
+                out = self._pending
+                self._pending = []
+            else:
+                out = self._pending[:max_frames]
+                del self._pending[:max_frames]
         return out
+
+    def pending_frames(self) -> int:
+        """Frames buffered by the selector thread, awaiting drain()."""
+        with self._lock:
+            return len(self._pending)
 
     def decode_frames(self, frames: List[bytes]) -> List[Any]:
         """Decode raw frames into a flat payload list on the CALLER's

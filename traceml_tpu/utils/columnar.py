@@ -1,0 +1,677 @@
+"""Columnar window engine: struct-of-arrays ring buffers + vectorized
+window builds for the live analytics path.
+
+The scalar pipeline in :mod:`traceml_tpu.utils.step_time_window` is the
+golden reference; this module is a drop-in fast path that must produce
+**byte-identical** payloads.  Three pieces:
+
+* :class:`StepTimeColumns` / :class:`MemoryColumns` — per-rank numpy
+  ring buffers (preallocated to 2x the retention bound, compacted with a
+  memmove when the write head reaches the end) that the snapshot store
+  fills in lockstep with its row deques.  Appends that the vectorized
+  build cannot represent exactly (duplicate or out-of-order steps, a
+  ``None`` step id, malformed event payloads, non-integer byte counts)
+  set a sticky ``columnar_ok = False`` flag on the rank's buffer.
+* :func:`build_columnar_step_time_window` — the vectorized equivalent of
+  ``build_step_time_window``: suffix alignment via unique-counts +
+  ``searchsorted``, clock selection as a boolean reduction, residual
+  clamp and per-phase averages/medians as numpy reductions over a
+  ``(rank, phase, aligned_step)`` cube.  Raises :class:`ColumnarFallback`
+  when any participating rank is flagged, so the caller reruns the
+  scalar reference on the row deques instead.
+* :class:`ColumnarStepTimeWindow` — a ``StepTimeWindow`` whose
+  ``rank_windows`` materialize per-rank lists lazily from the cube, so
+  diagnosis rules that only touch a few phases never pay for the rest.
+
+Exactness rules the implementation leans on (and the golden tests pin):
+
+* ``np.cumsum(xs)[-1]`` reproduces Python's left-fold ``sum(xs)``
+  exactly (``np.sum`` does NOT — it reduces pairwise);
+* substituting ``0.0`` for a missing value is exact for the non-negative
+  duration folds used here (``x + 0.0 == x``);
+* ``np.median`` and ``statistics.median`` agree for float input (odd
+  length picks the same element; even length computes ``(a + b) / 2``
+  both ways);
+* occupancy numerator/denominator pairs are precomputed at append time
+  by the scalar :func:`row_occupancy_parts`, so the events-dict
+  iteration order inside the fold is preserved by construction;
+* every value escaping into a payload goes through ``.tolist()`` /
+  ``float()`` first — ``np.float64`` is not JSON serializable and its
+  ``__round__`` differs from the float one.
+
+Kill switch: ``TRACEML_COLUMNAR_WINDOW=0`` forces the scalar path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, List, Mapping, Optional
+
+import numpy as np
+
+from traceml_tpu.utils import timing as T
+from traceml_tpu.utils.step_time_window import (
+    ACCOUNTED_PHASES,
+    ALL_KEYS,
+    PHASES,
+    RESIDUAL_KEY,
+    STEP_KEY,
+    RankWindow,
+    StepCombinedTimeMetric,
+    StepTimeWindow,
+    row_occupancy_parts,
+)
+
+# event layout inside the value cube: 0 = the step envelope, 1.. = the
+# accounted phases in PHASES order (the order the scalar fold uses)
+EVENT_NAMES = (T.STEP_TIME,) + tuple(PHASES.values())
+N_EVENTS = len(EVENT_NAMES)
+_EVENT_INDEX = {name: i for i, name in enumerate(EVENT_NAMES)}
+KEY_INDEX = {k: i for i, k in enumerate(ALL_KEYS)}
+
+_NAN = float("nan")
+
+
+def columnar_window_enabled() -> bool:
+    return os.environ.get("TRACEML_COLUMNAR_WINDOW", "1").strip().lower() not in (
+        "0",
+        "false",
+        "off",
+    )
+
+
+class ColumnarFallback(Exception):
+    """Raised when the columnar build cannot reproduce the scalar path
+    exactly; the caller must rerun the scalar reference on the rows."""
+
+
+class _CompactRing:
+    """Arrays sized ``2 * cap`` with ``[start, end)`` live; when the
+    write head hits ``2 * cap`` the live span is memmoved to the front.
+    Appends beyond ``cap`` drop the oldest entry, mirroring the snapshot
+    store's ``deque(maxlen=cap)`` exactly, so views are always
+    contiguous and eviction is an O(1) ``start`` bump."""
+
+    __slots__ = ("cap", "_start", "_end")
+
+    def __init__(self, cap: int) -> None:
+        self.cap = max(1, int(cap))
+        self._start = 0
+        self._end = 0
+
+    def __len__(self) -> int:
+        return self._end - self._start
+
+    def _arrays(self):  # pragma: no cover - subclass responsibility
+        raise NotImplementedError
+
+    def _next_slot(self) -> int:
+        if self._end == 2 * self.cap:
+            n = len(self)
+            lo = self._end - n
+            for a in self._arrays():
+                a[:n] = a[lo : self._end]
+            self._start, self._end = 0, n
+        if len(self) == self.cap:
+            self._start += 1
+        i = self._end
+        self._end += 1
+        return i
+
+    def evict_head(self, n: int) -> None:
+        """Drop the oldest ``n`` entries (retention-trim lockstep with
+        the snapshot store's deque eviction)."""
+        if n > 0:
+            self._start = min(self._start + n, self._end)
+
+    def _reset(self) -> None:
+        self._start = 0
+        self._end = 0
+
+
+class StepTimeColumns(_CompactRing):
+    """Per-rank step-time columns mirroring the store's row deque."""
+
+    __slots__ = ("_steps", "_vals", "_clock_ok", "_occ", "_last_step", "columnar_ok")
+
+    def __init__(self, cap: int) -> None:
+        super().__init__(cap)
+        n = 2 * self.cap
+        self._steps = np.empty(n, dtype=np.int64)
+        # (row, event, {cpu_ms, device_ms}); NaN == not reported
+        self._vals = np.empty((n, N_EVENTS, 2), dtype=np.float64)
+        self._clock_ok = np.empty(n, dtype=np.bool_)
+        # (row, {device_busy_ms, host_ms}) from row_occupancy_parts;
+        # NaN pair == parts unavailable for the row
+        self._occ = np.empty((n, 2), dtype=np.float64)
+        self._last_step: Optional[int] = None
+        self.columnar_ok = True
+
+    def _arrays(self):
+        return (self._steps, self._vals, self._clock_ok, self._occ)
+
+    def clear(self) -> None:
+        self._reset()
+        self._last_step = None
+        self.columnar_ok = True
+
+    def append(self, row: Mapping[str, Any]) -> None:
+        # always consume a slot, even for rows we cannot represent, so
+        # the ring stays 1:1 with the store's deque and eviction math
+        # holds; a flagged rank's columns are never read
+        i = self._next_slot()
+        if not self.columnar_ok:
+            return
+        try:
+            step = int(row["step"])
+            if self._last_step is not None and step <= self._last_step:
+                raise ColumnarFallback("duplicate or out-of-order step")
+            events = row.get("events") or {}
+            vals = self._vals[i]
+            vals.fill(_NAN)
+            for name, ev in events.items():
+                j = _EVENT_INDEX.get(name)
+                if j is None:
+                    continue
+                # scalar _row_value treats a truthy non-mapping as an
+                # error; float() raises on non-numeric values
+                cpu = ev.get("cpu_ms")
+                if cpu is not None:
+                    vals[j, 0] = float(cpu)
+                dev = ev.get("device_ms")
+                if dev is not None:
+                    vals[j, 1] = float(dev)
+            env = events.get(T.STEP_TIME) or {}
+            self._clock_ok[i] = (
+                row.get("clock") == "device" and env.get("device_ms") is not None
+            )
+            parts = row_occupancy_parts(events)
+            if parts is None:
+                self._occ[i, 0] = _NAN
+                self._occ[i, 1] = _NAN
+            else:
+                self._occ[i, 0] = parts[0]
+                self._occ[i, 1] = parts[1]
+            self._steps[i] = step
+            self._last_step = step
+        except Exception:
+            self.columnar_ok = False
+
+    # live views — valid until the next append/evict/clear
+    def steps_view(self) -> np.ndarray:
+        return self._steps[self._start : self._end]
+
+    def vals_view(self) -> np.ndarray:
+        return self._vals[self._start : self._end]
+
+    def occ_view(self) -> np.ndarray:
+        return self._occ[self._start : self._end]
+
+    def clock_all_device(self) -> bool:
+        return bool(self._clock_ok[self._start : self._end].all())
+
+
+# MemoryColumns layout: one int64 matrix, -1 == NULL.  Integer columns
+# (not float) so byte counts survive exactly into view payloads
+# (history / growth_bytes are ints in the scalar path).
+C_STEP, C_DEV, C_CUR, C_PEAK, C_SPEAK, C_LIM = range(6)
+_MEM_FIELDS = (
+    ("step", C_STEP),
+    ("device_id", C_DEV),
+    ("current_bytes", C_CUR),
+    ("peak_bytes", C_PEAK),
+    ("step_peak_bytes", C_SPEAK),
+    ("limit_bytes", C_LIM),
+)
+# int64 -> float64 is exact below 2**53; byte counts near that bound
+# (8 PiB) flag the rank instead of silently losing precision
+_MAX_EXACT_INT = 2 ** 53
+
+
+class MemoryColumns(_CompactRing):
+    """Per-rank step-memory columns mirroring the store's row deque."""
+
+    __slots__ = ("_data", "columnar_ok")
+
+    def __init__(self, cap: int) -> None:
+        super().__init__(cap)
+        self._data = np.empty((2 * self.cap, 6), dtype=np.int64)
+        self.columnar_ok = True
+
+    def _arrays(self):
+        return (self._data,)
+
+    def clear(self) -> None:
+        self._reset()
+        self.columnar_ok = True
+
+    def append(self, row: Mapping[str, Any]) -> None:
+        i = self._next_slot()
+        if not self.columnar_ok:
+            return
+        try:
+            out = self._data[i]
+            for field, c in _MEM_FIELDS:
+                if c == C_DEV:
+                    # scalar context does int(row.get("device_id", 0));
+                    # a None device would crash there, so fall back
+                    v = row.get(field, 0)
+                    if v is None or not isinstance(v, int):
+                        raise ColumnarFallback(field)
+                    out[c] = v
+                    continue
+                v = row.get(field)
+                if v is None:
+                    out[c] = -1
+                elif isinstance(v, int) and not isinstance(v, bool):
+                    # negatives would collide with the -1 NULL sentinel;
+                    # huge ints would lose exactness in float64 math
+                    if v < 0 or v >= _MAX_EXACT_INT:
+                        raise ColumnarFallback(field)
+                    out[c] = v
+                else:
+                    raise ColumnarFallback(field)
+        except Exception:
+            self.columnar_ok = False
+
+    def data_view(self) -> np.ndarray:
+        return self._data[self._start : self._end]
+
+    def column(self, c: int) -> np.ndarray:
+        return self._data[self._start : self._end, c]
+
+
+class _ColumnarData:
+    """Raw arrays behind a built window (the ``window.col`` namespace
+    the renderers/diagnostics fast paths read)."""
+
+    __slots__ = (
+        "ranks",
+        "steps",
+        "series_cube",
+        "averages",
+        "medians",
+        "occupancy",
+    )
+
+    def __init__(self, ranks, steps, series_cube, averages, medians, occupancy):
+        self.ranks: List[int] = ranks
+        self.steps: np.ndarray = steps  # (S,) int64 aligned step ids
+        self.series_cube: np.ndarray = series_cube  # (R, 11, S) ALL_KEYS order
+        self.averages: np.ndarray = averages  # (R, 11)
+        self.medians: np.ndarray = medians  # (R, 11)
+        self.occupancy: np.ndarray = occupancy  # (R,), NaN == None
+
+
+class _LazySeries(dict):
+    """``RankWindow.series`` stand-in: materializes a phase's list from
+    the cube on first access.  Consumers only use ``series[key]`` /
+    ``series.get``; iteration/equality materialize everything first so
+    the dict contract still holds."""
+
+    __slots__ = ("_cube",)
+
+    def __init__(self, cube_r: np.ndarray) -> None:
+        super().__init__()
+        self._cube = cube_r  # (11, S)
+
+    def __missing__(self, key: str) -> List[float]:
+        ki = KEY_INDEX.get(key)
+        if ki is None:
+            raise KeyError(key)
+        vals = self._cube[ki].tolist()
+        dict.__setitem__(self, key, vals)
+        return vals
+
+    def _materialize_all(self) -> None:
+        for k in ALL_KEYS:
+            self[k]
+
+    def get(self, key, default=None):
+        try:
+            return self[key]
+        except KeyError:
+            return default
+
+    def __contains__(self, key) -> bool:
+        return key in KEY_INDEX or dict.__contains__(self, key)
+
+    def __iter__(self):
+        self._materialize_all()
+        return dict.__iter__(self)
+
+    def __len__(self) -> int:
+        self._materialize_all()
+        return dict.__len__(self)
+
+    def keys(self):
+        self._materialize_all()
+        return dict.keys(self)
+
+    def values(self):
+        self._materialize_all()
+        return dict.values(self)
+
+    def items(self):
+        self._materialize_all()
+        return dict.items(self)
+
+    def __eq__(self, other):
+        self._materialize_all()
+        if isinstance(other, _LazySeries):
+            other._materialize_all()
+        return dict.__eq__(self, other)
+
+    def __ne__(self, other):
+        return not self.__eq__(other)
+
+    __hash__ = None
+
+
+class _LazyRankWindows(Mapping):
+    """``StepTimeWindow.rank_windows`` stand-in: builds each rank's
+    ``RankWindow`` from the cube on first access and caches it."""
+
+    def __init__(self, col: _ColumnarData, steps_list: List[int], clock: str) -> None:
+        self._col = col
+        self._steps = steps_list
+        self._clock = clock
+        self._index = {r: i for i, r in enumerate(col.ranks)}
+        self._cache: Dict[int, RankWindow] = {}
+
+    def __getitem__(self, rank: int) -> RankWindow:
+        w = self._cache.get(rank)
+        if w is None:
+            i = self._index[rank]
+            col = self._col
+            occ = float(col.occupancy[i])
+            w = RankWindow(
+                rank=rank,
+                steps=self._steps,
+                series=_LazySeries(col.series_cube[i]),
+                averages=dict(zip(ALL_KEYS, col.averages[i].tolist())),
+                medians=dict(zip(ALL_KEYS, col.medians[i].tolist())),
+                clock=self._clock,
+                occupancy=occ if occ == occ else None,
+            )
+            self._cache[rank] = w
+        return w
+
+    def __iter__(self):
+        return iter(self._col.ranks)
+
+    def __len__(self) -> int:
+        return len(self._col.ranks)
+
+
+class ColumnarStepTimeWindow(StepTimeWindow):
+    """A ``StepTimeWindow`` carrying its backing arrays in ``col``."""
+
+    def __init__(self, *, col: _ColumnarData, **kwargs) -> None:
+        super().__init__(**kwargs)
+        self.col = col
+
+    @property
+    def occupancy_by_rank(self) -> Dict[int, float]:
+        out: Dict[int, float] = {}
+        for r, v in zip(self.col.ranks, self.col.occupancy.tolist()):
+            if v == v:  # not NaN
+                out[r] = v
+        return out
+
+
+def build_columnar_step_time_window(
+    rank_cols: Mapping[int, StepTimeColumns],
+    max_steps: int,
+) -> Optional[ColumnarStepTimeWindow]:
+    """Vectorized ``build_step_time_window`` over per-rank columns.
+
+    Raises :class:`ColumnarFallback` if any non-empty rank is flagged.
+    """
+    items = [(r, c) for r, c in sorted(rank_cols.items(), key=lambda kv: kv[0]) if len(c)]
+    if not items:
+        return None
+    for _, c in items:
+        if not c.columnar_ok:
+            raise ColumnarFallback("flagged rank buffer")
+    ranks = [int(r) for r, _ in items]
+    R = len(items)
+
+    # 1. suffix alignment: steps present in EVERY rank, last max_steps.
+    # Per-rank step columns are strictly ascending and unique (flagged
+    # otherwise), so counts==R identifies the intersection.
+    step_views = [c.steps_view() for _, c in items]
+    if R == 1:
+        common = np.array(step_views[0][-max_steps:], dtype=np.int64)
+    else:
+        uniq, counts = np.unique(np.concatenate(step_views), return_counts=True)
+        common = uniq[counts == R][-max_steps:]
+    S = int(common.size)
+    if S == 0:
+        return None
+
+    # 2. clock selection: "device" only if EVERY buffered row (not just
+    # the aligned suffix — matching select_clock) is device-clocked
+    clock = "device" if all(c.clock_all_device() for _, c in items) else "host"
+
+    # 3. gather the aligned (rank, step, event, clock) values
+    cube_raw = np.empty((R, S, N_EVENTS, 2), dtype=np.float64)
+    occ_parts = np.empty((R, S, 2), dtype=np.float64)
+    for i, (_, c) in enumerate(items):
+        idx = np.searchsorted(c.steps_view(), common)
+        cube_raw[i] = c.vals_view()[idx]
+        occ_parts[i] = c.occ_view()[idx]
+
+    if clock == "device":
+        dev = cube_raw[..., 1]
+        cpu = cube_raw[..., 0]
+        sel = np.where(np.isnan(dev), cpu, dev)
+    else:
+        sel = cube_raw[..., 0]
+    sel = np.where(np.isnan(sel), 0.0, sel)  # missing -> 0.0, like the scalar `or 0.0`
+
+    # 4. residual clamp: any phase capped to the step envelope, then an
+    # explicit left-fold in PHASES order (exactly the scalar accumulation)
+    step = sel[:, :, 0]  # (R, S)
+    phases = sel[:, :, 1:]  # (R, S, 9)
+    clamped = np.where(
+        (step > 0)[:, :, None], np.minimum(phases, step[:, :, None]), phases
+    )
+    accounted = clamped[:, :, 0].copy()
+    for k in range(1, len(ACCOUNTED_PHASES)):
+        accounted += clamped[:, :, k]
+    residual = np.maximum(0.0, step - accounted)
+
+    series_cube = np.empty((R, len(ALL_KEYS), S), dtype=np.float64)
+    series_cube[:, 0] = step
+    series_cube[:, 1 : 1 + len(ACCOUNTED_PHASES)] = np.moveaxis(clamped, 2, 1)
+    series_cube[:, len(ALL_KEYS) - 1] = residual
+
+    # 5. per-rank stats: cumsum[-1] is the exact left-fold sum
+    averages = np.cumsum(series_cube, axis=2)[:, :, -1] / S
+    medians = np.median(series_cube, axis=2)
+
+    # 6. occupancy: fold the precomputed (device_busy, host) parts
+    num = np.where(np.isnan(occ_parts[:, :, 0]), 0.0, occ_parts[:, :, 0])
+    host = np.where(np.isnan(occ_parts[:, :, 1]), 0.0, occ_parts[:, :, 1])
+    num_sum = np.cumsum(num, axis=1)[:, -1]
+    host_sum = np.cumsum(host, axis=1)[:, -1]
+    with np.errstate(divide="ignore", invalid="ignore"):
+        occupancy = np.where(
+            host_sum > 0, np.minimum(num_sum / host_sum, 1.0), np.nan
+        )
+
+    # 7. cross-rank metrics (native floats throughout)
+    metrics: Dict[str, StepCombinedTimeMetric] = {}
+    avg_rows = averages.tolist()  # R x 11 native floats
+    for ki, key in enumerate(ALL_KEYS):
+        col_vals = [row[ki] for row in avg_rows]
+        med = float(np.median(averages[:, ki]))
+        wi = int(np.argmax(averages[:, ki]))  # first max == scalar max() tie-break
+        worst = col_vals[wi]
+        metrics[key] = StepCombinedTimeMetric(
+            key=key,
+            per_rank_avg_ms=dict(zip(ranks, col_vals)),
+            median_ms=med,
+            worst_ms=worst,
+            worst_rank=ranks[wi],
+            skew_pct=(worst - med) / med if med > 0 else 0.0,
+        )
+
+    phases_present = [
+        k
+        for j, k in enumerate(ACCOUNTED_PHASES)
+        if bool((series_cube[:, 1 + j, :] > 0).any())
+    ]
+
+    steps_list = common.tolist()
+    col = _ColumnarData(
+        ranks=ranks,
+        steps=common,
+        series_cube=series_cube,
+        averages=averages,
+        medians=medians,
+        occupancy=occupancy,
+    )
+    return ColumnarStepTimeWindow(
+        col=col,
+        clock=clock,
+        steps=steps_list,
+        ranks=list(ranks),
+        rank_windows=_LazyRankWindows(col, steps_list, clock),
+        metrics=metrics,
+        phases_present=phases_present,
+        n_steps=S,
+    )
+
+
+def window_to_plain(w: Optional[StepTimeWindow]) -> Optional[Dict[str, Any]]:
+    """Canonical plain-dict form of a window for golden comparisons
+    (dataclass ``__eq__`` is class-sensitive, so a scalar and a columnar
+    window never compare equal directly)."""
+    if w is None:
+        return None
+    return {
+        "clock": w.clock,
+        "steps": list(w.steps),
+        "ranks": list(w.ranks),
+        "n_steps": w.n_steps,
+        "phases_present": list(w.phases_present),
+        "metrics": {k: dataclasses.asdict(m) for k, m in w.metrics.items()},
+        "rank_windows": {
+            r: {
+                "rank": rw.rank,
+                "steps": list(rw.steps),
+                "series": {k: list(rw.series[k]) for k in ALL_KEYS},
+                "averages": dict(rw.averages),
+                "medians": dict(rw.medians),
+                "clock": rw.clock,
+                "occupancy": rw.occupancy,
+            }
+            for r, rw in w.rank_windows.items()
+        },
+    }
+
+
+class MemorySeries:
+    """One (rank, device) step-memory series, sorted by step — the
+    single representation every step-memory rule consumes, buildable
+    from row dicts (scalar reference) or from :class:`MemoryColumns`.
+
+    Values are float64 with NaN for NULL; both construction paths yield
+    bit-identical arrays for the same data (int64 -> float64 is exact
+    below 2**53, and MemoryColumns flags anything larger)."""
+
+    __slots__ = ("rank", "dev", "steps", "current", "peak", "step_peak", "limit")
+
+    def __init__(self, rank, dev, steps, current, peak, step_peak, limit):
+        # stable sort by (step or 0), matching the scalar context's
+        # rows.sort(key=lambda r: (r.get("step") or 0))
+        order = np.argsort(np.where(np.isnan(steps), 0.0, steps), kind="stable")
+        self.rank = rank
+        self.dev = dev
+        self.steps = steps[order]
+        self.current = current[order]
+        self.peak = peak[order]
+        self.step_peak = step_peak[order]
+        self.limit = limit[order]
+
+    @classmethod
+    def from_rows(cls, rank: int, dev: int, rows: List[Mapping[str, Any]]) -> "MemorySeries":
+        def col(key: str) -> np.ndarray:
+            return np.array(
+                [
+                    float(r[key]) if r.get(key) is not None else _NAN
+                    for r in rows
+                ],
+                dtype=np.float64,
+            )
+
+        return cls(
+            rank,
+            dev,
+            col("step"),
+            col("current_bytes"),
+            col("peak_bytes"),
+            col("step_peak_bytes"),
+            col("limit_bytes"),
+        )
+
+    @classmethod
+    def from_int_columns(
+        cls, rank: int, dev: int, data: np.ndarray
+    ) -> "MemorySeries":
+        """``data``: the (n, 6) int64 slice of a MemoryColumns buffer
+        already filtered to one device; -1 == NULL."""
+
+        def col(c: int) -> np.ndarray:
+            a = data[:, c].astype(np.float64)
+            a[data[:, c] == -1] = _NAN
+            return a
+
+        return cls(rank, dev, col(C_STEP), col(C_CUR), col(C_PEAK), col(C_SPEAK), col(C_LIM))
+
+    def __len__(self) -> int:
+        return int(self.steps.shape[0])
+
+    @staticmethod
+    def _opt(v: float) -> Optional[float]:
+        return None if v != v else v
+
+    def last_values(self):
+        """(step_peak, current, limit) of the final (sorted) row as
+        Optional floats — what the scalar rules read via rows[-1]."""
+        return (
+            self._opt(float(self.step_peak[-1])),
+            self._opt(float(self.current[-1])),
+            self._opt(float(self.limit[-1])),
+        )
+
+    def used_series(self) -> np.ndarray:
+        """Per-row ``step_peak or current or 0`` (NaN-aware truthiness,
+        so NULL and 0 both fall through, like the scalar `or` chain)."""
+        sp, cur = self.step_peak, self.current
+        sp_ok = ~np.isnan(sp) & (sp != 0)
+        cur_ok = ~np.isnan(cur) & (cur != 0)
+        return np.where(sp_ok, sp, np.where(cur_ok, cur, 0.0))
+
+    def latest_pressure(self) -> Optional[float]:
+        """used/limit of the newest row where both are truthy."""
+        used = self.used_series()
+        lim = self.limit
+        ok = (used != 0) & ~np.isnan(lim) & (lim != 0)
+        idx = np.flatnonzero(ok)
+        if idx.size == 0:
+            return None
+        i = int(idx[-1])
+        return float(used[i]) / float(lim[i])
+
+    def last_used(self) -> float:
+        sp, cur, _ = self.last_values()
+        return float(sp or cur or 0)
+
+    def current_list(self) -> List[float]:
+        """``float(current_bytes or 0)`` per row — the creep series."""
+        cur = self.current
+        return np.where(np.isnan(cur), 0.0, cur).tolist()

@@ -259,6 +259,8 @@ def build_step_time_metrics(rank_windows: Mapping[int, RankWindow]) -> Dict[str,
     for key in ALL_KEYS:
         per_rank = {r: w.averages.get(key, 0.0) for r, w in rank_windows.items()}
         vals = list(per_rank.values())
+        if not vals:  # empty-window early-out: never reach median([])
+            continue
         med = statistics.median(vals)
         worst_rank = max(per_rank, key=lambda r: per_rank[r])
         worst = per_rank[worst_rank]
