@@ -57,9 +57,19 @@ class TraceMLAggregator:
         self._stop_evt = threading.Event()
         self._finished_ranks: Set[int] = set()
         self._seen_ranks: Set[int] = set()
+        # _drain_lock now guards ONLY the frame handoff (server.drain +
+        # ticket issue); decode runs unlocked and ingest is ordered by
+        # ticket under _ingest_cond — see _drain_once
         self._drain_lock = threading.Lock()
+        self._ingest_cond = threading.Condition()
+        self._drain_ticket = 0
+        self._ingest_next = 0
         self._last_drain_frames = 0
         self._last_ui_tick = 0.0
+        self._last_stats_write = 0.0
+        # periodic ingest_stats.json cadence (instance attr so tests and
+        # embedders can tighten it)
+        self._stats_interval = 5.0
         self.envelopes_ingested = 0
         self.started = False
         self.port: Optional[int] = None
@@ -111,21 +121,10 @@ class TraceMLAggregator:
         if not ok:
             get_error_log().warning("sqlite finalize incomplete within budget")
         # self-metrics for the summary meta (reference parity: SQLite
-        # writer counters enqueued/dropped/written)
+        # writer counters enqueued/dropped/written, now with queue /
+        # group-commit / prune detail)
         try:
-            atomic_write_json(
-                self.settings.session_dir / "ingest_stats.json",
-                {
-                    "envelopes_ingested": self.envelopes_ingested,
-                    "frames_received": self.server.frames_received,
-                    "decode_errors": self.server.decode_errors,
-                    "rows_written": self.writer.written,
-                    "rows_enqueued": self.writer.enqueued,
-                    "rows_dropped": self.writer.dropped,
-                    "finished_ranks": sorted(self._finished_ranks),
-                    "ts": time.time(),
-                },
-            )
+            self._write_ingest_stats(final=True)
         except Exception as exc:
             get_error_log().warning("ingest stats write failed", exc)
         try:
@@ -143,27 +142,47 @@ class TraceMLAggregator:
 
     # -- ingest ----------------------------------------------------------
     def _drain_once(self, max_frames: Optional[int] = _DRAIN_BATCH_FRAMES) -> int:
+        # Three stages, pipelined across callers (aggregator loop and the
+        # summary-service thread via settle_telemetry):
+        #   1. frame handoff under _drain_lock (cheap list splice + a
+        #      monotonically increasing ticket),
+        #   2. msgpack decode with NO lock held — the expensive part, so
+        #      settle_telemetry never blocks behind another caller's
+        #      decode slice; concurrent slices decode in parallel,
+        #   3. ingest in ticket order under _ingest_cond, preserving the
+        #      seed's strict frame ordering into the writer queues.
         with self._drain_lock:
-            # drain() hands over raw frames; msgpack decode runs HERE on
-            # the aggregator thread, never on the TCP selector thread.
-            # Bounded batch: leftover frames stay queued in the server
-            # (the caller re-loops — see _drain_all / _loop).
             frames = self.server.drain(max_frames)
-            payloads = self.server.decode_frames(frames) if frames else []
+            ticket = self._drain_ticket
+            self._drain_ticket += 1
+        payloads: List[Any] = []
+        try:
+            if frames:
+                payloads = self.server.decode_frames(frames)
+        finally:
             n = 0
-            for p in payloads:
-                if is_control_message(p):
-                    self._handle_control(p)
-                    continue
-                env = normalize_telemetry_envelope(p)
-                if env is None:
-                    continue
-                self._seen_ranks.add(env.global_rank)
-                self.writer.ingest(env)
-                n += 1
-            self.envelopes_ingested += n
-            self._last_drain_frames = len(frames)
-            return n
+            with self._ingest_cond:
+                while ticket != self._ingest_next:
+                    self._ingest_cond.wait(1.0)
+                try:
+                    for p in payloads:
+                        if is_control_message(p):
+                            self._handle_control(p)
+                            continue
+                        env = normalize_telemetry_envelope(p)
+                        if env is None:
+                            continue
+                        self._seen_ranks.add(env.global_rank)
+                        self.writer.ingest(env)
+                        n += 1
+                    self.envelopes_ingested += n
+                    self._last_drain_frames = len(frames)
+                finally:
+                    # the ticket advances even when decode/ingest raised,
+                    # or every later caller would deadlock at the gate
+                    self._ingest_next += 1
+                    self._ingest_cond.notify_all()
+        return n
 
     def _drain_all(self) -> int:
         """Drain to empty in bounded slices (settle/shutdown path: no UI
@@ -172,6 +191,33 @@ class TraceMLAggregator:
         while self._last_drain_frames >= _DRAIN_BATCH_FRAMES:
             total += self._drain_once()
         return total
+
+    def _write_ingest_stats(self, final: bool = False) -> None:
+        """Self-metrics snapshot — written periodically from the loop
+        (every ``_stats_interval`` seconds) so a live observer sees
+        backpressure building, not just the post-mortem at stop()."""
+        wstats = self.writer.stats()
+        atomic_write_json(
+            self.settings.session_dir / "ingest_stats.json",
+            {
+                "envelopes_ingested": self.envelopes_ingested,
+                "frames_received": self.server.frames_received,
+                "decode_errors": self.server.decode_errors,
+                "pending_frames_hwm": self.server.pending_hwm,
+                "rows_written": self.writer.written,
+                "rows_enqueued": self.writer.enqueued,
+                "rows_dropped": self.writer.dropped,
+                "enqueued_by_domain": wstats["enqueued_by_domain"],
+                "dropped_by_domain": wstats["dropped_by_domain"],
+                "drop_warnings": wstats["drop_warnings"],
+                "queues": wstats["queues"],
+                "group_commit": wstats["group_commit"],
+                "prune": wstats["prune"],
+                "finished_ranks": sorted(self._finished_ranks),
+                "final": final,
+                "ts": time.time(),
+            },
+        )
 
     def _handle_control(self, payload: Dict[str, Any]) -> None:
         kind = control_kind(payload)
@@ -208,6 +254,14 @@ class TraceMLAggregator:
                             self.display.tick(self)
                         except Exception as exc:
                             get_error_log().warning("display tick failed", exc)
+                    if now - self._last_stats_write >= self._stats_interval:
+                        self._last_stats_write = now
+                        try:
+                            self._write_ingest_stats()
+                        except Exception as exc:
+                            get_error_log().warning(
+                                "periodic ingest stats write failed", exc
+                            )
                     if (
                         self._last_drain_frames < _DRAIN_BATCH_FRAMES
                         or self._stop_evt.is_set()

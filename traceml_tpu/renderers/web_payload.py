@@ -99,4 +99,23 @@ def build_web_payload(
     out["stdout"] = [
         {"stream": s, "line": l} for s, l in (payload.get("stdout") or [])
     ]
+    # aggregator self-metrics for the dashboard meta strip: backpressure
+    # (queue depth/hwm, per-domain sheds) and writer latency live, not
+    # just in the post-run summary
+    try:
+        from traceml_tpu.reporting.loaders import load_ingest_stats
+
+        stats = load_ingest_stats(Path(db_path).parent)
+        if stats:
+            out["ingest"] = {
+                k: stats[k]
+                for k in (
+                    "envelopes_ingested", "rows_dropped", "drop_warnings",
+                    "dropped_by_domain", "queues", "group_commit", "prune",
+                    "pending_frames_hwm", "ts",
+                )
+                if k in stats
+            }
+    except Exception:
+        pass
     return out

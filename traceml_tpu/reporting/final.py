@@ -840,7 +840,9 @@ def generate_summary(
             k: stats[k]
             for k in (
                 "envelopes_ingested", "frames_received", "decode_errors",
-                "rows_written", "rows_dropped",
+                "rows_written", "rows_dropped", "dropped_by_domain",
+                "drop_warnings", "pending_frames_hwm", "queues",
+                "group_commit", "prune",
             )
             if k in stats
         }

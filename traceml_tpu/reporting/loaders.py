@@ -327,3 +327,31 @@ def load_stdout_tail(
             (int(n),),
         ).fetchall()
     return [(r["stream"], r["line"]) for r in reversed(rows)]
+
+
+# ingest_stats.json is rewritten atomically every few seconds by the
+# aggregator loop; cache on (mtime, size) so live pollers don't re-parse
+# an unchanged file every tick.
+_INGEST_STATS_CACHE: Dict[str, Tuple[Tuple[float, int], Dict[str, Any]]] = {}
+
+
+def load_ingest_stats(session_dir: Path) -> Dict[str, Any]:
+    """Aggregator self-metrics (queue depths/HWMs, per-domain shed
+    counts, group-commit and prune latency) from ``ingest_stats.json``.
+    Returns ``{}`` when the file is missing or unreadable."""
+    from traceml_tpu.utils.atomic_io import read_json
+
+    path = Path(session_dir) / "ingest_stats.json"
+    try:
+        st = path.stat()
+    except OSError:
+        return {}
+    stamp = (st.st_mtime, st.st_size)
+    cached = _INGEST_STATS_CACHE.get(str(path))
+    if cached is not None and cached[0] == stamp:
+        return cached[1]
+    data = read_json(path)
+    if not isinstance(data, dict):
+        return {}
+    _INGEST_STATS_CACHE[str(path)] = (stamp, data)
+    return data

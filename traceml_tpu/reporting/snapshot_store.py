@@ -24,14 +24,19 @@ diagnostics and makes the tick cost proportional to *what changed*:
   versions let callers (``LiveComputer``) dirty-gate window
   construction and diagnosis instead of blind TTL caching.
 
-Retention interaction: the writer's periodic trim (``DELETE`` of old
-rows per ``(session_id, global_rank)`` partition,
-``aggregator/sqlite_writer.py``) only ever removes ids *below* every
-cursor, so cursors survive trims.  Trims are detected by watching the
-table's global ``MIN(id)``; on movement the deques evict in lockstep
-against per-rank minima (the trim is per-rank partitioned, so a global
-minimum alone would resurrect one rank's trimmed rows behind another
-rank's surviving ones).
+Retention interaction: the writer's watermark prune (``DELETE`` of one
+``(session_id, global_rank)`` partition's overflow below an indexed
+watermark id, ``aggregator/sqlite_writer.py``) only ever removes ids
+*below* every cursor, so cursors survive trims.  Trims are detected by
+reading the writer's ``retention_watermarks`` journal incrementally
+(one cursor query per refresh): each journal row names exactly which
+``(table, global_rank)`` partition was trimmed and the watermark id it
+was trimmed below, so the deques evict precisely the rows SQLite
+deleted — per-partition deletes do not move the global ``MIN(id)``,
+which is why the journal replaced the old MIN-movement detection.
+Legacy DBs without the journal (sessions recorded before the watermark
+writer) fall back to the MIN-movement + per-rank ``GROUP BY`` minima
+path.
 
 Contract note: accumulated identity sets (topology) never shrink on
 trim — a rank observed once stays in ``ranks_seen`` even if all its
@@ -113,6 +118,24 @@ class _RankBuffer:
             self.rows.popleft()
             changed = True
         return changed
+
+    def filter_watermarks(self, watermarks: Dict[int, int]) -> bool:
+        """Drop every held row at or below its rank's trim watermark
+        (journal mode).  Ranks without a journal entry keep all rows —
+        the writer only journals partitions it actually pruned."""
+        keep = [
+            (i, rk, rw)
+            for i, rk, rw in zip(self.ids, self.ranks, self.rows)
+            if rk not in watermarks or i > watermarks[rk]
+        ]
+        if len(keep) == len(self.ids):
+            return False
+        self.ids.clear()
+        self.ranks.clear()
+        self.rows.clear()
+        for i, rk, rw in keep:
+            self.append(i, rk, rw)
+        return True
 
     def filter_trimmed(self, per_rank_min: Dict[int, int]) -> bool:
         """Drop every held row the writer's PER-RANK retention trim
@@ -253,6 +276,10 @@ class LiveSnapshotStore:
         self._cursors: Dict[str, int] = {}
         self._min_seen: Dict[str, Optional[int]] = {}
         self._tables_seen: set = set()
+        # journal mode: table → {rank: trim watermark id} accumulated
+        # from retention_watermarks rows, consumed by each table reader
+        self._journal_mode = False
+        self._pending_trims: Dict[str, Dict[int, int]] = {}
 
         # step_time / step_memory: per-rank bounded windows (row deque
         # + columnar ring per rank, kept in lockstep)
@@ -352,6 +379,11 @@ class LiveSnapshotStore:
             if self._primed and db_dv == self._last_db_dv:
                 return False
 
+            try:
+                self._journal_mode = self._read_watermark_journal(conn)
+            except sqlite3.Error:
+                self._journal_mode = False
+
             dirty: set = set()
             clean_scan = True
             readers = (
@@ -405,8 +437,84 @@ class LiveSnapshotStore:
                 max(r["id"] for r in rows), self._cursors.get(table, 0)
             )
 
+    def _read_watermark_journal(self, conn: sqlite3.Connection) -> bool:
+        """Incremental read of the writer's ``retention_watermarks``
+        journal (one cursor query per non-idle refresh).  Returns True
+        when the journal exists — per-rank watermark detection replaces
+        the MIN-movement heuristic entirely, including its per-table
+        ``MIN(id)`` query and the trim-event ``GROUP BY`` aggregate.
+
+        Accumulated watermarks persist in ``_pending_trims`` until the
+        owning table's reader consumes them, so a journal row observed
+        while that reader errors (busy/locked) is applied on the retry
+        refresh rather than lost.  Applying a watermark is always safe:
+        it only evicts rows the writer committed deleting before it
+        journaled the trim (same transaction).
+        """
+        if not self._table_exists(conn, "retention_watermarks"):
+            return False
+        cur = self._cursors.get("retention_watermarks", 0)
+        rows = conn.execute(
+            "SELECT id, table_name, global_rank, watermark_id"
+            " FROM retention_watermarks WHERE id > ? ORDER BY id",
+            (cur,),
+        ).fetchall()
+        for r in rows:
+            trims = self._pending_trims.setdefault(str(r["table_name"]), {})
+            rank = int(r["global_rank"])
+            wm = int(r["watermark_id"])
+            if wm > trims.get(rank, -1):
+                trims[rank] = wm
+        self._advance_cursor("retention_watermarks", rows)
+        return True
+
+    def _begin_trim_check(
+        self, conn: sqlite3.Connection, table: str
+    ) -> bool:
+        """Legacy-mode trim pre-check (global ``MIN(id)`` movement).
+        In journal mode this is a no-op — the journal already told us
+        exactly which partitions trimmed."""
+        if self._journal_mode:
+            return False
+        return self._observe_min(conn, table)
+
+    def _apply_trims(
+        self,
+        conn: sqlite3.Connection,
+        table: str,
+        legacy_trimmed: bool,
+        rank_bufs: Optional[Dict[int, "_RankBuffer"]] = None,
+        flat_bufs: Tuple["_RankBuffer", ...] = (),
+    ) -> bool:
+        """Evict exactly the rows the writer's retention prune deleted.
+
+        Journal mode: each pending watermark names its partition — rank
+        buffers prefix-evict below ``watermark + 1``, mixed-rank buffers
+        filter per (rank, id).  Legacy mode falls back to the per-rank
+        ``GROUP BY`` minima reconciliation.
+        """
+        if self._journal_mode:
+            watermarks = self._pending_trims.pop(table, None)
+            if not watermarks:
+                return False
+            changed = False
+            if rank_bufs is not None:
+                for rank, wm in watermarks.items():
+                    buf = rank_bufs.get(rank)
+                    if buf is not None:
+                        changed |= buf.evict_below(wm + 1)
+            for buf in flat_bufs:
+                changed |= buf.filter_watermarks(watermarks)
+            return changed
+        if not legacy_trimmed:
+            return False
+        return self._reconcile_trim(
+            conn, table, rank_bufs=rank_bufs, flat_bufs=flat_bufs
+        )
+
     def _observe_min(self, conn: sqlite3.Connection, table: str) -> bool:
-        """Record the table's current ``MIN(id)`` and report whether a
+        """LEGACY detection (DBs recorded before the watermark journal):
+        record the table's current ``MIN(id)`` and report whether a
         retention trim happened since the last refresh (the minimum
         moved forward, or the table emptied while we hold rows).
 
@@ -466,7 +574,7 @@ class LiveSnapshotStore:
     # -- per-table readers ----------------------------------------------
 
     def _read_step_time(self, conn, table, dirty) -> bool:
-        trimmed = self._observe_min(conn, table)
+        trimmed = self._begin_trim_check(conn, table)
         cur = self._cursors.get(table, 0)
         rows = conn.execute(
             "SELECT id, global_rank, node_rank, hostname, world_size,"
@@ -500,13 +608,13 @@ class LiveSnapshotStore:
                 },
             )
         self._advance_cursor(table, rows)
-        evicted = trimmed and self._reconcile_trim(
-            conn, table, rank_bufs=self._step_time
+        evicted = self._apply_trims(
+            conn, table, trimmed, rank_bufs=self._step_time
         )
         return bool(rows) or evicted
 
     def _read_step_memory(self, conn, table, dirty) -> bool:
-        trimmed = self._observe_min(conn, table)
+        trimmed = self._begin_trim_check(conn, table)
         cur = self._cursors.get(table, 0)
         rows = conn.execute(
             "SELECT id, global_rank, step, timestamp, device_id, device_kind,"
@@ -525,13 +633,13 @@ class LiveSnapshotStore:
             del row["id"], row["global_rank"]
             buf.append(r["id"], rank, row)
         self._advance_cursor(table, rows)
-        evicted = trimmed and self._reconcile_trim(
-            conn, table, rank_bufs=self._step_memory
+        evicted = self._apply_trims(
+            conn, table, trimmed, rank_bufs=self._step_memory
         )
         return bool(rows) or evicted
 
     def _read_keyed(self, conn, table, buf, key_fn, topo_source=None, dirty=None):
-        trimmed = self._observe_min(conn, table)
+        trimmed = self._begin_trim_check(conn, table)
         cur = self._cursors.get(table, 0)
         rows = conn.execute(
             f"SELECT * FROM {table} WHERE id > ? ORDER BY id", (cur,)
@@ -545,7 +653,7 @@ class LiveSnapshotStore:
                     dirty.add("topology")
             buf.append(r["id"], int(r["global_rank"]), (key_fn(r), dict(r)))
         self._advance_cursor(table, rows)
-        evicted = trimmed and self._reconcile_trim(conn, table, flat_bufs=(buf,))
+        evicted = self._apply_trims(conn, table, trimmed, flat_bufs=(buf,))
         return bool(rows) or evicted
 
     def _read_system_host(self, conn, table, dirty) -> bool:
@@ -575,7 +683,7 @@ class LiveSnapshotStore:
         )
 
     def _read_stdout(self, conn, table, dirty) -> bool:
-        trimmed = self._observe_min(conn, table)
+        trimmed = self._begin_trim_check(conn, table)
         cur = self._cursors.get(table, 0)
         rows = conn.execute(
             f"SELECT id, global_rank, stream, line FROM {table}"
@@ -587,8 +695,8 @@ class LiveSnapshotStore:
                 r["id"], int(r["global_rank"]), (r["stream"], r["line"])
             )
         self._advance_cursor(table, rows)
-        evicted = trimmed and self._reconcile_trim(
-            conn, table, flat_bufs=(self._stdout,)
+        evicted = self._apply_trims(
+            conn, table, trimmed, flat_bufs=(self._stdout,)
         )
         return bool(rows) or evicted
 
@@ -611,7 +719,7 @@ class LiveSnapshotStore:
         return ", ".join(self._model_stats_cols)
 
     def _read_model_stats(self, conn, table, dirty) -> bool:
-        trimmed = self._observe_min(conn, table)
+        trimmed = self._begin_trim_check(conn, table)
         cur = self._cursors.get(table, 0)
         rows = conn.execute(
             f"SELECT id, {self._model_stats_select(conn, table)}"
@@ -621,8 +729,8 @@ class LiveSnapshotStore:
         for r in rows:
             self._model_stats.append(r["id"], int(r["global_rank"]), dict(r))
         self._advance_cursor(table, rows)
-        evicted = trimmed and self._reconcile_trim(
-            conn, table, flat_bufs=(self._model_stats,)
+        evicted = self._apply_trims(
+            conn, table, trimmed, flat_bufs=(self._model_stats,)
         )
         return bool(rows) or evicted
 

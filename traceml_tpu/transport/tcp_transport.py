@@ -126,6 +126,9 @@ class TCPServer:
         self.port: Optional[int] = None
         self.frames_received = 0
         self.decode_errors = 0
+        # deepest the undrained-frame buffer ever got: a proxy for how
+        # far the consumer fell behind the selector thread
+        self.pending_hwm = 0
 
     # -- lifecycle -----------------------------------------------------
     def start(self) -> None:
@@ -310,6 +313,8 @@ class TCPServer:
         self.frames_received += len(frames)
         with self._lock:
             self._pending.extend(frames)
+            if len(self._pending) > self.pending_hwm:
+                self.pending_hwm = len(self._pending)
         self._data_event.set()
 
 
