@@ -1,0 +1,318 @@
+"""Zero-copy producer path (r10): columnar accumulators, single-encode
+publish, v2 backup frames, idle gate, producer self-observability.
+
+The contract under test throughout: the fast path must be **golden
+identical** to the pre-accumulator path — same wire envelopes (modulo
+timestamp), same backup rows — under bursts, eviction, and resets.
+"""
+
+import struct
+from pathlib import Path
+
+import pytest
+
+from traceml_tpu.database.database import Database
+from traceml_tpu.database.database_sender import DBIncrementalSender
+from traceml_tpu.database.database_writer import (
+    ENVELOPE_FILE,
+    V2_MAGIC,
+    DatabaseWriter,
+    iter_backup_file,
+    iter_backup_tables,
+)
+from traceml_tpu.telemetry.envelope import (
+    SenderIdentity,
+    build_columnar_envelope,
+    columns_to_rows,
+    rows_to_columns,
+)
+from traceml_tpu.utils import msgpack_codec
+
+_LEN = struct.Struct(">I")
+
+
+def _strip_ts(wire):
+    wire = dict(wire)
+    meta = dict(wire["meta"])
+    meta.pop("timestamp", None)
+    wire["meta"] = meta
+    return wire
+
+
+def _seed_wire(sampler, tables):
+    """What the pre-r10 sender shipped for the same batch of rows."""
+    return _strip_ts(build_columnar_envelope(sampler, tables).to_wire())
+
+
+# -- columnar accumulator golden equivalence ----------------------------
+
+
+def test_fast_path_matches_seed_wire():
+    db = Database(max_rows_per_table=100)
+    s = DBIncrementalSender("samp", db)
+    rows = [{"a": i, "b": i * 2} for i in range(5)] + [{"a": 99, "c": "x"}]
+    for r in rows:
+        db.add_record("t", r)
+    assert s.dirty()
+    assert _strip_ts(s.collect_payload()) == _seed_wire("samp", {"t": rows})
+    assert not s.dirty()
+    assert s.collect_payload() is None  # idle: nothing new
+
+
+def test_fast_path_nested_soa_columns():
+    # dict-valued cells with uniform keys hit the nested-SoA encoding on
+    # both paths — the accumulated columns must encode identically
+    db = Database(max_rows_per_table=100)
+    s = DBIncrementalSender("samp", db)
+    rows = [
+        {"step": i, "events": {"fwd": {"ms": 1.0 * i}, "bwd": {"ms": 2.0 * i}}}
+        for i in range(4)
+    ]
+    db.add_records("t", rows)
+    assert _strip_ts(s.collect_payload()) == _seed_wire("samp", {"t": rows})
+
+
+@pytest.mark.parametrize(
+    "windows",
+    [
+        # pure-tail windows smaller than the drain chunk, repeated so
+        # pend_shape persistence across collection resets is exercised
+        pytest.param(
+            [[{"a": i, "b": {"x": i, "y": i * 2}} for i in range(5)]] * 4,
+            id="sub-chunk-windows",
+        ),
+        # windows straddling multiple chunk boundaries
+        pytest.param(
+            [[{"a": i, "b": {"x": i, "y": i * 2}} for i in range(35)]] * 2,
+            id="multi-chunk-windows",
+        ),
+        # shape drift while rows sit in the tail buffer
+        pytest.param(
+            [[{"a": 1, "b": 2}] * 10 + [{"a": 1}] * 3 + [{"a": 1, "b": 2}] * 7],
+            id="drift-mid-tail",
+        ),
+        # nested-SoA degradation mid-window (key-set change, then scalar)
+        pytest.param(
+            [[{"a": {"x": 1, "y": 2}}] * 20 + [{"a": {"x": 1}}] * 5 + [{"a": 3}] * 4],
+            id="nested-degradation",
+        ),
+        # same key set, different insertion order → general path
+        pytest.param(
+            [[{"a": 1, "b": 2}] * 5 + [{"b": 2, "a": 1}] * 5],
+            id="reordered-keys",
+        ),
+        # empty dict rows (no columns, count only), then keyed rows
+        pytest.param([[{}] * 3 + [{"a": 1}] * 3], id="empty-then-keyed"),
+        # chunk-aligned window, then a one-row window straight into the
+        # tail of a freshly reset (but shape-retaining) accumulator
+        pytest.param(
+            [
+                [{"a": i, "n": {"p": 1, "q": 2}} for i in range(32)],
+                [{"a": 9, "n": {"p": 3, "q": 4}}],
+            ],
+            id="chunk-aligned-then-single",
+        ),
+    ],
+)
+def test_chunked_tail_windows_match_seed_wire(windows):
+    # the tail buffer + chunked transpose must stay golden-identical to
+    # the batch path for every window shape, including partial chunks
+    db = Database(max_rows_per_table=100)
+    s = DBIncrementalSender("samp", db)
+    for rows in windows:
+        db.add_records("t", rows)
+        assert _strip_ts(s.collect_payload()) == _seed_wire(
+            "samp", {"t": rows}
+        )
+
+
+def test_overflow_falls_back_to_row_deque_golden():
+    # burst past retention between collects: the accumulator can no
+    # longer represent the window; the fallback must ship exactly the
+    # surviving rows, like the pre-r10 path
+    db = Database(max_rows_per_table=10)
+    s = DBIncrementalSender("samp", db)
+    for i in range(25):
+        db.add_record("t", {"i": i})
+    survivors = [{"i": i} for i in range(15, 25)]
+    assert _strip_ts(s.collect_payload()) == _seed_wire("samp", {"t": survivors})
+    # accumulator recovers after the fallback collection
+    db.add_record("t", {"i": 100})
+    assert _strip_ts(s.collect_payload()) == _seed_wire("samp", {"t": [{"i": 100}]})
+
+
+def test_reset_reships_via_fallback():
+    db = Database(max_rows_per_table=100)
+    s = DBIncrementalSender("samp", db)
+    rows = [{"i": i} for i in range(6)]
+    db.add_records("t", rows)
+    s.collect_payload()
+    s.reset()  # cursor no longer matches the accumulator's → fallback
+    assert s.dirty()
+    assert _strip_ts(s.collect_payload()) == _seed_wire("samp", {"t": rows})
+
+
+def test_interleaved_tables_and_incremental_batches():
+    db = Database(max_rows_per_table=100)
+    s = DBIncrementalSender("samp", db)
+    db.add_record("a", {"x": 1})
+    db.add_record("b", {"y": 1})
+    p = _strip_ts(s.collect_payload())
+    assert p == _seed_wire("samp", {"a": [{"x": 1}], "b": [{"y": 1}]})
+    db.add_record("a", {"x": 2, "z": 3})
+    p = _strip_ts(s.collect_payload())
+    assert p == _seed_wire("samp", {"a": [{"x": 2, "z": 3}]})
+
+
+def test_dirty_is_cheap_and_exact():
+    db = Database()
+    s = DBIncrementalSender("samp", db)
+    assert not s.dirty()
+    db.add_record("t", {"i": 0})
+    assert s.dirty()
+    s.collect_payload()
+    assert not s.dirty()
+
+
+# -- single-encode batch splice -----------------------------------------
+
+
+def test_encode_batch_splice_matches_whole_list_encode():
+    env = build_columnar_envelope(
+        "samp", {"t": [{"i": i, "v": "x" * i} for i in range(20)]}
+    ).to_wire()
+    enc = msgpack_codec.preencode(env)
+    plain = {"_traceml_control": "rank_finished", "meta": {"rank": 0}}
+    assert msgpack_codec.encode_batch([enc, plain]) == msgpack_codec.encode(
+        [env, plain]
+    )
+    # and the standalone body matches encode() of the object
+    assert enc.body() == msgpack_codec.encode(env)
+
+
+def test_encode_batch_large_array_headers():
+    objs = [{"i": i} for i in range(300)]  # > fixarray and > 0xFF
+    encs = [msgpack_codec.preencode(o) for o in objs]
+    assert msgpack_codec.encode_batch(encs) == msgpack_codec.encode(objs)
+
+
+# -- backup format v2 ----------------------------------------------------
+
+
+def _mk_envelope(rows, sampler="samp", table="t"):
+    env = build_columnar_envelope(sampler, {table: rows}).to_wire()
+    return msgpack_codec.preencode(env)
+
+
+def _wire_rows(rows):
+    """Rows as a columnar consumer materializes them (absent → None)."""
+    return columns_to_rows(rows_to_columns(rows))
+
+
+def test_v2_backup_roundtrip(tmp_path):
+    db = Database()
+    w = DatabaseWriter("samp", db, tmp_path, flush_every=1)
+    rows = [{"a": i} for i in range(4)] + [{"a": 9, "b": "x"}]
+    w.append_envelope(_mk_envelope(rows))
+    assert w.envelope_mode and w.has_pending()
+    assert w.flush(force=True) == 1
+    assert not w.has_pending()
+    f = tmp_path / "samp" / ENVELOPE_FILE
+    got = list(iter_backup_tables(f))
+    assert [t for t, _ in got] == ["t"] * 5
+    assert [r for _, r in got] == _wire_rows(rows)
+    assert list(iter_backup_file(f)) == _wire_rows(rows)
+
+
+def test_v2_backup_multiple_tables_per_frame(tmp_path):
+    db = Database()
+    w = DatabaseWriter("samp", db, tmp_path, flush_every=1)
+    env = build_columnar_envelope(
+        "samp", {"a": [{"x": 1}], "b": [{"y": 2}, {"y": 3}]}
+    ).to_wire()
+    w.append_envelope(msgpack_codec.preencode(env))
+    w.flush(force=True)
+    got = list(iter_backup_tables(tmp_path / "samp" / ENVELOPE_FILE))
+    assert got == [("a", {"x": 1}), ("b", {"y": 2}), ("b", {"y": 3})]
+
+
+def test_v1_backup_still_readable(tmp_path):
+    # legacy writer (never fed envelopes) keeps the per-row format
+    db = Database()
+    w = DatabaseWriter("s", db, tmp_path, flush_every=1)
+    db.add_records("t", [{"i": 0}, {"i": 1}])
+    assert not w.envelope_mode
+    assert w.flush(force=True) == 2
+    f = tmp_path / "s" / "t.msgpack"
+    assert list(iter_backup_file(f)) == [{"i": 0}, {"i": 1}]
+    assert list(iter_backup_tables(f)) == [(None, {"i": 0}), (None, {"i": 1})]
+
+
+def test_mixed_v1_v2_frames_one_file(tmp_path):
+    f = tmp_path / "mixed.msgpack"
+    buf = bytearray()
+    for r in ({"i": 0}, {"i": 1}):
+        frame = msgpack_codec.encode(r)
+        buf += _LEN.pack(len(frame)) + frame
+    enc = _mk_envelope([{"a": 1}, {"a": 2}])
+    buf += V2_MAGIC + _LEN.pack(len(enc.body())) + enc.body()
+    frame = msgpack_codec.encode({"i": 2})
+    buf += _LEN.pack(len(frame)) + frame
+    f.write_bytes(bytes(buf))
+    assert list(iter_backup_tables(f)) == [
+        (None, {"i": 0}),
+        (None, {"i": 1}),
+        ("t", {"a": 1}),
+        ("t", {"a": 2}),
+        (None, {"i": 2}),
+    ]
+
+
+@pytest.mark.parametrize("cut", ["magic", "length", "body"])
+def test_v2_torn_tail_stops_cleanly(tmp_path, cut):
+    f = tmp_path / "envelopes.msgpack"
+    enc = _mk_envelope([{"a": 1}])
+    good = V2_MAGIC + _LEN.pack(len(enc.body())) + enc.body()
+    torn = {
+        "magic": V2_MAGIC[:2],
+        "length": V2_MAGIC + _LEN.pack(len(enc.body()))[:3],
+        "body": V2_MAGIC + _LEN.pack(len(enc.body())) + enc.body()[:5],
+    }[cut]
+    f.write_bytes(good + torn)
+    assert list(iter_backup_tables(f)) == [("t", {"a": 1})]
+
+
+def test_v1_torn_tail_stops_cleanly(tmp_path):
+    f = tmp_path / "t.msgpack"
+    frame = msgpack_codec.encode({"i": 0})
+    f.write_bytes(_LEN.pack(len(frame)) + frame + _LEN.pack(99) + b"\x01par")
+    assert list(iter_backup_file(f)) == [{"i": 0}]
+
+
+def test_v2_magic_stops_v1_corrupt_length_bound(tmp_path):
+    # the magic deliberately parses as a >64MiB length for old readers;
+    # the new reader's own corrupt-length bound must still hold for
+    # genuinely corrupt v2 lengths
+    f = tmp_path / "envelopes.msgpack"
+    f.write_bytes(V2_MAGIC + _LEN.pack(200 * 1024 * 1024) + b"junk")
+    assert list(iter_backup_tables(f)) == []
+
+
+def test_writer_hwm_flushes_midburst(tmp_path):
+    db = Database()
+    w = DatabaseWriter("samp", db, tmp_path, flush_every=10**9)
+    big = [{"i": i, "pad": "x" * 1000} for i in range(700)]  # ~0.7MB encoded
+    w.append_envelope(_mk_envelope(big))
+    # the 512KiB high-water mark wrote the buffer despite the throttle
+    assert not w.has_pending()
+    assert (tmp_path / "samp" / ENVELOPE_FILE).exists()
+
+
+def test_writer_failed_write_keeps_buffer(tmp_path):
+    db = Database()
+    blocked = tmp_path / "nope"
+    blocked.write_text("file, not a dir")  # mkdir(parents) will fail
+    w = DatabaseWriter("samp", db, blocked, flush_every=1)
+    w.append_envelope(_mk_envelope([{"i": 1}]))
+    assert w.flush(force=True) == 0
+    assert w.has_pending()  # frames retained for the next attempt

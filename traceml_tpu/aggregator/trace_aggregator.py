@@ -23,7 +23,12 @@ from traceml_tpu.aggregator.sqlite_writer import SQLiteWriter
 from traceml_tpu.aggregator.summary_service import FinalSummaryService
 from traceml_tpu.runtime.settings import TraceMLSettings
 from traceml_tpu.sdk import protocol
-from traceml_tpu.telemetry.control import RANK_FINISHED, control_kind, is_control_message
+from traceml_tpu.telemetry.control import (
+    PRODUCER_STATS,
+    RANK_FINISHED,
+    control_kind,
+    is_control_message,
+)
 from traceml_tpu.telemetry.envelope import TelemetryEnvelope, normalize_telemetry_envelope
 from traceml_tpu.transport.tcp_transport import TCPServer
 from traceml_tpu.utils.atomic_io import atomic_write_json
@@ -57,6 +62,9 @@ class TraceMLAggregator:
         self._stop_evt = threading.Event()
         self._finished_ranks: Set[int] = set()
         self._seen_ranks: Set[int] = set()
+        # latest producer_stats snapshot per rank (publisher self-
+        # observability: collect/encode/flush cost, idle-tick ratio)
+        self._producer_stats: Dict[int, Dict[str, Any]] = {}
         # _drain_lock now guards ONLY the frame handoff (server.drain +
         # ticket issue); decode runs unlocked and ingest is ordered by
         # ticket under _ingest_cond — see _drain_once
@@ -214,6 +222,10 @@ class TraceMLAggregator:
                 "group_commit": wstats["group_commit"],
                 "prune": wstats["prune"],
                 "finished_ranks": sorted(self._finished_ranks),
+                "producers": {
+                    str(rank): stats
+                    for rank, stats in sorted(self._producer_stats.items())
+                },
                 "final": final,
                 "ts": time.time(),
             },
@@ -235,6 +247,17 @@ class TraceMLAggregator:
                 )
                 return
             self._finished_ranks.add(rank)
+        elif kind == PRODUCER_STATS:
+            meta = payload.get("meta") or {}
+            stats = payload.get("stats")
+            if not isinstance(stats, dict):
+                return
+            try:
+                rank = int(meta.get("global_rank", meta.get("rank")))
+            except (TypeError, ValueError):
+                return
+            # later snapshots are cumulative — keep only the latest
+            self._producer_stats[rank] = stats
 
     # -- loop ------------------------------------------------------------
     def _loop(self) -> None:

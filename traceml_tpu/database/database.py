@@ -5,6 +5,21 @@ Each sampler owns one ``Database``: a dict of named tables, each a
 ``deque(maxlen=N)`` of row dicts plus a **monotonic append counter** so an
 incremental sender can detect new rows in O(1) without scanning
 (rows may have been evicted from the left; the counter never decreases).
+
+Producer fast path (r10): every table also keeps a **columnar append
+accumulator** — a struct-of-arrays of the rows appended since the last
+collection, built in lockstep with the row deque and the append counter.
+Rows matching the window's shape are buffered and transposed in chunks
+of ``_PEND_CHUNK`` (C-level listcomps beat a python-level per-row
+scatter by roughly an order of magnitude), so ``add_record`` stays
+near deque-append cost and ``collect_wire_tables`` hands wire-ready
+columns to the incremental sender under one lock sweep — a publish tick
+never re-transposes row dicts.  The accumulator is an optimization,
+never a source of truth: any condition it cannot represent exactly (a
+pending window larger than the retention bound, a consumer cursor that
+does not match the accumulator's) falls back to the row deque, which
+keeps the collected batch byte-identical to the pre-accumulator path
+(see docs/developer_guide/rank-producer-path.md).
 """
 
 from __future__ import annotations
@@ -12,17 +27,291 @@ from __future__ import annotations
 import threading
 from collections import deque
 from itertools import islice
-from typing import Any, Deque, Dict, List, Optional
+from typing import Any, Deque, Dict, List, Optional, Tuple, Union
+
+from traceml_tpu.telemetry.envelope import SOA_KEY
 
 DEFAULT_MAX_ROWS = 3000
 
+# Rows buffered in a table's pend_tail before a chunked transpose.  Small
+# enough that a publish-tick drain of the residue is trivial; large enough
+# that the C-level ``zip`` amortizes the per-chunk python overhead.
+_PEND_CHUNK = 16
+
+
+class _SoaCol:
+    """Incremental nested struct-of-arrays accumulator for one pending
+    column whose cells — so far — are dicts with an identical key set.
+
+    Mirrors ``envelope._encode_cells`` decision-for-decision, but spread
+    across appends instead of re-scanning the batch every publish tick:
+    a cell that breaks uniformity (non-dict, different key set, or a
+    ``None`` pad for a row missing the column) degrades the node back to
+    a plain cell list via :meth:`materialize`, which is exactly what the
+    batch encoder would have chosen for that window.  Children recurse
+    independently, like the batch encoder's per-subcolumn recursion.
+    """
+
+    __slots__ = ("keys", "keyset", "sub", "n")
+
+    def __init__(self, keys: Tuple[str, ...], sub: List[Any], n: int) -> None:
+        self.keys = keys
+        self.keyset = set(keys)
+        self.sub = sub
+        self.n = n
+
+    def add(self, v: Any) -> bool:
+        """Append one cell; False when ``v`` breaks uniformity (the
+        caller must materialize and go plain)."""
+        if not isinstance(v, dict) or v.keys() != self.keyset:
+            return False
+        sub = self.sub
+        for j, k in enumerate(self.keys):
+            child = sub[j]
+            cv = v[k]
+            if type(child) is list:
+                child.append(cv)
+            elif not child.add(cv):
+                plain = child.materialize()
+                plain.append(cv)
+                sub[j] = plain
+        self.n += 1
+        return True
+
+    def bulk(self, cells) -> Optional[List[Any]]:
+        """Append many cells at once; ``None`` on success, else the
+        plain-cell list the caller must swap in for this column (the
+        cells are consumed either way).
+
+        The fast branch requires every cell to be a dict with exactly
+        this node's key set (``dict_keys == set`` is a C-level,
+        allocation-free compare), then transposes one key at a time with
+        a listcomp of dict lookups — order-insensitive and all C-loop.
+        Cells that break uniformity go through :meth:`add` per cell,
+        preserving the exact semantics of the per-row path (including
+        mid-batch degradation)."""
+        keyset = self.keyset
+        uniform = True
+        for d in cells:
+            if type(d) is not dict or d.keys() != keyset:
+                uniform = False
+                break
+        if uniform:
+            sub = self.sub
+            for j, k in enumerate(self.keys):
+                child = sub[j]
+                colvals = [d[k] for d in cells]
+                if type(child) is list:
+                    child.extend(colvals)
+                else:
+                    plain = child.bulk(colvals)
+                    if plain is not None:
+                        sub[j] = plain
+            self.n += len(cells)
+            return None
+        for i, d in enumerate(cells):
+            if not self.add(d):
+                plain = self.materialize()
+                plain.extend(cells[i:])
+                return plain
+        return None
+
+    def materialize(self) -> List[Any]:
+        """Back to plain per-row cell dicts (content-identical to the
+        originals; used only when the column degrades)."""
+        cols = [
+            c if type(c) is list else c.materialize() for c in self.sub
+        ]
+        keys = self.keys
+        return [
+            {k: cols[j][i] for j, k in enumerate(keys)}
+            for i in range(self.n)
+        ]
+
+    def wire(self) -> Dict[str, Any]:
+        """The wire nested-SoA encoding — what ``_encode_cells`` yields
+        for a uniform dict column, built from already-transposed leaves."""
+        return {
+            SOA_KEY: [
+                list(self.keys),
+                [c if type(c) is list else c.wire() for c in self.sub],
+            ]
+        }
+
+
+def _new_cell_store(v: Any) -> Union[List[Any], _SoaCol]:
+    """Storage for a column born at row 0 with first cell ``v``: a SoA
+    node for (str-keyed) dicts, a plain list otherwise."""
+    if isinstance(v, dict) and all(type(k) is str for k in v):
+        return _SoaCol(
+            tuple(v), [_new_cell_store(cv) for cv in v.values()], 1
+        )
+    return [v]
+
 
 class _Table:
-    __slots__ = ("rows", "appended")
+    __slots__ = (
+        "rows",
+        "appended",
+        "pend_cols",
+        "pend_idx",
+        "pend_vals",
+        "pend_n",
+        "pend_tail",
+        "pend_overflow",
+        "pend_shape",
+        "collected",
+    )
 
     def __init__(self, maxlen: int) -> None:
         self.rows: Deque[Dict[str, Any]] = deque(maxlen=maxlen)
         self.appended: int = 0  # total rows ever appended
+        # columnar accumulator over rows appended since the last
+        # collect_columns (invariant: pend_n + len(pend_tail) ==
+        # appended - collected unless pend_overflow is set)
+        self.pend_cols: List[str] = []
+        self.pend_idx: Dict[str, int] = {}
+        self.pend_vals: List[List[Any]] = []
+        self.pend_n: int = 0
+        # rows whose key tuple matches pend_shape, awaiting a chunked
+        # transpose (one C-level listcomp per column) — per-row python
+        # transposition costs more than it saves, so the hot append path
+        # is one list append
+        self.pend_tail: List[Dict[str, Any]] = []
+        self.pend_overflow: bool = False
+        # key tuple shared by every row this window (None once any row
+        # deviates) — gates the tail fast path
+        self.pend_shape: Optional[Tuple[str, ...]] = None
+        self.collected: int = 0  # append count at last collect_columns
+
+    def reset_pending(self) -> None:
+        self.pend_cols = []
+        self.pend_idx = {}
+        self.pend_vals = []
+        self.pend_n = 0
+        self.pend_tail = []
+        self.pend_overflow = False
+        # pend_shape deliberately survives the reset: samplers emit the
+        # same row shape tick after tick, so the NEXT window's rows can
+        # join the tail immediately (drain_tail seeds the columns from
+        # the first buffered row) instead of paying the general path and
+        # a mod-chunk residue drain every window
+
+    def pend_add(self, row: Dict[str, Any], maxlen: int) -> None:
+        """Transpose ``row`` into the pending columns (lock held).
+
+        Same semantics as ``rows_to_columns`` + ``_encode_cells``
+        applied to the pending batch: first-appearance column order,
+        ``None`` fill for keys a row lacks, uniform str-keyed dict
+        columns accumulated as nested struct-of-arrays (:class:`_SoaCol`)
+        so the publish tick never re-transposes.  A window that outgrows
+        the retention bound can no longer be represented exactly (the
+        deque evicts from the left) — it flips the sticky overflow flag
+        and the next collection takes the row-deque path instead.
+        """
+        if self.pend_overflow:
+            return
+        if self.pend_n + len(self.pend_tail) >= maxlen:
+            self.pend_overflow = True
+            self.pend_cols = []
+            self.pend_idx = {}
+            self.pend_vals = []
+            self.pend_n = 0
+            self.pend_tail = []
+            self.pend_shape = None
+            return
+        if self.pend_shape is not None:
+            if tuple(row) == self.pend_shape:
+                # hot path: the row has exactly the window's columns in
+                # the window's order, so it just joins the tail buffer —
+                # transposition is deferred to drain_tail's chunked
+                # per-column listcomps (per-row python transposition has
+                # a method-call floor the bulk path avoids)
+                tail = self.pend_tail
+                tail.append(row)
+                if len(tail) >= _PEND_CHUNK:
+                    self.drain_tail()
+                return
+            # shape drifted: flush buffered predecessors first so column
+            # order is preserved, then general path from here on
+            self.drain_tail()
+            self.pend_shape = None
+        n = self.pend_n
+        idx = self.pend_idx
+        vals = self.pend_vals
+        for k, v in row.items():
+            j = idx.get(k)
+            if j is None:
+                idx[k] = len(self.pend_cols)
+                self.pend_cols.append(k)
+                if n == 0:
+                    vals.append(_new_cell_store(v))
+                else:
+                    # born mid-window: earlier rows pad with None, so
+                    # the batch encoder would keep it plain regardless
+                    col: List[Any] = [None] * n
+                    col.append(v)
+                    vals.append(col)
+            else:
+                col = vals[j]
+                if type(col) is list:
+                    col.append(v)
+                elif not col.add(v):
+                    plain = col.materialize()
+                    plain.append(v)
+                    vals[j] = plain
+        self.pend_n = n + 1
+        for j, col in enumerate(vals):
+            if type(col) is list:
+                if len(col) <= n:  # column absent from this row
+                    col.append(None)
+            elif col.n <= n:  # a None pad breaks dict uniformity
+                plain = col.materialize()
+                plain.append(None)
+                vals[j] = plain
+        if n == 0:
+            # window seeded by this row: its key order IS the column
+            # order, so identically-shaped successors take the fast path
+            self.pend_shape = tuple(self.pend_cols)
+
+    def drain_tail(self) -> None:
+        """Transpose the buffered same-shape rows into the pending
+        columns in one pass (lock held).  Equivalent to running each row
+        through the general ``pend_add`` path — every tail row has
+        exactly the window's columns in the window's order, so the
+        ``None``-padding sweep is moot and each column is one C-level
+        listcomp of dict lookups."""
+        tail = self.pend_tail
+        if not tail:
+            return
+        vals = self.pend_vals
+        if self.pend_n == 0:
+            # window opened straight into the tail (pend_shape survived
+            # the last reset): seed the columns from the first buffered
+            # row, exactly as the general path would have
+            first = tail[0]
+            cols = self.pend_cols
+            idx = self.pend_idx
+            for k, v in first.items():
+                idx[k] = len(cols)
+                cols.append(k)
+                vals.append(_new_cell_store(v))
+            self.pend_n = 1
+            tail = tail[1:]
+            if not tail:
+                self.pend_tail = []
+                return
+        for j, k in enumerate(self.pend_cols):
+            col = vals[j]
+            colvals = [r[k] for r in tail]
+            if type(col) is list:
+                col.extend(colvals)
+            else:
+                plain = col.bulk(colvals)
+                if plain is not None:
+                    vals[j] = plain
+        self.pend_n += len(tail)
+        self.pend_tail = []
 
 
 class Database:
@@ -30,6 +319,7 @@ class Database:
         self._max_rows = int(max_rows_per_table)
         self._tables: Dict[str, _Table] = {}
         self._lock = threading.Lock()
+        self._appended_total = 0  # across all tables; never decreases
 
     def add_record(self, table: str, row: Dict[str, Any]) -> None:
         with self._lock:
@@ -38,6 +328,8 @@ class Database:
                 t = self._tables[table] = _Table(self._max_rows)
             t.rows.append(row)
             t.appended += 1
+            t.pend_add(row, self._max_rows)
+            self._appended_total += 1
 
     def add_records(self, table: str, rows: List[Dict[str, Any]]) -> None:
         if not rows:
@@ -48,6 +340,9 @@ class Database:
                 t = self._tables[table] = _Table(self._max_rows)
             t.rows.extend(rows)
             t.appended += len(rows)
+            for row in rows:
+                t.pend_add(row, self._max_rows)
+            self._appended_total += len(rows)
 
     def table_names(self) -> List[str]:
         with self._lock:
@@ -57,6 +352,16 @@ class Database:
         with self._lock:
             t = self._tables.get(table)
             return t.appended if t else 0
+
+    def appended_total(self) -> int:
+        """Monotonic count of rows ever appended, across all tables.
+
+        Read without the lock: it is a single int only ever incremented
+        under the lock, so a reader sees some recent value — enough for
+        the sender's O(1) "anything new since my last collection?" gate
+        (a concurrent append is picked up on the next tick either way).
+        """
+        return self._appended_total
 
     def tail(self, table: str, n: Optional[int] = None) -> List[Dict[str, Any]]:
         with self._lock:
@@ -97,6 +402,68 @@ class Database:
             rows = list(islice(reversed(t.rows), take))
         rows.reverse()
         return rows, new_cursor
+
+    def collect_columns(
+        self, table: str, cursor: int
+    ) -> Tuple[Optional[Dict[str, Any]], Optional[List[Dict[str, Any]]], int]:
+        """Atomic ``(columns, rows, new_cursor)`` snapshot for one table
+        (single-table convenience over :meth:`collect_wire_tables`;
+        same fast-path/fallback semantics)."""
+        cursors = {table: cursor}
+        fast, fallback = self.collect_wire_tables(cursors)
+        new_cursor = cursors[table]
+        if table in fast:
+            return fast[table], None, new_cursor
+        if table in fallback:
+            return None, fallback[table], new_cursor
+        return None, None, new_cursor
+
+    def collect_wire_tables(
+        self, cursors: Dict[str, int]
+    ) -> Tuple[Dict[str, Dict[str, Any]], Dict[str, List[Dict[str, Any]]]]:
+        """One-lock sweep over every table: ``(fast, fallback)``.
+
+        ``cursors`` is the consumer's per-table cursor map, updated IN
+        PLACE to each table's append count (the handoff is atomic per
+        table: rows appended after the lock is taken land in the next
+        collection).  ``fast[name]`` is a **wire-ready** columnar table
+        — ``{"cols": [...], "vals": [...], "n": N}`` with nested
+        struct-of-arrays columns already in their ``_encode_cells``
+        form, handed over in O(columns) — and the accumulator resets.
+        A table whose pending window overflowed the retention bound, or
+        whose cursor does not match the accumulator's (``reset()``
+        replay, a second consumer), lands in ``fallback[name]`` as the
+        row snapshot ``collect_since`` would have served, golden-
+        identical to the pre-accumulator path.
+        """
+        fast: Dict[str, Dict[str, Any]] = {}
+        fallback: Dict[str, List[Dict[str, Any]]] = {}
+        with self._lock:
+            for name, t in self._tables.items():
+                cursor = cursors.get(name, 0)
+                new_cursor = t.appended
+                new = new_cursor - cursor
+                cursors[name] = new_cursor
+                if new <= 0:
+                    continue
+                if not t.pend_overflow and cursor == t.collected:
+                    t.drain_tail()  # fold the buffered chunk residue in
+                    fast[name] = {
+                        "cols": t.pend_cols,
+                        "vals": [
+                            c if type(c) is list else c.wire()
+                            for c in t.pend_vals
+                        ],
+                        "n": t.pend_n,
+                    }
+                else:
+                    take = min(new, len(t.rows))
+                    fallback[name] = list(islice(reversed(t.rows), take))
+                t.reset_pending()
+                t.collected = new_cursor
+        for rows in fallback.values():
+            rows.reverse()
+        return fast, fallback
 
     def clear(self) -> None:
         with self._lock:

@@ -10,17 +10,30 @@ Envelopes go out as **schema v2 (columnar)** — each table transposed to
 struct-of-arrays so table keys are encoded once per batch instead of
 once per row (see docs/developer_guide/wire-schema-v2.md).  The
 aggregator still accepts v1 row-lists from older senders.
+
+Producer fast path (r10, docs/developer_guide/rank-producer-path.md):
+``dirty()`` is an O(1) gate on the database's global append counter —
+an idle publish tick never touches per-table state.  When there IS new
+data, one :meth:`Database.collect_wire_tables` sweep (a single lock
+round-trip for all tables) hands over wire-ready columnar tables
+accumulated at ``add_record`` time (nested struct-of-arrays included),
+so the per-tick transpose is gone; the row→column path only
+runs on the fallback (overflowed window or replayed cursor), where it
+is golden-identical to the pre-r10 ``collect_since`` output.  The
+envelope meta is built from a cached template — only the timestamp
+changes per tick.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional
+import time
+from typing import Any, Dict, Optional
 
 from traceml_tpu.database.database import Database
 from traceml_tpu.telemetry.envelope import (
+    SCHEMA_V2,
     SenderIdentity,
-    TelemetryEnvelope,
-    build_columnar_envelope,
+    rows_to_columns,
 )
 
 
@@ -30,6 +43,8 @@ class DBIncrementalSender:
         self._db = db
         self._cursors: Dict[str, int] = {}
         self._identity: Optional[SenderIdentity] = None
+        self._last_total = 0  # db.appended_total() at last collection
+        self._meta_template: Optional[Dict[str, Any]] = None
 
     @property
     def sampler_name(self) -> str:
@@ -37,21 +52,42 @@ class DBIncrementalSender:
 
     def set_identity(self, identity: SenderIdentity) -> None:
         self._identity = identity
+        self._meta_template = None
+
+    def dirty(self) -> bool:
+        """O(1), lock-free: rows appended since the last collection?"""
+        return self._db.appended_total() != self._last_total
+
+    def _wire_meta(self) -> Dict[str, Any]:
+        tmpl = self._meta_template
+        if tmpl is None:
+            identity = self._identity or SenderIdentity()
+            tmpl = identity.to_meta()
+            tmpl["schema"] = SCHEMA_V2
+            tmpl["sampler"] = self._sampler
+            self._meta_template = tmpl
+        meta = dict(tmpl)
+        meta["timestamp"] = time.time()
+        return meta
 
     def collect_payload(self) -> Optional[Dict[str, Any]]:
-        tables: Dict[str, List[Dict[str, Any]]] = {}
-        for table in self._db.table_names():
-            cursor = self._cursors.get(table, 0)
-            rows, new_cursor = self._db.collect_since(table, cursor)
-            if rows:
-                tables[table] = rows
-            self._cursors[table] = new_cursor
+        if not self.dirty():
+            return None
+        # Read the total BEFORE collecting: rows appended mid-collect may
+        # or may not land in this batch, but the stale total keeps dirty()
+        # true so the next tick picks them up (at worst one extra scan —
+        # never a skipped row).
+        total = self._db.appended_total()
+        tables, fallback = self._db.collect_wire_tables(self._cursors)
+        self._last_total = total
+        for table, rows in fallback.items():
+            tables[table] = rows_to_columns(rows)
         if not tables:
             return None
-        env: TelemetryEnvelope = build_columnar_envelope(
-            self._sampler, tables, identity=self._identity
-        )
-        return env.to_wire()
+        # the canonical wire shape, assembled directly (what
+        # build_columnar_envelope_from_columns(...).to_wire() returns)
+        return {"meta": self._wire_meta(), "body": {"tables": tables}}
 
     def reset(self) -> None:
         self._cursors.clear()
+        self._last_total = 0

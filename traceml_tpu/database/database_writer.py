@@ -1,23 +1,42 @@
 """Optional per-rank on-disk telemetry backup
 (reference: src/traceml_ai/database/database_writer.py:28-137).
 
-Append-only, length-prefixed codec frames per table under
-``<logs>/<session>/rank_N/data/<sampler>/<table>.msgpack``.  Used for
-post-mortem `inspect` when the aggregator was unreachable.  Flushes are
-throttled; failures are logged and swallowed.
+Append-only files under ``<logs>/<session>/rank_N/data/<sampler>/``,
+used for post-mortem `inspect` when the aggregator was unreachable.
+Two frame formats coexist (see docs/developer_guide/rank-producer-path.md):
+
+* **v1 (per-row)** — ``u32_be(len) + codec(row)``, one file per table
+  (``<table>.msgpack``).  Written by the legacy collect path
+  (:meth:`DatabaseWriter.flush` on a writer that was never fed
+  envelopes).
+* **v2 (envelope frame)** — ``b"TMB2" + u32_be(len) + codec(envelope)``
+  appended to ``envelopes.msgpack``.  The envelope body is the SAME
+  pre-encoded bytes the wire ships (single-encode contract); the magic
+  reads as a ~1.4 GB length to a v1 reader, beyond its 64 MiB
+  corruption bound, so old readers stop cleanly instead of misparsing.
+
+:func:`iter_backup_file` reads both formats, in any mix within one
+file.  Flushes are throttled; failures are logged and swallowed.
 """
 
 from __future__ import annotations
 
 import struct
 from pathlib import Path
-from typing import Dict, Optional
+from typing import Dict, Iterator, Optional, Tuple
 
 from traceml_tpu.database.database import Database
 from traceml_tpu.utils import msgpack_codec
 from traceml_tpu.utils.error_log import get_error_log
 
 _LEN = struct.Struct(">I")
+V2_MAGIC = b"TMB2"  # 0x544D4232 ≈ 1.4 GB as a big-endian length
+ENVELOPE_FILE = "envelopes.msgpack"
+_MAX_FRAME = 64 * 1024 * 1024
+# envelope-buffer high-water mark: a burst between flush ticks must not
+# hold unbounded encoded bytes in memory
+_BUF_FLUSH_BYTES = 512 * 1024
+_PREFIX_LEN = len(msgpack_codec.MSGPACK_PREFIX)
 
 
 class DatabaseWriter:
@@ -34,14 +53,96 @@ class DatabaseWriter:
         self._cursors: Dict[str, int] = {}
         self._flush_every = max(1, flush_every)
         self._calls = 0
+        # v2 path: pre-encoded envelope frames buffered until the flush
+        # throttle (or force, or the byte HWM) writes them in one append
+        self._buf = bytearray()
+        self._buf_envelopes = 0
+        self._envelope_mode = False
+        self.envelopes_written = 0
+
+    @property
+    def envelope_mode(self) -> bool:
+        """True once the writer has been fed a pre-encoded envelope —
+        the legacy per-row collect path is retired for its lifetime (the
+        publisher owns collection; re-collecting here would double-write
+        every row)."""
+        return self._envelope_mode
+
+    def mark_envelope_mode(self) -> None:
+        """Commit to the envelope path up front.  The runtime publisher
+        calls this at startup so a throttle-aligned ``flush`` can never
+        race the sender into a legacy row collection (which would put
+        the same rows on disk twice — once per-row, once in an
+        envelope)."""
+        self._envelope_mode = True
+
+    def has_pending(self) -> bool:
+        """O(1): buffered envelope bytes awaiting a disk write."""
+        return bool(self._buf)
+
+    def append_envelope(self, enc: "msgpack_codec.EncodedPayload") -> None:
+        """Buffer one pre-encoded envelope as a v2 backup frame.
+
+        The bytes are the same single encode the wire reuses — this is
+        a length-prefix + concatenation, never a re-encode."""
+        if self._dir is None:
+            return
+        self._envelope_mode = True
+        buf = self._buf
+        raw = enc.raw
+        if raw is not None:
+            # splice prefix + raw straight into the frame buffer — no
+            # intermediate body concatenation
+            buf += V2_MAGIC
+            buf += _LEN.pack(len(raw) + _PREFIX_LEN)
+            buf += msgpack_codec.MSGPACK_PREFIX
+            buf += raw
+        else:
+            body = enc.body()
+            buf += V2_MAGIC
+            buf += _LEN.pack(len(body))
+            buf += body
+        self._buf_envelopes += 1
+        if len(self._buf) >= _BUF_FLUSH_BYTES:
+            self._write_buffer()
+
+    def _write_buffer(self) -> int:
+        if not self._buf:
+            return 0
+        n = self._buf_envelopes
+        try:
+            self._dir.mkdir(parents=True, exist_ok=True)
+            with open(self._dir / ENVELOPE_FILE, "ab") as fh:
+                fh.write(self._buf)
+        except Exception as exc:
+            get_error_log().warning(
+                f"disk backup flush failed for sampler={self._sampler}", exc
+            )
+            return 0
+        # buffer cleared only after a successful write: an OSError keeps
+        # the frames for the next attempt instead of dropping them
+        del self._buf[:]
+        self._buf_envelopes = 0
+        self.envelopes_written += n
+        return n
 
     def flush(self, force: bool = False) -> int:
-        """Write new rows to disk; returns rows written."""
+        """Write pending data to disk; returns rows (v1) or envelope
+        frames (v2) written.  Throttled to every ``flush_every`` calls
+        unless ``force``."""
         if self._dir is None:
             return 0
         self._calls += 1
         if not force and self._calls % self._flush_every:
             return 0
+        if self._envelope_mode:
+            return self._write_buffer()
+        return self._flush_rows()
+
+    def _flush_rows(self) -> int:
+        """Legacy v1 path: collect rows from the database and write one
+        per-row frame each (only for writers never fed envelopes —
+        standalone tooling; the runtime publisher always pre-encodes)."""
         written = 0
         try:
             self._dir.mkdir(parents=True, exist_ok=True)
@@ -71,25 +172,61 @@ class DatabaseWriter:
         return written
 
 
-def iter_backup_file(path: Path):
-    """Decode an append-only backup file → yields rows (used by `inspect`).
+def iter_backup_tables(
+    path: Path,
+) -> Iterator[Tuple[Optional[str], dict]]:
+    """Decode an append-only backup file → yields ``(table, row)``.
 
-    A torn/corrupt tail frame (crash mid-write) terminates iteration
-    instead of raising — post-mortem inspection must work on exactly the
-    runs that crashed.
+    Handles both frame formats, freely mixed within one file: v1
+    per-row frames yield ``(None, row)`` (their table is the file
+    name); v2 envelope frames are unpacked into their tables and yield
+    ``(table_name, row)`` per materialized row.  A torn/corrupt tail
+    frame (crash mid-write) terminates iteration instead of raising —
+    post-mortem inspection must work on exactly the runs that crashed.
     """
+    from traceml_tpu.telemetry.envelope import normalize_telemetry_envelope
+
     with open(path, "rb") as fh:
         while True:
             hdr = fh.read(_LEN.size)
             if len(hdr) < _LEN.size:
                 return
+            if hdr == V2_MAGIC:
+                hdr = fh.read(_LEN.size)
+                if len(hdr) < _LEN.size:
+                    return
+                (n,) = _LEN.unpack(hdr)
+                if n > _MAX_FRAME:
+                    return
+                body = fh.read(n)
+                if len(body) < n:
+                    return
+                try:
+                    payload = msgpack_codec.decode(body)
+                except msgpack_codec.CodecError:
+                    return
+                env = normalize_telemetry_envelope(payload)
+                if env is None:
+                    continue  # decodable but not an envelope: skip frame
+                for table in env.table_names():
+                    for row in env.tables.get(table, []):
+                        yield table, row
+                continue
             (n,) = _LEN.unpack(hdr)
-            if n > 64 * 1024 * 1024:  # corrupt length → stop
+            if n > _MAX_FRAME:  # corrupt length → stop
                 return
             body = fh.read(n)
             if len(body) < n:
                 return
             try:
-                yield msgpack_codec.decode(body)
+                yield None, msgpack_codec.decode(body)
             except msgpack_codec.CodecError:
                 return
+
+
+def iter_backup_file(path: Path):
+    """Decode an append-only backup file → yields rows (used by
+    `inspect`).  v2 envelope frames are flattened into their rows; use
+    :func:`iter_backup_tables` when the table attribution matters."""
+    for _table, row in iter_backup_tables(path):
+        yield row

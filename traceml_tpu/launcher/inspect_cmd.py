@@ -1,12 +1,18 @@
 """``traceml-tpu inspect`` — decode per-rank msgpack backups
-(reference: launcher/commands.py:580-616)."""
+(reference: launcher/commands.py:580-616).
+
+Handles both backup frame formats (see database/database_writer.py):
+legacy per-row files print one JSON object per row; envelope files
+(v2, ``envelopes.msgpack``) carry multiple tables per frame, so each
+row is printed with a ``table`` field naming its origin.
+"""
 
 from __future__ import annotations
 
 import json
 from pathlib import Path
 
-from traceml_tpu.database.database_writer import iter_backup_file
+from traceml_tpu.database.database_writer import iter_backup_tables
 
 
 def run_inspect(path: Path, limit: int = 20) -> int:
@@ -22,8 +28,11 @@ def run_inspect(path: Path, limit: int = 20) -> int:
     for f in files:
         print(f"── {f}")
         n = 0
-        for row in iter_backup_file(f):
-            print(json.dumps(row, default=str))
+        for table, row in iter_backup_tables(f):
+            if table is None:
+                print(json.dumps(row, default=str))
+            else:
+                print(json.dumps({"table": table, **row}, default=str))
             n += 1
             if n >= limit:
                 print(f"… (showing first {limit})")

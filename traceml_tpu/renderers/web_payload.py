@@ -112,7 +112,7 @@ def build_web_payload(
                 for k in (
                     "envelopes_ingested", "rows_dropped", "drop_warnings",
                     "dropped_by_domain", "queues", "group_commit", "prune",
-                    "pending_frames_hwm", "ts",
+                    "pending_frames_hwm", "producers", "ts",
                 )
                 if k in stats
             }

@@ -842,7 +842,7 @@ def generate_summary(
                 "envelopes_ingested", "frames_received", "decode_errors",
                 "rows_written", "rows_dropped", "dropped_by_domain",
                 "drop_warnings", "pending_frames_hwm", "queues",
-                "group_commit", "prune",
+                "group_commit", "prune", "producers",
             )
             if k in stats
         }

@@ -207,7 +207,10 @@ class TraceMLRuntime:
         for s in self.samplers:
             s.drain()
         if self.publisher is not None:
-            self.publisher.publish(self._take_rank_finished())
+            # final=True force-flushes every writer (even throttled ones)
+            # so the disk backup holds the full run, and ships the last
+            # producer_stats snapshot
+            self.publisher.publish(self._take_rank_finished(), final=True)
 
 
 class NoOpRuntime:

@@ -1,16 +1,36 @@
 """Telemetry publisher (reference: src/traceml_ai/runtime/sender.py:17-174).
 
-Per tick: flush disk writers, collect each sampler sender's incremental
-payload, ship ONE batch over TCP.  Best-effort all the way down.
+Per tick: collect each sampler sender's incremental payload, encode it
+ONCE, hand the same bytes to the TCP batch and the disk backup, ship ONE
+frame.  Best-effort all the way down.
+
+Single-encode contract (r10, docs/developer_guide/rank-producer-path.md):
+
+    payload = sender.collect_payload()        # columnar fast path
+    enc = msgpack_codec.preencode(payload)    # THE encode
+    batch.append(enc)                         # wire splices enc.raw
+    writer.append_envelope(enc)               # disk splices enc.raw
+
+Idle ticks take an O(#samplers) gate — ``sender.dirty()`` (one int
+compare each) plus ``writer.has_pending()`` — and return without
+building a payload, touching the disk, or taking the client lock.
+
+The publisher also self-observes: per-sampler collect/encode/flush
+nanoseconds and the idle-tick ratio, exposed via :meth:`stats` and
+shipped to the aggregator as a ``producer_stats`` control message
+(piggybacked on a non-idle batch at most every ``stats_interval_s``).
 """
 
 from __future__ import annotations
 
-from typing import Any, List, Optional
+import time
+from typing import Any, Dict, List, Optional
 
 from traceml_tpu.samplers.base_sampler import BaseSampler
+from traceml_tpu.telemetry.control import build_producer_stats
 from traceml_tpu.telemetry.envelope import SenderIdentity
 from traceml_tpu.transport.tcp_transport import TCPClient
+from traceml_tpu.utils import msgpack_codec
 from traceml_tpu.utils.error_log import get_error_log
 
 
@@ -20,32 +40,119 @@ class TelemetryPublisher:
         samplers: List[BaseSampler],
         client: Optional[TCPClient],
         identity: SenderIdentity,
+        stats_interval_s: float = 10.0,
     ) -> None:
         self._samplers = samplers
         self._client = client
         self._identity = identity
         for s in samplers:
             s.sender.set_identity(identity)
+            # the publisher owns collection; the writer must never fall
+            # back to its legacy self-collecting row path (double-write)
+            s.writer.mark_envelope_mode()
         self.ticks = 0
+        self.idle_ticks = 0
         self.payloads_sent = 0
+        self._stats_interval = stats_interval_s
+        self._last_stats_emit = time.monotonic()
+        self._sampler_stats: Dict[str, Dict[str, int]] = {
+            s.name: {
+                "envelopes": 0,
+                "bytes": 0,
+                "collect_ns": 0,
+                "encode_ns": 0,
+                "flush_ns": 0,
+            }
+            for s in samplers
+        }
+        # (sender, writer, stats) resolved once: the publish tick is the
+        # producer hot path and skips per-tick attribute/dict lookups
+        self._units = [
+            (s, s.sender, s.writer, self._sampler_stats[s.name])
+            for s in samplers
+        ]
 
-    def publish(self, extra_payloads: Optional[List[Any]] = None) -> int:
+    def _idle(self) -> bool:
+        for s in self._samplers:
+            if s.sender.dirty() or s.writer.has_pending():
+                return False
+        return True
+
+    def publish(
+        self, extra_payloads: Optional[List[Any]] = None, final: bool = False
+    ) -> int:
         """Collect + send; returns number of payloads in the batch."""
         self.ticks += 1
+        if not final and not extra_payloads and self._idle():
+            self.idle_ticks += 1
+            return 0
         batch: List[Any] = []
-        for s in self._samplers:
+        perf = time.perf_counter_ns
+        for s, sender, writer, st in self._units:
             try:
-                s.writer.flush()
-                payload = s.sender.collect_payload()
+                t0 = perf()
+                payload = sender.collect_payload()
+                t1 = perf()
+                st["collect_ns"] += t1 - t0
                 if payload is not None:
-                    batch.append(payload)
+                    enc = msgpack_codec.preencode(payload)
+                    t2 = perf()
+                    st["encode_ns"] += t2 - t1
+                    st["envelopes"] += 1
+                    st["bytes"] += enc.size()
+                    batch.append(enc)
+                    writer.append_envelope(enc)
+                    t3 = perf()
+                    writer.flush(force=final)
+                    st["flush_ns"] += perf() - t3
+                elif final or writer.has_pending():
+                    # nothing collected but buffered backup frames (or a
+                    # final drain) still need the flush throttle to run
+                    t3 = perf()
+                    writer.flush(force=final)
+                    st["flush_ns"] += perf() - t3
             except Exception as exc:
                 get_error_log().warning(
                     f"collect failed for sampler {s.name}", exc
                 )
         if extra_payloads:
             batch.extend(extra_payloads)
+        if batch:
+            stats_msg = self._maybe_stats_message(final)
+            if stats_msg is not None:
+                batch.append(stats_msg)
         if batch and self._client is not None:
             if self._client.send_batch(batch):
                 self.payloads_sent += len(batch)
         return len(batch)
+
+    def _maybe_stats_message(self, final: bool) -> Optional[Dict[str, Any]]:
+        """Producer self-observability, piggybacked on a batch that is
+        shipping anyway (never turns an idle tick into traffic)."""
+        now = time.monotonic()
+        if not final and now - self._last_stats_emit < self._stats_interval:
+            return None
+        self._last_stats_emit = now
+        try:
+            return build_producer_stats(self._identity.to_meta(), self.stats())
+        except Exception:
+            return None
+
+    def stats(self) -> Dict[str, Any]:
+        """Per-sampler producer-path cost (microseconds) + idle ratio."""
+        samplers: Dict[str, Any] = {}
+        for name, st in self._sampler_stats.items():
+            samplers[name] = {
+                "envelopes": st["envelopes"],
+                "bytes": st["bytes"],
+                "collect_us": st["collect_ns"] // 1000,
+                "encode_us": st["encode_ns"] // 1000,
+                "flush_us": st["flush_ns"] // 1000,
+            }
+        return {
+            "ticks": self.ticks,
+            "idle_ticks": self.idle_ticks,
+            "idle_ratio": (self.idle_ticks / self.ticks) if self.ticks else 0.0,
+            "payloads_sent": self.payloads_sent,
+            "samplers": samplers,
+        }

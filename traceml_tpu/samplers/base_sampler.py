@@ -39,6 +39,20 @@ class BaseSampler:
         self.sample()
 
     def stop(self) -> None:
+        """Last-chance backup flush.  In envelope mode the writer only
+        holds what the publisher fed it — if rows landed after the final
+        publish (or the publisher died mid-window), collect them into one
+        last envelope here so the on-disk backup is complete, then force
+        the buffer out."""
+        try:
+            if self.writer.envelope_mode and self.sender.dirty():
+                payload = self.sender.collect_payload()
+                if payload is not None:
+                    from traceml_tpu.utils import msgpack_codec
+
+                    self.writer.append_envelope(msgpack_codec.preencode(payload))
+        except Exception as exc:
+            get_error_log().warning(f"sampler {self.name} final collect failed", exc)
         try:
             self.writer.flush(force=True)
         except Exception:

@@ -54,7 +54,14 @@ def _aggregate_step(
     event pair brackets stream work regardless of when the host enqueued
     it).  The FIFO emission order of the sampler makes this well-defined.
     """
-    ordered = sorted(events, key=lambda e: e.cpu_start)
+    # Events arrive in host-issue order in the common case (the SDK
+    # appends as the step executes) — detect that in one pass and skip
+    # the per-step sort + list copy entirely.
+    ordered = events
+    for i in range(1, len(events)):
+        if events[i].cpu_start < events[i - 1].cpu_start:
+            ordered = sorted(events, key=lambda e: e.cpu_start)
+            break
     # Late stamps (shutdown drain / timeout) carry observation times far
     # from the true completion — their device durations would be fiction,
     # so they are excluded and counted instead.
@@ -95,9 +102,12 @@ def _aggregate_step(
                 d_ms = max(ev.cpu_ms, (last_ready - ev.cpu_start) * 1000.0)
         else:
             d_ms = device_ms.get(i)
-        slot = agg.setdefault(
-            ev.name, {"cpu_ms": 0.0, "device_ms": None, "count": 0}
-        )
+        # get-then-insert instead of setdefault: setdefault builds a
+        # fresh dict literal per EVENT even when the slot already exists
+        # (hot path — every event of every step passes through here)
+        slot = agg.get(ev.name)
+        if slot is None:
+            slot = agg[ev.name] = {"cpu_ms": 0.0, "device_ms": None, "count": 0}
         slot["cpu_ms"] += ev.cpu_ms
         slot["count"] += 1
         if d_ms is not None:

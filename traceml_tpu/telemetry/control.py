@@ -1,9 +1,15 @@
 """Control-plane messages sharing the telemetry channel
 (reference: src/traceml_ai/telemetry/control.py:24-81).
 
-The only control message today is ``rank_finished`` — the end-of-run
-barrier marker the aggregator counts against ``expected_world_size``
-before finalizing (reference: aggregator/trace_aggregator.py:440-499).
+Two control messages today:
+
+* ``rank_finished`` — the end-of-run barrier marker the aggregator
+  counts against ``expected_world_size`` before finalizing
+  (reference: aggregator/trace_aggregator.py:440-499).
+* ``producer_stats`` — periodic per-rank publisher self-observability
+  (collect/encode/flush microseconds, idle-tick ratio; see
+  docs/developer_guide/rank-producer-path.md).  Aggregated into
+  ``ingest_stats.json`` under ``producers``.
 """
 
 from __future__ import annotations
@@ -13,12 +19,24 @@ from typing import Any, Dict, Mapping, Optional
 
 CONTROL_KEY = "_traceml_control"
 RANK_FINISHED = "rank_finished"
+PRODUCER_STATS = "producer_stats"
 
 
 def build_rank_finished(identity_meta: Mapping[str, Any]) -> Dict[str, Any]:
     return {
         CONTROL_KEY: RANK_FINISHED,
         "meta": dict(identity_meta),
+        "timestamp": time.time(),
+    }
+
+
+def build_producer_stats(
+    identity_meta: Mapping[str, Any], stats: Mapping[str, Any]
+) -> Dict[str, Any]:
+    return {
+        CONTROL_KEY: PRODUCER_STATS,
+        "meta": dict(identity_meta),
+        "stats": dict(stats),
         "timestamp": time.time(),
     }
 

@@ -161,6 +161,23 @@ def rows_to_columns(rows: List[Mapping[str, Any]]) -> Dict[str, Any]:
     return {"cols": cols, "vals": [_encode_cells(col) for col in vals], "n": n}
 
 
+def encode_columns(table: Mapping[str, Any]) -> Dict[str, Any]:
+    """Raw accumulated columns → wire columnar table.
+
+    ``table`` is ``{"cols": [...], "vals": [...], "n": N}`` with plain
+    value lists (what ``Database.collect_columns`` hands over); this
+    applies the nested struct-of-arrays pass (:data:`SOA_KEY`) per
+    column, producing exactly what :func:`rows_to_columns` would have
+    built from the same batch of rows — without ever materializing the
+    row dicts.
+    """
+    return {
+        "cols": list(table["cols"]),
+        "vals": [_encode_cells(col) for col in table["vals"]],
+        "n": table["n"],
+    }
+
+
 def columns_to_rows(table: Mapping[str, Any]) -> List[Dict[str, Any]]:
     """Materialize row dicts from a columnar table (inverse of
     :func:`rows_to_columns` for batches with uniform keys)."""
@@ -406,6 +423,24 @@ def build_columnar_envelope(
         meta=meta,
         columns={str(k): rows_to_columns(v) for k, v in tables.items()},
     )
+
+
+def build_columnar_envelope_from_columns(
+    sampler: str,
+    tables: Mapping[str, Mapping[str, Any]],
+    identity: Optional[SenderIdentity] = None,
+    timestamp: Optional[float] = None,
+) -> TelemetryEnvelope:
+    """Schema-2 envelope from **wire-ready columnar tables** (already
+    nested-SoA encoded — see :func:`encode_columns`).  The producer fast
+    path: no row dicts exist at any point between ``add_record`` and the
+    wire."""
+    identity = identity or SenderIdentity()
+    meta = identity.to_meta()
+    meta["schema"] = SCHEMA_V2
+    meta["sampler"] = sampler
+    meta["timestamp"] = time.time() if timestamp is None else timestamp
+    return TelemetryEnvelope(meta=meta, columns=dict(tables))
 
 
 def _split_wire_tables(

@@ -340,6 +340,14 @@ class TCPClient:
         # established socket never waits behind a stalled connect.
         self._connect_lock = threading.Lock()
         self._gen = 0  # bumped by close(); a dial that straddles it is discarded
+        # reusable frame buffer: steady-state sends assemble the length
+        # prefix + body into one persistent bytearray instead of
+        # allocating a fresh frame per tick.  Guarded by its own lock
+        # (ordering: _framebuf_lock → _lock) so frame assembly — cheap
+        # concatenation of pre-encoded bodies — never waits behind a
+        # stalled sendall from the socket lock's perspective alone.
+        self._framebuf = bytearray()
+        self._framebuf_lock = threading.Lock()
         self.batches_sent = 0
         self.batches_dropped = 0
 
@@ -383,31 +391,41 @@ class TCPClient:
     def send_batch(self, payloads: List[Any]) -> bool:
         """Encode ``payloads`` as ONE frame, one sendall. True on success.
 
-        Encoding happens before any lock is taken — a large batch being
-        msgpack'd must not block a concurrent close() or sender.
+        Members may be :class:`msgpack_codec.EncodedPayload` — their
+        pre-encoded bodies are spliced into the batch array with zero
+        re-encode (the producer's single-encode contract; see
+        docs/developer_guide/rank-producer-path.md) — or plain objects,
+        encoded here.  Encoding happens before the socket lock is taken
+        — a large batch being msgpack'd must not block a concurrent
+        close() or sender.
         """
         if not payloads:
             return True
         try:
-            frame = encode_frame(payloads)
+            body = msgpack_codec.encode_batch(payloads)
         except Exception:
             self.batches_dropped += 1
             return False
         if self._ensure_connected() is None:
             self.batches_dropped += 1
             return False
-        with self._lock:
-            if self._sock is None:  # torn down between connect and send
-                self.batches_dropped += 1
-                return False
-            try:
-                self._sock.sendall(frame)
-                self.batches_sent += 1
-                return True
-            except Exception:
-                self.batches_dropped += 1
-                self._teardown_locked()
-                return False
+        with self._framebuf_lock:
+            buf = self._framebuf
+            del buf[:]
+            buf += _LEN.pack(len(body))
+            buf += body
+            with self._lock:
+                if self._sock is None:  # torn down between connect and send
+                    self.batches_dropped += 1
+                    return False
+                try:
+                    self._sock.sendall(buf)
+                    self.batches_sent += 1
+                    return True
+                except Exception:
+                    self.batches_dropped += 1
+                    self._teardown_locked()
+                    return False
 
     def _teardown_locked(self) -> None:
         if self._sock is not None:

@@ -18,6 +18,9 @@ from typing import Any
 
 _MSGPACK_PREFIX = b"\x01"
 _JSON_PREFIX = b"\x02"
+# public alias: consumers splicing EncodedPayload.raw into their own
+# frames (disk backup) prepend this to reconstruct the standalone body
+MSGPACK_PREFIX = _MSGPACK_PREFIX
 
 try:  # pragma: no cover - exercised implicitly
     import msgpack as _msgpack
@@ -61,6 +64,100 @@ def encode(obj: Any) -> bytes:
         return _JSON_PREFIX + json.dumps(obj, default=_json_default).encode("utf-8")
     except Exception as exc:  # pragma: no cover - last resort
         raise CodecError(f"cannot encode payload: {exc}") from exc
+
+
+class EncodedPayload:
+    """A payload encoded exactly once, reusable by every consumer.
+
+    ``raw`` is the bare msgpack body (NO codec prefix) so it can be
+    spliced verbatim into a batch array frame (msgpack is compositional:
+    ``array_header(n) + body_0 + ... + body_{n-1}`` is byte-identical to
+    packing the list in one call).  On a JSON-fallback host ``raw`` is
+    ``None`` and consumers encode ``obj`` themselves — correctness never
+    depends on msgpack being importable.
+    """
+
+    __slots__ = ("obj", "raw", "_body")
+
+    def __init__(self, obj: Any, raw: "bytes | None") -> None:
+        self.obj = obj
+        self.raw = raw
+        self._body: "bytes | None" = None
+
+    def body(self) -> bytes:
+        """Standalone wire body (codec prefix + payload) — what
+        :func:`encode` would produce; the raw bytes are reused, not
+        re-encoded.  Cached: wire and disk consumers share one copy."""
+        if self._body is None:
+            if self.raw is not None:
+                self._body = _MSGPACK_PREFIX + self.raw
+            else:
+                self._body = encode(self.obj)
+        return self._body
+
+    def size(self) -> int:
+        """``len(self.body())`` without materializing the concatenated
+        body when only the byte count is needed (stats, length
+        prefixes)."""
+        if self._body is not None:
+            return len(self._body)
+        if self.raw is not None:
+            return len(_MSGPACK_PREFIX) + len(self.raw)
+        return len(self.body())
+
+
+def preencode(obj: Any) -> EncodedPayload:
+    """Encode ``obj`` once for multi-consumer reuse (wire batch + disk
+    backup).  Falls back to a raw-less wrapper when msgpack is
+    unavailable or the object defeats it (consumers then pay the
+    whole-batch JSON path, exactly as before)."""
+    if _HAVE_MSGPACK:
+        try:
+            raw = _msgpack.packb(obj, use_bin_type=True, default=_json_default)
+            return EncodedPayload(obj, raw)
+        except Exception:
+            pass
+    return EncodedPayload(obj, None)
+
+
+def pack_array_header(n: int) -> bytes:
+    """msgpack array header for ``n`` elements (fixarray/array16/32)."""
+    if n <= 0x0F:
+        return bytes((0x90 | n,))
+    if n <= 0xFFFF:
+        return b"\xdc" + n.to_bytes(2, "big")
+    return b"\xdd" + n.to_bytes(4, "big")
+
+
+def encode_batch(payloads: list) -> bytes:
+    """One wire body for a batch, reusing pre-encoded members.
+
+    Items may be :class:`EncodedPayload` (their ``raw`` bytes are
+    spliced, zero re-encode) or plain objects (encoded here).  Output is
+    byte-identical to ``encode([...plain objects...])``.  If any member
+    lacks raw bytes — JSON-fallback host, or an object msgpack refused —
+    the whole batch takes the legacy single-``encode`` path.
+    """
+    if _HAVE_MSGPACK:
+        parts = [pack_array_header(len(payloads))]
+        try:
+            for p in payloads:
+                if isinstance(p, EncodedPayload):
+                    if p.raw is None:
+                        raise CodecError("member without raw bytes")
+                    parts.append(p.raw)
+                else:
+                    parts.append(
+                        _msgpack.packb(
+                            p, use_bin_type=True, default=_json_default
+                        )
+                    )
+            return _MSGPACK_PREFIX + b"".join(parts)
+        except Exception:
+            pass  # fall through to the whole-list encode
+    return encode(
+        [p.obj if isinstance(p, EncodedPayload) else p for p in payloads]
+    )
 
 
 def decode(data: bytes) -> Any:
