@@ -240,11 +240,42 @@ def test_store_trim_lockstep_without_global_min_movement(tmp_path):
 
 
 def test_ingest_priority_mapping():
-    assert HIGH_PRIORITY_SAMPLERS == {"step_time", "step_memory"}
+    # collectives joined the high lane in r11: per-step model telemetry
+    # must survive a low-value flood just like step time/memory
+    assert HIGH_PRIORITY_SAMPLERS == {"step_time", "step_memory", "collectives"}
     for sampler in HIGH_PRIORITY_SAMPLERS:
         assert ingest_priority(sampler) == 0
     for sampler in ("system", "process", "stdout_stderr", "mystery"):
         assert ingest_priority(sampler) == 1
+
+
+def test_unknown_domain_envelopes_counted_not_dropped_silently(tmp_path):
+    """An envelope naming a sampler with no projection writer lands in
+    ``unknown_domain_drops`` (per domain) with ONE rate-limited warning —
+    never an exception, never silence."""
+    w = SQLiteWriter(tmp_path / "t.sqlite")
+    w.start()
+    rows = [{"step": 1, "timestamp": 1.0, "value": 42.0}]
+    for i in range(5):
+        assert w.ingest(
+            build_telemetry_envelope("wizardry", {"wizardry": rows}, _ident(0))
+        )
+    assert w.ingest(
+        build_telemetry_envelope("hexes", {"hexes": rows}, _ident(1))
+    )
+    # known domains in the same batch still get written
+    assert w.ingest(_step_time_env(0, 1, 3))
+    assert w.force_flush()
+    stats = w.stats()
+    assert stats["unknown_domain_drops"] == {"wizardry": 5, "hexes": 1}
+    assert stats["written"] >= 1
+    conn = sqlite3.connect(str(tmp_path / "t.sqlite"))
+    try:
+        n = conn.execute("SELECT COUNT(*) FROM step_time_samples").fetchone()[0]
+    finally:
+        conn.close()
+    assert n == 3
+    w.finalize()
 
 
 def test_priority_shedding_and_rate_limited_warning(tmp_path):
@@ -305,7 +336,11 @@ def test_aggregator_periodic_ingest_stats(tmp_path):
     stats_path = settings.session_dir / "ingest_stats.json"
     try:
         client = TCPClient("127.0.0.1", agg.port)
-        assert client.send_batch([_step_time_env(0, 1, 5).to_wire()])
+        rows = [{"step": 1, "timestamp": 1.0, "value": 42.0}]
+        assert client.send_batch([
+            _step_time_env(0, 1, 5).to_wire(),
+            build_telemetry_envelope("wizardry", {"wizardry": rows}, _ident(0)).to_wire(),
+        ])
         client.close()
         deadline = time.monotonic() + 5
         live = None
@@ -328,6 +363,8 @@ def test_aggregator_periodic_ingest_stats(tmp_path):
     assert final["queues"]["high"]["capacity"] > 0
     assert final["prune"]["retention_rows"] > 0
     assert "dropped_by_domain" in final and "group_commit" in final
+    # the per-domain unknown counter reaches the FILE, not just stats()
+    assert final["unknown_domain_drops"] == {"wizardry": 1}
     assert final["rows_written"] >= 5
     # the loaders helper reads (and caches) the same file
     assert loaders.load_ingest_stats(settings.session_dir) == final

@@ -1,0 +1,251 @@
+"""Collectives domain ingest path: sampler aggregation → v2 envelope
+encode → SQLite ingest → columnar window build, end to end.
+
+Shape (the acceptance load): 256 ranks × 120 steps × 8 collectives per
+step — 245k raw per-call records.  Each rank flushes one step per
+envelope (the live-streaming shape bench_ingest.py's r09 envelope was
+measured at), so aggregation bounds the wire at ≤(op × dtype) rows per
+envelope regardless of call fan-out.  Ingest drives the real
+``SQLiteWriter._write_batch`` synchronously in fixed 64-envelope
+batches — the same drain granularity bench_ingest.py times — and its
+per-batch p99 (first batch excluded: one-time schema init + WAL
+warm-up) must stay inside the r09 ingest envelope (BENCH_LOCAL_r09's
+256-rank watermark lane): the new domain must not cost more than the
+heaviest existing one at the same drain granularity.
+
+Golden first, timing second:
+
+* the aggregated rows driven through encode→ingest→store must fold to
+  a window IDENTICAL (``collectives_window_to_plain``) to a direct
+  scalar fold over the pre-wire rows — the pipeline may not move a bit;
+* the store's columnar window must equal the scalar reference over the
+  store's own rows (the engine's standing golden).
+
+Emits bench_common JSON lines (collected into BENCH_LOCAL_r11.json):
+
+* ``agg_records_per_s``  — sampler-side fold of raw call records;
+* ``encode_envelopes_per_s`` / ``encode_total_ms``;
+* ``ingest_envelopes_per_s`` / ``ingest_batch_p99_ms`` /
+  ``ingest_batch_max_ms`` and ``r09_p99_envelope_ms`` (the bound);
+* ``window_cold_build_ms`` (refresh + first columnar fold) and
+  ``window_warm_rebuild_us`` (dirty-gated rebuild, no new rows).
+"""
+
+import random
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent))
+# standalone `python tests/benchmarks/bench_collectives_ingest.py` support
+sys.path.insert(1, str(Path(__file__).parent.parent.parent))
+import bench_common  # noqa: E402
+
+from traceml_tpu.aggregator.sqlite_writer import SQLiteWriter  # noqa: E402
+from traceml_tpu.reporting.snapshot_store import LiveSnapshotStore  # noqa: E402
+from traceml_tpu.samplers.collectives_sampler import (  # noqa: E402
+    aggregate_collective_records,
+)
+from traceml_tpu.telemetry.envelope import (  # noqa: E402
+    SenderIdentity,
+    build_telemetry_envelope,
+)
+from traceml_tpu.utils.columnar import (  # noqa: E402
+    build_collectives_window_rows,
+    collectives_window_to_plain,
+)
+
+pytestmark = pytest.mark.slow
+
+BENCH = "collectives_ingest"
+RANKS = 256
+STEPS = 120
+COLL_PER_STEP = 8
+FLUSH_STEPS = 1        # steps per envelope — live-streaming shape (r09)
+BATCH_ENVELOPES = 64   # writer drain granularity (matches bench_ingest)
+REPEATS = 2            # min-of-N: deterministic work, noise only adds
+# the 256-rank watermark lane's per-batch p99 from BENCH_LOCAL_r09 —
+# the ingest envelope this domain must stay inside (2x headroom for the
+# shared-CI host; the local acceptance number is recorded in r11)
+R09_P99_ENVELOPE_MS = 10.9093
+
+_OPS = ("all_reduce", "all_reduce", "all_reduce", "all_gather",
+        "reduce_scatter", "p2p")  # AR-heavy, like a DP training step
+_DTYPES = ("float32", "float32", "bfloat16")
+
+
+def _raw_records(rank, rng):
+    """8 per-call records per step for one rank — what the fallback
+    recorders enqueue during real training."""
+    out = []
+    for step in range(1, STEPS + 1):
+        for _ in range(COLL_PER_STEP):
+            dur = rng.uniform(0.2, 6.0)
+            out.append({
+                "step": step,
+                "ts": 1000.0 + step,
+                "op": rng.choice(_OPS),
+                "dtype": rng.choice(_DTYPES),
+                "bytes": rng.randint(1 << 10, 1 << 22),
+                "group_size": RANKS,
+                "duration_ms": dur,
+                "exposed_ms": dur * rng.uniform(0.0, 1.0),
+            })
+    return out
+
+
+def _ident(rank):
+    return SenderIdentity(
+        session_id="bench", global_rank=rank, local_rank=rank % 4,
+        world_size=RANKS, node_rank=rank // 4, hostname=f"h{rank // 4}",
+        pid=100 + rank,
+    )
+
+
+def _p99(lat):
+    s = sorted(lat)
+    return s[min(len(s) - 1, int(len(s) * 0.99))]
+
+
+def _run(tmp):
+    rng = random.Random(7)
+    raw = {rank: _raw_records(rank, rng) for rank in range(RANKS)}
+    n_raw = sum(len(v) for v in raw.values())
+
+    # -- stage 1: sampler aggregation (per tick of FLUSH_STEPS steps) --
+    agg_s = None
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        flushes = {}  # rank -> list of per-tick row lists
+        for rank in range(RANKS):
+            per_tick = {}
+            for rec in raw[rank]:
+                per_tick.setdefault(
+                    (rec["step"] - 1) // FLUSH_STEPS, []
+                ).append(rec)
+            flushes[rank] = [
+                aggregate_collective_records(per_tick[k])
+                for k in sorted(per_tick)
+            ]
+        el = time.perf_counter() - t0
+        agg_s = el if agg_s is None else min(agg_s, el)
+    for rank in range(RANKS):  # rows need the timestamp the sampler adds
+        for rows in flushes[rank]:
+            for row in rows:
+                row["timestamp"] = 1000.0 + row["step"]
+
+    # -- stage 2: v2 columnar envelope encode ---------------------------
+    encode_s = None
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        envs = [
+            build_telemetry_envelope(
+                "collectives", {"collectives": rows}, _ident(rank)
+            )
+            for rank in range(RANKS)
+            for rows in flushes[rank]
+            if rows
+        ]
+        el = time.perf_counter() - t0
+        encode_s = el if encode_s is None else min(encode_s, el)
+    n_envs = len(envs)
+
+    # -- stage 3: SQLite ingest (sync drive of the writer internals) ---
+    batches = [
+        envs[i : i + BATCH_ENVELOPES]
+        for i in range(0, len(envs), BATCH_ENVELOPES)
+    ]
+    ingest_s = None
+    ingest_lat = None
+    for rep in range(REPEATS):
+        db = Path(tmp) / f"coll_{rep}.sqlite"
+        w = SQLiteWriter(db)
+        conn = w._connect()
+        lat = []
+        t_start = time.perf_counter()
+        for batch in batches:
+            t0 = time.perf_counter()
+            w._write_batch(conn, batch)
+            lat.append((time.perf_counter() - t0) * 1000.0)
+        el = time.perf_counter() - t_start
+        conn.execute("PRAGMA wal_checkpoint(TRUNCATE)")
+        conn.commit()
+        conn.close()
+        if ingest_s is None or el < ingest_s:
+            # first batch carries one-time schema init + WAL warm-up;
+            # the sustained envelope is the steady-state distribution
+            ingest_s, ingest_lat, final_db = el, lat[1:], db
+
+    # -- golden BEFORE timing is reported ------------------------------
+    store = LiveSnapshotStore(final_db, window_steps=STEPS)
+    t0 = time.perf_counter()
+    store.refresh()
+    win = store.build_collectives_window(max_steps=STEPS)
+    cold_ms = (time.perf_counter() - t0) * 1000.0
+    # (a) columnar engine vs scalar reference over the store's rows
+    scalar_store = build_collectives_window_rows(
+        store.collectives_rows(), max_steps=STEPS
+    )
+    assert collectives_window_to_plain(win) == collectives_window_to_plain(
+        scalar_store
+    ), "columnar window diverged from the scalar reference"
+    # (b) end to end: the pipeline may not move a bit vs the pre-wire rows
+    expected = build_collectives_window_rows(
+        {r: [row for rows in flushes[r] for row in rows] for r in raw},
+        max_steps=STEPS,
+    )
+    assert collectives_window_to_plain(win) == collectives_window_to_plain(
+        expected
+    ), "ingest pipeline changed the window payload"
+    assert win.n_steps == STEPS and len(win.ranks) == RANKS
+
+    # warm rebuild: no new rows → dirty-gated cursor read + cached fold
+    t0 = time.perf_counter()
+    for _ in range(50):
+        store.refresh()
+        store.build_collectives_window(max_steps=STEPS)
+    warm_us = (time.perf_counter() - t0) * 1e6 / 50
+    store.close()
+
+    p99 = _p99(ingest_lat)
+    extra = {"ranks": RANKS, "steps": STEPS, "coll_per_step": COLL_PER_STEP,
+             "raw_records": n_raw, "envelopes": n_envs,
+             "batch_envelopes": BATCH_ENVELOPES}
+    bench_common.emit(BENCH, "agg_records_per_s", n_raw / agg_s, "rec/s", **extra)
+    bench_common.emit(
+        BENCH, "encode_envelopes_per_s", n_envs / encode_s, "env/s", **extra
+    )
+    bench_common.emit(BENCH, "encode_total_ms", encode_s * 1000.0, "ms", **extra)
+    bench_common.emit(
+        BENCH, "ingest_envelopes_per_s", n_envs / ingest_s, "env/s", **extra
+    )
+    bench_common.emit(BENCH, "ingest_batch_p99_ms", p99, "ms", **extra)
+    bench_common.emit(
+        BENCH, "ingest_batch_max_ms", max(ingest_lat), "ms", **extra
+    )
+    bench_common.emit(
+        BENCH, "r09_p99_envelope_ms", R09_P99_ENVELOPE_MS, "ms", **extra
+    )
+    bench_common.emit(BENCH, "window_cold_build_ms", cold_ms, "ms", **extra)
+    bench_common.emit(BENCH, "window_warm_rebuild_us", warm_us, "us", **extra)
+    return p99
+
+
+def test_collectives_ingest_bench(tmp_path):
+    p99 = _run(tmp_path)
+    # the collectives lane must stay inside the r09 ingest envelope
+    # (2x headroom absorbs shared-CI scheduler noise; the local
+    # acceptance run in BENCH_LOCAL_r11.json is compared at 1x)
+    assert p99 <= R09_P99_ENVELOPE_MS * 2.0, p99
+
+
+if __name__ == "__main__":
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        p99 = _run(tmp)
+        within = "within" if p99 <= R09_P99_ENVELOPE_MS else "OUTSIDE"
+        print(f"# ingest p99 {p99:.2f} ms — {within} the r09 envelope "
+              f"({R09_P99_ENVELOPE_MS} ms)", file=sys.stderr)

@@ -114,6 +114,28 @@ def test_checkpoint_stall_phase_measured(tmp_path):
     assert ckpt["mean_ms"] > 0, ckpt
 
 
+def test_comm_bound_collectives_section(tmp_path):
+    # every rank's gradient sync is a slow host-blocking all-reduce —
+    # the collectives domain (fallback recorders, no profiler) must
+    # produce a populated section with the per-step overlap series and
+    # call the run COMM_BOUND
+    payload = _run(tmp_path, "comm_bound", steps=40)
+    sec = payload["sections"]["collectives"]
+    assert sec["status"] == "OK", sec
+    g = sec["global"]
+    assert g["n_steps"] >= 10, g
+    # a fully exposed sync: low overlap efficiency, all_reduce present
+    assert g["overlap_efficiency"] < 0.5, g
+    assert "all_reduce" in g["per_op"], g["per_op"].keys()
+    series = g["overlap_efficiency_series"]
+    assert series and len(series) == len(g["series_steps"])
+    assert all(0.0 <= v <= 1.0 for v in series)
+    assert sec["diagnosis"]["kind"] == "COMM_BOUND", sec["diagnosis"]
+    # the compute-only scenarios must stay silent on this rule — pinned
+    # by test_healthy_not_misdiagnosed below via the primary check
+    assert sec["diagnosis"]["severity"] in ("warning", "critical")
+
+
 def test_healthy_not_misdiagnosed(tmp_path):
     payload = _run(tmp_path, "healthy", steps=60)
     primary = payload["primary_diagnosis"]
@@ -127,6 +149,8 @@ def test_healthy_not_misdiagnosed(tmp_path):
         "COMPILE_BOUND",
         "MEMORY_CREEP_EARLY",
         "MEMORY_CREEP_CONFIRMED",
+        "COMM_BOUND",
+        "POOR_OVERLAP",
     ), primary
     st_primary = payload["sections"]["step_time"]["diagnosis"]
     assert st_primary["kind"] in (

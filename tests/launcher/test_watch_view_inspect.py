@@ -94,3 +94,45 @@ def test_inspect_decodes_backups(tmp_path):
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "steps" in proc.stdout
     assert "step" in proc.stdout
+
+
+def test_inspect_domain_filter_and_overlap_column(tmp_path):
+    """--domain keeps only the named table and collectives rows gain a
+    derived overlap_efficiency column (zero-duration rows read 1.0)."""
+    from traceml_tpu.database import Database, DatabaseWriter
+
+    db = Database()
+    w = DatabaseWriter("mixed", db, tmp_path / "data", flush_every=1)
+    db.add_records("steps", [{"step": i, "ms": 10.0 * i} for i in range(3)])
+    db.add_records(
+        "collectives",
+        [
+            {"step": 1, "op": "all_reduce", "dtype": "float32",
+             "count": 2, "bytes": 4096, "group_size": 8,
+             "duration_ms": 4.0, "exposed_ms": 1.0},
+            {"step": 2, "op": "all_gather", "dtype": "bfloat16",
+             "count": 1, "bytes": 0, "group_size": 8,
+             "duration_ms": 0.0, "exposed_ms": 0.0},
+        ],
+    )
+    assert w.flush(force=True) == 5
+    proc = _cli(
+        ["inspect", str(tmp_path / "data"), "--domain", "collectives"]
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rows = [
+        json.loads(line)
+        for line in proc.stdout.splitlines()
+        if line.startswith("{")
+    ]
+    # only the collectives table's rows survive the filter (legacy
+    # per-table backups are matched by file stem)
+    assert rows and all("op" in r for r in rows)
+    assert "steps.msgpack" not in proc.stdout
+    by_step = {r["step"]: r for r in rows}
+    assert by_step[1]["overlap_efficiency"] == 0.75  # 1 − 1/4
+    assert by_step[2]["overlap_efficiency"] == 1.0   # zero comm ≠ NaN
+    # unknown domain → helpful non-zero exit
+    miss = _cli(["inspect", str(tmp_path / "data"), "--domain", "nope"])
+    assert miss.returncode == 1
+    assert "no rows for domain" in miss.stdout

@@ -1,0 +1,394 @@
+"""Collectives window engine: golden equivalence vs the scalar path,
+plus the domain's core invariants.
+
+Contract (docs/developer_guide/collectives-domain.md): for any input the
+scalar builder accepts, the columnar engine either produces a
+bit-identical window (``collectives_window_to_plain`` compares the full
+payload) or raises ``ColumnarFallback``.  Domain invariants pinned here:
+
+* ragged participation — steps are the UNION across ranks, a rank that
+  skipped a collective still leaves the step in the window
+* zero-comm steps read overlap efficiency 1.0, never NaN
+* a dtype mix round-trips and only fp32 all-reduce bytes feed the
+  ALLREDUCE_QUANTIZABLE series
+* ring eviction stays in lockstep with a deque of the same maxlen
+* ``TRACEML_COLLECTIVES=0`` kills recording and sampler registration
+* ``COLLECTIVE_OPS`` (columnar vocabulary) == ``OP_KINDS`` (recorder)
+"""
+
+import math
+import random
+from collections import deque
+
+import pytest
+
+from traceml_tpu.aggregator.sqlite_writer import SQLiteWriter
+from traceml_tpu.diagnostics.collectives.api import diagnose_collectives_window
+from traceml_tpu.instrumentation import collectives as IC
+from traceml_tpu.reporting.snapshot_store import LiveSnapshotStore
+from traceml_tpu.samplers.collectives_sampler import aggregate_collective_records
+from traceml_tpu.telemetry.envelope import SenderIdentity, build_telemetry_envelope
+from traceml_tpu.utils.columnar import (
+    COLLECTIVE_OPS,
+    CollectivesColumns,
+    ColumnarFallback,
+    build_collectives_window_rows,
+    build_columnar_collectives_window,
+    collectives_window_to_plain,
+)
+
+
+# -- row factories -------------------------------------------------------
+
+
+def _row(step, op="all_reduce", dtype="float32", count=1, nbytes=1 << 20,
+         group=8, dur=4.0, exposed=None):
+    return {
+        "step": step,
+        "timestamp": 100.0 + step,
+        "op": op,
+        "dtype": dtype,
+        "count": count,
+        "bytes": nbytes,
+        "group_size": group,
+        "duration_ms": dur,
+        "exposed_ms": dur if exposed is None else exposed,
+    }
+
+
+def _rand_rows(rng, steps, ops=("all_reduce", "all_gather", "reduce_scatter"),
+               dtypes=("float32", "bfloat16")):
+    rows = []
+    for s in steps:
+        for op in ops:
+            if rng.random() < 0.3:
+                continue  # ragged op participation within a step
+            dur = rng.uniform(0.0, 8.0)
+            rows.append(
+                _row(
+                    s,
+                    op=op,
+                    dtype=rng.choice(dtypes),
+                    count=rng.randint(1, 4),
+                    nbytes=rng.randint(0, 1 << 22),
+                    group=rng.choice((4, 8)),
+                    dur=dur,
+                    exposed=dur * rng.random(),
+                )
+            )
+    return rows
+
+
+def _cols_for(rank_rows, cap=512):
+    out = {}
+    for rank, rows in rank_rows.items():
+        c = CollectivesColumns(cap)
+        for row in rows:
+            c.append(row)
+        out[rank] = c
+    return out
+
+
+def _assert_golden(rank_rows, max_steps, cap=512):
+    scalar = build_collectives_window_rows(rank_rows, max_steps=max_steps)
+    columnar = build_columnar_collectives_window(
+        _cols_for(rank_rows, cap), max_steps
+    )
+    assert collectives_window_to_plain(scalar) == collectives_window_to_plain(
+        columnar
+    )
+    return columnar
+
+
+# -- golden edge cases ---------------------------------------------------
+
+
+def test_vocabulary_pinned_to_recorder():
+    # the columnar op vocabulary and the recorder's canonical kinds must
+    # stay the same tuple — a new op kind needs both sides updated
+    assert COLLECTIVE_OPS == IC.OP_KINDS
+
+
+def test_ragged_participation_union_of_steps():
+    rng = random.Random(21)
+    rank_rows = {
+        r: _rand_rows(rng, range(rng.randint(0, 6), 40)) for r in range(6)
+    }
+    # one rank reports only even steps — union keeps the odd ones
+    rank_rows[6] = _rand_rows(rng, range(0, 40, 2))
+    w = _assert_golden(rank_rows, max_steps=30)
+    assert w is not None and w.n_steps == 30
+    assert w.ranks == list(range(7))
+
+
+def test_zero_comm_steps_efficiency_one_not_nan():
+    rows = [
+        _row(1, dur=4.0, exposed=1.0),
+        _row(2, dur=0.0, exposed=0.0),  # a step with zero comm time
+        _row(3, dur=2.0, exposed=2.0),
+    ]
+    w = _assert_golden({0: rows}, max_steps=10)
+    effs = w.per_step["overlap_efficiency"]
+    assert not any(math.isnan(e) for e in effs)
+    assert effs[1] == 1.0
+    assert effs[0] == 0.75 and effs[2] == 0.0
+    # an all-zero window keeps the invariant at the totals level too
+    w0 = build_collectives_window_rows(
+        {0: [_row(1, dur=0.0, exposed=0.0)]}, max_steps=10
+    )
+    assert w0.totals["overlap_efficiency"] == 1.0
+
+
+def test_dtype_mix_and_fp32_allreduce_series():
+    rows = [
+        _row(1, op="all_reduce", dtype="float32", nbytes=100),
+        _row(1, op="all_reduce", dtype="bfloat16", nbytes=7),
+        _row(1, op="all_gather", dtype="float32", nbytes=1000),  # not AR
+        _row(2, op="all_reduce", dtype="float32", nbytes=200),
+        _row(2, op="all_reduce", dtype="int8", nbytes=13),
+    ]
+    w = _assert_golden({0: rows}, max_steps=10)
+    assert w.per_step["allreduce_fp32_bytes"] == [100, 200]
+    assert w.per_op["all_reduce"]["bytes"] == 100 + 7 + 200 + 13
+    assert w.per_op["all_gather"]["bytes"] == 1000
+
+
+def test_unknown_op_folds_into_other():
+    rows = [_row(1, op="fancy_ring_exchange"), _row(1, op="all_reduce")]
+    w = _assert_golden({0: rows}, max_steps=10)
+    assert "other" in w.per_op and "all_reduce" in w.per_op
+
+
+def test_ring_eviction_matches_deque_maxlen():
+    rng = random.Random(22)
+    cap = 16
+    cols = CollectivesColumns(cap)
+    rows = deque(maxlen=cap)
+    step = 0
+    for i in range(3 * cap + 5):  # force several compactions
+        step += rng.randint(0, 2)  # non-decreasing, repeats allowed
+        row = _row(
+            step,
+            op=rng.choice(COLLECTIVE_OPS),
+            dur=rng.uniform(0, 5),
+            exposed=0.0,
+        )
+        cols.append(row)
+        rows.append(row)
+        scalar = build_collectives_window_rows({0: list(rows)}, max_steps=12)
+        columnar = build_columnar_collectives_window({0: cols}, 12)
+        assert collectives_window_to_plain(
+            scalar
+        ) == collectives_window_to_plain(columnar)
+    assert len(cols) == cap
+
+
+# -- fallback flagging ---------------------------------------------------
+
+
+def test_out_of_order_step_flags_fallback():
+    cols = CollectivesColumns(16)
+    cols.append(_row(5))
+    cols.append(_row(3))
+    assert not cols.columnar_ok
+    with pytest.raises(ColumnarFallback):
+        build_columnar_collectives_window({0: cols}, 10)
+
+
+def test_malformed_values_flag_fallback():
+    for bad in (
+        _row(1, nbytes=-4),                      # negative volume
+        _row(1, nbytes=2**60),                   # beyond exact float64
+        _row(1, dur=3.0, exposed=5.0),           # exposed > duration
+        dict(_row(1), count="two"),              # non-int count
+        dict(_row(1), step=True),                # bool step
+    ):
+        cols = CollectivesColumns(16)
+        cols.append(bad)
+        assert not cols.columnar_ok
+
+
+def test_dtype_vocab_overflow_flags_fallback():
+    cols = CollectivesColumns(256)
+    for i in range(70):  # _COLL_DTYPE_VOCAB_MAX is 64
+        cols.append(_row(i + 1, dtype=f"custom{i}"))
+    assert not cols.columnar_ok
+
+
+# -- sampler aggregation -------------------------------------------------
+
+
+def test_aggregate_collective_records_merges_by_step_op_dtype():
+    recs = [
+        {"step": 1, "ts": 1.0, "op": "all_reduce", "dtype": "float32",
+         "bytes": 100, "group_size": 8, "duration_ms": 2.0, "exposed_ms": 1.0},
+        {"step": 1, "ts": 1.1, "op": "all_reduce", "dtype": "float32",
+         "bytes": 50, "group_size": 4, "duration_ms": 1.0, "exposed_ms": 0.5},
+        {"step": 1, "ts": 1.2, "op": "all_gather", "dtype": "float32",
+         "bytes": 10, "group_size": 8, "duration_ms": 0.5, "exposed_ms": 0.0},
+        {"step": 2, "ts": 2.0, "op": "all_reduce", "dtype": "float32",
+         "bytes": 100, "group_size": 8, "duration_ms": 2.0, "exposed_ms": 2.0},
+    ]
+    rows = aggregate_collective_records(recs)
+    key = {(r["step"], r["op"], r["dtype"]): r for r in rows}
+    assert len(rows) == 3
+    ar1 = key[(1, "all_reduce", "float32")]
+    assert ar1["count"] == 2 and ar1["bytes"] == 150
+    assert ar1["duration_ms"] == 3.0 and ar1["exposed_ms"] == 1.5
+    assert ar1["group_size"] == 8  # max across merged records
+
+
+# -- kill switch ---------------------------------------------------------
+
+
+def test_kill_switch_disables_recording_and_sampler(tmp_path, monkeypatch):
+    monkeypatch.setenv("TRACEML_COLLECTIVES", "0")
+    monkeypatch.setattr(IC, "_lax_patched", False)
+    assert not IC.collectives_enabled()
+    assert IC.record_collective("all_reduce", duration_ms=1.0) is False
+    assert IC.patch_lax_collectives() is False
+
+    from traceml_tpu.runtime.identity import RuntimeIdentity
+    from traceml_tpu.runtime.sampler_registry import build_samplers
+    from traceml_tpu.runtime.settings import TraceMLSettings
+
+    settings = TraceMLSettings(session_id="s", logs_dir=tmp_path)
+    ident = RuntimeIdentity(global_rank=0, local_rank=0)
+    names = {type(s).__name__ for s in build_samplers(settings, ident)}
+    assert "CollectivesSampler" not in names
+
+    # the gate is checked per build (not at registration): re-enabling
+    # the env brings the sampler back without re-registering
+    monkeypatch.setenv("TRACEML_COLLECTIVES", "1")
+    names = {type(s).__name__ for s in build_samplers(settings, ident)}
+    assert "CollectivesSampler" in names
+
+
+def test_record_collective_enqueues_and_clamps(monkeypatch):
+    monkeypatch.delenv("TRACEML_COLLECTIVES", raising=False)
+    IC.GLOBAL_COLLECTIVES_QUEUE.drain()
+    assert IC.record_collective(
+        "psum", nbytes=64, dtype="float32", group_size=8,
+        duration_ms=2.0, exposed_ms=5.0, step=7,
+    )
+    (rec,) = IC.GLOBAL_COLLECTIVES_QUEUE.drain()
+    assert rec["op"] == "all_reduce"  # alias normalized
+    assert rec["exposed_ms"] == 2.0   # clamped to duration
+    assert rec["step"] == 7
+
+
+# -- store-level integration (ingest → cursor read → trim lockstep) ------
+
+
+def _ident(rank=0):
+    return SenderIdentity(
+        session_id="s1",
+        global_rank=rank,
+        local_rank=rank,
+        world_size=2,
+        node_rank=0,
+        hostname="host-0",
+        pid=100 + rank,
+    )
+
+
+def _ingest(w, rank, rows):
+    w.ingest(
+        build_telemetry_envelope("collectives", {"collectives": rows}, _ident(rank))
+    )
+
+
+def test_store_columnar_window_matches_scalar_rows(tmp_path):
+    rng = random.Random(23)
+    db = tmp_path / "t.sqlite"
+    w = SQLiteWriter(db)
+    w.start()
+    store = LiveSnapshotStore(db, window_steps=40)
+    for rank in (0, 1):
+        _ingest(w, rank, _rand_rows(rng, range(1, 31)))
+    assert w.force_flush()
+    store.refresh()
+
+    assert store.has_collectives_rows()
+    win = store.build_collectives_window(max_steps=20)
+    scalar = build_collectives_window_rows(
+        store.collectives_rows(), max_steps=20
+    )
+    assert collectives_window_to_plain(win) == collectives_window_to_plain(
+        scalar
+    )
+
+    # incremental append advances the window identically (dirty-gated
+    # cursor read + ring/deque lockstep through eviction)
+    for rank in (0, 1):
+        _ingest(w, rank, _rand_rows(rng, range(31, 41)))
+    assert w.force_flush()
+    store.refresh()
+    win2 = store.build_collectives_window(max_steps=20)
+    scalar2 = build_collectives_window_rows(
+        store.collectives_rows(), max_steps=20
+    )
+    assert collectives_window_to_plain(win2) == collectives_window_to_plain(
+        scalar2
+    )
+    assert win2.steps[-1] == 40
+    w.finalize()
+    store.close()
+
+
+# -- diagnosis fixtures --------------------------------------------------
+
+
+def test_comm_bound_fires_on_comm_heavy_window():
+    rows = [_row(s, dur=30.0, exposed=30.0) for s in range(1, 31)]
+    w = build_collectives_window_rows({0: rows, 1: rows}, max_steps=60)
+    result = diagnose_collectives_window(w, mode="summary", step_time_ms=100.0)
+    # 60 ms exposed across 2 ranks ÷ 100 ms step = 0.6 ≥ 0.40 critical
+    assert result.diagnosis.kind == "COMM_BOUND"
+    assert result.diagnosis.severity == "critical"
+
+
+def test_comm_bound_silent_on_compute_only_window():
+    rows = [
+        _row(s, dtype="bfloat16", nbytes=4096, dur=0.05, exposed=0.05)
+        for s in range(1, 31)
+    ]
+    w = build_collectives_window_rows({0: rows}, max_steps=60)
+    result = diagnose_collectives_window(w, mode="summary", step_time_ms=100.0)
+    assert all(i.kind != "COMM_BOUND" for i in result.issues)
+    assert result.healthy
+
+
+def test_poor_overlap_fires_with_step_headroom():
+    rows = [
+        _row(s, dur=10.0, exposed=(9.0 if s <= 20 else 0.5))
+        for s in range(1, 31)
+    ]
+    w = build_collectives_window_rows({0: rows}, max_steps=60)
+    result = diagnose_collectives_window(w, mode="summary")
+    kinds = {i.kind for i in result.issues}
+    assert "POOR_OVERLAP" in kinds
+    # no step-time denominator was provided → COMM_BOUND must stay quiet
+    assert "COMM_BOUND" not in kinds
+
+
+def test_allreduce_quantizable_info_on_stable_fp32_payload():
+    rows = [
+        _row(s, op="all_reduce", dtype="float32", nbytes=2 << 20,
+             dur=5.0, exposed=0.0)
+        for s in range(1, 31)
+    ]
+    w = build_collectives_window_rows({0: rows}, max_steps=60)
+    result = diagnose_collectives_window(w, mode="summary")
+    quant = [i for i in result.issues if i.kind == "ALLREDUCE_QUANTIZABLE"]
+    assert quant and quant[0].severity == "info"
+
+
+def test_insufficient_data_below_min_steps():
+    rows = [_row(s) for s in range(1, 4)]
+    w = build_collectives_window_rows({0: rows}, max_steps=60)
+    result = diagnose_collectives_window(w, mode="summary")
+    assert result.diagnosis.kind == "INSUFFICIENT_COLLECTIVES_DATA"
+    assert diagnose_collectives_window(None).diagnosis.kind == (
+        "INSUFFICIENT_COLLECTIVES_DATA"
+    )

@@ -31,6 +31,9 @@ _API_SYMBOLS = (
     "wrap_backward",
     "wrap_optimizer",
     "wrap_collective",
+    "instrument_collective",
+    "patch_lax_collectives",
+    "record_collective",
     "wrap_checkpoint",
     "current_step",
     "enable_ici_stats",

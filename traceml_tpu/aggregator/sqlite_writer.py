@@ -61,7 +61,7 @@ _QUEUE_LOW_MAX = 10_000
 # stdout_stderr, unknown samplers) sheds first under overload.  Control
 # messages never reach this queue: the aggregator handles them inline,
 # ahead of any telemetry backpressure.
-HIGH_PRIORITY_SAMPLERS = frozenset({"step_time", "step_memory"})
+HIGH_PRIORITY_SAMPLERS = frozenset({"step_time", "step_memory", "collectives"})
 PRIORITY_NAMES = ("high", "low")
 
 # group-commit thresholds: commit when this many envelopes are pending,
@@ -150,6 +150,13 @@ class SQLiteWriter:
         self._drops_since_warn = 0
         self.drop_warnings = 0
 
+        # envelopes whose sampler has no registered projection writer —
+        # counted and surfaced instead of silently skipped (a version-skewed
+        # producer shipping a new domain must be visible in ingest stats)
+        self._unknown_by_domain: Dict[str, int] = {}
+        self._last_unknown_warn = 0.0
+        self._unknown_since_warn = 0
+
         # retention bookkeeping (writer thread only)
         self._part_counts: Dict[Tuple[str, str, int], int] = {}
         self._prune_due: Deque[Tuple[str, str, int]] = deque()
@@ -226,6 +233,31 @@ class SQLiteWriter:
                 f"{sampler}); dropped by domain so far: {totals}"
             )
 
+    def _record_unknown_domain(self, sampler: str) -> None:
+        """An envelope named a table with no registered writer.  Neither
+        raise nor vanish: count it per domain for ingest_stats.json and
+        warn rate-limited (the producer may be a newer version shipping a
+        domain this aggregator doesn't know)."""
+        warn_count = 0
+        with self._stats_lock:
+            self._unknown_by_domain[sampler] = (
+                self._unknown_by_domain.get(sampler, 0) + 1
+            )
+            self._unknown_since_warn += 1
+            now = time.monotonic()
+            if now - self._last_unknown_warn >= _DROP_WARN_INTERVAL_S:
+                self._last_unknown_warn = now
+                warn_count = self._unknown_since_warn
+                self._unknown_since_warn = 0
+                totals = dict(self._unknown_by_domain)
+        if warn_count:
+            get_error_log().warning(
+                f"no projection writer for telemetry domain {sampler!r}: "
+                f"dropped {warn_count} envelope(s) in the last "
+                f"{_DROP_WARN_INTERVAL_S:.0f}s; unknown-domain drops so "
+                f"far: {totals}"
+            )
+
     def force_flush(self, timeout: float = 10.0) -> bool:
         """Barrier: returns once everything enqueued so far is committed
         (reference: sqlite_writer.py:168).  One barrier per priority
@@ -267,6 +299,7 @@ class SQLiteWriter:
         with self._stats_lock:
             enq = dict(self._enq_by_domain)
             drop = dict(self._drop_by_domain)
+            unknown = dict(self._unknown_by_domain)
             hwm = list(self._queue_hwm)
         queues = {}
         for pri, name in enumerate(PRIORITY_NAMES):
@@ -282,6 +315,7 @@ class SQLiteWriter:
             "written": self.written,
             "enqueued_by_domain": enq,
             "dropped_by_domain": drop,
+            "unknown_domain_drops": unknown,
             "drop_warnings": self.drop_warnings,
             "queues": queues,
             "group_commit": {
@@ -482,6 +516,7 @@ class SQLiteWriter:
                 writer = writer_for(env.sampler)
                 self._writer_cache[env.sampler] = writer
             if writer is None:
+                self._record_unknown_domain(env.sampler)
                 continue
             try:
                 table_rows = writer.build_rows(env)

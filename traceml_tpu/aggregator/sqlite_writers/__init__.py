@@ -7,6 +7,7 @@ Uniform contract per module: ``accepts_sampler(name)``,
 """
 
 from traceml_tpu.aggregator.sqlite_writers import (  # noqa: F401
+    collectives_writer,
     process_writer,
     step_memory_writer,
     step_time_writer,
@@ -19,6 +20,7 @@ ALL_WRITERS = [
     process_writer,
     step_time_writer,
     step_memory_writer,
+    collectives_writer,
     stdout_writer,
 ]
 

@@ -217,6 +217,7 @@ class TraceMLAggregator:
                 "rows_dropped": self.writer.dropped,
                 "enqueued_by_domain": wstats["enqueued_by_domain"],
                 "dropped_by_domain": wstats["dropped_by_domain"],
+                "unknown_domain_drops": wstats["unknown_domain_drops"],
                 "drop_warnings": wstats["drop_warnings"],
                 "queues": wstats["queues"],
                 "group_commit": wstats["group_commit"],

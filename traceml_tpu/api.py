@@ -18,6 +18,11 @@ from traceml_tpu.sdk.wrappers import (  # noqa: F401
     wrap_optimizer,
 )
 from traceml_tpu.instrumentation.dataloader import wrap_dataloader  # noqa: F401
+from traceml_tpu.instrumentation.collectives import (  # noqa: F401
+    instrument_collective,
+    patch_lax_collectives,
+    record_collective,
+)
 from traceml_tpu.sdk.summary_client import (  # noqa: F401
     final_summary,
     live_metrics,

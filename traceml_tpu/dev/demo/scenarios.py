@@ -12,8 +12,11 @@ Scenarios and their expected verdicts:
 * ``compute_straggler`` → COMPUTE_STRAGGLER (extra matmuls on one rank)
 * ``collective_straggler`` → COLLECTIVE_STRAGGLER (one rank's explicit
   gradient-sync collective is slow — degraded ICI link analogue; uses
-  ``wrap_collective`` so the time lands in the first-class ``collective``
-  phase)
+  ``instrument_collective`` so the time lands in the first-class
+  ``collective`` phase AND the collectives telemetry domain)
+* ``comm_bound``        → COMM_BOUND (every rank's gradient sync is a
+  slow, host-blocking — fully exposed — all-reduce; the collectives
+  domain reports low overlap efficiency and a dominant exposed share)
 * ``checkpoint_stall``  → checkpoint phase visible (a blocking save
   every few steps; with orbax installed the auto-patch times a REAL
   PyTreeCheckpointer save, else a wrap_checkpoint'd stand-in)
@@ -141,7 +144,8 @@ def run_scenario(name: str, steps: int = 80) -> None:
     elif name == "collective_straggler":
         # each rank dispatches an explicit "gradient sync" outside the
         # fused step; the last rank's link is slow (ICI degradation
-        # analogue).  trace via wrap_collective → collective phase.
+        # analogue).  instrument_collective keeps the wrap_collective
+        # phase timing AND records the sync in the collectives domain.
         world = int(os.environ.get("WORLD_SIZE", 1))
         slow_rank = world - 1
 
@@ -151,13 +155,38 @@ def run_scenario(name: str, steps: int = 80) -> None:
             time.sleep(0.12 if _rank() == slow_rank else 0.02)
             return jax.tree_util.tree_map(sync_op, tree)
 
-        timed_sync = traceml_tpu.wrap_collective(gradient_sync)
+        timed_sync = traceml_tpu.instrument_collective(
+            gradient_sync, op="all_reduce", group_size=max(1, world)
+        )
         loader = _batches(steps)
         for x, y in traceml_tpu.wrap_dataloader(loader):
             with traceml_tpu.trace_step():
                 x, y = jax.device_put(x), jax.device_put(y)
                 params, opt_state, loss = step(params, opt_state, x, y)
                 params = timed_sync(params)
+
+    elif name == "comm_bound":
+        # every rank's gradient sync is slow and host-blocking — fully
+        # exposed comm, no overlap.  The collectives domain should
+        # report COMM_BOUND (exposed share of the step well past the
+        # warn bar) with near-zero overlap efficiency; the compute-only
+        # scenarios above must stay silent on this rule.
+        world = int(os.environ.get("WORLD_SIZE", 1))
+        sync_op = jax.jit(lambda t: t * (1.0 / max(1, world)))
+
+        def gradient_sync(tree):
+            time.sleep(0.03)
+            return jax.tree_util.tree_map(sync_op, tree)
+
+        sync = traceml_tpu.instrument_collective(
+            gradient_sync, op="all_reduce", group_size=max(1, world)
+        )
+        loader = _batches(steps)
+        for x, y in traceml_tpu.wrap_dataloader(loader):
+            with traceml_tpu.trace_step():
+                x, y = jax.device_put(x), jax.device_put(y)
+                params, opt_state, loss = step(params, opt_state, x, y)
+                params = sync(params)
 
     elif name == "checkpoint_stall":
         # blocking save every 5 steps; time lands in the checkpoint

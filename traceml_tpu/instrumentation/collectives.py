@@ -1,0 +1,412 @@
+"""Collective-communication capture
+(motivated by T3, arXiv:2401.16677 — fine-grained compute/collective
+overlap tracking — and EQuARX, arXiv:2506.17615 — quantized AllReduce).
+
+Two sources feed one bounded queue of per-collective records:
+
+1. **Profiler trace events** (preferred, when a capture is running):
+   :func:`extract_collectives_from_trace_events` maps XLA trace rows
+   (``all-reduce``, ``all-gather``, ``reduce-scatter``, ``all-to-all``,
+   ``collective-permute`` fusions) to canonical records, including the
+   *exposed* portion of each collective — the span NOT covered by a
+   concurrently running compute op.  A capture backend registers itself
+   via :func:`register_trace_source`; none is required.
+
+2. **Pure-Python fallback** (always available, mirrors the
+   ColumnarFallback philosophy — correctness never depends on the
+   profiler): :func:`instrument_collective` wraps a host-dispatched
+   collective callable (gradient sync, manual ring hop), and
+   :func:`patch_lax_collectives` wraps the eager ``jax.lax`` collective
+   entry points.  Both time the host window, estimate bytes/dtype from
+   the output pytree, and record the call as fully exposed unless the
+   caller declares overlap — a host-blocking dispatch IS exposed comm.
+
+Every record is a flat uniform dict (plays well with the r10 columnar
+producer accumulators)::
+
+    {"step", "ts", "op", "dtype", "bytes", "group_size",
+     "duration_ms", "exposed_ms"}
+
+Kill switch: ``TRACEML_COLLECTIVES=0`` turns every entry point into a
+no-op (and unregisters the sampler — see runtime/sampler_registry.py).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from traceml_tpu.utils.error_log import get_error_log
+from traceml_tpu.utils.timing import BoundedDropQueue
+
+# --- canonical op vocabulary ------------------------------------------------
+OP_ALL_REDUCE = "all_reduce"
+OP_ALL_GATHER = "all_gather"
+OP_REDUCE_SCATTER = "reduce_scatter"
+OP_ALL_TO_ALL = "all_to_all"
+OP_P2P = "p2p"
+OP_OTHER = "other"
+
+OP_KINDS = (
+    OP_ALL_REDUCE,
+    OP_ALL_GATHER,
+    OP_REDUCE_SCATTER,
+    OP_ALL_TO_ALL,
+    OP_P2P,
+    OP_OTHER,
+)
+
+# XLA HLO / trace-event spellings → canonical kind.  Longest-prefix style
+# matching happens in normalize_op; these are exact (lowered) aliases.
+_OP_ALIASES: Dict[str, str] = {
+    "all_reduce": OP_ALL_REDUCE,
+    "all-reduce": OP_ALL_REDUCE,
+    "allreduce": OP_ALL_REDUCE,
+    "psum": OP_ALL_REDUCE,
+    "pmean": OP_ALL_REDUCE,
+    "pmax": OP_ALL_REDUCE,
+    "pmin": OP_ALL_REDUCE,
+    "cross-replica-sum": OP_ALL_REDUCE,
+    "all_gather": OP_ALL_GATHER,
+    "all-gather": OP_ALL_GATHER,
+    "allgather": OP_ALL_GATHER,
+    "reduce_scatter": OP_REDUCE_SCATTER,
+    "reduce-scatter": OP_REDUCE_SCATTER,
+    "reducescatter": OP_REDUCE_SCATTER,
+    "psum_scatter": OP_REDUCE_SCATTER,
+    "all_to_all": OP_ALL_TO_ALL,
+    "all-to-all": OP_ALL_TO_ALL,
+    "alltoall": OP_ALL_TO_ALL,
+    "collective-permute": OP_P2P,
+    "collective_permute": OP_P2P,
+    "ppermute": OP_P2P,
+    "send": OP_P2P,
+    "recv": OP_P2P,
+}
+
+_QUEUE_MAX = 8192
+
+
+def collectives_enabled() -> bool:
+    return os.environ.get("TRACEML_COLLECTIVES", "1").strip().lower() not in (
+        "0",
+        "false",
+        "off",
+    )
+
+
+# Global queue shared by the recorders above and CollectivesSampler.
+GLOBAL_COLLECTIVES_QUEUE = BoundedDropQueue("collectives", maxsize=_QUEUE_MAX)
+
+
+def normalize_op(name: Any) -> str:
+    """Canonicalize an op spelling (HLO name, jax.lax name, user string)."""
+    s = str(name or "").strip().lower()
+    if s in _OP_ALIASES:
+        return _OP_ALIASES[s]
+    if s in OP_KINDS:
+        return s
+    # trace events carry suffixed HLO names ("all-reduce.17", fusion tags)
+    for alias, kind in _OP_ALIASES.items():
+        if s.startswith(alias):
+            return kind
+    return OP_OTHER
+
+
+def bytes_of(tree: Any) -> Tuple[int, str]:
+    """Best-effort (payload bytes, dtype) of a collective's output pytree.
+
+    Dtype is taken from the largest leaf — for a fused sync that's the
+    gradient payload, which is what ALLREDUCE_QUANTIZABLE cares about.
+    """
+    leaves: Sequence[Any]
+    try:
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(tree)
+    except Exception:
+        leaves = [tree]
+    total = 0
+    dtype = ""
+    best = -1
+    for leaf in leaves:
+        try:
+            n = int(leaf.nbytes)
+        except Exception:
+            continue
+        total += n
+        if n > best:
+            best = n
+            dtype = str(getattr(leaf, "dtype", "") or "")
+    return total, dtype
+
+
+def _current_step() -> int:
+    try:
+        from traceml_tpu.sdk.state import get_state
+
+        return int(get_state().current_step)
+    except Exception:
+        return 0
+
+
+def record_collective(
+    op: str,
+    *,
+    nbytes: int = 0,
+    dtype: str = "",
+    group_size: int = 1,
+    duration_ms: float = 0.0,
+    exposed_ms: Optional[float] = None,
+    overlapped: bool = False,
+    step: Optional[int] = None,
+    ts: Optional[float] = None,
+) -> bool:
+    """Record one collective occurrence.  Never raises; returns whether
+    the record was enqueued (False: disabled or queue full).
+
+    ``exposed_ms`` is the portion of ``duration_ms`` NOT hidden behind
+    compute.  When omitted it defaults from the coarse ``overlapped``
+    flag: fully exposed (fallback, host-blocking dispatch) or fully
+    hidden.  Profiler sources pass the measured value.
+    """
+    if not collectives_enabled():
+        return False
+    try:
+        dur = max(0.0, float(duration_ms))
+        if exposed_ms is None:
+            exp = 0.0 if overlapped else dur
+        else:
+            exp = min(dur, max(0.0, float(exposed_ms)))
+        rec = {
+            "step": int(step) if step is not None else _current_step(),
+            "ts": float(ts) if ts is not None else time.time(),
+            "op": normalize_op(op),
+            "dtype": str(dtype or ""),
+            "bytes": max(0, int(nbytes)),
+            "group_size": max(1, int(group_size)),
+            "duration_ms": dur,
+            "exposed_ms": exp,
+        }
+    except Exception as exc:
+        get_error_log().warning("record_collective failed", exc)
+        return False
+    return GLOBAL_COLLECTIVES_QUEUE.put(rec)
+
+
+# --- profiler trace-event source (preferred when a capture runs) ------------
+
+_trace_sources: List[Callable[[], List[Dict[str, Any]]]] = []
+
+
+def register_trace_source(fn: Callable[[], List[Dict[str, Any]]]) -> None:
+    """Register a callable returning raw trace-event dicts to harvest.
+    The sampler drains it each tick; exceptions disable nothing (the
+    fallback recorders keep the domain alive)."""
+    _trace_sources.append(fn)
+
+
+def clear_trace_sources() -> None:
+    _trace_sources.clear()
+
+
+def drain_trace_sources() -> List[Dict[str, Any]]:
+    events: List[Dict[str, Any]] = []
+    for src in list(_trace_sources):
+        try:
+            events.extend(src() or [])
+        except Exception as exc:
+            get_error_log().warning("collective trace source failed", exc)
+    return events
+
+
+def extract_collectives_from_trace_events(
+    events: Sequence[Dict[str, Any]],
+    default_step: Optional[int] = None,
+) -> List[Dict[str, Any]]:
+    """Map raw XLA trace events to canonical collective records.
+
+    Expects the chrome-trace-ish rows the profiler emits: ``name``,
+    ``dur`` (µs), ``ts`` (µs), optional ``args`` with
+    ``bytes_accessed``/``shape``/``dtype``/``group_size``/``step``.
+    Exposure: a trace row may carry ``args.exposed_us`` (computed by the
+    capture backend from concurrent compute spans); otherwise the event
+    counts as fully exposed — the conservative reading.
+
+    Pure function (unit-testable without a profiler present).
+    """
+    out: List[Dict[str, Any]] = []
+    for ev in events:
+        try:
+            op = normalize_op(ev.get("name"))
+            if op == OP_OTHER and normalize_op(str(ev.get("name"))) == OP_OTHER:
+                # not a collective at all → skip non-matching trace rows
+                if not any(
+                    str(ev.get("name", "")).lower().startswith(a)
+                    for a in _OP_ALIASES
+                ):
+                    continue
+            args = ev.get("args") or {}
+            dur_ms = float(ev.get("dur", 0.0)) / 1000.0
+            exposed_us = args.get("exposed_us")
+            step = args.get("step", default_step)
+            rec = {
+                "step": int(step) if step is not None else _current_step(),
+                "ts": float(ev.get("ts", 0.0)) / 1e6 or time.time(),
+                "op": op,
+                "dtype": str(args.get("dtype", "") or ""),
+                "bytes": max(0, int(args.get("bytes_accessed", 0) or 0)),
+                "group_size": max(1, int(args.get("group_size", 1) or 1)),
+                "duration_ms": max(0.0, dur_ms),
+                "exposed_ms": (
+                    min(max(0.0, float(exposed_us) / 1000.0), max(0.0, dur_ms))
+                    if exposed_us is not None
+                    else max(0.0, dur_ms)
+                ),
+            }
+            out.append(rec)
+        except Exception:
+            continue  # one malformed row never poisons the batch
+    return out
+
+
+# --- pure-Python fallback recorders ----------------------------------------
+
+
+def _default_group_size() -> int:
+    try:
+        import jax
+
+        return int(jax.device_count())
+    except Exception:
+        return 1
+
+
+def instrument_collective(
+    fn: Callable,
+    op: str = OP_ALL_REDUCE,
+    state: Any = None,
+    group_size: Optional[int] = None,
+    overlapped: bool = False,
+) -> Callable:
+    """Fallback capture for a host-dispatched collective callable.
+
+    Composes with the step-phase machinery: the call is also timed as
+    the first-class ``collective`` phase (sdk wrap_collective), so
+    COLLECTIVE_STRAGGLER attribution keeps working, and additionally
+    emits a collectives-domain record with bytes/dtype estimated from
+    the outputs.  A host-blocking dispatch is recorded fully exposed
+    unless the caller declares ``overlapped=True`` (e.g. an async
+    dispatch known to run under compute).
+    """
+    from traceml_tpu.sdk.wrappers import wrap_collective
+
+    timed = wrap_collective(fn, state)
+    kind = normalize_op(op)
+
+    @functools.wraps(fn)
+    def wrapped(*args: Any, **kwargs: Any):
+        if not collectives_enabled():
+            return timed(*args, **kwargs)
+        t0 = time.perf_counter()
+        out = timed(*args, **kwargs)
+        dur_ms = (time.perf_counter() - t0) * 1000.0
+        try:
+            nbytes, dtype = bytes_of(out)
+            record_collective(
+                kind,
+                nbytes=nbytes,
+                dtype=dtype,
+                group_size=(
+                    group_size if group_size is not None else _default_group_size()
+                ),
+                duration_ms=dur_ms,
+                overlapped=overlapped,
+            )
+        except Exception as exc:  # never raise into user code
+            get_error_log().warning("instrument_collective record failed", exc)
+        return out
+
+    wrapped._traceml_collective_instrumented = True  # type: ignore[attr-defined]
+    return wrapped
+
+
+# jax.lax entry point → canonical op kind for the eager-call patches
+_LAX_COLLECTIVES = {
+    "psum": OP_ALL_REDUCE,
+    "pmean": OP_ALL_REDUCE,
+    "pmax": OP_ALL_REDUCE,
+    "pmin": OP_ALL_REDUCE,
+    "all_gather": OP_ALL_GATHER,
+    "psum_scatter": OP_REDUCE_SCATTER,
+    "all_to_all": OP_ALL_TO_ALL,
+    "ppermute": OP_P2P,
+}
+
+_lax_patched = False
+
+
+def _is_tracing(args: Any, kwargs: Any) -> bool:
+    """True when any argument is a JAX tracer — i.e. we are inside a
+    jit/pmap trace, where wall time measures tracing, not communication,
+    and one trace serves many steps.  Such calls are skipped."""
+    try:
+        import jax
+
+        for leaf in jax.tree_util.tree_leaves((args, kwargs)):
+            if isinstance(leaf, jax.core.Tracer):
+                return True
+    except Exception:
+        pass
+    return False
+
+
+def patch_lax_collectives() -> bool:
+    """Wrap the eager ``jax.lax`` collective entry points so call sites
+    need no code change.  Trace-time calls (tracer arguments) pass
+    through unrecorded; only host-dispatched eager calls are timed.
+    Idempotent; returns whether the patch is installed."""
+    global _lax_patched
+    if _lax_patched:
+        return True
+    if not collectives_enabled():
+        return False
+    try:
+        import jax
+    except Exception:
+        return False
+    lax = jax.lax
+    for name, kind in _LAX_COLLECTIVES.items():
+        orig = getattr(lax, name, None)
+        if orig is None or getattr(orig, "_traceml_collective_instrumented", False):
+            continue
+
+        def make(orig: Callable, kind: str) -> Callable:
+            @functools.wraps(orig)
+            def wrapped(*args: Any, **kwargs: Any):
+                if not collectives_enabled() or _is_tracing(args, kwargs):
+                    return orig(*args, **kwargs)
+                t0 = time.perf_counter()
+                out = orig(*args, **kwargs)
+                dur_ms = (time.perf_counter() - t0) * 1000.0
+                try:
+                    nbytes, dtype = bytes_of(out)
+                    record_collective(
+                        kind,
+                        nbytes=nbytes,
+                        dtype=dtype,
+                        group_size=_default_group_size(),
+                        duration_ms=dur_ms,
+                    )
+                except Exception:
+                    pass
+                return out
+
+            wrapped._traceml_collective_instrumented = True  # type: ignore[attr-defined]
+            return wrapped
+
+        setattr(lax, name, make(orig, kind))
+    _lax_patched = True
+    return True

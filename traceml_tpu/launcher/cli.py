@@ -97,6 +97,15 @@ def _build_parser() -> argparse.ArgumentParser:
     insp = sub.add_parser("inspect", help="decode per-rank disk backups")
     insp.add_argument("path", help="a rank data dir or .msgpack file")
     insp.add_argument("--limit", type=int, default=20)
+    insp.add_argument(
+        "--domain",
+        default=None,
+        help=(
+            "only rows from this telemetry domain (table name, e.g. "
+            "collectives — which also gains a derived overlap_efficiency "
+            "column)"
+        ),
+    )
 
     prof = sub.add_parser(
         "profile",
@@ -158,7 +167,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.command == "inspect":
         from traceml_tpu.launcher.inspect_cmd import run_inspect
 
-        return run_inspect(Path(args.path), limit=args.limit)
+        return run_inspect(Path(args.path), limit=args.limit, domain=args.domain)
     if args.command == "watch":
         from traceml_tpu.launcher.watch_cmd import run_watch
 

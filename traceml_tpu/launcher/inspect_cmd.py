@@ -5,17 +5,41 @@ Handles both backup frame formats (see database/database_writer.py):
 legacy per-row files print one JSON object per row; envelope files
 (v2, ``envelopes.msgpack``) carry multiple tables per frame, so each
 row is printed with a ``table`` field naming its origin.
+
+``--domain`` filters to one telemetry domain (table name, e.g.
+``collectives``); collectives rows additionally get a derived
+``overlap_efficiency`` column (``1 − exposed_ms/duration_ms``, 1.0 for
+zero-duration rows) so overlap quality is readable straight off the
+backups.
 """
 
 from __future__ import annotations
 
 import json
 from pathlib import Path
+from typing import Any, Dict, Optional
 
 from traceml_tpu.database.database_writer import iter_backup_tables
 
 
-def run_inspect(path: Path, limit: int = 20) -> int:
+def _enrich_row(table: Optional[str], row: Dict[str, Any]) -> Dict[str, Any]:
+    """Derived columns per domain.  Collectives: overlap efficiency."""
+    if table == "collectives" or (table is None and "exposed_ms" in row):
+        try:
+            dur = float(row.get("duration_ms", 0.0) or 0.0)
+            exp = float(row.get("exposed_ms", 0.0) or 0.0)
+            row = dict(row)
+            row["overlap_efficiency"] = (
+                round(1.0 - exp / dur, 4) if dur > 0 else 1.0
+            )
+        except Exception:
+            pass
+    return row
+
+
+def run_inspect(
+    path: Path, limit: int = 20, domain: Optional[str] = None
+) -> int:
     path = Path(path)
     files = []
     if path.is_file():
@@ -25,16 +49,32 @@ def run_inspect(path: Path, limit: int = 20) -> int:
     if not files:
         print(f"no .msgpack backups under {path}")
         return 1
+    matched = 0
     for f in files:
-        print(f"── {f}")
+        printed_header = False
         n = 0
         for table, row in iter_backup_tables(f):
+            # legacy per-row files carry no table tag; fall back to the
+            # file stem so --domain still works on old backups
+            effective = table if table is not None else f.stem
+            if domain is not None and effective != domain:
+                continue
+            if not printed_header:
+                print(f"── {f}")
+                printed_header = True
+            row = _enrich_row(effective, row)
             if table is None:
                 print(json.dumps(row, default=str))
             else:
                 print(json.dumps({"table": table, **row}, default=str))
+            matched += 1
             n += 1
             if n >= limit:
                 print(f"… (showing first {limit})")
                 break
+        if domain is None and not printed_header:
+            print(f"── {f}")
+    if domain is not None and matched == 0:
+        print(f"no rows for domain {domain!r} under {path}")
+        return 1
     return 0

@@ -28,10 +28,13 @@ from traceml_tpu.renderers import views as V
 from traceml_tpu.reporting.snapshot_store import LiveSnapshotStore
 
 # payload domain → (store versions it depends on, views key or None)
+# collectives also depends on step_time: COMM_BOUND needs the mean step
+# duration as the denominator for the exposed-comm share
 _DOMAIN_DEPS: Dict[str, Tuple[Tuple[str, ...], Optional[str]]] = {
     "topology": (("topology",), None),
     "step_time": (("step_time", "model_stats", "topology"), "step_time"),
     "memory": (("step_memory",), "memory"),
+    "collectives": (("collectives", "step_time"), "collectives"),
     "system": (("system", "topology"), "system"),
     "process": (("process",), "process"),
     "stdout": (("stdout",), None),
@@ -168,6 +171,41 @@ class LiveComputer:
             return updates, view
         except Exception as exc:
             return {"step_memory": {"error": str(exc)}}, None
+
+    def _compute_collectives(self) -> Tuple[Dict[str, Any], Any]:
+        try:
+            window = self._store.build_collectives_window(
+                max_steps=self.window_steps
+            )
+            step_time_ms: Optional[float] = None
+            try:
+                st = self._store.build_step_time_window(
+                    max_steps=self.window_steps
+                )
+                if st is not None:
+                    m = st.metric("step_time")
+                    if m is not None and m.median_ms > 0:
+                        step_time_ms = m.median_ms
+            except Exception:
+                pass
+            view = V.build_collectives_view(window, step_time_ms=step_time_ms)
+            from traceml_tpu.diagnostics.collectives.api import (
+                diagnose_collectives_window,
+            )
+
+            updates = {
+                "collectives": {
+                    "window": window,
+                    "diagnosis": diagnose_collectives_window(
+                        window, mode="live", step_time_ms=step_time_ms
+                    )
+                    if self._store.has_collectives_rows()
+                    else None,
+                },
+            }
+            return updates, view
+        except Exception as exc:
+            return {"collectives": {"error": str(exc)}}, None
 
     def _compute_system(self) -> Tuple[Dict[str, Any], Any]:
         nodes = int((self._store.topology() or {}).get("nodes") or 0)

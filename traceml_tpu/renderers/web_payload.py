@@ -55,6 +55,7 @@ def build_web_payload(
         "ts": time.time(),
         "step_time": None,
         "memory": None,
+        "collectives": None,
         "system": None,
         "process": None,
         "stdout": [],
@@ -69,6 +70,7 @@ def build_web_payload(
     for key, payload_key in (
         ("step_time", "step_time"),
         ("memory", "memory"),
+        ("collectives", "collectives"),
         ("system", "system"),
         ("process", "process"),
     ):
@@ -83,6 +85,7 @@ def build_web_payload(
     domain_results = {
         "step_time": st_result,
         "step_memory": payload.get("step_memory_diagnosis"),
+        "collectives": (payload.get("collectives") or {}).get("diagnosis"),
         "system": payload.get("system_diagnosis"),
         "process": payload.get("process_diagnosis"),
     }
@@ -111,7 +114,8 @@ def build_web_payload(
                 k: stats[k]
                 for k in (
                     "envelopes_ingested", "rows_dropped", "drop_warnings",
-                    "dropped_by_domain", "queues", "group_commit", "prune",
+                    "dropped_by_domain", "unknown_domain_drops", "queues",
+                    "group_commit", "prune",
                     "pending_frames_hwm", "producers", "ts",
                 )
                 if k in stats

@@ -21,6 +21,7 @@ import time
 from pathlib import Path
 from typing import Any, Callable, Dict, Optional
 
+from traceml_tpu.diagnostics.collectives.api import diagnose_collectives_window
 from traceml_tpu.diagnostics.common import DiagnosticResult
 from traceml_tpu.diagnostics.process.api import diagnose as diagnose_process
 from traceml_tpu.diagnostics.step_memory.api import (
@@ -297,6 +298,84 @@ def _build_step_memory_section(store, identities=None):
     return section, result
 
 
+def _build_collectives_section(store, mode: str, step_time_ms=None):
+    if not store.has_collectives_rows():
+        return _no_data_section("collectives"), None
+    window = store.build_collectives_window(max_steps=200)
+    result = diagnose_collectives_window(
+        window, mode=mode, step_time_ms=step_time_ms
+    )
+    section: Dict[str, Any] = {
+        "status": "OK" if window else "NO_DATA",
+        "diagnosis": result.diagnosis.to_dict(),
+        "issues": [i.to_dict() for i in result.issues],
+        "units": {"time": "ms", "volume": "bytes"},
+    }
+    if window:
+        n = window.n_steps
+        comm_per_step = window.totals["duration_ms"] / n
+        exposed_per_step = window.totals["exposed_ms"] / n
+        per_op = {
+            op: {
+                "count": int(v.get("count", 0)),
+                "bytes": int(v.get("bytes", 0)),
+                "duration_ms": round(float(v.get("duration_ms", 0.0)), 4),
+                "exposed_ms": round(float(v.get("exposed_ms", 0.0)), 4),
+            }
+            for op, v in sorted(window.per_op.items())
+        }
+        per_rank = {
+            str(r): {
+                "duration_ms": round(float(v["duration_ms"]), 4),
+                "exposed_ms": round(float(v["exposed_ms"]), 4),
+                "bytes": int(v["bytes"]),
+                "overlap_efficiency": round(float(v["overlap_efficiency"]), 4),
+            }
+            for r, v in sorted(window.per_rank.items())
+        }
+        tail = 120
+        section["global"] = {
+            "n_steps": n,
+            "step_range": [window.steps[0], window.steps[-1]],
+            "ranks": window.ranks,
+            "group_size": int(window.group_size),
+            "comm_ms_per_step": round(comm_per_step, 4),
+            "exposed_ms_per_step": round(exposed_per_step, 4),
+            "bytes_per_step": round(window.totals["bytes"] / n, 1),
+            "overlap_efficiency": round(
+                window.totals["overlap_efficiency"], 4
+            ),
+            "exposed_share_of_step": (
+                round(exposed_per_step / step_time_ms, 4)
+                if step_time_ms
+                else None
+            ),
+            "comm_share_of_step": (
+                round(comm_per_step / step_time_ms, 4)
+                if step_time_ms
+                else None
+            ),
+            "per_op": per_op,
+            "per_rank": per_rank,
+            # aligned per-step series — the acceptance artifact: every
+            # step's overlap efficiency is in the final summary
+            "series_steps": window.steps[-tail:],
+            "overlap_efficiency_series": [
+                round(float(v), 4)
+                for v in window.per_step["overlap_efficiency"][-tail:]
+            ],
+            "comm_ms_series": [
+                round(float(v), 4)
+                for v in window.per_step["duration_ms"][-tail:]
+            ],
+            "exposed_ms_series": [
+                round(float(v), 4)
+                for v in window.per_step["exposed_ms"][-tail:]
+            ],
+        }
+    return section, result
+
+
 def _build_system_section(store):
     host, devices = store.system_rows()
     if not host and not devices:
@@ -532,6 +611,46 @@ def _step_memory_card(sec: Dict[str, Any]) -> str:
     return "\n".join(out)
 
 
+def _collectives_card(sec: Dict[str, Any]) -> str:
+    g = sec.get("global") or {}
+    if not g:
+        return ""
+    out = [
+        f"{g.get('n_steps')} steps · group size {g.get('group_size')} · "
+        f"comm {fmt_ms(g.get('comm_ms_per_step'))}/step "
+        f"(exposed {fmt_ms(g.get('exposed_ms_per_step'))}) · "
+        f"overlap {fmt_pct(g.get('overlap_efficiency'))}"
+    ]
+    share = g.get("exposed_share_of_step")
+    if share is not None:
+        out[-1] += f" · exposed share of step {fmt_pct(share)}"
+    for op, v in (g.get("per_op") or {}).items():
+        dur = v.get("duration_ms") or 0.0
+        eff = 1.0 - (v.get("exposed_ms") or 0.0) / dur if dur > 0 else 1.0
+        out.append(
+            f"{op:<15} {v.get('count', 0):>6}×  {fmt_bytes(v.get('bytes')):>10}  "
+            f"{fmt_ms(dur):>10}  overlap {fmt_pct(eff)}"
+        )
+    per_rank = g.get("per_rank") or {}
+    if len(per_rank) > 1:
+        worst = min(
+            (
+                (r, v)
+                for r, v in per_rank.items()
+                if (v.get("duration_ms") or 0.0) > 0
+            ),
+            key=lambda kv: kv[1].get("overlap_efficiency", 1.0),
+            default=None,
+        )
+        if worst is not None:
+            out.append(
+                f"worst-overlap rank {worst[0]}: "
+                f"{fmt_pct(worst[1].get('overlap_efficiency'))} "
+                f"({fmt_ms(worst[1].get('exposed_ms'))} exposed)"
+            )
+    return "\n".join(out)
+
+
 def _system_card(sec: Dict[str, Any]) -> str:
     g = sec.get("global") or {}
     out = []
@@ -591,6 +710,7 @@ def _process_card(sec: Dict[str, Any]) -> str:
 _CARD_BUILDERS = {
     "step_time": _step_time_card,
     "step_memory": _step_memory_card,
+    "collectives": _collectives_card,
     "system": _system_card,
     "process": _process_card,
 }
@@ -709,9 +829,13 @@ def render_text_summary(payload: Dict[str, Any]) -> str:
         )
         out.append("")
 
-    # system/process detail cards (step_time/step_memory detail is the
-    # richer inline layout above)
-    for key, title in (("system", "System"), ("process", "Processes")):
+    # system/process/collectives detail cards (step_time/step_memory
+    # detail is the richer inline layout above)
+    for key, title in (
+        ("collectives", "Collectives (compute/comm overlap)"),
+        ("system", "System"),
+        ("process", "Processes"),
+    ):
         sec = (payload.get("sections") or {}).get(key) or {}
         card = sec.get("card")
         if card:
@@ -719,7 +843,7 @@ def render_text_summary(payload: Dict[str, Any]) -> str:
             out.extend(f"  {l}" for l in card.splitlines())
             out.append("")
 
-    for key in ("system", "process", "step_memory", "step_time"):
+    for key in ("system", "process", "collectives", "step_memory", "step_time"):
         sec = (payload.get("sections") or {}).get(key) or {}
         diag = sec.get("diagnosis") or {}
         if diag and diag.get("status") == "issue":
@@ -756,7 +880,10 @@ def generate_summary(
             },
             "sections": {
                 k: _no_data_section(k)
-                for k in ("system", "process", "step_time", "step_memory")
+                for k in (
+                    "system", "process", "step_time", "step_memory",
+                    "collectives",
+                )
             },
         }
         atomic_write_json(protocol.get_final_summary_json_path(session_dir), payload)
@@ -793,6 +920,24 @@ def generate_summary(
         results["step_time"] = result
         return section
 
+    def run_collectives():
+        # cross-domain join: the mean step duration denominates the
+        # COMM_BOUND exposed-comm share (columnar rebuild — cheap)
+        step_time_ms = None
+        try:
+            st = store.build_step_time_window(max_steps=200)
+            if st is not None:
+                m = st.metric(STEP_KEY)
+                if m is not None and m.median_ms > 0:
+                    step_time_ms = m.median_ms
+        except Exception:
+            pass
+        section, result = _build_collectives_section(
+            store, mode, step_time_ms=step_time_ms
+        )
+        results["collectives"] = result
+        return section
+
     def run_step_memory():
         section, result = _build_step_memory_section(store, identities)
         results["step_memory"] = result
@@ -813,6 +958,7 @@ def generate_summary(
         "process": _safe_section("process", run_process),
         "step_time": _safe_section("step_time", run_step_time),
         "step_memory": _safe_section("step_memory", run_step_memory),
+        "collectives": _safe_section("collectives", run_collectives),
     }
     try:
         topology = store.topology()
@@ -825,6 +971,7 @@ def generate_summary(
         results.get("system"),
         results.get("process"),
         step_time_error=sections["step_time"].get("error"),
+        collectives=results.get("collectives"),
     )
     meta: Dict[str, Any] = {
         "session_id": getattr(settings, "session_id", "unknown"),
@@ -841,7 +988,8 @@ def generate_summary(
             for k in (
                 "envelopes_ingested", "frames_received", "decode_errors",
                 "rows_written", "rows_dropped", "dropped_by_domain",
-                "drop_warnings", "pending_frames_hwm", "queues",
+                "unknown_domain_drops", "drop_warnings",
+                "pending_frames_hwm", "queues",
                 "group_commit", "prune", "producers",
             )
             if k in stats

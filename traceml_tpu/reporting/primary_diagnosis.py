@@ -26,6 +26,7 @@ def build_primary_diagnosis(
     system: Optional[DiagnosticResult] = None,
     process: Optional[DiagnosticResult] = None,
     step_time_error: Optional[str] = None,
+    collectives: Optional[DiagnosticResult] = None,
 ) -> Dict[str, Any]:
     candidates = []
     if step_time is not None:
@@ -38,6 +39,15 @@ def build_primary_diagnosis(
             candidates.append(
                 (_SEV_ORDER.get(issue.severity, 0) + 0.6, "step_time", issue)
             )
+    if collectives is not None and not collectives.healthy:
+        # collectives is a model domain too (the user's schedule causes
+        # it): a COMM_BOUND verdict outranks environment findings of the
+        # same severity but defers to a step-time verdict — step time is
+        # where the comm tax is actually paid
+        issue = collectives.diagnosis
+        candidates.append(
+            (_SEV_ORDER.get(issue.severity, 0) + 0.5, "collectives", issue)
+        )
     for domain, result in (
         ("step_memory", step_memory),
         ("system", system),

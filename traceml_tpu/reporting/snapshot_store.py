@@ -54,9 +54,13 @@ from pathlib import Path
 from typing import Any, Dict, List, Optional, Tuple
 
 from traceml_tpu.utils.columnar import (
+    CollectivesColumns,
+    CollectivesWindow,
     ColumnarFallback,
     MemoryColumns,
     StepTimeColumns,
+    build_collectives_window_rows,
+    build_columnar_collectives_window,
     build_columnar_step_time_window,
     columnar_window_enabled,
 )
@@ -77,6 +81,7 @@ _READ_PRAGMAS = (
 DOMAINS = (
     "step_time",
     "step_memory",
+    "collectives",
     "system",
     "process",
     "stdout",
@@ -209,6 +214,31 @@ class _MemoryBuffer(_RankBuffer):
         return changed
 
 
+class _CollectivesBuffer(_RankBuffer):
+    """Row deque + collectives columnar ring in lockstep (same contract
+    as :class:`_StepTimeBuffer`)."""
+
+    __slots__ = ("cols",)
+
+    def __init__(self, maxlen: int) -> None:
+        super().__init__(maxlen)
+        self.cols = CollectivesColumns(maxlen)
+
+    def append(self, row_id: int, rank: Optional[int], row: Any) -> None:
+        super().append(row_id, rank, row)
+        self.cols.append(row)
+
+    def clear(self) -> bool:
+        had = super().clear()
+        self.cols.clear()
+        return had
+
+    def evict_below(self, min_id: int) -> bool:
+        changed = super().evict_below(min_id)
+        self.cols.evict_head(len(self.cols) - len(self.ids))
+        return changed
+
+
 class _TopologySource:
     """Accumulated identity sets for one projection table."""
 
@@ -250,6 +280,7 @@ class LiveSnapshotStore:
         db_path: Path,
         window_steps: int = 120,
         memory_rows_per_rank: Optional[int] = None,
+        collectives_rows_per_rank: Optional[int] = None,
         system_rows: int = 300,
         process_rows: int = 300,
         stdout_rows: int = 64,
@@ -261,6 +292,13 @@ class LiveSnapshotStore:
             memory_rows_per_rank
             if memory_rows_per_rank is not None
             else window_steps * 4
+        )
+        # several (op, dtype) rows share one step — 8x headroom matches
+        # the bench workload (8 collectives/step) without unbounded growth
+        self.collectives_rows_per_rank = int(
+            collectives_rows_per_rank
+            if collectives_rows_per_rank is not None
+            else window_steps * 8
         )
         self.max_system_rows = int(system_rows)
         self.max_process_rows = int(process_rows)
@@ -285,6 +323,7 @@ class LiveSnapshotStore:
         # + columnar ring per rank, kept in lockstep)
         self._step_time: Dict[int, _StepTimeBuffer] = {}
         self._step_memory: Dict[int, _MemoryBuffer] = {}
+        self._collectives: Dict[int, _CollectivesBuffer] = {}
         # system / process: globally-bounded (loader semantics), keyed rows
         self._system_host = _RankBuffer(self.max_system_rows)
         self._system_dev = _RankBuffer(self.max_system_rows)
@@ -389,6 +428,7 @@ class LiveSnapshotStore:
             readers = (
                 ("step_time_samples", self._read_step_time, "step_time"),
                 ("step_memory_samples", self._read_step_memory, "step_memory"),
+                ("collectives_samples", self._read_collectives, "collectives"),
                 ("system_samples", self._read_system_host, "system"),
                 ("system_device_samples", self._read_system_dev, "system"),
                 ("process_samples", self._read_process, "process"),
@@ -613,6 +653,31 @@ class LiveSnapshotStore:
         )
         return bool(rows) or evicted
 
+    def _read_collectives(self, conn, table, dirty) -> bool:
+        trimmed = self._begin_trim_check(conn, table)
+        cur = self._cursors.get(table, 0)
+        rows = conn.execute(
+            "SELECT id, global_rank, step, timestamp, op, dtype, count,"
+            " bytes, group_size, duration_ms, exposed_ms"
+            f" FROM {table} WHERE id > ? ORDER BY global_rank, step, id",
+            (cur,),
+        ).fetchall()
+        for r in rows:
+            rank = int(r["global_rank"])
+            buf = self._collectives.get(rank)
+            if buf is None:
+                buf = self._collectives[rank] = _CollectivesBuffer(
+                    self.collectives_rows_per_rank
+                )
+            row = dict(r)
+            del row["id"], row["global_rank"]
+            buf.append(r["id"], rank, row)
+        self._advance_cursor(table, rows)
+        evicted = self._apply_trims(
+            conn, table, trimmed, rank_bufs=self._collectives
+        )
+        return bool(rows) or evicted
+
     def _read_step_memory(self, conn, table, dirty) -> bool:
         trimmed = self._begin_trim_check(conn, table)
         cur = self._cursors.get(table, 0)
@@ -799,6 +864,48 @@ class LiveSnapshotStore:
                 if buf.rows
             }
         return _build_window_from_rows(rank_rows, max_steps=limit)
+
+    def collectives_rows(self) -> Dict[int, List[Dict[str, Any]]]:
+        """global_rank → decoded (step, op, dtype) aggregate rows."""
+        with self._lock:
+            return {
+                rank: list(buf.rows)
+                for rank, buf in sorted(self._collectives.items())
+                if buf.rows
+            }
+
+    def has_collectives_rows(self) -> bool:
+        with self._lock:
+            return any(buf.rows for buf in self._collectives.values())
+
+    def build_collectives_window(
+        self, max_steps: Optional[int] = None
+    ) -> Optional[CollectivesWindow]:
+        """Cross-rank collectives window (overlap efficiency per step).
+
+        Columnar fast path over the per-rank rings; scalar reference
+        fold over the row deques when a buffer is flagged or the
+        columnar engine is disabled.  Both paths are golden-pinned
+        bit-identical (tests/utils/test_collectives_window.py).
+        """
+        limit = self.window_steps if max_steps is None else int(max_steps)
+        with self._lock:
+            if columnar_window_enabled():
+                try:
+                    cols = {
+                        rank: buf.cols
+                        for rank, buf in self._collectives.items()
+                        if buf.rows
+                    }
+                    return build_columnar_collectives_window(cols, limit)
+                except ColumnarFallback:
+                    pass
+            rank_rows = {
+                rank: list(buf.rows)
+                for rank, buf in sorted(self._collectives.items())
+                if buf.rows
+            }
+        return build_collectives_window_rows(rank_rows, max_steps=limit)
 
     def step_memory_columns(self) -> Optional[Dict[int, MemoryColumns]]:
         """rank → memory ring buffer, or None when any rank's buffer is

@@ -30,6 +30,7 @@ SAMPLER_REGISTRY = Registry("samplers")
 
 
 def register_default_samplers() -> None:
+    from traceml_tpu.samplers.collectives_sampler import CollectivesSampler
     from traceml_tpu.samplers.process_sampler import ProcessSampler
     from traceml_tpu.samplers.step_memory_sampler import StepMemorySampler
     from traceml_tpu.samplers.step_time_sampler import StepTimeSampler
@@ -40,6 +41,7 @@ def register_default_samplers() -> None:
         SamplerSpec("process", ProcessSampler),
         SamplerSpec("step_time", StepTimeSampler, drain_on_recording_stop=True),
         SamplerSpec("step_memory", StepMemorySampler, drain_on_recording_stop=True),
+        SamplerSpec("collectives", CollectivesSampler, drain_on_recording_stop=True),
     ]
     for spec in defaults:
         if spec.key not in SAMPLER_REGISTRY:
@@ -60,6 +62,13 @@ def build_samplers(
     out: List[BaseSampler] = []
     for key in SAMPLER_REGISTRY.keys():
         spec: SamplerSpec = SAMPLER_REGISTRY.require(key)
+        if key == "collectives":
+            # TRACEML_COLLECTIVES=0 kill switch — checked per build (not
+            # at registration) so tests toggling the env see it live
+            from traceml_tpu.instrumentation.collectives import collectives_enabled
+
+            if not collectives_enabled():
+                continue
         if spec.node_primary_only and not identity.is_node_primary:
             continue
         if spec.cli_mode_only and settings.mode != "cli":

@@ -675,3 +675,394 @@ class MemorySeries:
         """``float(current_bytes or 0)`` per row — the creep series."""
         cur = self.current
         return np.where(np.isnan(cur), 0.0, cur).tolist()
+
+
+# ---------------------------------------------------------------------------
+# Collectives domain (round 11): per-rank (step, op, dtype) rows →
+# per-step overlap-efficiency window.
+# ---------------------------------------------------------------------------
+
+# canonical op vocabulary — mirrors instrumentation/collectives.OP_KINDS
+# (pinned equal by tests/utils/test_collectives_window.py so the two
+# layers can't silently fork)
+COLLECTIVE_OPS = (
+    "all_reduce",
+    "all_gather",
+    "reduce_scatter",
+    "all_to_all",
+    "p2p",
+    "other",
+)
+_COLL_OP_INDEX = {op: i for i, op in enumerate(COLLECTIVE_OPS)}
+_COLL_DTYPE_VOCAB_MAX = 64  # per-buffer dtype vocabulary bound
+
+# int column layout
+CC_STEP, CC_COUNT, CC_BYTES, CC_GROUP = range(4)
+
+
+class CollectivesColumns(_CompactRing):
+    """Per-rank collectives columns mirroring the store's row deque.
+
+    One appended row per (step, op, dtype) aggregate from the sampler;
+    steps are non-decreasing (several op/dtype rows share a step) —
+    anything else flags the buffer for the scalar reference path."""
+
+    __slots__ = (
+        "_ints",
+        "_floats",
+        "_ops",
+        "_dtypes",
+        "_dtype_vocab",
+        "_dtype_index",
+        "_last_step",
+        "columnar_ok",
+    )
+
+    def __init__(self, cap: int) -> None:
+        super().__init__(cap)
+        n = 2 * self.cap
+        self._ints = np.empty((n, 4), dtype=np.int64)
+        self._floats = np.empty((n, 2), dtype=np.float64)  # duration, exposed
+        self._ops = np.empty(n, dtype=np.int8)
+        self._dtypes = np.empty(n, dtype=np.int16)
+        self._dtype_vocab: List[str] = []
+        self._dtype_index: Dict[str, int] = {}
+        self._last_step: Optional[int] = None
+        self.columnar_ok = True
+
+    def _arrays(self):
+        return (self._ints, self._floats, self._ops, self._dtypes)
+
+    def clear(self) -> None:
+        self._reset()
+        self._last_step = None
+        self.columnar_ok = True
+        # the dtype vocab survives a clear on purpose: codes in the ring
+        # are gone, and re-coding the same strings is stable either way
+
+    def append(self, row: Mapping[str, Any]) -> None:
+        # always consume a slot (ring stays 1:1 with the row deque)
+        i = self._next_slot()
+        if not self.columnar_ok:
+            return
+        try:
+            step = int(row["step"])
+            if isinstance(row["step"], bool):
+                raise ColumnarFallback("bool step")
+            if self._last_step is not None and step < self._last_step:
+                raise ColumnarFallback("out-of-order step")
+            op = row.get("op")
+            oi = _COLL_OP_INDEX.get(op)
+            if oi is None:
+                oi = _COLL_OP_INDEX["other"]
+            dtype = str(row.get("dtype", "") or "")
+            di = self._dtype_index.get(dtype)
+            if di is None:
+                if len(self._dtype_vocab) >= _COLL_DTYPE_VOCAB_MAX:
+                    raise ColumnarFallback("dtype vocabulary overflow")
+                di = len(self._dtype_vocab)
+                self._dtype_vocab.append(dtype)
+                self._dtype_index[dtype] = di
+            ints = self._ints[i]
+            for c, key in ((CC_COUNT, "count"), (CC_BYTES, "bytes"), (CC_GROUP, "group_size")):
+                v = row.get(key, 0)
+                if v is None:
+                    v = 0
+                if not isinstance(v, int) or isinstance(v, bool):
+                    raise ColumnarFallback(key)
+                if v < 0 or v >= _MAX_EXACT_INT:
+                    raise ColumnarFallback(key)
+                ints[c] = v
+            ints[CC_STEP] = step
+            dur = float(row.get("duration_ms", 0.0) or 0.0)
+            exp = float(row.get("exposed_ms", 0.0) or 0.0)
+            if dur < 0.0 or exp < 0.0 or exp > dur:
+                raise ColumnarFallback("exposure outside duration")
+            self._floats[i, 0] = dur
+            self._floats[i, 1] = exp
+            self._ops[i] = oi
+            self._dtypes[i] = di
+            self._last_step = step
+        except Exception:
+            self.columnar_ok = False
+
+    # live views — valid until the next append/evict/clear
+    def steps_view(self) -> np.ndarray:
+        return self._ints[self._start : self._end, CC_STEP]
+
+    def ints_view(self) -> np.ndarray:
+        return self._ints[self._start : self._end]
+
+    def floats_view(self) -> np.ndarray:
+        return self._floats[self._start : self._end]
+
+    def ops_view(self) -> np.ndarray:
+        return self._ops[self._start : self._end]
+
+    def dtypes_view(self) -> np.ndarray:
+        return self._dtypes[self._start : self._end]
+
+    def dtype_name(self, code: int) -> str:
+        return self._dtype_vocab[code]
+
+
+def _overlap_efficiency(total_ms: float, exposed_ms: float) -> float:
+    """Share of comm time hidden behind compute: ``1 − exposed/total``.
+    A zero-comm step is perfectly hidden by definition → 1.0, not NaN."""
+    if total_ms > 0.0:
+        return 1.0 - exposed_ms / total_ms
+    return 1.0
+
+
+@dataclasses.dataclass
+class CollectivesWindow:
+    """Cross-rank collectives aggregate over the last ``n_steps`` steps.
+
+    Steps are the UNION of the ranks' steps (ragged participation — a
+    rank that skips a collective still leaves the step in the window).
+    ``per_step`` series are aligned to ``steps``; ``overlap_efficiency``
+    is ``1 − exposed/total`` with zero-comm steps defined as 1.0."""
+
+    steps: List[int]
+    n_steps: int
+    ranks: List[int]
+    group_size: int
+    per_step: Dict[str, List[float]]
+    per_op: Dict[str, Dict[str, float]]
+    per_rank: Dict[int, Dict[str, float]]
+    totals: Dict[str, float]
+
+
+def build_collectives_window_rows(
+    rank_rows: Mapping[int, Any],
+    max_steps: int,
+) -> Optional[CollectivesWindow]:
+    """Scalar reference fold over row dicts — the golden path the
+    columnar build below must reproduce bit-identically.  Ranks are
+    folded in sorted order, rows in arrival order."""
+    items = [(r, list(rows)) for r, rows in sorted(rank_rows.items()) if rows]
+    if not items:
+        return None
+    all_steps = sorted({int(row["step"]) for _, rows in items for row in rows})
+    steps = all_steps[-max_steps:]
+    lo = steps[0]
+    idx = {s: i for i, s in enumerate(steps)}
+    S = len(steps)
+
+    count = [0] * S
+    nbytes = [0] * S
+    dur = [0.0] * S
+    exp = [0.0] * S
+    ar_fp32 = [0] * S
+    per_op: Dict[str, Dict[str, float]] = {}
+    per_rank: Dict[int, Dict[str, float]] = {}
+    group = 1
+    for rank, rows in items:
+        r_dur = 0.0
+        r_exp = 0.0
+        r_bytes = 0
+        for row in rows:
+            s = int(row["step"])
+            if s < lo:
+                continue
+            i = idx[s]
+            c = int(row.get("count", 0) or 0)
+            b = int(row.get("bytes", 0) or 0)
+            d = float(row.get("duration_ms", 0.0) or 0.0)
+            e = float(row.get("exposed_ms", 0.0) or 0.0)
+            op = row.get("op") if row.get("op") in _COLL_OP_INDEX else "other"
+            count[i] += c
+            nbytes[i] += b
+            dur[i] += d
+            exp[i] += e
+            if op == "all_reduce" and str(row.get("dtype", "")) == "float32":
+                ar_fp32[i] += b
+            slot = per_op.get(op)
+            if slot is None:
+                slot = per_op[op] = {
+                    "count": 0, "bytes": 0, "duration_ms": 0.0, "exposed_ms": 0.0,
+                }
+            slot["count"] += c
+            slot["bytes"] += b
+            slot["duration_ms"] += d
+            slot["exposed_ms"] += e
+            group = max(group, int(row.get("group_size", 1) or 1))
+            r_dur += d
+            r_exp += e
+            r_bytes += b
+        per_rank[rank] = {
+            "duration_ms": r_dur,
+            "exposed_ms": r_exp,
+            "bytes": r_bytes,
+            "overlap_efficiency": _overlap_efficiency(r_dur, r_exp),
+        }
+
+    total_dur = 0.0
+    total_exp = 0.0
+    for v in dur:
+        total_dur += v
+    for v in exp:
+        total_exp += v
+    return CollectivesWindow(
+        steps=steps,
+        n_steps=S,
+        ranks=[r for r, _ in items],
+        group_size=group,
+        per_step={
+            "count": count,
+            "bytes": nbytes,
+            "duration_ms": dur,
+            "exposed_ms": exp,
+            "overlap_efficiency": [
+                _overlap_efficiency(dur[i], exp[i]) for i in range(S)
+            ],
+            "allreduce_fp32_bytes": ar_fp32,
+        },
+        per_op=per_op,
+        per_rank=per_rank,
+        totals={
+            "count": sum(count),
+            "bytes": sum(nbytes),
+            "duration_ms": total_dur,
+            "exposed_ms": total_exp,
+            "overlap_efficiency": _overlap_efficiency(total_dur, total_exp),
+        },
+    )
+
+
+def build_columnar_collectives_window(
+    rank_cols: Mapping[int, CollectivesColumns],
+    max_steps: int,
+) -> Optional[CollectivesWindow]:
+    """Vectorized ``build_collectives_window_rows`` over per-rank columns.
+
+    Exactness: per-slot accumulation uses ``np.add.at`` — unbuffered,
+    element-order application, so repeated step slots accumulate in row
+    order exactly like the scalar ``acc[i] += v`` fold; ranks are
+    processed in sorted order, matching the scalar traversal.  Raises
+    :class:`ColumnarFallback` if any non-empty rank is flagged."""
+    items = [
+        (r, c) for r, c in sorted(rank_cols.items(), key=lambda kv: kv[0]) if len(c)
+    ]
+    if not items:
+        return None
+    for _, c in items:
+        if not c.columnar_ok:
+            raise ColumnarFallback("flagged rank buffer")
+
+    uniq = np.unique(np.concatenate([c.steps_view() for _, c in items]))
+    common = uniq[-max_steps:]
+    S = int(common.size)
+    lo = int(common[0])
+
+    count = np.zeros(S, dtype=np.int64)
+    nbytes = np.zeros(S, dtype=np.int64)
+    dur = np.zeros(S, dtype=np.float64)
+    exp = np.zeros(S, dtype=np.float64)
+    ar_fp32 = np.zeros(S, dtype=np.int64)
+    n_ops = len(COLLECTIVE_OPS)
+    op_count = np.zeros(n_ops, dtype=np.int64)
+    op_bytes = np.zeros(n_ops, dtype=np.int64)
+    op_dur = np.zeros(n_ops, dtype=np.float64)
+    op_exp = np.zeros(n_ops, dtype=np.float64)
+    op_seen = np.zeros(n_ops, dtype=np.bool_)
+    per_rank: Dict[int, Dict[str, float]] = {}
+    group = 1
+    ar_code = _COLL_OP_INDEX["all_reduce"]
+
+    for rank, c in items:
+        steps = c.steps_view()
+        mask = steps >= lo
+        slots = np.searchsorted(common, steps[mask])
+        ints = c.ints_view()[mask]
+        floats = c.floats_view()[mask]
+        ops = c.ops_view()[mask].astype(np.int64)
+        np.add.at(count, slots, ints[:, CC_COUNT])
+        np.add.at(nbytes, slots, ints[:, CC_BYTES])
+        np.add.at(dur, slots, floats[:, 0])
+        np.add.at(exp, slots, floats[:, 1])
+        np.add.at(op_count, ops, ints[:, CC_COUNT])
+        np.add.at(op_bytes, ops, ints[:, CC_BYTES])
+        np.add.at(op_dur, ops, floats[:, 0])
+        np.add.at(op_exp, ops, floats[:, 1])
+        op_seen[ops] = True
+        try:
+            fp32_code = c._dtype_index["float32"]
+        except KeyError:
+            fp32_code = -1
+        fp32_mask = (ops == ar_code) & (c.dtypes_view()[mask] == fp32_code)
+        if fp32_mask.any():
+            np.add.at(ar_fp32, slots[fp32_mask], ints[fp32_mask, CC_BYTES])
+        if ints.shape[0]:
+            group = max(group, int(ints[:, CC_GROUP].max()))
+            r_dur = float(np.cumsum(floats[:, 0])[-1])
+            r_exp = float(np.cumsum(floats[:, 1])[-1])
+            r_bytes = int(np.cumsum(ints[:, CC_BYTES])[-1])
+        else:
+            r_dur = r_exp = 0.0
+            r_bytes = 0
+        per_rank[rank] = {
+            "duration_ms": r_dur,
+            "exposed_ms": r_exp,
+            "bytes": r_bytes,
+            "overlap_efficiency": _overlap_efficiency(r_dur, r_exp),
+        }
+
+    dur_l = dur.tolist()
+    exp_l = exp.tolist()
+    # totals fold over the per-step series, matching the scalar loop
+    total_dur = float(np.cumsum(dur)[-1]) if S else 0.0
+    total_exp = float(np.cumsum(exp)[-1]) if S else 0.0
+    per_op: Dict[str, Dict[str, float]] = {}
+    for oi, op in enumerate(COLLECTIVE_OPS):
+        if not op_seen[oi]:
+            continue
+        per_op[op] = {
+            "count": int(op_count[oi]),
+            "bytes": int(op_bytes[oi]),
+            "duration_ms": float(op_dur[oi]),
+            "exposed_ms": float(op_exp[oi]),
+        }
+    return CollectivesWindow(
+        steps=common.tolist(),
+        n_steps=S,
+        ranks=[r for r, _ in items],
+        group_size=group,
+        per_step={
+            "count": count.tolist(),
+            "bytes": nbytes.tolist(),
+            "duration_ms": dur_l,
+            "exposed_ms": exp_l,
+            "overlap_efficiency": [
+                _overlap_efficiency(dur_l[i], exp_l[i]) for i in range(S)
+            ],
+            "allreduce_fp32_bytes": ar_fp32.tolist(),
+        },
+        per_op=per_op,
+        per_rank=per_rank,
+        totals={
+            "count": int(np.cumsum(count)[-1]) if S else 0,
+            "bytes": int(np.cumsum(nbytes)[-1]) if S else 0,
+            "duration_ms": total_dur,
+            "exposed_ms": total_exp,
+            "overlap_efficiency": _overlap_efficiency(total_dur, total_exp),
+        },
+    )
+
+
+def collectives_window_to_plain(
+    w: Optional[CollectivesWindow],
+) -> Optional[Dict[str, Any]]:
+    """Canonical plain-dict form for golden comparisons."""
+    if w is None:
+        return None
+    return {
+        "steps": list(w.steps),
+        "n_steps": w.n_steps,
+        "ranks": list(w.ranks),
+        "group_size": w.group_size,
+        "per_step": {k: list(v) for k, v in w.per_step.items()},
+        "per_op": {k: dict(v) for k, v in sorted(w.per_op.items())},
+        "per_rank": {r: dict(v) for r, v in sorted(w.per_rank.items())},
+        "totals": dict(w.totals),
+    }
