@@ -1,0 +1,169 @@
+"""Rank liveness: tracker state machine, snapshot/seed resume, and the
+diagnostics rules that turn a rank_status snapshot into verdicts
+(docs/developer_guide/fault-tolerance.md)."""
+
+from traceml_tpu.aggregator.liveness import (
+    STATE_ACTIVE,
+    STATE_FINISHED,
+    STATE_LOST,
+    STATE_STALE,
+    RankLivenessTracker,
+)
+from traceml_tpu.diagnostics.liveness import diagnose_rank_status
+
+
+def _tracker():
+    return RankLivenessTracker(stale_after=10.0, lost_after=30.0)
+
+
+# -- state machine -------------------------------------------------------
+
+
+def test_states_by_silence_age():
+    t = _tracker()
+    t.observe(0, ts=100.0)
+    assert t.state_of(0, now=105.0) == STATE_ACTIVE
+    assert t.state_of(0, now=110.0) == STATE_STALE  # >= stale_after
+    assert t.state_of(0, now=129.9) == STATE_STALE
+    assert t.state_of(0, now=130.0) == STATE_LOST  # >= lost_after
+
+
+def test_finished_is_terminal():
+    t = _tracker()
+    t.observe(1, ts=100.0)
+    t.mark_finished(1, ts=101.0)
+    # a finished rank is never STALE/LOST no matter how silent
+    assert t.state_of(1, now=101.0 + 10_000) == STATE_FINISHED
+
+
+def test_observe_is_max_monotonic():
+    t = _tracker()
+    t.observe(0, ts=100.0, progress=True)
+    t.observe(0, ts=90.0, progress=True)  # late/reordered envelope
+    snap = t.snapshot(now=100.0)["ranks"]["0"]
+    assert snap["last_seen"] == 100.0
+    assert snap["last_progress"] == 100.0
+    assert snap["first_seen"] == 100.0
+
+
+def test_progress_tracked_separately_from_seen():
+    t = _tracker()
+    t.observe(0, ts=100.0, progress=True)  # step_time envelope
+    t.observe(0, ts=120.0)  # heartbeat only
+    snap = t.snapshot(now=121.0)["ranks"]["0"]
+    assert snap["last_seen"] == 120.0
+    assert snap["last_progress"] == 100.0
+
+
+def test_never_seen_rank_defaults_active():
+    # a rank with no history can't be aged: silence is measured from
+    # last_seen, and an unseen rank has none (never_seen ranks are the
+    # diagnostics layer's job, via expected_world_size)
+    t = _tracker()
+    assert t.state_of(7, now=1e9) == STATE_ACTIVE
+    assert t.ranks() == []
+
+
+def test_snapshot_seed_roundtrip_preserves_states():
+    t = _tracker()
+    t.observe(0, ts=100.0, progress=True)
+    t.observe(1, ts=100.0)
+    t.mark_finished(1, ts=105.0)
+    snap = t.snapshot(now=140.0)
+    assert snap["ranks"]["0"]["state"] == STATE_LOST
+    assert snap["ranks"]["1"]["state"] == STATE_FINISHED
+    assert snap["thresholds"]["lost_after_sec"] == 30.0
+
+    # crash-resume: a fresh incarnation seeded from the file derives
+    # the same states — finished stays finished, history is intact
+    t2 = _tracker()
+    t2.seed(snap)
+    assert t2.state_of(0, now=140.0) == STATE_LOST
+    assert t2.state_of(1, now=140.0) == STATE_FINISHED
+    assert t2.snapshot(now=140.0)["ranks"]["0"]["last_progress"] == 100.0
+
+
+def test_seed_tolerates_garbage():
+    t = _tracker()
+    t.seed({})
+    t.seed({"ranks": "nope"})
+    t.seed({"ranks": {"x": {"last_seen": "y"}, "2": None, "3": {}}})
+    assert t.ranks() == []
+
+
+# -- diagnostics rules over a snapshot -----------------------------------
+
+
+def _snap(ranks, now=1000.0, stale=10.0, lost=30.0, world=None):
+    return {
+        "ts": now,
+        "session_id": "s",
+        "expected_world_size": world if world is not None else len(ranks),
+        "thresholds": {"stale_after_sec": stale, "lost_after_sec": lost},
+        "ranks": ranks,
+    }
+
+
+def _rank(state, last_seen, last_progress=None, finished=False):
+    return {
+        "state": state,
+        "first_seen": 0.0,
+        "last_seen": last_seen,
+        "last_progress": last_progress,
+        "finished": finished,
+    }
+
+
+def test_healthy_world_is_healthy():
+    snap = _snap({
+        "0": _rank(STATE_ACTIVE, 999.0, 999.0),
+        "1": _rank(STATE_FINISHED, 998.0, 998.0, finished=True),
+    })
+    res = diagnose_rank_status(snap)
+    assert res.diagnosis.kind == "HEALTHY", res.diagnosis
+
+
+def test_lost_rank_is_critical_rank_lost():
+    snap = _snap({
+        "0": _rank(STATE_ACTIVE, 999.0, 999.0),
+        "1": _rank(STATE_LOST, 900.0, 850.0),  # idled before vanishing
+    })
+    res = diagnose_rank_status(snap)
+    assert res.diagnosis.kind == "RANK_LOST"
+    assert res.diagnosis.severity == "critical"
+    assert res.diagnosis.ranks == [1]
+    # not preempted: there was a 50s progress gap before the silence
+    assert "LIKELY_PREEMPTED" not in {i.kind for i in res.issues}
+
+
+def test_died_mid_stride_adds_likely_preempted():
+    snap = _snap({
+        "0": _rank(STATE_ACTIVE, 999.0, 999.0),
+        "1": _rank(STATE_LOST, 900.0, 898.0),  # progress right up to silence
+    })
+    kinds = {i.kind for i in diagnose_rank_status(snap).issues}
+    assert {"RANK_LOST", "LIKELY_PREEMPTED"} <= kinds
+
+
+def test_never_seen_rank_counts_as_lost():
+    snap = _snap({"0": _rank(STATE_ACTIVE, 999.0, 999.0)}, world=4)
+    res = diagnose_rank_status(snap)
+    assert res.diagnosis.kind == "RANK_LOST"
+    assert res.diagnosis.evidence["never_seen_ranks"] == [1, 2, 3]
+
+
+def test_stale_world_warns():
+    snap = _snap({
+        "0": _rank(STATE_STALE, 985.0, 985.0),
+        "1": _rank(STATE_STALE, 985.0, 985.0),
+        "2": _rank(STATE_ACTIVE, 999.0, 999.0),
+    })
+    res = diagnose_rank_status(snap)
+    assert res.diagnosis.kind == "WORLD_STALE"
+    assert res.diagnosis.severity == "warning"
+
+
+def test_missing_snapshot_degrades_to_info():
+    res = diagnose_rank_status(None)
+    assert res.diagnosis.kind == "NO_LIVENESS_DATA"
+    assert res.healthy

@@ -111,6 +111,104 @@ def test_writer_unknown_sampler_ignored(tmp_path):
     assert w.written == 0
 
 
+def _seq_env(seq, step, rank=0, sampler="step_time"):
+    tables = {
+        "step_time": [{"step": step, "timestamp": float(step),
+                       "clock": "host", "events": {}}],
+        "process": [{"timestamp": float(step), "cpu_pct": 5.0,
+                     "rss_bytes": 10, "vms_bytes": 20, "num_threads": 3}],
+    }
+    env = _env(sampler, {sampler: tables[sampler]}, rank=rank)
+    env.meta["seq"] = seq
+    return env
+
+
+def test_writer_seq_dedup_drops_replayed_duplicates(tmp_path):
+    # at-least-once replay (transport/spool.py): a replayed envelope
+    # whose seq the writer already committed must not double-insert
+    w = SQLiteWriter(tmp_path / "t.sqlite")
+    w.start()
+    w.ingest(_seq_env(100, step=1))
+    w.ingest(_seq_env(101, step=2))
+    assert w.force_flush()
+    w.ingest(_seq_env(100, step=1))  # over-replayed prefix
+    w.ingest(_seq_env(101, step=2))
+    w.ingest(_seq_env(102, step=3))  # genuinely new
+    assert w.force_flush()
+    assert w.finalize()
+    conn = sqlite3.connect(tmp_path / "t.sqlite")
+    assert conn.execute("SELECT COUNT(*) FROM step_time_samples").fetchone()[0] == 3
+    conn.close()
+    assert w.stats()["replay_duplicates"] == 2
+
+
+def test_writer_seq_dedup_within_one_batch(tmp_path):
+    w = SQLiteWriter(tmp_path / "t.sqlite")
+    w.start()
+    w.ingest(_seq_env(5, step=1))
+    w.ingest(_seq_env(5, step=1))  # duplicate before any flush
+    assert w.force_flush()
+    assert w.finalize()
+    conn = sqlite3.connect(tmp_path / "t.sqlite")
+    assert conn.execute("SELECT COUNT(*) FROM step_time_samples").fetchone()[0] == 1
+    conn.close()
+
+
+def test_writer_seq_lanes_are_independent(tmp_path):
+    # FIFO is only guaranteed WITHIN a priority lane, so the dedup
+    # watermark is per (session, rank, lane): the same seq arriving on
+    # the high lane (step_time) and the low lane (process) is two
+    # distinct envelopes, not a duplicate
+    w = SQLiteWriter(tmp_path / "t.sqlite")
+    w.start()
+    w.ingest(_seq_env(7, step=1, sampler="step_time"))
+    w.ingest(_seq_env(7, step=1, sampler="process"))
+    assert w.force_flush()
+    assert w.finalize()
+    conn = sqlite3.connect(tmp_path / "t.sqlite")
+    assert conn.execute("SELECT COUNT(*) FROM step_time_samples").fetchone()[0] == 1
+    assert conn.execute("SELECT COUNT(*) FROM process_samples").fetchone()[0] == 1
+    conn.close()
+    assert w.stats()["replay_duplicates"] == 0
+
+
+def test_writer_seqless_envelopes_bypass_dedup(tmp_path):
+    # pre-seq producers: no meta.seq → every envelope is taken
+    w = SQLiteWriter(tmp_path / "t.sqlite")
+    w.start()
+    for _ in range(2):
+        w.ingest(_env("step_time", {"step_time": [
+            {"step": 1, "timestamp": 1.0, "clock": "host", "events": {}}]}))
+    assert w.force_flush()
+    assert w.finalize()
+    conn = sqlite3.connect(tmp_path / "t.sqlite")
+    assert conn.execute("SELECT COUNT(*) FROM step_time_samples").fetchone()[0] == 2
+    conn.close()
+
+
+def test_writer_reopen_reseeds_seq_watermarks(tmp_path):
+    # aggregator crash-resume: a fresh writer on the same DB must keep
+    # dropping seqs the previous incarnation committed
+    db = tmp_path / "t.sqlite"
+    w = SQLiteWriter(db)
+    w.start()
+    w.ingest(_seq_env(10, step=1))
+    w.ingest(_seq_env(11, step=2))
+    w.force_flush()
+    assert w.finalize()
+
+    w2 = SQLiteWriter(db)
+    w2.start()
+    w2.ingest(_seq_env(11, step=2))  # replayed across the restart
+    w2.ingest(_seq_env(12, step=3))
+    assert w2.force_flush()
+    assert w2.finalize()
+    conn = sqlite3.connect(db)
+    assert conn.execute("SELECT COUNT(*) FROM step_time_samples").fetchone()[0] == 3
+    conn.close()
+    assert w2.stats()["replay_duplicates"] == 1
+
+
 def test_writer_wal_checkpointed_on_finalize(tmp_path):
     db = tmp_path / "t.sqlite"
     w = SQLiteWriter(db)

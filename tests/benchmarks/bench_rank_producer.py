@@ -324,7 +324,12 @@ def _decoded_envelopes(sink):
             continue
         env = normalize_telemetry_envelope(p)
         assert env is not None, p
-        meta = {k: v for k, v in env.meta.items() if k != "timestamp"}
+        # timestamp and seq are stamped at publish time (seq is the
+        # durable-replay dedup counter, time_ns-based), not payload
+        # content — both arms' tables/meta must match without them
+        meta = {
+            k: v for k, v in env.meta.items() if k not in ("timestamp", "seq")
+        }
         out.append((meta, {t: env.tables[t] for t in env.table_names()}))
     return out
 

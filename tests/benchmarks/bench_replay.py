@@ -1,0 +1,273 @@
+"""Durable-replay path micro-bench: spool append, reconnect drain,
+crash-recovery scan, and the healthy-path overhead of the durable
+wrapper (transport/spool.py; docs/developer_guide/fault-tolerance.md).
+
+Golden first, like bench_rank_producer: the identical pre-encoded
+envelope stream is spooled, crash-recovered (a fresh ``ReplaySpool``
+over the same directory), and replayed — and every decoded replayed
+envelope must equal its original, in order, before any timing is
+reported.  A replay path that is fast but reorders or re-encodes is
+worthless.
+
+Timed regimes (min over repeats, fresh spool dir each):
+
+* **append** — spooling N already-encoded envelopes (the link-down hot
+  path: the publisher tick must not stall while the aggregator is gone);
+* **drain** — ``DurableSender.replay()`` of N spooled frames through a
+  sink client: raw-body splice via ``pack_array_header`` in groups of
+  64, zero re-encode.  ``replay_vs_reencode`` compares that splice
+  against ``encode_batch`` re-encoding the same payload objects — the
+  whole point of spooling post-encode bytes;
+* **recovery** — ``ReplaySpool.__init__`` over an existing multi-segment
+  spool (the restarted rank's header-walk scan, no body decode);
+* **healthy overhead** — ``DurableSender.send`` with an empty spool vs
+  the bare client: the per-batch cost of the pending check + unacked
+  ring, which is the price every fault-free run pays.
+
+Pytest floors are conservative CI gates; acceptance numbers come from
+``python tests/benchmarks/bench_replay.py`` (BENCH_LOCAL records).
+"""
+
+import json
+import shutil
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent))
+sys.path.insert(1, str(Path(__file__).parent.parent.parent))
+import bench_common  # noqa: E402
+
+from traceml_tpu.transport.spool import DurableSender, ReplaySpool  # noqa: E402
+from traceml_tpu.utils import msgpack_codec  # noqa: E402
+
+pytestmark = [
+    pytest.mark.slow,
+    pytest.mark.skipif(
+        msgpack_codec.preencode({}).raw is None,
+        reason="JSON-fallback host: no raw bodies to spool",
+    ),
+]
+
+N_ENVELOPES = 20_000
+REPEATS = 3
+HEALTHY_BATCHES = 2_000
+BATCH = 8
+
+
+def _payload(seq):
+    return {
+        "meta": {
+            "seq": seq,
+            "session_id": "bench",
+            "sampler": "step_time",
+            "schema": 2,
+            "global_rank": 0,
+            "timestamp": 1700000000.0 + seq * 0.015,
+        },
+        "columns": {
+            "step_time": {
+                "step": [seq],
+                "timestamp": [1700000000.0 + seq * 0.015],
+                "clock": ["device"],
+                "events": [
+                    {"step_time": {"cpu_ms": 15.2, "device_ms": 14.8,
+                                   "count": 1}}
+                ],
+            }
+        },
+    }
+
+
+def _encoded_stream(n):
+    return [msgpack_codec.preencode(_payload(seq)) for seq in range(n)]
+
+
+class _SinkClient:
+    """Counts bytes; replay groups are kept for the golden decode."""
+
+    __slots__ = ("bodies", "batches", "keep")
+
+    def __init__(self, keep=False):
+        self.bodies = []
+        self.batches = 0
+        self.keep = keep
+
+    def send_batch(self, payloads):
+        self.batches += 1
+        return True
+
+    def send_encoded_body(self, body):
+        if self.keep:
+            self.bodies.append(bytes(body))
+        else:
+            self.bodies.append(len(body))
+        return True
+
+
+# -- golden --------------------------------------------------------------
+
+
+def _golden(tmp):
+    stream = _encoded_stream(500)
+    spool = ReplaySpool(tmp / "golden", segment_bytes=64 * 1024)
+    for enc in stream:
+        assert spool.append(enc.obj["meta"]["seq"], enc.raw)
+    spool.close()
+
+    # crash-recover: a FRESH spool over the same directory must replay
+    # the identical stream (this is the restarted-rank path)
+    recovered = ReplaySpool(tmp / "golden", segment_bytes=64 * 1024)
+    assert recovered.torn_tails == 0
+    client = _SinkClient(keep=True)
+    sender = DurableSender(client, recovered)
+    assert sender.replay()
+    got = []
+    for body in client.bodies:
+        decoded = msgpack_codec.decode(body)
+        assert isinstance(decoded, list)
+        got.extend(decoded)
+    assert len(got) == len(stream), (len(got), len(stream))
+    for enc, out in zip(stream, got):
+        assert out == enc.obj
+    sender.close()
+    return len(got)
+
+
+# -- timed regimes -------------------------------------------------------
+
+
+def _time_append(tmp, stream):
+    spool = ReplaySpool(tmp, segment_bytes=4 * 1024 * 1024)
+    pairs = [(enc.obj["meta"]["seq"], enc.raw) for enc in stream]
+    t0 = time.perf_counter()
+    for seq, raw in pairs:
+        spool.append(seq, raw)
+    elapsed = time.perf_counter() - t0
+    spool.close()
+    return elapsed
+
+
+def _time_drain(tmp, stream):
+    spool = ReplaySpool(tmp, segment_bytes=4 * 1024 * 1024)
+    for enc in stream:
+        spool.append(enc.obj["meta"]["seq"], enc.raw)
+    sender = DurableSender(_SinkClient(), spool)
+    t0 = time.perf_counter()
+    assert sender.replay()
+    elapsed = time.perf_counter() - t0
+    sender.close()
+    return elapsed
+
+
+def _time_recovery(tmp, stream):
+    spool = ReplaySpool(tmp, segment_bytes=256 * 1024)
+    for enc in stream:
+        spool.append(enc.obj["meta"]["seq"], enc.raw)
+    spool.close()
+    t0 = time.perf_counter()
+    recovered = ReplaySpool(tmp, segment_bytes=256 * 1024)
+    elapsed = time.perf_counter() - t0
+    assert recovered.pending_frames() == len(stream)
+    recovered.close()
+    return elapsed
+
+
+def _time_reencode(stream):
+    objs = [enc.obj for enc in stream]
+    t0 = time.perf_counter()
+    for i in range(0, len(objs), 64):
+        msgpack_codec.encode_batch(objs[i : i + 64])
+    return time.perf_counter() - t0
+
+
+def _time_healthy(tmp, durable):
+    stream = _encoded_stream(HEALTHY_BATCHES * BATCH)
+    batches = [
+        stream[i : i + BATCH] for i in range(0, len(stream), BATCH)
+    ]
+    client = _SinkClient()
+    if durable:
+        sender = DurableSender(client, ReplaySpool(tmp))
+        send = sender.send
+    else:
+        send = client.send_batch
+    t0 = time.perf_counter()
+    for batch in batches:
+        send(batch)
+    elapsed = time.perf_counter() - t0
+    if durable:
+        sender.close()
+    return elapsed
+
+
+def _best(fn, tmp, tag, *args):
+    times = []
+    for r in range(REPEATS):
+        d = tmp / f"{tag}_{r}"
+        times.append(fn(d, *args))
+        shutil.rmtree(d, ignore_errors=True)
+    return min(times)
+
+
+def _run_case(tmp):
+    golden_n = _golden(tmp)
+    bench_common.emit("replay", "golden_envelopes", golden_n, "envelopes")
+
+    stream = _encoded_stream(N_ENVELOPES)
+    raw_mb = sum(len(e.raw) for e in stream) / 1e6
+
+    append_s = _best(_time_append, tmp, "append", stream)
+    drain_s = _best(_time_drain, tmp, "drain", stream)
+    recovery_s = _best(_time_recovery, tmp, "recover", stream)
+    reencode_s = min(_time_reencode(stream) for _ in range(REPEATS))
+    bare_s = _best(lambda d: _time_healthy(d, False), tmp, "bare")
+    durable_s = _best(lambda d: _time_healthy(d, True), tmp, "durable")
+
+    r = {
+        "append_us_per_envelope": append_s / N_ENVELOPES * 1e6,
+        "append_mb_s": raw_mb / append_s,
+        "drain_us_per_envelope": drain_s / N_ENVELOPES * 1e6,
+        "drain_envelopes_per_s": N_ENVELOPES / drain_s,
+        "recovery_scan_ms": recovery_s * 1e3,
+        "replay_vs_reencode_speedup": reencode_s / drain_s,
+        "healthy_bare_us_per_batch": bare_s / HEALTHY_BATCHES * 1e6,
+        "healthy_durable_us_per_batch": durable_s / HEALTHY_BATCHES * 1e6,
+        "healthy_overhead_us_per_batch": (durable_s - bare_s)
+        / HEALTHY_BATCHES * 1e6,
+    }
+    units = {
+        "append_mb_s": "MB/s",
+        "drain_envelopes_per_s": "envelopes/s",
+        "recovery_scan_ms": "ms",
+        "replay_vs_reencode_speedup": "x",
+    }
+    for metric, value in r.items():
+        bench_common.emit(
+            "replay", metric, value, units.get(metric, "us"),
+            envelopes=N_ENVELOPES,
+        )
+    return r
+
+
+def test_replay_bench(tmp_path):
+    r = _run_case(tmp_path)
+    # conservative CI floors — acceptance numbers live in BENCH_LOCAL
+    assert r["drain_envelopes_per_s"] > 20_000, r
+    assert r["append_us_per_envelope"] < 50, r
+    assert r["recovery_scan_ms"] < 500, r
+    # the raw-splice replay must beat re-encoding the same objects —
+    # that is the reason the spool stores post-encode bytes
+    assert r["replay_vs_reencode_speedup"] > 1.0, r
+    # fault-free runs pay only the pending check + unacked ring
+    assert r["healthy_overhead_us_per_batch"] < 100, r
+
+
+if __name__ == "__main__":
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        results = _run_case(Path(td))
+    print(json.dumps(results, indent=2, sort_keys=True))

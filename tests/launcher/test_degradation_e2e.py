@@ -41,6 +41,9 @@ def test_aggregator_death_degrades_not_fails(tmp_path):
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = str(REPO)
+    # this test pins the restart-budget-exhausted contract (degrade, not
+    # fail); the restart path itself is covered by test_chaos_e2e.py
+    env["TRACEML_AGG_MAX_RESTARTS"] = "0"
     proc = subprocess.Popen(
         [
             sys.executable, "-m", "traceml_tpu", "run",

@@ -151,6 +151,10 @@ def test_healthy_not_misdiagnosed(tmp_path):
         "MEMORY_CREEP_CONFIRMED",
         "COMM_BOUND",
         "POOR_OVERLAP",
+        # liveness: a healthy run where every rank finishes cleanly must
+        # never read as a dead or preempted world
+        "RANK_LOST",
+        "LIKELY_PREEMPTED",
     ), primary
     st_primary = payload["sections"]["step_time"]["diagnosis"]
     assert st_primary["kind"] in (

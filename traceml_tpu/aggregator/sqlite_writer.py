@@ -81,6 +81,13 @@ _DROP_WARN_INTERVAL_S = 5.0
 
 WATERMARK_TABLE = "retention_watermarks"
 
+# durable-replay dedup: per (session, rank, lane) max committed seq.
+# Lane-scoped because FIFO commit order is only guaranteed WITHIN a
+# priority queue — a low-lane envelope with a smaller seq legitimately
+# commits after a high-lane envelope with a larger one, and a single
+# (session, rank) max would swallow it as a duplicate.
+RANK_SEQ_TABLE = "rank_seq"
+
 _MISSING = object()
 
 
@@ -177,6 +184,12 @@ class SQLiteWriter:
         self._prune_max_ms = 0.0
         self.prunes = 0
         self.rows_pruned = 0
+
+        # replay dedup state (writer thread only): seeded from the
+        # rank_seq table on (re)open so a restarted aggregator keeps
+        # rejecting envelopes its previous incarnation already committed
+        self._seq_max: Dict[Tuple[str, int, str], int] = {}
+        self.replay_duplicates = 0
 
     # -- producer side (aggregator loop) --------------------------------
     def start(self) -> None:
@@ -317,6 +330,7 @@ class SQLiteWriter:
             "dropped_by_domain": drop,
             "unknown_domain_drops": unknown,
             "drop_warnings": self.drop_warnings,
+            "replay_duplicates": self.replay_duplicates,
             "queues": queues,
             "group_commit": {
                 "commits": self._batches,
@@ -363,6 +377,15 @@ class SQLiteWriter:
                 ts REAL
             )"""
         )
+        conn.execute(
+            f"""CREATE TABLE IF NOT EXISTS {RANK_SEQ_TABLE} (
+                session_id TEXT,
+                global_rank INTEGER,
+                lane TEXT,
+                max_seq INTEGER,
+                PRIMARY KEY (session_id, global_rank, lane)
+            )"""
+        )
         for table in self._retention_tables:
             # the watermark SELECT and the range DELETE both need a
             # (session_id, global_rank) prefix to stay partition-scoped
@@ -378,6 +401,7 @@ class SQLiteWriter:
                 )
         conn.commit()
         self._seed_partition_counts(conn)
+        self._seed_seq_max(conn)
         return conn
 
     @staticmethod
@@ -406,6 +430,20 @@ class SQLiteWriter:
                 key = (table, str(session_id), int(rank))
                 self._part_counts[key] = int(n)
                 self._note_overflow(key, int(n))
+
+    def _seed_seq_max(self, conn: sqlite3.Connection) -> None:
+        """Crash-resume: reload committed per-lane seq maxima so a
+        restarted aggregator dedups the ranks' reconnect replay against
+        everything its previous incarnation durably wrote."""
+        try:
+            rows = conn.execute(
+                f"SELECT session_id, global_rank, lane, max_seq"
+                f" FROM {RANK_SEQ_TABLE}"
+            ).fetchall()
+        except sqlite3.Error:
+            return
+        for session_id, rank, lane, mx in rows:
+            self._seq_max[(str(session_id), int(rank), str(lane))] = int(mx)
 
     def _note_overflow(self, key: Tuple[str, str, int], count: int) -> None:
         if (
@@ -510,7 +548,26 @@ class SQLiteWriter:
         # per-envelope when many ranks ship the same table.
         grouped: Dict[str, List[tuple]] = {}
         touched: Dict[Tuple[str, str, int], int] = {}
+        seq_touched: Dict[Tuple[str, int, str], int] = {}
         for env in batch:
+            seq = env.seq
+            if seq is not None:
+                # dedup replayed envelopes: the spool re-delivers
+                # anything sent-but-unacked around a link failure, so a
+                # seq at or below the lane's committed max is a replay
+                # of a row already in the DB.  seq_touched covers dups
+                # landing inside this same batch (original + replay
+                # drained together).
+                skey = (
+                    str(env.meta.get("session_id", "unknown")),
+                    env.global_rank,
+                    PRIORITY_NAMES[ingest_priority(env.sampler)],
+                )
+                cur_max = seq_touched.get(skey, self._seq_max.get(skey, -1))
+                if seq <= cur_max:
+                    self.replay_duplicates += 1
+                    continue
+                seq_touched[skey] = seq
             writer = self._writer_cache.get(env.sampler, _MISSING)
             if writer is _MISSING:
                 writer = writer_for(env.sampler)
@@ -544,6 +601,16 @@ class SQLiteWriter:
             for sql, rows in grouped.items():
                 conn.executemany(sql, rows)
                 self.written += len(rows)
+            if seq_touched:
+                # the new maxima commit atomically with the rows they
+                # cover: a crash between the two can never produce an
+                # aggregator that drops a replay it didn't persist
+                conn.executemany(
+                    f"INSERT OR REPLACE INTO {RANK_SEQ_TABLE}"
+                    " (session_id, global_rank, lane, max_seq)"
+                    " VALUES (?,?,?,?)",
+                    [(k[0], k[1], k[2], mx) for k, mx in seq_touched.items()],
+                )
             for key, n in touched.items():
                 count = self._part_counts.get(key, 0) + n
                 self._part_counts[key] = count
@@ -553,6 +620,9 @@ class SQLiteWriter:
             # atomically with the inserts that triggered it
             self._prune_slice(conn, commit=False)
             conn.commit()
+            # in-memory maxima advance only after the commit lands —
+            # on rollback the rows are gone, so their replay must pass
+            self._seq_max.update(seq_touched)
         except sqlite3.Error as exc:
             get_error_log().warning("sqlite batch write failed", exc)
             try:

@@ -10,15 +10,23 @@ Shutdown (``stop()``): settle late telemetry until every expected rank
 sent ``rank_finished`` or the deadline passes (writing a
 ``finalization_warning.json`` naming missing ranks), budgeted SQLite
 finalize, then generate the final summary and write artifacts.
+
+Fault tolerance (docs/developer_guide/fault-tolerance.md): every
+envelope and control message feeds the rank liveness tracker
+(``rank_status.json``, ACTIVE→STALE→LOST); a restarted aggregator
+re-seeds finished ranks and last-seen from that file, and the SQLite
+writer's per-lane seq table dedups the ranks' reconnect replay.
 """
 
 from __future__ import annotations
 
+import json
 import threading
 import time
 from typing import Any, Dict, List, Optional, Set
 
 from traceml_tpu.aggregator.display_drivers import resolve_display_driver
+from traceml_tpu.aggregator.liveness import RankLivenessTracker
 from traceml_tpu.aggregator.sqlite_writer import SQLiteWriter
 from traceml_tpu.aggregator.summary_service import FinalSummaryService
 from traceml_tpu.runtime.settings import TraceMLSettings
@@ -26,6 +34,7 @@ from traceml_tpu.sdk import protocol
 from traceml_tpu.telemetry.control import (
     PRODUCER_STATS,
     RANK_FINISHED,
+    RANK_HEARTBEAT,
     control_kind,
     is_control_message,
 )
@@ -62,6 +71,7 @@ class TraceMLAggregator:
         self._stop_evt = threading.Event()
         self._finished_ranks: Set[int] = set()
         self._seen_ranks: Set[int] = set()
+        self.liveness = RankLivenessTracker()
         # latest producer_stats snapshot per rank (publisher self-
         # observability: collect/encode/flush cost, idle-tick ratio)
         self._producer_stats: Dict[int, Dict[str, Any]] = {}
@@ -89,6 +99,7 @@ class TraceMLAggregator:
         self.started = True
         get_error_log().set_path(self.settings.session_dir / "aggregator_error.log")
         self.settings.session_dir.mkdir(parents=True, exist_ok=True)
+        self._reseed_from_prior_run()
         self.server.start()
         self.port = self.server.port
         self.writer.start()
@@ -148,6 +159,35 @@ class TraceMLAggregator:
                 {"error": str(exc), "ts": time.time()},
             )
 
+    def _reseed_from_prior_run(self) -> None:
+        """Crash-resume: a restarted aggregator (same session dir) picks
+        up where its predecessor left off.  The SQLite writer re-seeds
+        its partition counts and seq-dedup table from the reopened DB;
+        here we restore what only lived in aggregator memory — which
+        ranks already finished, and their liveness history — from the
+        last persisted ``rank_status.json``."""
+        path = self.settings.session_dir / "rank_status.json"
+        if not path.exists():
+            return
+        try:
+            snap = json.loads(path.read_text())
+        except (OSError, ValueError) as exc:
+            get_error_log().warning("rank_status reseed failed", exc)
+            return
+        if snap.get("session_id") not in (None, self.settings.session_id):
+            return  # stale file from a different session sharing the dir
+        self.liveness.seed(snap)
+        ranks = snap.get("ranks")
+        if isinstance(ranks, dict):
+            for rank_s, info in ranks.items():
+                try:
+                    rank = int(rank_s)
+                except (TypeError, ValueError):
+                    continue
+                self._seen_ranks.add(rank)
+                if isinstance(info, dict) and info.get("finished"):
+                    self._finished_ranks.add(rank)
+
     # -- ingest ----------------------------------------------------------
     def _drain_once(self, max_frames: Optional[int] = _DRAIN_BATCH_FRAMES) -> int:
         # Three stages, pipelined across callers (aggregator loop and the
@@ -160,13 +200,15 @@ class TraceMLAggregator:
         #   3. ingest in ticket order under _ingest_cond, preserving the
         #      seed's strict frame ordering into the writer queues.
         with self._drain_lock:
-            frames = self.server.drain(max_frames)
+            frames = self.server.drain_tagged(max_frames)
             ticket = self._drain_ticket
             self._drain_ticket += 1
         payloads: List[Any] = []
         try:
             if frames:
-                payloads = self.server.decode_frames(frames)
+                # tagged decode: a corrupt frame is counted against its
+                # peer and skipped instead of poisoning the whole batch
+                payloads = self.server.decode_tagged(frames)
         finally:
             n = 0
             with self._ingest_cond:
@@ -174,6 +216,7 @@ class TraceMLAggregator:
                     self._ingest_cond.wait(1.0)
                 try:
                     for p in payloads:
+                        self._chaos_ingest_hook()
                         if is_control_message(p):
                             self._handle_control(p)
                             continue
@@ -181,6 +224,10 @@ class TraceMLAggregator:
                         if env is None:
                             continue
                         self._seen_ranks.add(env.global_rank)
+                        self.liveness.observe(
+                            env.global_rank,
+                            progress=env.sampler == "step_time",
+                        )
                         self.writer.ingest(env)
                         n += 1
                     self.envelopes_ingested += n
@@ -191,6 +238,20 @@ class TraceMLAggregator:
                     self._ingest_next += 1
                     self._ingest_cond.notify_all()
         return n
+
+    @staticmethod
+    def _chaos_ingest_hook() -> None:
+        """Fault-injection point: fires ``aggregator.ingest`` once per
+        drained payload (kill9 rules SIGKILL this process inside fire —
+        the chaos e2e suite uses that to crash the aggregator at a
+        deterministic envelope count)."""
+        try:
+            from traceml_tpu.dev import chaos
+
+            if chaos.active():
+                chaos.fire("aggregator.ingest")
+        except ImportError:  # pragma: no cover
+            pass
 
     def _drain_all(self) -> int:
         """Drain to empty in bounded slices (settle/shutdown path: no UI
@@ -211,6 +272,7 @@ class TraceMLAggregator:
                 "envelopes_ingested": self.envelopes_ingested,
                 "frames_received": self.server.frames_received,
                 "decode_errors": self.server.decode_errors,
+                "corrupt_frame_drops": dict(self.server.corrupt_frame_drops),
                 "pending_frames_hwm": self.server.pending_hwm,
                 "rows_written": self.writer.written,
                 "rows_enqueued": self.writer.enqueued,
@@ -219,6 +281,7 @@ class TraceMLAggregator:
                 "dropped_by_domain": wstats["dropped_by_domain"],
                 "unknown_domain_drops": wstats["unknown_domain_drops"],
                 "drop_warnings": wstats["drop_warnings"],
+                "replay_duplicates": wstats["replay_duplicates"],
                 "queues": wstats["queues"],
                 "group_commit": wstats["group_commit"],
                 "prune": wstats["prune"],
@@ -231,6 +294,17 @@ class TraceMLAggregator:
                 "ts": time.time(),
             },
         )
+        self._write_rank_status()
+
+    def _write_rank_status(self) -> None:
+        """Persist the liveness snapshot.  Written on the stats cadence
+        and at settle-end; readers (report, web payload, a restarted
+        aggregator) use the states as written — re-deriving them after
+        the run would mark every silent-because-done rank LOST."""
+        snap = self.liveness.snapshot()
+        snap["session_id"] = self.settings.session_id
+        snap["expected_world_size"] = self.expected_world_size()
+        atomic_write_json(self.settings.session_dir / "rank_status.json", snap)
 
     def _handle_control(self, payload: Dict[str, Any]) -> None:
         kind = control_kind(payload)
@@ -248,6 +322,15 @@ class TraceMLAggregator:
                 )
                 return
             self._finished_ranks.add(rank)
+            self.liveness.mark_finished(rank)
+        elif kind == RANK_HEARTBEAT:
+            meta = payload.get("meta") or {}
+            try:
+                rank = int(meta.get("global_rank", meta.get("rank")))
+            except (TypeError, ValueError):
+                return
+            self._seen_ranks.add(rank)
+            self.liveness.observe(rank)
         elif kind == PRODUCER_STATS:
             meta = payload.get("meta") or {}
             stats = payload.get("stats")
@@ -259,6 +342,7 @@ class TraceMLAggregator:
                 return
             # later snapshots are cumulative — keep only the latest
             self._producer_stats[rank] = stats
+            self.liveness.observe(rank)
 
     # -- loop ------------------------------------------------------------
     def _loop(self) -> None:
@@ -323,13 +407,20 @@ class TraceMLAggregator:
             set(range(expected)) - self._finished_ranks
         )
         if missing:
+            # per-missing-rank liveness verdicts ride along: the report
+            # distinguishes a rank that died mid-run (LOST, telemetry
+            # data gap) from one that merely lost its finish marker
+            now = time.time()
             atomic_write_json(
                 self.settings.session_dir / "finalization_warning.json",
                 {
                     "missing_ranks": missing,
+                    "missing_rank_states": {
+                        str(r): self.liveness.state_of(r, now) for r in missing
+                    },
                     "finished_ranks": sorted(self._finished_ranks),
                     "expected_world_size": expected,
-                    "ts": time.time(),
+                    "ts": now,
                 },
             )
 

@@ -1,0 +1,6 @@
+from traceml_tpu.diagnostics.liveness.api import (
+    DOMAIN,
+    diagnose_rank_status,
+)
+
+__all__ = ["DOMAIN", "diagnose_rank_status"]

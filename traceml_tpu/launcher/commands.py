@@ -30,6 +30,7 @@ from traceml_tpu.launcher.process import (
 )
 from traceml_tpu.runtime.session import generate_session_id
 from traceml_tpu.runtime.settings import (
+    ENV_AGG_PORT,
     ENV_SCRIPT,
     ENV_SCRIPT_ARGS,
     AggregatorEndpoint,
@@ -37,6 +38,50 @@ from traceml_tpu.runtime.settings import (
     settings_to_env,
 )
 from traceml_tpu.sdk import protocol
+
+# bounded aggregator crash-resume: how many times the launcher respawns
+# a dead aggregator (pinned to its original port so the ranks' backoff
+# reconnects land) before degrading to untraced
+ENV_AGG_MAX_RESTARTS = "TRACEML_AGG_MAX_RESTARTS"
+DEFAULT_AGG_MAX_RESTARTS = 3
+
+
+def _restart_aggregator(
+    session_dir: Path, base_env: Dict[str, str], port: int
+) -> Optional[SupervisedChild]:
+    """Respawn the aggregator after a crash, pinned to the port the dead
+    incarnation had bound (ranks keep dialing it; SO_REUSEADDR makes the
+    rebind race-free).  The stale ready file must go first — it still
+    advertises the dead pid, and waiting on it would succeed instantly.
+
+    The new process reopens the session DB (re-seeding watermark counts
+    and the seq-dedup table) and re-seeds liveness/finished ranks from
+    rank_status.json — see docs/developer_guide/fault-tolerance.md."""
+    ready_path = session_dir / "aggregator_ready.json"
+    try:
+        ready_path.unlink()
+    except OSError:
+        pass
+    env = dict(base_env)
+    env[ENV_AGG_PORT] = str(port)
+    # A fault plan's counters are per-process: the restarted aggregator
+    # would re-parse the inherited plan with fresh counters and a kill9
+    # rule would fire again on the replayed backlog — "kill the
+    # aggregator once" would mean "kill every incarnation".  The plan
+    # describes the incarnation it already killed; restarts run clean.
+    # Cleared via empty string, not pop: spawn merges over os.environ,
+    # where the launcher's own copy of the plan would resurface.
+    env["TRACEML_FAULT_PLAN"] = ""
+    child = spawn_supervised(
+        python_argv("traceml_tpu.aggregator.aggregator_main"),
+        label="aggregator",
+        env=env,
+    )
+    ready = wait_for_ready_file(ready_path, timeout=20.0)
+    if ready is None or child.poll() is not None:
+        terminate(child.proc, grace_sec=2)
+        return None
+    return child
 
 
 def resolve_settings(cli: Dict[str, Any]) -> TraceMLSettings:
@@ -240,6 +285,13 @@ def launch_process(
     # 3. supervise
     exit_code = 0
     launcher_stopped: set = set()  # pids WE terminated (victims, not crashes)
+    agg_restarts = 0
+    try:
+        agg_max_restarts = int(
+            os.environ.get(ENV_AGG_MAX_RESTARTS, DEFAULT_AGG_MAX_RESTARTS)
+        )
+    except ValueError:
+        agg_max_restarts = DEFAULT_AGG_MAX_RESTARTS
     try:
         while True:
             alive = [p for p in procs if p.poll() is None]
@@ -259,14 +311,35 @@ def launch_process(
                         )
                         crash_logs.append(str(log))
             if owner and agg_child is not None and agg_child.poll() is not None:
-                # aggregator died mid-run: degrade, keep training
-                print("[TraceML] aggregator exited early; telemetry degraded")
+                # aggregator died mid-run: bounded restarts on the same
+                # port (ranks spool + reconnect), then degrade
                 log = agg_child.write_crash_log(session_dir)
                 if log is not None:
                     crash_logs.append(str(log))
-                mf.update_run_manifest(session_dir, telemetry_status="degraded")
                 agg_child = None
-                telemetry_ok = False
+                if agg_restarts < agg_max_restarts:
+                    agg_restarts += 1
+                    print(
+                        f"[TraceML] aggregator exited mid-run; restarting "
+                        f"({agg_restarts}/{agg_max_restarts}) on port {agg_port}"
+                    )
+                    agg_child = _restart_aggregator(
+                        session_dir, base_env, agg_port
+                    )
+                if agg_child is not None:
+                    mf.update_run_manifest(
+                        session_dir,
+                        telemetry_status="restarted",
+                        aggregator_restarts=agg_restarts,
+                    )
+                else:
+                    print(
+                        "[TraceML] aggregator exited early; telemetry degraded"
+                    )
+                    mf.update_run_manifest(
+                        session_dir, telemetry_status="degraded"
+                    )
+                    telemetry_ok = False
             if not alive:
                 break
             if exit_code not in (0, None):

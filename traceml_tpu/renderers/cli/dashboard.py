@@ -19,6 +19,42 @@ from traceml_tpu.renderers.cli.step_time import step_time_panel
 from traceml_tpu.renderers.cli.system import cluster_panel, system_panel
 
 
+_STATE_STYLE = {
+    "active": "green",
+    "finished": "dim",
+    "stale": "yellow",
+    "lost": "bold red",
+}
+
+
+def _append_rank_strip(header: Text, payload: Dict[str, Any]) -> None:
+    """Per-rank liveness strip in the header: which ranks the live
+    numbers actually average (a STALE/LOST rank silently shrinks every
+    cross-rank aggregate — the strip makes that visible)."""
+    status = payload.get("rank_status") or {}
+    states = status.get("states") or {}
+    if not states:
+        return
+    counts: Dict[str, int] = {}
+    for s in states.values():
+        counts[s] = counts.get(s, 0) + 1
+    header.append("   ranks: ", style="dim")
+    first = True
+    for state in ("active", "finished", "stale", "lost"):
+        n = counts.get(state, 0)
+        if n == 0:
+            continue
+        if not first:
+            header.append(" · ", style="dim")
+        first = False
+        header.append(f"{n} {state}", style=_STATE_STYLE.get(state, ""))
+    lost = sorted(int(r) for r, s in states.items() if s == "lost")
+    if lost:
+        shown = ",".join(str(r) for r in lost[:8])
+        more = "…" if len(lost) > 8 else ""
+        header.append(f" (rank {shown}{more})", style="red")
+
+
 def dashboard(payload: Dict[str, Any], session: str) -> Group:
     header = Text(f"TraceML-TPU — live · session {session}", style="bold")
     # staleness = age of the NEWEST telemetry row, not of the payload
@@ -28,6 +64,7 @@ def dashboard(payload: Dict[str, Any], session: str) -> Group:
         age = time.time() - ts
         if age > 5.0:  # staleness badge (reference: display staleness)
             header.append(f"   ⚠ telemetry {age:.0f}s stale", style="yellow")
+    _append_rank_strip(header, payload)
     parts = [header, step_time_panel(payload), diagnostics_panel(payload)]
     cluster = cluster_panel(payload)
     if cluster is not None:

@@ -86,6 +86,7 @@ class LiveComputer:
             ]
             if not dirty and self._cache is not None:
                 self._cache["ts"] = time.time()  # idle tick: same object
+                self._attach_rank_status(self._cache)
                 return self._cache
             for domain in dirty:
                 deps, _ = _DOMAIN_DEPS[domain]
@@ -101,8 +102,31 @@ class LiveComputer:
                 out.update(updates)
                 if view is not None and view_key is not None:
                     out["views"][view_key] = view
+            self._attach_rank_status(out)
             self._cache = out
             return out
+
+    def _attach_rank_status(self, out: Dict[str, Any]) -> None:
+        """Liveness strip, refreshed EVERY tick (never dirty-gated): a
+        lost rank's state changes exactly when its DB writes stop, so
+        gating on table versions would freeze the strip at ACTIVE.  The
+        loader is (mtime, size)-cached, so idle ticks cost one stat."""
+        try:
+            from traceml_tpu.reporting.loaders import load_rank_status
+
+            status = load_rank_status(self.db_path.parent)
+            if status and isinstance(status.get("ranks"), dict):
+                out["rank_status"] = {
+                    "ts": status.get("ts"),
+                    "thresholds": status.get("thresholds"),
+                    "states": {
+                        r: (info or {}).get("state")
+                        for r, info in status["ranks"].items()
+                        if isinstance(info, dict)
+                    },
+                }
+        except Exception:
+            pass
 
     # -- per-domain builders ---------------------------------------------
     # Each returns (top-level payload updates, typed view or None) and

@@ -106,7 +106,10 @@ def build_web_payload(
     # (queue depth/hwm, per-domain sheds) and writer latency live, not
     # just in the post-run summary
     try:
-        from traceml_tpu.reporting.loaders import load_ingest_stats
+        from traceml_tpu.reporting.loaders import (
+            load_ingest_stats,
+            load_rank_status,
+        )
 
         stats = load_ingest_stats(Path(db_path).parent)
         if stats:
@@ -115,10 +118,24 @@ def build_web_payload(
                 for k in (
                     "envelopes_ingested", "rows_dropped", "drop_warnings",
                     "dropped_by_domain", "unknown_domain_drops", "queues",
-                    "group_commit", "prune",
+                    "group_commit", "prune", "corrupt_frame_drops",
+                    "replay_duplicates",
                     "pending_frames_hwm", "producers", "ts",
                 )
                 if k in stats
+            }
+        # per-rank liveness strip (ACTIVE/STALE/LOST/FINISHED): the
+        # dashboard shows which ranks a live dip is actually averaging
+        status = load_rank_status(Path(db_path).parent)
+        if status and isinstance(status.get("ranks"), dict):
+            out["rank_status"] = {
+                "ts": status.get("ts"),
+                "thresholds": status.get("thresholds"),
+                "states": {
+                    r: (info or {}).get("state")
+                    for r, info in status["ranks"].items()
+                    if isinstance(info, dict)
+                },
             }
     except Exception:
         pass

@@ -23,6 +23,7 @@ from typing import Any, Callable, Dict, Optional
 
 from traceml_tpu.diagnostics.collectives.api import diagnose_collectives_window
 from traceml_tpu.diagnostics.common import DiagnosticResult
+from traceml_tpu.diagnostics.liveness.api import diagnose_rank_status
 from traceml_tpu.diagnostics.process.api import diagnose as diagnose_process
 from traceml_tpu.diagnostics.step_memory.api import (
     diagnose_rank_rows as diagnose_memory,
@@ -843,12 +844,55 @@ def render_text_summary(payload: Dict[str, Any]) -> str:
             out.extend(f"  {l}" for l in card.splitlines())
             out.append("")
 
-    for key in ("system", "process", "collectives", "step_memory", "step_time"):
+    for key in (
+        "liveness", "system", "process", "collectives", "step_memory",
+        "step_time",
+    ):
         sec = (payload.get("sections") or {}).get(key) or {}
         diag = sec.get("diagnosis") or {}
         if diag and diag.get("status") == "issue":
             out.append(f"[{key}] {diag.get('kind')}: {diag.get('summary')}")
     return "\n".join(out) + "\n"
+
+
+def _build_liveness_section(session_dir: Path, mode: str):
+    """Rank liveness + data-gap annotation from the aggregator's
+    persisted snapshots (rank_status.json, finalization_warning.json) —
+    file-backed, not DB-backed: a SIGKILLed rank left no closing rows,
+    which is exactly the point."""
+    snap = loaders.load_rank_status(session_dir)
+    if not snap:
+        return _no_data_section("liveness"), None
+    result = diagnose_rank_status(snap, mode=mode)
+    ranks = snap.get("ranks") or {}
+    # data gaps: a lost rank's telemetry is trustworthy only up to its
+    # last contact — downstream cross-rank aggregates past gap_from_ts
+    # cover survivors only
+    gaps: Dict[str, Any] = {}
+    for rank_s, info in ranks.items():
+        if not isinstance(info, dict):
+            continue
+        if info.get("state") == "lost" and not info.get("finished"):
+            gaps[rank_s] = {
+                "gap_from_ts": info.get("last_seen"),
+                "last_progress_ts": info.get("last_progress"),
+            }
+    section: Dict[str, Any] = {
+        "status": "OK",
+        "diagnosis": result.diagnosis.to_dict(),
+        "issues": [i.to_dict() for i in result.issues],
+        "thresholds": snap.get("thresholds"),
+        "expected_world_size": snap.get("expected_world_size"),
+        "ranks": ranks,
+    }
+    if gaps:
+        section["data_gaps"] = gaps
+    warn = read_json(Path(session_dir) / "finalization_warning.json")
+    if isinstance(warn, dict) and warn.get("missing_ranks"):
+        section["unfinished_ranks"] = warn.get("missing_ranks")
+        if warn.get("missing_rank_states"):
+            section["unfinished_rank_states"] = warn["missing_rank_states"]
+    return section, result
 
 
 # -- entrypoint ----------------------------------------------------------
@@ -882,7 +926,7 @@ def generate_summary(
                 k: _no_data_section(k)
                 for k in (
                     "system", "process", "step_time", "step_memory",
-                    "collectives",
+                    "collectives", "liveness",
                 )
             },
         }
@@ -953,12 +997,18 @@ def generate_summary(
         results["process"] = result
         return section
 
+    def run_liveness():
+        section, result = _build_liveness_section(session_dir, mode)
+        results["liveness"] = result
+        return section
+
     sections = {
         "system": _safe_section("system", run_system),
         "process": _safe_section("process", run_process),
         "step_time": _safe_section("step_time", run_step_time),
         "step_memory": _safe_section("step_memory", run_step_memory),
         "collectives": _safe_section("collectives", run_collectives),
+        "liveness": _safe_section("liveness", run_liveness),
     }
     try:
         topology = store.topology()
@@ -972,6 +1022,7 @@ def generate_summary(
         results.get("process"),
         step_time_error=sections["step_time"].get("error"),
         collectives=results.get("collectives"),
+        liveness=results.get("liveness"),
     )
     meta: Dict[str, Any] = {
         "session_id": getattr(settings, "session_id", "unknown"),
@@ -987,6 +1038,7 @@ def generate_summary(
             k: stats[k]
             for k in (
                 "envelopes_ingested", "frames_received", "decode_errors",
+                "corrupt_frame_drops", "replay_duplicates",
                 "rows_written", "rows_dropped", "dropped_by_domain",
                 "unknown_domain_drops", "drop_warnings",
                 "pending_frames_hwm", "queues",

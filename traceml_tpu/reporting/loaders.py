@@ -355,3 +355,31 @@ def load_ingest_stats(session_dir: Path) -> Dict[str, Any]:
         return {}
     _INGEST_STATS_CACHE[str(path)] = (stamp, data)
     return data
+
+
+# rank_status.json shares the ingest-stats write cadence; same
+# (mtime, size) cache so the live web poller stays O(1) per tick.
+_RANK_STATUS_CACHE: Dict[str, Tuple[Tuple[float, int], Dict[str, Any]]] = {}
+
+
+def load_rank_status(session_dir: Path) -> Dict[str, Any]:
+    """Rank liveness snapshot (per-rank ACTIVE/STALE/LOST/FINISHED,
+    last-seen, thresholds) from ``rank_status.json`` — states as
+    written by the aggregator (aggregator/liveness.py).  Returns ``{}``
+    when the file is missing or unreadable."""
+    from traceml_tpu.utils.atomic_io import read_json
+
+    path = Path(session_dir) / "rank_status.json"
+    try:
+        st = path.stat()
+    except OSError:
+        return {}
+    stamp = (st.st_mtime, st.st_size)
+    cached = _RANK_STATUS_CACHE.get(str(path))
+    if cached is not None and cached[0] == stamp:
+        return cached[1]
+    data = read_json(path)
+    if not isinstance(data, dict):
+        return {}
+    _RANK_STATUS_CACHE[str(path)] = (stamp, data)
+    return data

@@ -27,8 +27,18 @@ def build_primary_diagnosis(
     process: Optional[DiagnosticResult] = None,
     step_time_error: Optional[str] = None,
     collectives: Optional[DiagnosticResult] = None,
+    liveness: Optional[DiagnosticResult] = None,
 ) -> Dict[str, Any]:
     candidates = []
+    if liveness is not None and not liveness.healthy:
+        # a lost rank trumps every performance story: the run's world
+        # shrank, cross-rank metrics past the loss point cover
+        # survivors only, and any perf verdict is computed on a
+        # different machine count than the user asked for
+        issue = liveness.diagnosis
+        candidates.append(
+            (_SEV_ORDER.get(issue.severity, 0) + 0.7, "liveness", issue)
+        )
     if step_time is not None:
         issue = step_time.diagnosis
         if issue.kind == "INSUFFICIENT_STEP_TIME_DATA":

@@ -8,6 +8,7 @@ DRAINING) → stop: final drain + ``rank_finished`` control marker.
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import List, Optional
 
@@ -69,7 +70,26 @@ class TraceMLRuntime:
                 self.settings.aggregator.port,
             )
         sender_identity = self.identity.to_sender_identity(self.settings.session_id)
-        self.publisher = TelemetryPublisher(self.samplers, self.client, sender_identity)
+        try:
+            heartbeat_s = float(
+                os.environ.get("TRACEML_HEARTBEAT_INTERVAL_SEC", 3.0)
+            )
+        except ValueError:
+            heartbeat_s = 3.0
+        self.publisher = TelemetryPublisher(
+            self.samplers,
+            self.client,
+            sender_identity,
+            # durable replay spool under the rank dir: failed sends are
+            # retained on disk and replayed on reconnect (seq-deduped
+            # aggregator-side; docs/developer_guide/fault-tolerance.md)
+            spool_dir=(
+                self.settings.rank_dir(self.identity.global_rank) / "spool"
+                if self.client is not None
+                else None
+            ),
+            heartbeat_interval_s=heartbeat_s,
+        )
         # max-steps lifecycle: observe sdk step flushes
         get_state().on_step_flushed.append(self.recording.on_step_flushed)
         # on-demand XLA profiler capture (control-file protocol)
@@ -110,6 +130,11 @@ class TraceMLRuntime:
             self.capture.stop()
         for s in self.samplers:
             s.stop()
+        if self.publisher is not None:
+            try:
+                self.publisher.close()
+            except Exception:
+                pass
         if self.client is not None:
             self.client.close()
         try:
@@ -162,6 +187,13 @@ class TraceMLRuntime:
 
     # -- tick loop -----------------------------------------------------
     def _tick(self) -> None:
+        try:
+            from traceml_tpu.dev import chaos
+
+            if chaos.active():
+                chaos.fire("rank.tick")  # kill9 executes inside fire()
+        except ImportError:  # pragma: no cover
+            pass
         phase = self.recording.phase
         # RECORDING: everyone samples.  DRAINING: only drain samplers, via
         # their (possibly heavier) drain() path.  COMPLETE: nobody samples

@@ -15,23 +15,45 @@ Idle ticks take an O(#samplers) gate — ``sender.dirty()`` (one int
 compare each) plus ``writer.has_pending()`` — and return without
 building a payload, touching the disk, or taking the client lock.
 
+Fault tolerance (docs/developer_guide/fault-tolerance.md):
+
+* every outgoing payload is stamped with a per-rank monotonic ``seq``
+  (``time_ns`` base, so a restarted rank resumes above its previous
+  range without persisting a counter);
+* with a spool directory configured, sends go through
+  :class:`~traceml_tpu.transport.spool.DurableSender` — failed batches
+  land in a bounded on-disk replay queue and drain on reconnect, with
+  the aggregator deduping by seq;
+* a ``rank_heartbeat`` control message ships every
+  ``heartbeat_interval_s`` even across idle ticks (transient — never
+  spooled), keeping the aggregator's liveness tracker fed.
+
 The publisher also self-observes: per-sampler collect/encode/flush
-nanoseconds and the idle-tick ratio, exposed via :meth:`stats` and
-shipped to the aggregator as a ``producer_stats`` control message
-(piggybacked on a non-idle batch at most every ``stats_interval_s``).
+nanoseconds, idle-tick ratio, and the transport/spool counters
+(``reconnects``, ``replayed_envelopes``, ``spool_bytes``), exposed via
+:meth:`stats` and shipped to the aggregator as a ``producer_stats``
+control message (piggybacked on a non-idle batch at most every
+``stats_interval_s``).
 """
 
 from __future__ import annotations
 
 import time
+from pathlib import Path
 from typing import Any, Dict, List, Optional
 
 from traceml_tpu.samplers.base_sampler import BaseSampler
-from traceml_tpu.telemetry.control import build_producer_stats
+from traceml_tpu.telemetry.control import (
+    build_producer_stats,
+    build_rank_heartbeat,
+)
 from traceml_tpu.telemetry.envelope import SenderIdentity
+from traceml_tpu.transport.spool import DurableSender, ReplaySpool
 from traceml_tpu.transport.tcp_transport import TCPClient
 from traceml_tpu.utils import msgpack_codec
 from traceml_tpu.utils.error_log import get_error_log
+
+DEFAULT_HEARTBEAT_INTERVAL_S = 3.0
 
 
 class TelemetryPublisher:
@@ -41,6 +63,8 @@ class TelemetryPublisher:
         client: Optional[TCPClient],
         identity: SenderIdentity,
         stats_interval_s: float = 10.0,
+        spool_dir: Optional[Path] = None,
+        heartbeat_interval_s: float = DEFAULT_HEARTBEAT_INTERVAL_S,
     ) -> None:
         self._samplers = samplers
         self._client = client
@@ -55,6 +79,19 @@ class TelemetryPublisher:
         self.payloads_sent = 0
         self._stats_interval = stats_interval_s
         self._last_stats_emit = time.monotonic()
+        self._heartbeat_interval = max(0.25, float(heartbeat_interval_s))
+        self._last_heartbeat = 0.0  # monotonic; 0 → first tick sends one
+        # per-rank monotonic seq: time_ns base means a restarted rank
+        # (same session, same global_rank) resumes strictly above every
+        # seq its previous incarnation could have stamped, so the
+        # aggregator's max-seq dedup never swallows fresh telemetry
+        self._seq = time.time_ns()
+        self._durable: Optional[DurableSender] = None
+        if client is not None and spool_dir is not None:
+            try:
+                self._durable = DurableSender(client, ReplaySpool(spool_dir))
+            except Exception as exc:
+                get_error_log().warning("replay spool unavailable", exc)
         self._sampler_stats: Dict[str, Dict[str, int]] = {
             s.name: {
                 "envelopes": 0,
@@ -78,6 +115,16 @@ class TelemetryPublisher:
                 return False
         return True
 
+    def _stamp_seq(self, payload: Any) -> None:
+        """Stamp the next per-rank seq into ``payload["meta"]``.  Control
+        messages get one too — the spool frames every payload uniformly
+        (their handlers are idempotent, so they skip the dedup table)."""
+        self._seq += 1
+        try:
+            payload["meta"]["seq"] = self._seq
+        except (TypeError, KeyError):
+            pass
+
     def publish(
         self, extra_payloads: Optional[List[Any]] = None, final: bool = False
     ) -> int:
@@ -85,6 +132,7 @@ class TelemetryPublisher:
         self.ticks += 1
         if not final and not extra_payloads and self._idle():
             self.idle_ticks += 1
+            self._maybe_heartbeat()
             return 0
         batch: List[Any] = []
         perf = time.perf_counter_ns
@@ -95,6 +143,7 @@ class TelemetryPublisher:
                 t1 = perf()
                 st["collect_ns"] += t1 - t0
                 if payload is not None:
+                    self._stamp_seq(payload)
                     enc = msgpack_codec.preencode(payload)
                     t2 = perf()
                     st["encode_ns"] += t2 - t1
@@ -116,15 +165,44 @@ class TelemetryPublisher:
                     f"collect failed for sampler {s.name}", exc
                 )
         if extra_payloads:
+            for p in extra_payloads:
+                self._stamp_seq(p)
             batch.extend(extra_payloads)
         if batch:
             stats_msg = self._maybe_stats_message(final)
             if stats_msg is not None:
+                self._stamp_seq(stats_msg)
                 batch.append(stats_msg)
         if batch and self._client is not None:
-            if self._client.send_batch(batch):
+            if self._durable is not None:
+                if self._durable.send(batch):
+                    self.payloads_sent += len(batch)
+                self._last_heartbeat = time.monotonic()
+            elif self._client.send_batch(batch):
                 self.payloads_sent += len(batch)
+                self._last_heartbeat = time.monotonic()
         return len(batch)
+
+    def _maybe_heartbeat(self) -> None:
+        """Liveness beacon on idle ticks.  Transient (never spooled — a
+        replayed heartbeat carries no liveness information), but it
+        kicks the durable sender's replay so an idle rank still drains
+        its spool the moment the link heals."""
+        if self._client is None:
+            return
+        now = time.monotonic()
+        if now - self._last_heartbeat < self._heartbeat_interval:
+            return
+        self._last_heartbeat = now
+        try:
+            hb = build_rank_heartbeat(self._identity.to_meta())
+            self._stamp_seq(hb)
+            if self._durable is not None:
+                self._durable.send_transient([hb])
+            else:
+                self._client.send_batch([hb])
+        except Exception as exc:
+            get_error_log().warning("heartbeat send failed", exc)
 
     def _maybe_stats_message(self, final: bool) -> Optional[Dict[str, Any]]:
         """Producer self-observability, piggybacked on a batch that is
@@ -138,8 +216,13 @@ class TelemetryPublisher:
         except Exception:
             return None
 
+    def close(self) -> None:
+        if self._durable is not None:
+            self._durable.close()
+
     def stats(self) -> Dict[str, Any]:
-        """Per-sampler producer-path cost (microseconds) + idle ratio."""
+        """Per-sampler producer-path cost (microseconds) + idle ratio +
+        transport/spool health."""
         samplers: Dict[str, Any] = {}
         for name, st in self._sampler_stats.items():
             samplers[name] = {
@@ -149,10 +232,24 @@ class TelemetryPublisher:
                 "encode_us": st["encode_ns"] // 1000,
                 "flush_us": st["flush_ns"] // 1000,
             }
-        return {
+        out: Dict[str, Any] = {
             "ticks": self.ticks,
             "idle_ticks": self.idle_ticks,
             "idle_ratio": (self.idle_ticks / self.ticks) if self.ticks else 0.0,
             "payloads_sent": self.payloads_sent,
             "samplers": samplers,
         }
+        transport: Dict[str, Any] = {}
+        if self._client is not None:
+            # getattr: embedders pass client doubles that predate these
+            # counters; stats must never take down the publish tick
+            transport = {
+                "reconnects": getattr(self._client, "reconnects", 0),
+                "batches_sent": getattr(self._client, "batches_sent", 0),
+                "batches_dropped": getattr(self._client, "batches_dropped", 0),
+            }
+        if self._durable is not None:
+            transport.update(self._durable.stats())
+        if transport:
+            out["transport"] = transport
+        return out

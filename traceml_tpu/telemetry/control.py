@@ -1,7 +1,7 @@
 """Control-plane messages sharing the telemetry channel
 (reference: src/traceml_ai/telemetry/control.py:24-81).
 
-Two control messages today:
+Three control messages today:
 
 * ``rank_finished`` — the end-of-run barrier marker the aggregator
   counts against ``expected_world_size`` before finalizing
@@ -10,6 +10,14 @@ Two control messages today:
   (collect/encode/flush microseconds, idle-tick ratio; see
   docs/developer_guide/rank-producer-path.md).  Aggregated into
   ``ingest_stats.json`` under ``producers``.
+* ``rank_heartbeat`` — periodic per-rank liveness beacon, sent even on
+  idle ticks so a silent-but-alive rank stays distinguishable from a
+  dead one (aggregator/liveness.py drives STALE→LOST transitions off
+  last-seen; docs/developer_guide/fault-tolerance.md).
+
+All three are idempotent on replay (set-add / keep-latest / last-seen
+max), so the durable-send spool may re-deliver them without a dedup
+table.
 """
 
 from __future__ import annotations
@@ -20,11 +28,20 @@ from typing import Any, Dict, Mapping, Optional
 CONTROL_KEY = "_traceml_control"
 RANK_FINISHED = "rank_finished"
 PRODUCER_STATS = "producer_stats"
+RANK_HEARTBEAT = "rank_heartbeat"
 
 
 def build_rank_finished(identity_meta: Mapping[str, Any]) -> Dict[str, Any]:
     return {
         CONTROL_KEY: RANK_FINISHED,
+        "meta": dict(identity_meta),
+        "timestamp": time.time(),
+    }
+
+
+def build_rank_heartbeat(identity_meta: Mapping[str, Any]) -> Dict[str, Any]:
+    return {
+        CONTROL_KEY: RANK_HEARTBEAT,
         "meta": dict(identity_meta),
         "timestamp": time.time(),
     }

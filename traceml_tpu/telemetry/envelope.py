@@ -18,6 +18,8 @@ Canonical shape on the wire::
         "pid": int,
         "platform": str,               # "tpu" | "cpu" | "gpu"
         "device_kind": str,            # e.g. "TPU v5p"
+        "seq": int,                    # per-rank monotonic (optional;
+                                       # durable-replay dedup key)
       },
       "body": {"tables": {table_name: <table>}}
     }
@@ -335,6 +337,19 @@ class TelemetryEnvelope:
             return int(self.meta.get("schema", SCHEMA_VERSION))
         except (TypeError, ValueError):
             return SCHEMA_VERSION
+
+    @property
+    def seq(self) -> Optional[int]:
+        """Per-rank monotonic sequence number stamped by the publisher
+        (durable-replay dedup; docs/developer_guide/fault-tolerance.md).
+        None for pre-seq producers — those envelopes bypass dedup."""
+        v = self.meta.get("seq")
+        if v is None:
+            return None
+        try:
+            return int(v)
+        except (TypeError, ValueError):
+            return None
 
     @property
     def tables(self) -> Dict[str, List[Dict[str, Any]]]:

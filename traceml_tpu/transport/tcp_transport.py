@@ -30,15 +30,23 @@ connect, drop-on-failure, bounded reconnect backoff
 
 from __future__ import annotations
 
+import random
 import selectors
 import socket
 import struct
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from traceml_tpu.utils import msgpack_codec
 from traceml_tpu.utils.error_log import get_error_log
+
+# fault-injection harness (no-op unless TRACEML_FAULT_PLAN is set; the
+# module is stdlib-only and its fire() is one None check when inactive)
+try:
+    from traceml_tpu.dev import chaos as _chaos
+except Exception:  # pragma: no cover
+    _chaos = None
 
 _LEN = struct.Struct(">I")
 MAX_FRAME_BYTES = 256 * 1024 * 1024  # sanity bound against corrupt lengths
@@ -119,13 +127,22 @@ class TCPServer:
         self._running = threading.Event()
         self._wake_r, self._wake_w = socket.socketpair()
         self._lock = threading.Lock()
-        self._pending: List[Any] = []
+        # (peer, frame) tuples: the peer tag ("ip:port" at accept) lets
+        # the consumer attribute corrupt frames to the client that sent
+        # them instead of one server-wide counter
+        self._pending: List[Tuple[str, bytes]] = []
         self._data_event = threading.Event()
         self._clients: Dict[int, _ClientBuffer] = {}
+        self._peers: Dict[int, str] = {}
         self._stopped = False
         self.port: Optional[int] = None
         self.frames_received = 0
         self.decode_errors = 0
+        # per-peer count of frames that arrived but could not be decoded
+        # (body corruption) or desynced the stream (length corruption);
+        # the connection survives body corruption — only a framing
+        # desync still evicts that one client
+        self.corrupt_frame_drops: Dict[str, int] = {}
         # deepest the undrained-frame buffer ever got: a proxy for how
         # far the consumer fell behind the selector thread
         self.pending_hwm = 0
@@ -179,6 +196,7 @@ class TCPServer:
         except Exception:
             pass
         self._clients.clear()
+        self._peers.clear()
         if self._sock is not None:
             try:
                 self._sock.close()
@@ -206,6 +224,13 @@ class TCPServer:
         :meth:`pending_frames`), so one drain call can't hold the caller
         hostage decoding an unbounded backlog.
         """
+        return [frame for _peer, frame in self.drain_tagged(max_frames)]
+
+    def drain_tagged(
+        self, max_frames: Optional[int] = None
+    ) -> List[Tuple[str, bytes]]:
+        """:meth:`drain`, keeping each frame's peer tag ("ip:port") so
+        the consumer can attribute decode failures per client."""
         with self._lock:
             if max_frames is None or len(self._pending) <= max_frames:
                 out = self._pending
@@ -230,6 +255,33 @@ class TCPServer:
                 f"dropped {errors} undecodable frame(s) during drain"
             )
         return payloads
+
+    def decode_tagged(self, tagged: List[Tuple[str, bytes]]) -> List[Any]:
+        """Per-frame decode of :meth:`drain_tagged` output.  A corrupt
+        frame is skipped (its whole batch of envelopes is lost — msgpack
+        cannot partially decode) and counted against the peer that sent
+        it in ``corrupt_frame_drops``; the connection stays up."""
+        payloads: List[Any] = []
+        for peer, frame in tagged:
+            try:
+                decoded = msgpack_codec.decode(frame)
+            except msgpack_codec.CodecError:
+                self.decode_errors += 1
+                self._count_corrupt(peer)
+                continue
+            if isinstance(decoded, list):
+                payloads.extend(decoded)
+            else:
+                payloads.append(decoded)
+        return payloads
+
+    def _count_corrupt(self, peer: str) -> None:
+        n = self.corrupt_frame_drops.get(peer, 0) + 1
+        self.corrupt_frame_drops[peer] = n
+        get_error_log().warning(
+            f"undecodable frame from {peer} skipped "
+            f"({n} corrupt frame(s) from this client so far)"
+        )
 
     def drain_decoded(self) -> List[Any]:
         """Convenience: :meth:`drain` + :meth:`decode_frames`."""
@@ -259,10 +311,15 @@ class TCPServer:
         assert self._sock is not None and self._selector is not None
         try:
             while True:
-                conn, _addr = self._sock.accept()
+                conn, addr = self._sock.accept()
                 conn.setblocking(False)
                 conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                self._clients[conn.fileno()] = _ClientBuffer()
+                fileno = conn.fileno()
+                self._clients[fileno] = _ClientBuffer()
+                try:
+                    self._peers[fileno] = f"{addr[0]}:{addr[1]}"
+                except (TypeError, IndexError):
+                    self._peers[fileno] = "unknown"
                 self._selector.register(conn, selectors.EVENT_READ, ("client", None))
         except BlockingIOError:
             return
@@ -284,6 +341,7 @@ class TCPServer:
             except Exception:
                 pass
             self._clients.pop(fileno, None)
+            self._peers.pop(fileno, None)
             try:
                 conn.close()
             except OSError:
@@ -292,15 +350,24 @@ class TCPServer:
         buf = self._clients.get(fileno)
         if buf is None:
             return
+        peer = self._peers.get(fileno, "unknown")
         try:
             frames = buf.feed(data)
         except ValueError as exc:
+            # a corrupt LENGTH field desyncs the stream — nothing after
+            # it can be reframed, so this one client is evicted (and the
+            # loss attributed to it); a corrupt BODY with intact framing
+            # survives to decode_tagged, which skips just that frame
             get_error_log().warning(f"dropping client with bad frame: {exc}")
+            self.corrupt_frame_drops[peer] = (
+                self.corrupt_frame_drops.get(peer, 0) + 1
+            )
             try:
                 self._selector.unregister(conn)
             except Exception:
                 pass
             self._clients.pop(fileno, None)
+            self._peers.pop(fileno, None)
             try:
                 conn.close()
             except OSError:
@@ -312,14 +379,25 @@ class TCPServer:
         # client.  Frames are handed to the consumer as-is.
         self.frames_received += len(frames)
         with self._lock:
-            self._pending.extend(frames)
+            for frame in frames:
+                self._pending.append((peer, frame))
             if len(self._pending) > self.pending_hwm:
                 self.pending_hwm = len(self._pending)
         self._data_event.set()
 
 
 class TCPClient:
-    """Best-effort sender: never raises, lazily connects, drops on failure."""
+    """Best-effort sender: never raises, lazily connects, drops on failure.
+
+    Reconnect policy: capped exponential backoff with full jitter.
+    ``reconnect_backoff`` is the BASE delay (kwarg name kept for
+    back-compat with callers tuning it); consecutive dial failures
+    double the window up to ``backoff_cap``, and the actual wait is
+    drawn uniformly from [window/2, window] so a thousand ranks losing
+    one aggregator never re-dial in lockstep.  Any successful dial
+    resets the window to zero (the first retry after a blip is
+    immediate).
+    """
 
     def __init__(
         self,
@@ -327,11 +405,17 @@ class TCPClient:
         port: int,
         connect_timeout: float = 2.0,
         reconnect_backoff: float = 1.0,
+        backoff_cap: float = 15.0,
     ) -> None:
         self._host = host
         self._port = port
         self._timeout = connect_timeout
-        self._backoff = reconnect_backoff
+        self._backoff_base = max(0.001, float(reconnect_backoff))
+        self._backoff_cap = max(self._backoff_base, float(backoff_cap))
+        self._backoff_cur = 0.0  # jittered wait before the next dial
+        self._fail_streak = 0
+        self._connected_once = False
+        self.reconnects = 0  # successful dials after the first
         self._sock: Optional[socket.socket] = None
         self._last_fail = 0.0
         self._lock = threading.Lock()
@@ -351,11 +435,20 @@ class TCPClient:
         self.batches_sent = 0
         self.batches_dropped = 0
 
+    def _note_dial_failure_locked(self) -> None:
+        self._last_fail = time.monotonic()
+        self._fail_streak += 1
+        window = min(
+            self._backoff_cap,
+            self._backoff_base * (2 ** (self._fail_streak - 1)),
+        )
+        self._backoff_cur = random.uniform(window / 2.0, window)
+
     def _ensure_connected(self) -> Optional[socket.socket]:
         with self._lock:
             if self._sock is not None:
                 return self._sock
-            if time.monotonic() - self._last_fail < self._backoff:
+            if time.monotonic() - self._last_fail < self._backoff_cur:
                 return None
             gen = self._gen
         with self._connect_lock:
@@ -370,7 +463,7 @@ class TCPClient:
                 )
             except OSError:
                 with self._lock:
-                    self._last_fail = time.monotonic()
+                    self._note_dial_failure_locked()
                 return None
             try:
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -386,6 +479,11 @@ class TCPClient:
                         pass
                     return None
                 self._sock = sock
+                self._fail_streak = 0
+                self._backoff_cur = 0.0
+                if self._connected_once:
+                    self.reconnects += 1
+                self._connected_once = True
                 return sock
 
     def send_batch(self, payloads: List[Any]) -> bool:
@@ -406,6 +504,23 @@ class TCPClient:
         except Exception:
             self.batches_dropped += 1
             return False
+        return self.send_encoded_body(body)
+
+    def send_encoded_body(self, body: bytes) -> bool:
+        """Send an already-assembled wire body as one frame.  The replay
+        path (transport/spool.py) splices spooled raw envelope bytes
+        into a batch body itself and ships it through here — same
+        framing, same counters, same failure semantics as send_batch."""
+        fault = _chaos.fire("client.send") if _chaos is not None else None
+        if fault is not None:
+            if fault.action == "stall":
+                time.sleep(float(fault.arg or 0.2))
+                fault = None
+            elif fault.action == "reset":
+                with self._lock:
+                    self._teardown_locked()
+                self.batches_dropped += 1
+                return False
         if self._ensure_connected() is None:
             self.batches_dropped += 1
             return False
@@ -414,11 +529,24 @@ class TCPClient:
             del buf[:]
             buf += _LEN.pack(len(body))
             buf += body
+            if fault is not None and fault.action == "corrupt":
+                # flip one byte past the length prefix: framing stays
+                # intact, the receiver's decode fails (per-client
+                # corrupt_frame_drops path, connection survives)
+                idx = 4 + (len(body) // 2)
+                buf[idx] ^= 0xFF
             with self._lock:
                 if self._sock is None:  # torn down between connect and send
                     self.batches_dropped += 1
                     return False
                 try:
+                    if fault is not None and fault.action == "truncate":
+                        # ship a prefix then reset: receiver-side stream
+                        # desync (bad length next), client evicted there
+                        self._sock.sendall(bytes(buf[: max(5, len(buf) // 2)]))
+                        self.batches_dropped += 1
+                        self._teardown_locked()
+                        return False
                     self._sock.sendall(buf)
                     self.batches_sent += 1
                     return True
