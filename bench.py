@@ -773,9 +773,10 @@ def _run_device_child(rounds: int, steps: int) -> bool:
 def _run_micro_benches() -> int:
     """The slow-marker micro-bench lane (tests/benchmarks/bench_*.py):
     aggregator/read-path component benches with built-in golden
-    comparisons — live tick, window compute, codec, TCP drain, and the
+    comparisons — live tick, window compute, codec, TCP drain, the
     high-rank ingest write path (watermark retention vs the seed
-    windowed prune).  They run
+    windowed prune), and the serving tier (delta protocol + shared
+    payload cache under 8 sessions × 32 viewers).  They run
     under pytest so their assertions (speedup floors, payload equality)
     gate the same way CI's slow lane runs them; ``-s`` keeps the
     bench_common JSON lines on stdout for collection into BENCH_LOCAL_*

@@ -55,7 +55,7 @@ def test_dashboard_endpoints(tmp_path):
         assert status == 200
         payload = json.loads(body)
         assert payload["session"] == "web"
-        assert payload["version"] == 2
+        assert payload["version"] == 3
         assert payload["step_time"]["n_steps"] == 39
         phase_keys = [p["key"] for p in payload["step_time"]["phases"]]
         assert "compute" in phase_keys

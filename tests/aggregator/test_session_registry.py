@@ -1,0 +1,203 @@
+"""Session registry + publisher cache behavior
+(docs/developer_guide/serving-tier.md).
+
+The old ``web_payload._computers`` cache closed EVERY cached computer
+whenever a different db_path polled — one session per aggregator
+process.  These tests pin the replacement semantics: keyed publishers
+that coexist, an LRU bound that closes only the evicted publisher,
+strict session-id validation ahead of any filesystem access, and the
+fleet index fed from rank-status/final-summary artifacts.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from traceml_tpu.aggregator.session_registry import (
+    SessionRegistry,
+    valid_session_id,
+)
+from traceml_tpu.renderers import serving
+
+from tests.display.test_browser_driver import _make_session_db
+
+
+@pytest.fixture(autouse=True)
+def _fresh_publishers():
+    serving.close_all_publishers()
+    yield
+    serving.close_all_publishers()
+
+
+def _session(tmp_path, name):
+    d = tmp_path / name
+    d.mkdir(parents=True, exist_ok=True)
+    return _make_session_db(d)
+
+
+# -- publisher cache -------------------------------------------------------
+
+def test_two_sessions_poll_without_thrashing(tmp_path):
+    """The satellite fix: session B polling must not close session A's
+    sqlite connection (the seed cache cleared everything on a different
+    db_path)."""
+    db_a = _session(tmp_path, "a")
+    db_b = _session(tmp_path, "b")
+    pub_a = serving.publisher_for(db_a, "a")
+    pub_b = serving.publisher_for(db_b, "b")
+    pub_a.min_poll_interval = pub_b.min_poll_interval = 0
+    assert pub_a is not pub_b
+    for _ in range(3):  # interleaved polling, both stay open
+        pub_a.poll()
+        pub_b.poll()
+    assert not pub_a.closed and not pub_b.closed
+    # same key → same instance (no rebuild churn)
+    assert serving.publisher_for(db_a, "a") is pub_a
+    body_a, _, _ = pub_a.full_body()
+    body_b, _, _ = pub_b.full_body()
+    assert json.loads(body_a)["session"] == "a"
+    assert json.loads(body_b)["session"] == "b"
+
+
+def test_lru_bound_closes_only_the_evicted_publisher(tmp_path):
+    dbs = [_session(tmp_path, n) for n in ("a", "b", "c")]
+    pub_a = serving.publisher_for(dbs[0], "a", max_publishers=2)
+    pub_b = serving.publisher_for(dbs[1], "b", max_publishers=2)
+    pub_c = serving.publisher_for(dbs[2], "c", max_publishers=2)
+    assert pub_a.closed  # least recently used
+    assert not pub_b.closed and not pub_c.closed
+    # re-requesting the evicted session opens a FRESH publisher
+    pub_a2 = serving.publisher_for(dbs[0], "a", max_publishers=2)
+    assert pub_a2 is not pub_a and not pub_a2.closed
+    assert pub_b.closed  # b was next in LRU order
+
+
+def test_closed_publisher_degrades_not_crashes(tmp_path):
+    db = _session(tmp_path, "a")
+    pub = serving.publisher_for(db, "a")
+    pub.min_poll_interval = 0
+    pub.poll()
+    pub.close()
+    # a request thread still holding the evicted publisher gets a
+    # served (stale) response, not an exception
+    body, token, _ = pub.full_body()
+    assert json.loads(body)["session"] == "a"
+    assert pub.delta_body(token)[0] is None
+
+
+# -- session id validation -------------------------------------------------
+
+@pytest.mark.parametrize("bad", [
+    "../etc", "a/b", "a\\b", ".hidden", "..", ".",
+    "x" * 129, "sp ace", "semi;colon", "<script>",
+])
+def test_invalid_session_ids_rejected(tmp_path, bad):
+    reg = SessionRegistry(tmp_path, default_session="ok")
+    assert not valid_session_id(bad)
+    assert reg.resolve(bad) is None
+    with pytest.raises(KeyError):
+        reg.publisher(bad)
+
+
+@pytest.mark.parametrize("empty", ["", None])
+def test_empty_session_falls_back_to_default_but_is_not_an_id(
+    tmp_path, empty
+):
+    reg = SessionRegistry(tmp_path, default_session="ok")
+    assert not valid_session_id(empty)
+    assert reg.resolve(empty) == "ok"  # omitted → default session
+    with pytest.raises(KeyError):
+        reg.publisher(empty)
+
+
+def test_resolve_defaults_and_validates(tmp_path):
+    reg = SessionRegistry(tmp_path, default_session="dash")
+    assert reg.resolve(None) == "dash"
+    assert reg.resolve("") == "dash"
+    assert reg.resolve("other-1.2_x") == "other-1.2_x"
+
+
+def test_discovery_skips_hostile_directory_names(tmp_path):
+    _session(tmp_path, "good")
+    (tmp_path / "bad name!").mkdir()
+    (tmp_path / "bad name!" / "telemetry.sqlite").write_bytes(b"")
+    (tmp_path / ".dotted").mkdir()
+    (tmp_path / ".dotted" / "telemetry.sqlite").write_bytes(b"")
+    reg = SessionRegistry(tmp_path)
+    assert reg.sessions() == ["good"]
+
+
+# -- fleet index -----------------------------------------------------------
+
+def test_fleet_index_liveness_and_diagnosis(tmp_path):
+    _session(tmp_path, "live1")
+    _session(tmp_path, "done1")
+    (tmp_path / "live1" / "rank_status.json").write_text(json.dumps({
+        "ts": 123.0,
+        "ranks": {"0": {"state": "ACTIVE"}, "1": {"state": "ACTIVE"},
+                  "2": {"state": "LOST"}},
+    }))
+    (tmp_path / "done1" / "final_summary.json").write_text(json.dumps({
+        "primary_diagnosis": {"kind": "INPUT_BOUND", "severity": "warning",
+                              "summary": "input pipeline dominates",
+                              "confidence": 0.8},
+        "sections": {},
+    }))
+    reg = SessionRegistry(tmp_path, default_session="live1")
+    index = reg.fleet_index()
+    assert index["default_session"] == "live1"
+    by_id = {e["session"]: e for e in index["sessions"]}
+    assert set(by_id) == {"live1", "done1"}
+    live = by_id["live1"]
+    assert live["ranks"] == {"ACTIVE": 2, "LOST": 1}
+    assert live["last_update_ts"] == 123.0
+    assert live["db_exists"] and not live["finished"]
+    done = by_id["done1"]
+    assert done["finished"]
+    assert done["primary_diagnosis"] == {
+        "kind": "INPUT_BOUND", "severity": "warning",
+        "summary": "input pipeline dominates",
+    }
+
+
+def test_fleet_index_live_diagnosis_from_open_publisher(tmp_path):
+    _session(tmp_path, "live1")
+    reg = SessionRegistry(tmp_path, default_session="live1")
+    index = reg.fleet_index()
+    entry = index["sessions"][0]
+    # no publisher open yet: the index must not force one open
+    assert entry["primary_diagnosis"] is None
+    pub = reg.publisher("live1")
+    pub.min_poll_interval = 0
+    pub.poll()
+    index = reg.fleet_index()
+    entry = index["sessions"][0]
+    # the session DB is input-bound by construction (40ms dataloader on
+    # a 100ms step) — the open publisher's diagnosis feeds the index
+    assert entry["primary_diagnosis"] is not None
+    assert entry["primary_diagnosis"]["kind"]
+    reg.close()
+    assert pub.closed
+
+
+# -- ready file ------------------------------------------------------------
+
+def test_ready_file_carries_display_port(tmp_path):
+    from traceml_tpu.aggregator.trace_aggregator import write_ready_file
+    from traceml_tpu.runtime.settings import TraceMLSettings
+
+    settings = TraceMLSettings(session_id="s", logs_dir=tmp_path)
+    settings.session_dir.mkdir(parents=True)
+    write_ready_file(settings, 1234, display_port=5678)
+    ready = json.loads(
+        (settings.session_dir / "aggregator_ready.json").read_text()
+    )
+    assert ready["port"] == 1234
+    assert ready["display_port"] == 5678
+    write_ready_file(settings, 1234)
+    ready = json.loads(
+        (settings.session_dir / "aggregator_ready.json").read_text()
+    )
+    assert "display_port" not in ready

@@ -217,3 +217,61 @@ def test_every_interpolation_is_escaped_or_vetted(section):
         f"section {section.id!r} interpolates unvetted expressions "
         f"(wrap in esc()/a formatter, or audit + add to _VETTED): {bad}"
     )
+
+
+# --- fleet index page (serving tier) --------------------------------------
+# Session ids and diagnosis strings in /api/sessions are telemetry-
+# derived (unauthenticated ingest port) — the fleet page is held to the
+# same escape-coverage contract as the section pages.  SSE fragments
+# carry the same payload keys the sections render, so their escaping is
+# covered by the per-section interpolation test above.
+
+from traceml_tpu.aggregator.display_drivers.browser_sections.fleet import (  # noqa: E402
+    FLEET_JS,
+    build_fleet_page,
+)
+
+_FLEET_PAGE = build_fleet_page()
+_FLEET_SAFE = _SAFE_MARKERS + ("encodeURIComponent(",)
+# audited locals: fleetRanks/fleetDiag esc() every payload string
+# internally; `state` is a ternary over badge HTML literals; the two
+# tick() interpolations land in textContent (inert) and are numeric/Date
+_FLEET_VETTED = {
+    "fleetRanks(s.ranks)",
+    "fleetDiag(s)",
+    "state",
+    "(x.sessions||[]).length",
+    "new Date(x.ts*1000).toLocaleTimeString()",
+}
+
+
+def test_fleet_every_interpolation_is_escaped_or_vetted():
+    bad = []
+    for m in re.finditer(r"\$\{([^{}]+)\}", FLEET_JS):
+        expr = m.group(1).strip()
+        if any(mark in expr for mark in _FLEET_SAFE):
+            continue
+        if expr in _FLEET_VETTED:
+            continue
+        bad.append(expr)
+    assert not bad, (
+        f"fleet page interpolates unvetted expressions "
+        f"(wrap in esc()/a formatter, or audit + add to _FLEET_VETTED): {bad}"
+    )
+
+
+def test_fleet_session_strings_are_escaped():
+    # the id shown as text goes through esc(); the id placed in the
+    # dashboard link additionally through encodeURIComponent()
+    assert "esc(s.session)" in FLEET_JS
+    assert "encodeURIComponent(s.session)" in FLEET_JS
+    # diagnosis text (summary/kind/severity) is esc()'d
+    assert "esc(p.summary||p.kind||" in FLEET_JS
+    assert 'esc(p.severity||"info")' in FLEET_JS
+
+
+def test_fleet_js_ids_exist_in_markup():
+    used = set(re.findall(r'getElementById\("([\w-]+)"\)', _FLEET_PAGE))
+    declared = set(re.findall(r'id="([\w-]+)"', _FLEET_PAGE))
+    missing = used - declared
+    assert not missing, f"fleet JS touches ids with no markup: {missing}"

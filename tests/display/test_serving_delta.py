@@ -1,0 +1,335 @@
+"""Delta-protocol correctness for the serving tier
+(docs/developer_guide/serving-tier.md).
+
+The core property: a viewer that consumes ANY interleaving of
+``?since=<token>`` deltas — including missing whole rounds of updates,
+as an SSE client does after a dropped connection — ends up with a
+payload equivalent to a fresh full ``GET /api/live``.  Equivalence is
+byte-identical on the canonical encoding (``json.dumps(sort_keys=True)``)
+with the ``ts`` stamp excluded: ``ts`` is wall-clock serving time, baked
+fresh into every full body, and deltas carry it in the envelope instead
+of any fragment.
+
+Also covered here: the 204 idle path, SSE framing + ``Last-Event-ID``
+resume, and the gzip/strong-ETag conditional-request behavior of the
+full endpoints.
+"""
+
+from __future__ import annotations
+
+import gzip
+import http.client
+import json
+import random
+import types
+
+import pytest
+
+from traceml_tpu.aggregator.display_drivers.browser import (
+    BrowserDisplayDriver,
+    wait_until_ready,
+)
+from traceml_tpu.renderers import serving
+
+from tests.display.test_browser_driver import _make_session_db
+
+
+@pytest.fixture(autouse=True)
+def _fresh_publishers():
+    serving.close_all_publishers()
+    yield
+    serving.close_all_publishers()
+
+
+def _write_rows(db, step0, n_ranks=2, n_steps=5):
+    """Append more telemetry to an existing session DB."""
+    from traceml_tpu.aggregator.sqlite_writer import SQLiteWriter
+    from traceml_tpu.telemetry.envelope import (
+        SenderIdentity,
+        build_telemetry_envelope,
+    )
+    from traceml_tpu.utils import timing as T
+
+    w = SQLiteWriter(db)
+    w.start()
+    for rank in range(n_ranks):
+        ident = SenderIdentity(
+            session_id="dash", global_rank=rank, world_size=n_ranks
+        )
+        rows = [
+            {"step": s, "timestamp": float(s), "clock": "device",
+             "events": {
+                 T.STEP_TIME: {"cpu_ms": 100.0 + s, "device_ms": 100.0 + s,
+                               "count": 1},
+                 T.COMPUTE_TIME: {"cpu_ms": 1.0, "device_ms": 55.0,
+                                  "count": 1},
+             }}
+            for s in range(step0, step0 + n_steps)
+        ]
+        w.ingest(build_telemetry_envelope(
+            "step_time", {"step_time": rows}, ident))
+    w.force_flush()
+    w.finalize()
+
+
+def _start_driver(logs_dir, session="dash"):
+    db = logs_dir / session / "telemetry.sqlite"
+    ctx = types.SimpleNamespace(
+        db_path=db,
+        settings=types.SimpleNamespace(
+            session_id=session,
+            session_dir=logs_dir / session,
+            logs_dir=logs_dir,
+            serve_max_sessions=8,
+        ),
+    )
+    driver = BrowserDisplayDriver(port=0)
+    driver.sse_wait_slice = 0.02
+    driver.start(ctx)
+    assert driver.port and wait_until_ready("127.0.0.1", driver.port, 5.0)
+    # deterministic tests: no poll rate-limiting
+    serving.publisher_for(db, session).min_poll_interval = 0
+    return driver, db
+
+
+def _get(port, path, headers=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        conn.request("GET", path, headers=headers or {})
+        resp = conn.getresponse()
+        return resp.status, dict(resp.getheaders()), resp.read()
+    finally:
+        conn.close()
+
+
+def _canon(payload):
+    return json.dumps(
+        {k: v for k, v in payload.items() if k != "ts"}, sort_keys=True
+    )
+
+
+# -- delta replay ----------------------------------------------------------
+
+def test_delta_replay_any_interleaving_matches_full(tmp_path):
+    session_dir = tmp_path / "dash"
+    session_dir.mkdir(parents=True)
+    _make_session_db(session_dir)
+    driver, db = _start_driver(tmp_path)
+    try:
+        rng = random.Random(1307)
+        token = None
+        state = {}
+        step0 = 100
+        for _ in range(6):
+            _write_rows(db, step0)
+            step0 += 5
+            if rng.random() < 0.4:
+                continue  # viewer misses this round entirely (dropped)
+            q = f"?since={token}" if token else ""
+            code, headers, body = _get(driver.port, f"/api/live{q}")
+            token = headers.get("X-TraceML-Token", token)
+            if code == 204:
+                continue
+            assert code == 200
+            m = json.loads(body)
+            if "fragments" in m:
+                for frag in m["fragments"].values():
+                    state.update(frag)
+                token = m["token"]
+            else:  # first fetch without a token: the flat full payload
+                state = m
+        # catch-up delta after the last write, then compare to a full GET
+        code, headers, body = _get(
+            driver.port, f"/api/live?since={token}" if token else "/api/live"
+        )
+        if code == 200:
+            m = json.loads(body)
+            if "fragments" in m:
+                for frag in m["fragments"].values():
+                    state.update(frag)
+            else:
+                state = m
+        code, _, full = _get(driver.port, "/api/live")
+        assert code == 200
+        full_payload = json.loads(full)
+        assert full_payload["step_time"]["n_steps"] > 0
+        assert _canon(state) == _canon(full_payload)
+    finally:
+        driver.stop()
+
+
+def test_idle_delta_is_204_with_stable_token(tmp_path):
+    session_dir = tmp_path / "dash"
+    session_dir.mkdir(parents=True)
+    _make_session_db(session_dir)
+    driver, db = _start_driver(tmp_path)
+    try:
+        code, headers, body = _get(driver.port, "/api/live")
+        assert code == 200
+        token = headers["X-TraceML-Token"]
+        # nothing changed: empty 304-style body, token echoed
+        code, headers, body = _get(driver.port, f"/api/live?since={token}")
+        assert code == 204 and body == b""
+        assert headers["X-TraceML-Token"] == token
+        # garbled token: treated as no token → every fragment returned
+        code, _, body = _get(driver.port, "/api/live?since=bogus")
+        assert code == 200
+        m = json.loads(body)
+        assert set(m["fragments"]) >= {"header", "step_time", "diagnosis"}
+    finally:
+        driver.stop()
+
+
+def test_full_payload_unchanged_shape_and_version(tmp_path):
+    """Acceptance: the legacy full GET /api/live works unchanged —
+    version bump only."""
+    session_dir = tmp_path / "dash"
+    session_dir.mkdir(parents=True)
+    _make_session_db(session_dir)
+    driver, db = _start_driver(tmp_path)
+    try:
+        code, _, body = _get(driver.port, "/api/live")
+        d = json.loads(body)
+        assert code == 200 and d["version"] == 3
+        assert list(d.keys())[:3] == ["version", "session", "ts"]
+        for key in ("step_time", "memory", "collectives", "system",
+                    "process", "stdout", "diagnosis", "findings"):
+            assert key in d
+        assert d["session"] == "dash"
+        assert d["step_time"]["n_steps"] > 0
+    finally:
+        driver.stop()
+
+
+# -- SSE -------------------------------------------------------------------
+
+def _read_sse_event(resp, timeout_lines=200):
+    """Read one SSE event (dict of field → value) from a streaming
+    response."""
+    event = {}
+    for _ in range(timeout_lines):
+        line = resp.fp.readline()
+        if not line:
+            break
+        line = line.decode().rstrip("\n")
+        if line == "":
+            if event:
+                return event
+            continue
+        field, _, value = line.partition(": ")
+        event[field] = value
+    return event or None
+
+
+def test_sse_stream_and_last_event_id_resume(tmp_path):
+    session_dir = tmp_path / "dash"
+    session_dir.mkdir(parents=True)
+    _make_session_db(session_dir)
+    driver, db = _start_driver(tmp_path)
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", driver.port, timeout=10)
+        conn.request("GET", "/api/stream")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.getheader("Content-Type") == "text/event-stream"
+        first = _read_sse_event(resp)
+        conn.close()  # dropped connection, mid-stream
+        assert first["event"] == "fragment"
+        token = first["id"]
+        m = json.loads(first["data"])
+        assert m["token"] == token
+        assert set(m["fragments"]) >= {"header", "step_time"}
+
+        # new data lands while the viewer is disconnected
+        _write_rows(db, 500)
+
+        # browser reconnect: Last-Event-ID carries the resume point —
+        # only fragments whose version advanced come back
+        conn = http.client.HTTPConnection("127.0.0.1", driver.port, timeout=10)
+        conn.request("GET", "/api/stream", headers={"Last-Event-ID": token})
+        resp = conn.getresponse()
+        second = _read_sse_event(resp)
+        conn.close()
+        assert second["event"] == "fragment"
+        m2 = json.loads(second["data"])
+        assert "step_time" in m2["fragments"]
+        assert "header" not in m2["fragments"]  # constant → never resent
+
+        # merged state equals a fresh full GET (ts excluded)
+        state = {}
+        for frag in m["fragments"].values():
+            state.update(frag)
+        for frag in m2["fragments"].values():
+            state.update(frag)
+        code, _, full = _get(driver.port, "/api/live")
+        assert code == 200
+        assert _canon(state) == _canon(json.loads(full))
+    finally:
+        driver.stop()
+
+
+# -- gzip + ETag conditional requests --------------------------------------
+
+def test_live_etag_and_gzip(tmp_path):
+    session_dir = tmp_path / "dash"
+    session_dir.mkdir(parents=True)
+    _make_session_db(session_dir)
+    driver, db = _start_driver(tmp_path)
+    try:
+        code, headers, plain = _get(driver.port, "/api/live")
+        assert code == 200
+        etag = headers["ETag"]
+        assert etag == '"' + headers["X-TraceML-Token"] + '"'
+        # conditional revalidation: nothing changed → 304, no body
+        code, headers, body = _get(
+            driver.port, "/api/live", {"If-None-Match": etag}
+        )
+        assert code == 304 and body == b""
+        # gzip negotiation: decoded bytes match the plain body (mod ts)
+        code, headers, gz = _get(
+            driver.port, "/api/live", {"Accept-Encoding": "gzip"}
+        )
+        assert code == 200 and headers.get("Content-Encoding") == "gzip"
+        assert _canon(json.loads(gzip.decompress(gz))) == _canon(
+            json.loads(plain)
+        )
+        # a write invalidates the ETag
+        _write_rows(db, 900)
+        code, headers, body = _get(
+            driver.port, "/api/live", {"If-None-Match": etag}
+        )
+        assert code == 200 and headers["ETag"] != etag
+    finally:
+        driver.stop()
+
+
+def test_summary_etag_and_gzip(tmp_path):
+    session_dir = tmp_path / "dash"
+    session_dir.mkdir(parents=True)
+    _make_session_db(session_dir)
+    driver, db = _start_driver(tmp_path)
+    try:
+        code, _, _ = _get(driver.port, "/api/summary")
+        assert code == 404
+        summary = {
+            "primary_diagnosis": {"kind": "INPUT_BOUND", "severity": "warning",
+                                  "summary": "input pipeline dominates"},
+            "sections": {"pad": "x" * 600},  # over the gzip threshold
+            "meta": {},
+        }
+        (session_dir / "final_summary.json").write_text(json.dumps(summary))
+        code, headers, plain = _get(driver.port, "/api/summary")
+        assert code == 200
+        etag = headers["ETag"]
+        assert json.loads(plain)["primary_diagnosis"]["kind"] == "INPUT_BOUND"
+        code, _, body = _get(
+            driver.port, "/api/summary", {"If-None-Match": etag}
+        )
+        assert code == 304 and body == b""
+        code, headers, gz = _get(
+            driver.port, "/api/summary", {"Accept-Encoding": "gzip"}
+        )
+        assert code == 200 and headers.get("Content-Encoding") == "gzip"
+        assert gzip.decompress(gz) == plain
+    finally:
+        driver.stop()

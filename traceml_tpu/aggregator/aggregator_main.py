@@ -41,7 +41,11 @@ def main() -> int:
         agg = TraceMLAggregator(settings)
         agg.start()
         assert agg.port is not None
-        write_ready_file(settings, agg.port)
+        write_ready_file(
+            settings,
+            agg.port,
+            display_port=getattr(agg.display, "port", None),
+        )
         while not stop_evt.wait(0.25):
             pass
         agg.stop()

@@ -5,38 +5,62 @@ framework).
 
 The PAGE itself is assembled by ``browser_sections/pages.py`` from
 per-domain section modules + a theme layer (reference role:
-nicegui_sections/); this module is only the HTTP server:
+nicegui_sections/); this module is only the HTTP server — since the
+serving-tier split (docs/developer_guide/serving-tier.md) a *read
+service* over N sessions, not a single-session viewer:
 
-* ``GET /``          — the dashboard page (self-contained HTML/JS/CSS)
-* ``GET /api/live``  — live JSON payload (renderers/web_payload.py, v2:
-  the typed views from renderers/views.py serialized verbatim)
-* ``GET /api/summary`` — final_summary.json once it exists
-* ``GET /healthz``   — readiness probe ({"ok": true, session, ts}) —
+* ``GET /``            — the dashboard page (``?session=<id>`` selects a
+  session; the page itself is static)
+* ``GET /fleet``       — the fleet index page (one row per session)
+* ``GET /api/sessions``— fleet index JSON (session registry)
+* ``GET /api/live``    — full payload (strong ETag = version token,
+  If-None-Match → 304, gzip negotiated); with ``?since=<token>`` a
+  delta body carrying only the fragments whose version advanced
+  (204 + ``X-TraceML-Token`` when nothing moved)
+* ``GET /api/stream``  — SSE push of the same fragment deltas
+  (``id:`` = version token, heartbeat, ``Last-Event-ID`` resume)
+* ``GET /api/summary`` — final_summary.json once it exists (content-hash
+  ETag, gzip)
+* ``GET /healthz``     — readiness probe ({"ok": true, session, ts}) —
   ``wait_until_ready()`` polls it so watchers/tests never race startup
 
+All payload bodies come from the per-session ``SessionPublisher``
+(renderers/serving.py): fragments are serialized once per (domain,
+version) and the bytes are shared across every connection.
+
 Security: every interpolated value that originates in telemetry
-(hostnames, diagnosis text, phase/rank keys) goes through ``esc()`` —
-the ingest port is unauthenticated, so the page treats all payload
-strings as hostile (enforced by the escape-coverage contract test).
+(hostnames, diagnosis text, phase/rank keys, session ids) goes through
+``esc()`` client-side — the ingest port is unauthenticated, so the page
+treats all payload strings as hostile (enforced by the escape-coverage
+contract test); session ids arriving in URLs are validated server-side
+before touching the filesystem (aggregator/session_registry.py).
 """
 
 from __future__ import annotations
 
+import gzip as _gzip
+import hashlib
 import json
 import threading
+import time
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
-from typing import Any, Optional
+from typing import Any, Dict, Optional, Tuple
 
 from traceml_tpu.aggregator.display_drivers.base import BaseDisplayDriver
 from traceml_tpu.utils.atomic_io import read_json
 from traceml_tpu.utils.error_log import get_error_log
 
+from traceml_tpu.aggregator.display_drivers.browser_sections.fleet import (
+    build_fleet_page,
+)
 from traceml_tpu.aggregator.display_drivers.browser_sections.pages import (
     build_page,
 )
 
 _PAGE = build_page()
+_FLEET_PAGE = build_fleet_page()
 
 
 def wait_until_ready(
@@ -45,7 +69,6 @@ def wait_until_ready(
     """Poll the dashboard's ``/healthz`` until it answers — the server
     readiness probe (reference role: nicegui's startup wait), so
     watchers, tests, and launch tooling never race the bind."""
-    import time
     import urllib.request
 
     deadline = time.monotonic() + timeout
@@ -73,10 +96,49 @@ class BrowserDisplayDriver(BaseDisplayDriver):
         self._db_path: Optional[Path] = None
         self._session = ""
         self._session_dir: Optional[Path] = None
+        self._registry: Optional[Any] = None
+        self._own_registry = False
+        self._stopping = threading.Event()
+        #: SSE cadence knobs (instance attrs so tests/benches can tighten)
+        self.sse_heartbeat_sec = 10.0
+        self.sse_wait_slice = 0.25
+        # (mtime, size)-keyed summary body cache: path → (stamp, etag,
+        # raw bytes, gzip bytes or None)
+        self._summary_cache: Dict[str, Tuple] = {}
 
     @property
     def host(self) -> str:
         return self._host
+
+    @property
+    def registry(self) -> Optional[Any]:
+        return self._registry
+
+    # -- per-request resolution (called from handler threads) -----------
+
+    def _publisher_for(self, session_param: Optional[str]):
+        """(publisher or None, validated session id or None).  Without a
+        registry (bare driver) only the bound session is served."""
+        from traceml_tpu.renderers.serving import publisher_for
+
+        if self._registry is not None:
+            sid = self._registry.resolve(session_param)
+            if sid is None:
+                return None, None
+            return self._registry.publisher(sid), sid
+        if session_param and session_param != self._session:
+            return None, None
+        if self._db_path is None:
+            # context-less driver (legacy tests): empty payload, not 404
+            return None, self._session
+        return publisher_for(self._db_path, self._session), self._session
+
+    def _session_dir_for(self, sid: Optional[str]) -> Optional[Path]:
+        if self._registry is not None and sid:
+            return Path(self._registry.session_dir(sid))
+        return self._session_dir
+
+    # -- lifecycle -------------------------------------------------------
 
     def start(self, context: Optional[Any] = None) -> None:
         try:
@@ -84,62 +146,306 @@ class BrowserDisplayDriver(BaseDisplayDriver):
                 self._db_path = context.db_path
                 self._session = context.settings.session_id
                 self._session_dir = context.settings.session_dir
+                self._registry = getattr(context, "registry", None)
+                if self._registry is None:
+                    try:
+                        from traceml_tpu.aggregator.session_registry import (
+                            SessionRegistry,
+                        )
+
+                        self._registry = SessionRegistry(
+                            context.settings.logs_dir,
+                            default_session=context.settings.session_id,
+                            max_sessions=getattr(
+                                context.settings, "serve_max_sessions", 8
+                            ),
+                        )
+                        self._own_registry = True
+                    except Exception as exc:
+                        get_error_log().warning(
+                            "session registry init failed", exc
+                        )
+                if self._registry is not None and self._db_path is not None:
+                    # the context's binding wins over the logs_dir/<sid>/
+                    # convention for the driver's own session
+                    try:
+                        self._registry.register(
+                            self._session,
+                            self._db_path,
+                            session_dir=self._session_dir,
+                        )
+                    except KeyError:
+                        pass
+            self._stopping.clear()
             driver = self
 
             class Handler(BaseHTTPRequestHandler):
                 def log_message(self, fmt, *args):  # silence
                     pass
 
-                def _send(self, code: int, body: bytes, ctype: str) -> None:
+                def _accepts_gzip(self) -> bool:
+                    return "gzip" in (
+                        self.headers.get("Accept-Encoding") or ""
+                    )
+
+                def _send(
+                    self,
+                    code: int,
+                    body: bytes,
+                    ctype: str,
+                    headers: Optional[Dict[str, str]] = None,
+                    gzip_ok: bool = False,
+                ) -> None:
+                    from traceml_tpu.renderers.serving import GZIP_MIN_BYTES
+
+                    enc = None
+                    if (
+                        gzip_ok
+                        and len(body) >= GZIP_MIN_BYTES
+                        and self._accepts_gzip()
+                    ):
+                        body = _gzip.compress(body, mtime=0)
+                        enc = "gzip"
                     self.send_response(code)
                     self.send_header("Content-Type", ctype)
+                    if enc:
+                        self.send_header("Content-Encoding", enc)
+                    for k, v in (headers or {}).items():
+                        self.send_header(k, v)
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
 
+                def _api_live(self, query: Dict[str, list]) -> None:
+                    session_param = (query.get("session") or [None])[0]
+                    pub, sid = driver._publisher_for(session_param)
+                    if pub is None and sid is None:
+                        self._send(
+                            404,
+                            b'{"error": "unknown session"}',
+                            "application/json",
+                        )
+                        return
+                    since = (query.get("since") or [None])[0]
+                    if pub is None:
+                        # bare driver without a DB: legacy empty payload
+                        self._send(200, b"{}", "application/json")
+                        return
+                    if since is not None:
+                        body, token = pub.delta_body(since)
+                        if body is None:
+                            self._send(
+                                204,
+                                b"",
+                                "application/json",
+                                headers={"X-TraceML-Token": token},
+                            )
+                        else:
+                            self._send(
+                                200,
+                                body,
+                                "application/json",
+                                headers={"X-TraceML-Token": token},
+                                gzip_ok=True,
+                            )
+                        return
+                    # full payload: strong ETag == quoted version token
+                    inm = (
+                        self.headers.get("If-None-Match") or ""
+                    ).strip()
+                    token = pub.poll()
+                    if inm and inm == f'"{token}"':
+                        self._send(
+                            304,
+                            b"",
+                            "application/json",
+                            headers={
+                                "ETag": f'"{token}"',
+                                "X-TraceML-Token": token,
+                            },
+                        )
+                        return
+                    accept_gz = self._accepts_gzip()
+                    body, token, enc = pub.full_body(accept_gzip=accept_gz)
+                    headers = {
+                        "ETag": f'"{token}"',
+                        "X-TraceML-Token": token,
+                    }
+                    if enc:
+                        headers["Content-Encoding"] = enc
+                    self._send(
+                        200, body, "application/json", headers=headers
+                    )
+
+                def _api_summary(self, query: Dict[str, list]) -> None:
+                    session_param = (query.get("session") or [None])[0]
+                    sid = session_param
+                    if driver._registry is not None:
+                        sid = driver._registry.resolve(session_param)
+                        if sid is None:
+                            self._send(
+                                404,
+                                b'{"error": "unknown session"}',
+                                "application/json",
+                            )
+                            return
+                    session_dir = driver._session_dir_for(sid)
+                    path = (
+                        session_dir / "final_summary.json"
+                        if session_dir is not None
+                        else None
+                    )
+                    entry = None
+                    if path is not None:
+                        try:
+                            st = path.stat()
+                            stamp = (st.st_mtime, st.st_size)
+                            cached = driver._summary_cache.get(str(path))
+                            if cached is not None and cached[0] == stamp:
+                                entry = cached
+                            else:
+                                data = read_json(path)
+                                if data:
+                                    raw = json.dumps(data).encode()
+                                    etag = (
+                                        '"'
+                                        + hashlib.sha1(raw).hexdigest()
+                                        + '"'
+                                    )
+                                    entry = (stamp, etag, raw)
+                                    driver._summary_cache[str(path)] = entry
+                        except OSError:
+                            entry = None
+                    if entry is None:
+                        self._send(
+                            404,
+                            json.dumps({"error": "not ready"}).encode(),
+                            "application/json",
+                        )
+                        return
+                    _, etag, raw = entry
+                    inm = (
+                        self.headers.get("If-None-Match") or ""
+                    ).strip()
+                    if inm and inm == etag:
+                        self._send(
+                            304,
+                            b"",
+                            "application/json",
+                            headers={"ETag": etag},
+                        )
+                        return
+                    self._send(
+                        200,
+                        raw,
+                        "application/json",
+                        headers={"ETag": etag},
+                        gzip_ok=True,
+                    )
+
+                def _api_stream(self, query: Dict[str, list]) -> None:
+                    session_param = (query.get("session") or [None])[0]
+                    pub, sid = driver._publisher_for(session_param)
+                    if pub is None:
+                        self._send(
+                            404,
+                            b'{"error": "unknown session"}',
+                            "application/json",
+                        )
+                        return
+                    # resume point: browsers replay the last event id on
+                    # reconnect; curl-style clients can pass ?since=.  A
+                    # stale/garbled token simply selects every fragment.
+                    since = self.headers.get("Last-Event-ID") or (
+                        query.get("since") or [None]
+                    )[0]
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "text/event-stream"
+                    )
+                    self.send_header("Cache-Control", "no-cache")
+                    self.end_headers()
+                    last_write = time.monotonic()
+                    while not driver._stopping.is_set() and not pub.closed:
+                        body, token = pub.delta_body(since)
+                        if body is not None:
+                            self.wfile.write(
+                                b"id: "
+                                + token.encode("ascii")
+                                + b"\nevent: fragment\ndata: "
+                                + body
+                                + b"\n\n"
+                            )
+                            self.wfile.flush()
+                            since = token
+                            last_write = time.monotonic()
+                        else:
+                            pub.wait_for_change(
+                                since, timeout=driver.sse_wait_slice
+                            )
+                        if (
+                            time.monotonic() - last_write
+                            >= driver.sse_heartbeat_sec
+                        ):
+                            self.wfile.write(b"event: hb\ndata: {}\n\n")
+                            self.wfile.flush()
+                            last_write = time.monotonic()
+
                 def do_GET(self):  # noqa: N802
                     try:
-                        if self.path == "/" or self.path.startswith("/index"):
-                            self._send(200, _PAGE.encode(), "text/html; charset=utf-8")
-                        elif self.path.startswith("/healthz"):
-                            import time as _time
-
+                        parts = urllib.parse.urlsplit(self.path)
+                        route = parts.path
+                        query = urllib.parse.parse_qs(parts.query)
+                        if route == "/" or route.startswith("/index"):
+                            self._send(
+                                200,
+                                _PAGE.encode(),
+                                "text/html; charset=utf-8",
+                                gzip_ok=True,
+                            )
+                        elif route.startswith("/fleet"):
+                            self._send(
+                                200,
+                                _FLEET_PAGE.encode(),
+                                "text/html; charset=utf-8",
+                                gzip_ok=True,
+                            )
+                        elif route.startswith("/healthz"):
                             self._send(
                                 200,
                                 json.dumps({
                                     "ok": True,
                                     "session": driver._session,
-                                    "ts": _time.time(),
+                                    "ts": time.time(),
                                 }).encode(),
                                 "application/json",
                             )
-                        elif self.path.startswith("/api/live"):
-                            from traceml_tpu.renderers.web_payload import (
-                                build_web_payload,
-                            )
-
-                            payload = build_web_payload(
-                                driver._db_path, driver._session
-                            ) if driver._db_path else {}
+                        elif route.startswith("/api/sessions"):
+                            if driver._registry is not None:
+                                index = driver._registry.fleet_index()
+                            else:
+                                index = {
+                                    "version": 1,
+                                    "ts": time.time(),
+                                    "default_session": driver._session
+                                    or None,
+                                    "sessions": [],
+                                }
                             self._send(
                                 200,
-                                json.dumps(payload).encode(),
+                                json.dumps(index).encode(),
                                 "application/json",
+                                gzip_ok=True,
                             )
-                        elif self.path.startswith("/api/summary"):
-                            data = None
-                            if driver._session_dir is not None:
-                                data = read_json(
-                                    driver._session_dir / "final_summary.json"
-                                )
-                            self._send(
-                                200 if data else 404,
-                                json.dumps(data or {"error": "not ready"}).encode(),
-                                "application/json",
-                            )
+                        elif route.startswith("/api/stream"):
+                            self._api_stream(query)
+                        elif route.startswith("/api/live"):
+                            self._api_live(query)
+                        elif route.startswith("/api/summary"):
+                            self._api_summary(query)
                         else:
                             self._send(404, b"not found", "text/plain")
-                    except BrokenPipeError:
+                    except (BrokenPipeError, ConnectionResetError):
                         pass
                     except Exception as exc:
                         try:
@@ -149,7 +455,14 @@ class BrowserDisplayDriver(BaseDisplayDriver):
                         except Exception:
                             pass
 
-            self._httpd = ThreadingHTTPServer(
+            class _Server(ThreadingHTTPServer):
+                # socketserver's default listen backlog (5) drops SYNs
+                # under fleet load — a few dozen viewers each opening a
+                # connection per poll — and every drop costs the client a
+                # full 1 s retransmit.  Deep backlog, cheap to hold.
+                request_queue_size = 128
+
+            self._httpd = _Server(
                 (self._host, self._requested_port), Handler
             )
             self.port = self._httpd.server_address[1]
@@ -165,9 +478,10 @@ class BrowserDisplayDriver(BaseDisplayDriver):
             self._httpd = None
 
     def tick(self, context: Optional[Any] = None) -> None:
-        pass  # pull-based: the page polls /api/live
+        pass  # pull-based: the page polls or streams /api/*
 
     def stop(self) -> None:
+        self._stopping.set()
         if self._httpd is not None:
             try:
                 self._httpd.shutdown()
@@ -175,3 +489,10 @@ class BrowserDisplayDriver(BaseDisplayDriver):
             except Exception:
                 pass
             self._httpd = None
+        if self._own_registry and self._registry is not None:
+            try:
+                self._registry.close()
+            except Exception:
+                pass
+            self._registry = None
+            self._own_registry = False

@@ -1,0 +1,98 @@
+"""Fleet index page: one row per session the registry serves
+(docs/developer_guide/serving-tier.md).
+
+Served at ``GET /fleet``; polls ``GET /api/sessions`` and renders the
+session id (linking to the per-session dashboard via ``?session=``),
+the rank-liveness summary, and the primary diagnosis.  Session ids and
+diagnosis strings are telemetry-derived (the ingest port is
+unauthenticated), so EVERY interpolation goes through ``esc()`` — and
+ids placed into URLs additionally through ``encodeURIComponent()`` —
+enforced by the escape-coverage contract test alongside the section
+pages.
+"""
+
+from __future__ import annotations
+
+from traceml_tpu.aggregator.display_drivers.browser_sections import theme
+
+FLEET_HTML = """
+<div class="wrap">
+ <div class="card reveal" style="padding:13px 20px">
+  <div style="display:flex;align-items:center;gap:14px;flex-wrap:wrap">
+    <span class="wm">TraceML<b>-TPU</b></span>
+    <span class="eyebrow">fleet</span>
+    <span style="flex:1"></span>
+    <span class="muted" id="fleet-meta">connecting…</span>
+    <span class="livedot"></span>
+  </div>
+ </div>
+ <div class="card reveal d1">
+  <div class="chead"><h2 class="ctitle">Sessions</h2><span class="sp"></span>
+    <span class="cmeta" id="fleet-count"></span></div>
+  <table><thead><tr>
+    <th>session</th><th>ranks</th><th>state</th><th>diagnosis</th>
+    <th class="num">updated</th>
+  </tr></thead><tbody id="fleet-rows">
+    <tr><td colspan="5" class="muted">no sessions yet</td></tr>
+  </tbody></table>
+ </div>
+</div>
+<div id="tip"></div>
+"""
+
+FLEET_JS = """
+function fleetRanks(r){
+  const order=["ACTIVE","STALE","LOST","FINISHED"];
+  const keys=Object.keys(r||{});
+  keys.sort((a,b)=>(order.indexOf(a)+1||99)-(order.indexOf(b)+1||99));
+  return keys.map(k=>`${esc(k.toLowerCase())} ${esc(r[k])}`).join(" · ");
+}
+function fleetDiag(s){
+  const p=s.primary_diagnosis;
+  if(!p)return'<span class="muted">—</span>';
+  return`<span class="sevpill" style="background:${SEV[p.severity]||SEV.info}">${
+    esc(p.severity||"info")}</span> ${esc(p.summary||p.kind||"")}`;
+}
+function fleetRow(s){
+  const total=Object.values(s.ranks||{}).reduce((a,n)=>a+n,0);
+  const state=s.finished?'<span class="badge">finished</span>':
+    (s.db_exists?'<span class="badge" style="color:var(--good)">live</span>':
+     '<span class="badge stale">no data</span>');
+  const upd=s.last_update_ts?
+    new Date(s.last_update_ts*1000).toLocaleTimeString():"—";
+  return`<tr>
+    <td><a style="color:var(--accent)" href="/?session=${
+      encodeURIComponent(s.session)}">${esc(s.session)}</a></td>
+    <td>${total?esc(total):'<span class="muted">—</span>'}
+      <span class="muted">${fleetRanks(s.ranks)}</span></td>
+    <td>${state}</td>
+    <td>${fleetDiag(s)}</td>
+    <td class="num cmeta">${esc(upd)}</td></tr>`;
+}
+async function tick(){
+ try{
+  const r=await fetch("/api/sessions");const x=await r.json();
+  const rows=(x.sessions||[]).map(fleetRow).join("");
+  document.getElementById("fleet-rows").innerHTML=
+    rows||'<tr><td colspan="5" class="muted">no sessions yet</td></tr>';
+  document.getElementById("fleet-count").textContent=
+    `${(x.sessions||[]).length} session(s)`;
+  const meta=document.getElementById("fleet-meta");
+  meta.textContent=`updated ${new Date(x.ts*1000).toLocaleTimeString()}`;
+  meta.className="muted";
+ }catch(e){const meta=document.getElementById("fleet-meta");
+   meta.textContent="poll failed: "+e;meta.className="err"}
+ setTimeout(tick,2000);
+}
+tick();
+"""
+
+
+def build_fleet_page() -> str:
+    return (
+        "<!doctype html><html><head><meta charset=\"utf-8\">\n"
+        "<title>TraceML-TPU fleet</title>\n"
+        f"{theme.head()}\n</head><body>\n"
+        + FLEET_HTML
+        + f"\n<script>{theme.HELPERS_JS}\n{FLEET_JS}</script></body></html>"
+    )

@@ -4,9 +4,11 @@ payload).
 
 ``build_page()`` stitches the theme CSS, every section's static HTML
 (wrapped in a glass card, laid out step-time-first), the shared JS
-helpers, each section's render function, and one ``tick()`` that polls
-``/api/live`` and fans the payload out to every section — assembled
-once at import, served as a single self-contained page.
+helpers, each section's render function, and a delta client: payload
+fragments arrive over SSE (``/api/stream``) with a ``?since=``-token
+polling fallback in ``tick()``, merge into one payload object, and fan
+out to every section via ``renderAll()`` — assembled once at import,
+served as a single self-contained page.
 """
 
 from __future__ import annotations
@@ -118,19 +120,53 @@ function runContext(d){{
     if(devs)bits.push(`${{devs}} chip${{devs>1?"s":""}}`);
     bits.push(String(s.nodes[0].hostname).split(".")[0])}}
   document.getElementById("runctx").textContent=bits.join(" · ")}}
-async function tick(){{
- try{{
-  const r=await fetch("/api/live");const d=await r.json();
+function renderAll(d){{
   const meta=document.getElementById("meta");
   meta.textContent=
     `session ${{d.session}} · updated ${{new Date(d.ts*1000).toLocaleTimeString()}}`;
   meta.className="muted";
   runContext(d);
   {calls}
+}}
+// delta client: D is the merged payload, TOKEN the server's version
+// token. Fragments arrive over SSE (preferred) or the ?since= polling
+// fallback; either way each delta merges fragment keys into D and
+// re-renders — same render fns, fed incrementally.
+let D=null,TOKEN=null,SSE_OK=false;
+const SESSION=new URLSearchParams(location.search).get("session");
+function api(p){{
+  return SESSION?p+(p.indexOf("?")>=0?"&":"?")+
+    "session="+encodeURIComponent(SESSION):p}}
+function applyDelta(m){{
+  if(!D)D={{}};
+  for(const k in m.fragments)Object.assign(D,m.fragments[k]);
+  D.ts=m.ts;TOKEN=m.token;
+  renderAll(D);
+}}
+function startStream(){{
+  if(!window.EventSource)return;
+  const es=new EventSource(api("/api/stream"));
+  es.addEventListener("fragment",ev=>{{SSE_OK=true;
+    try{{applyDelta(JSON.parse(ev.data))}}catch(e){{}}}});
+  es.addEventListener("hb",()=>{{SSE_OK=true}});
+  es.onerror=()=>{{SSE_OK=false}};
+}}
+async function tick(){{
+ try{{
+  if(!SSE_OK){{
+    const r=await fetch(TOKEN?api("/api/live?since="+
+      encodeURIComponent(TOKEN)):api("/api/live"));
+    if(r.status===200){{
+      const m=await r.json();
+      if(m.fragments)applyDelta(m);
+      else{{D=m;TOKEN=r.headers.get("X-TraceML-Token");renderAll(D)}}
+    }}
+  }}
  }}catch(e){{const meta=document.getElementById("meta");
    meta.textContent="poll failed: "+e;meta.className="err"}}
  setTimeout(tick,1000);
 }}
+startStream();
 tick();
 """
     return (

@@ -27,6 +27,7 @@ from typing import Any, Dict, List, Optional, Set
 
 from traceml_tpu.aggregator.display_drivers import resolve_display_driver
 from traceml_tpu.aggregator.liveness import RankLivenessTracker
+from traceml_tpu.aggregator.session_registry import SessionRegistry
 from traceml_tpu.aggregator.sqlite_writer import SQLiteWriter
 from traceml_tpu.aggregator.summary_service import FinalSummaryService
 from traceml_tpu.runtime.settings import TraceMLSettings
@@ -62,6 +63,14 @@ class TraceMLAggregator:
             self.db_path, summary_window_rows=settings.summary_window_rows
         )
         self.display = resolve_display_driver(settings.mode)
+        # serving tier: the display driver reads THROUGH this registry,
+        # so one aggregator process can serve sibling sessions under the
+        # same logs_dir (fleet index + per-session publishers)
+        self.registry = SessionRegistry(
+            settings.logs_dir,
+            default_session=settings.session_id,
+            max_sessions=settings.serve_max_sessions,
+        )
         self.summary_service = FinalSummaryService(
             settings,
             generate=self.generate_final_summary,
@@ -136,6 +145,10 @@ class TraceMLAggregator:
             self.display.stop()
         except Exception as exc:
             get_error_log().warning("display stop failed", exc)
+        try:
+            self.registry.close()
+        except Exception as exc:
+            get_error_log().warning("session registry close failed", exc)
         ok = self.writer.finalize(timeout=max(5.0, deadline - time.monotonic()))
         if not ok:
             get_error_log().warning("sqlite finalize incomplete within budget")
@@ -436,9 +449,20 @@ class TraceMLAggregator:
         )
 
 
-def write_ready_file(settings: TraceMLSettings, port: int) -> None:
-    """The launcher polls this to learn the bound port."""
+def write_ready_file(
+    settings: TraceMLSettings,
+    port: int,
+    display_port: Optional[int] = None,
+) -> None:
+    """The launcher polls this to learn the bound ports (ingest always;
+    the dashboard's HTTP port when a browser driver is serving)."""
+    payload: Dict[str, Any] = {
+        "port": port,
+        "pid": __import__("os").getpid(),
+        "ts": time.time(),
+    }
+    if display_port is not None:
+        payload["display_port"] = display_port
     atomic_write_json(
-        settings.session_dir / "aggregator_ready.json",
-        {"port": port, "pid": __import__("os").getpid(), "ts": time.time()},
+        settings.session_dir / "aggregator_ready.json", payload
     )

@@ -106,6 +106,16 @@ class LiveComputer:
             self._cache = out
             return out
 
+    def payload_with_versions(
+        self,
+    ) -> Tuple[Dict[str, Any], Dict[str, int]]:
+        """Payload plus the store versions it was computed at, read
+        atomically under the lock — the serving tier keys its serialized
+        fragment cache on these, so the pair must be consistent."""
+        with self._lock:
+            payload = self.payload()
+            return payload, dict(self._store.versions)
+
     def _attach_rank_status(self, out: Dict[str, Any]) -> None:
         """Liveness strip, refreshed EVERY tick (never dirty-gated): a
         lost rank's state changes exactly when its DB writes stop, so

@@ -3,32 +3,67 @@
 
 One pipeline, N surfaces: the payload is derived from the SAME
 ``LiveComputer`` the CLI renders from (one load→views→diagnose pass per
-TTL regardless of how many dashboard tabs poll), with the typed views
-serialized verbatim via ``as_dict()``.
+version change regardless of how many dashboard tabs poll), with the
+typed views serialized verbatim via ``as_dict()``.
+
+Since the serving-tier split (docs/developer_guide/serving-tier.md) the
+payload is built as PER-DOMAIN FRAGMENTS: each fragment owns a disjoint
+set of top-level payload keys (``_FRAGMENT_KEYS``) and recomputes only
+when the snapshot-store versions it depends on (``FRAGMENT_DEPS``)
+advance.  ``build_web_payload`` composes every fragment back into the
+flat dict the dashboard has always consumed — same keys, same order —
+while the delta/SSE endpoints ship fragments individually, serialized
+once per (fragment, version) by ``renderers/serving.py``.
+
+The old module-global ``_computers`` cache (which closed EVERY cached
+computer whenever a different db_path polled — one session per process)
+is gone: computers now live inside the serving tier's keyed, LRU-bounded
+publisher cache, so N sessions polling concurrently keep N live sqlite
+connections instead of thrashing each other's.
 """
 
 from __future__ import annotations
 
-import time
 from pathlib import Path
-from typing import Any, Dict
+from typing import Any, Dict, Tuple
 
-from traceml_tpu.renderers.compute import LiveComputer
+PAYLOAD_VERSION = 3
 
-PAYLOAD_VERSION = 2
+#: fragment name → top-level payload keys it owns, in payload key order
+#: (``header`` first; the assembler splices ``ts`` between header and
+#: the domain fragments to preserve the historical key order)
+_FRAGMENT_KEYS: Dict[str, Tuple[str, ...]] = {
+    "header": ("version", "session"),
+    "step_time": ("step_time",),
+    "memory": ("memory",),
+    "collectives": ("collectives",),
+    "system": ("system",),
+    "process": ("process",),
+    "stdout": ("stdout",),
+    "diagnosis": ("diagnosis", "findings"),
+    "meta": ("ingest", "rank_status"),
+}
 
-_computers: Dict[str, LiveComputer] = {}
+#: serving order — also the position of each counter in the version token
+FRAGMENT_ORDER: Tuple[str, ...] = tuple(_FRAGMENT_KEYS)
 
-
-def _computer_for(db_path: Path, window_steps: int) -> LiveComputer:
-    key = str(db_path)
-    comp = _computers.get(key)
-    if comp is None or comp.window_steps != window_steps:
-        for old in _computers.values():  # one session per aggregator process
-            old.close()  # the computer holds a live sqlite connection now
-        _computers.clear()
-        comp = _computers[key] = LiveComputer(db_path, window_steps=window_steps)
-    return comp
+#: fragment → snapshot-store domains whose ``data_version`` gates its
+#: recompute.  ``diagnosis`` joins every diagnosing domain (the composed
+#: findings list can reorder when any of them moves).  ``header`` is
+#: constant and ``meta`` is file-backed (ingest_stats/rank_status json),
+#: so both are content-compared instead of version-gated.
+FRAGMENT_DEPS: Dict[str, Tuple[str, ...]] = {
+    "step_time": ("step_time", "model_stats", "topology"),
+    "memory": ("step_memory",),
+    "collectives": ("collectives", "step_time"),
+    "system": ("system", "topology"),
+    "process": ("process",),
+    "stdout": ("stdout",),
+    "diagnosis": (
+        "step_time", "model_stats", "topology", "step_memory",
+        "collectives", "system", "process",
+    ),
+}
 
 
 def _issue_dict(issue: Any) -> Dict[str, Any]:
@@ -46,42 +81,18 @@ def _issue_dict(issue: Any) -> Dict[str, Any]:
     }
 
 
-def build_web_payload(
-    db_path: Path, session: str, window_steps: int = 150
-) -> Dict[str, Any]:
-    out: Dict[str, Any] = {
-        "version": PAYLOAD_VERSION,
-        "session": session,
-        "ts": time.time(),
-        "step_time": None,
-        "memory": None,
-        "collectives": None,
-        "system": None,
-        "process": None,
-        "stdout": [],
-        "diagnosis": None,
-        "findings": [],
-    }
-    payload = _computer_for(Path(db_path), window_steps).payload()
+def _view_fragment(payload: Dict[str, Any], key: str) -> Dict[str, Any]:
+    view = (payload.get("views") or {}).get(key)
+    return {key: view.as_dict() if view is not None else None}
+
+
+def _diagnosis_fragment(payload: Dict[str, Any]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {"diagnosis": None, "findings": []}
     if not payload.get("db_exists"):
         return out
-
-    views = payload.get("views") or {}
-    for key, payload_key in (
-        ("step_time", "step_time"),
-        ("memory", "memory"),
-        ("collectives", "collectives"),
-        ("system", "system"),
-        ("process", "process"),
-    ):
-        view = views.get(key)
-        if view is not None:
-            out[payload_key] = view.as_dict()
-
     st_result = (payload.get("step_time") or {}).get("diagnosis")
     if st_result is not None:
         out["diagnosis"] = _issue_dict(st_result.diagnosis)
-
     domain_results = {
         "step_time": st_result,
         "step_memory": payload.get("step_memory_diagnosis"),
@@ -99,19 +110,25 @@ def build_web_payload(
         ]
     except Exception:
         pass
-    out["stdout"] = [
-        {"stream": s, "line": l} for s, l in (payload.get("stdout") or [])
-    ]
-    # aggregator self-metrics for the dashboard meta strip: backpressure
-    # (queue depth/hwm, per-domain sheds) and writer latency live, not
-    # just in the post-run summary
+    return out
+
+
+def _meta_fragment(
+    payload: Dict[str, Any], session_dir: Path
+) -> Dict[str, Any]:
+    """Aggregator self-metrics for the dashboard meta strip: backpressure
+    (queue depth/hwm, per-domain sheds), writer latency, and the per-rank
+    liveness strip — live, not just in the post-run summary."""
+    out: Dict[str, Any] = {}
+    if not payload.get("db_exists"):
+        return out
     try:
         from traceml_tpu.reporting.loaders import (
             load_ingest_stats,
             load_rank_status,
         )
 
-        stats = load_ingest_stats(Path(db_path).parent)
+        stats = load_ingest_stats(session_dir)
         if stats:
             out["ingest"] = {
                 k: stats[k]
@@ -126,7 +143,7 @@ def build_web_payload(
             }
         # per-rank liveness strip (ACTIVE/STALE/LOST/FINISHED): the
         # dashboard shows which ranks a live dip is actually averaging
-        status = load_rank_status(Path(db_path).parent)
+        status = load_rank_status(session_dir)
         if status and isinstance(status.get("ranks"), dict):
             out["rank_status"] = {
                 "ts": status.get("ts"),
@@ -140,3 +157,44 @@ def build_web_payload(
     except Exception:
         pass
     return out
+
+
+def build_fragment(
+    name: str,
+    payload: Dict[str, Any],
+    *,
+    session: str,
+    db_path: Path,
+) -> Dict[str, Any]:
+    """One fragment's top-level payload keys, built from a
+    ``LiveComputer.payload()`` result.  Fragments are plain JSON-able
+    dicts — the serving tier serializes each exactly once per version."""
+    if name == "header":
+        return {"version": PAYLOAD_VERSION, "session": session}
+    if name in ("step_time", "memory", "collectives", "system", "process"):
+        return _view_fragment(payload, name)
+    if name == "stdout":
+        return {
+            "stdout": [
+                {"stream": s, "line": l}
+                for s, l in (payload.get("stdout") or [])
+            ]
+        }
+    if name == "diagnosis":
+        return _diagnosis_fragment(payload)
+    if name == "meta":
+        return _meta_fragment(payload, Path(db_path).parent)
+    raise KeyError(name)
+
+
+def build_web_payload(
+    db_path: Path, session: str, window_steps: int = 150
+) -> Dict[str, Any]:
+    """The flat full payload (legacy full-poll shape) — every fragment
+    merged in historical key order, plus a fresh ``ts``.  Reads through
+    the serving tier's publisher cache, so dashboard polls share the
+    per-(fragment, version) work with the delta/SSE endpoints."""
+    from traceml_tpu.renderers.serving import publisher_for
+
+    pub = publisher_for(Path(db_path), session, window_steps=window_steps)
+    return pub.full_payload_dict()

@@ -29,6 +29,7 @@ ENV_RUN_NAME = "TRACEML_RUN_NAME"
 ENV_EXPECTED_WORLD_SIZE = "TRACEML_EXPECTED_WORLD_SIZE"
 ENV_FINALIZE_TIMEOUT = "TRACEML_FINALIZE_TIMEOUT_SEC"
 ENV_SUMMARY_WINDOW_ROWS = "TRACEML_SUMMARY_WINDOW_ROWS"
+ENV_SERVE_MAX_SESSIONS = "TRACEML_SERVE_MAX_SESSIONS"
 ENV_SCRIPT = "TRACEML_SCRIPT"
 ENV_SCRIPT_ARGS = "TRACEML_SCRIPT_ARGS"
 
@@ -60,6 +61,9 @@ class TraceMLSettings:
     expected_world_size: Optional[int] = None
     finalize_timeout_sec: float = 300.0
     summary_window_rows: int = 10000
+    # serving tier: max concurrently-open session publishers (LRU bound
+    # on sqlite connections) when one aggregator serves a fleet
+    serve_max_sessions: int = 8
 
     @property
     def session_dir(self) -> Path:
@@ -124,6 +128,7 @@ def settings_from_env(env: Optional[Dict[str, str]] = None) -> TraceMLSettings:
         expected_world_size=int(expected_ws) if expected_ws else None,
         finalize_timeout_sec=float(get(ENV_FINALIZE_TIMEOUT, 300.0) or 300.0),
         summary_window_rows=int(get(ENV_SUMMARY_WINDOW_ROWS, 10000) or 10000),
+        serve_max_sessions=int(get(ENV_SERVE_MAX_SESSIONS, 8) or 8),
     )
 
 
@@ -140,6 +145,7 @@ def settings_to_env(s: TraceMLSettings) -> Dict[str, str]:
         ENV_CAPTURE_STDERR: "1" if s.capture_stderr else "0",
         ENV_FINALIZE_TIMEOUT: str(s.finalize_timeout_sec),
         ENV_SUMMARY_WINDOW_ROWS: str(s.summary_window_rows),
+        ENV_SERVE_MAX_SESSIONS: str(s.serve_max_sessions),
     }
     if s.trace_max_steps is not None:
         env[ENV_MAX_STEPS] = str(s.trace_max_steps)
