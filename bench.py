@@ -775,8 +775,9 @@ def _run_micro_benches() -> int:
     aggregator/read-path component benches with built-in golden
     comparisons — live tick, window compute, codec, TCP drain, the
     high-rank ingest write path (watermark retention vs the seed
-    windowed prune), and the serving tier (delta protocol + shared
-    payload cache under 8 sessions × 32 viewers).  They run
+    windowed prune), the serving tier (delta protocol + shared
+    payload cache under 8 sessions × 32 viewers), and the topology
+    attribution pass (mesh axis reductions + η² scoring).  They run
     under pytest so their assertions (speedup floors, payload equality)
     gate the same way CI's slow lane runs them; ``-s`` keeps the
     bench_common JSON lines on stdout for collection into BENCH_LOCAL_*

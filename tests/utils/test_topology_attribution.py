@@ -1,0 +1,475 @@
+"""Topology attribution: reduction goldens, attribution fixtures, and
+the back-compat pin (docs/developer_guide/topology-attribution.md).
+
+Three contracts pinned here:
+
+* ``reduce_cube`` is **bit-equal** to ``reduce_cube_reference`` (the
+  scalar left-fold in ascending-rank order) for every aggregate, on
+  ragged cubes with missing ranks and missing steps;
+* ``attribute_ranks`` names the right physical structure on the four
+  canonical fixtures — host outlier, DCN boundary side, model-axis
+  shard imbalance, and unstructured noise (flat fallback: None);
+* a session with NO mesh topology produces **byte-identical** diagnosis
+  payloads to the pre-topology contract: ``to_dict`` has no
+  ``attribution`` key, ``topology()`` has no ``"mesh"`` key, and the
+  serialized step-time result is unchanged.
+"""
+
+import json
+import random
+import time
+
+import numpy as np
+import pytest
+
+from traceml_tpu.aggregator.sqlite_writer import SQLiteWriter
+from traceml_tpu.diagnostics.attribution import attach_attribution
+from traceml_tpu.diagnostics.common import (
+    DiagnosticIssue,
+    DiagnosticResult,
+    SEVERITY_WARNING,
+    STATUS_ISSUE,
+)
+from traceml_tpu.diagnostics.step_time.api import diagnose_rank_rows
+from traceml_tpu.reporting.loaders import load_mesh_topology
+from traceml_tpu.reporting.snapshot_store import LiveSnapshotStore
+from traceml_tpu.telemetry.envelope import (
+    SenderIdentity,
+    TelemetryEnvelope,
+    build_telemetry_envelope,
+)
+from traceml_tpu.utils import timing as T
+from traceml_tpu.utils.columnar import reduce_window_by_grouping
+from traceml_tpu.utils.step_time_window import (
+    STEP_KEY,
+    build_step_time_window,
+)
+from traceml_tpu.utils.topology import (
+    AxisInfo,
+    Grouping,
+    MeshTopology,
+    _coords_for_rank,
+    attribute_ranks,
+    candidate_groupings,
+    capture_local_topology,
+    parse_mesh_spec,
+    reduce_cube,
+    reduce_cube_reference,
+    topology_from_rank_rows,
+)
+
+
+# -- fixtures ------------------------------------------------------------
+
+
+def _mesh(spec, world, hosts_of=None, hostnames=None):
+    axes = parse_mesh_spec(spec)
+    assert axes, spec
+    sizes = [a.size for a in axes]
+    return MeshTopology(
+        axes=axes,
+        rank_coords={r: tuple(_coords_for_rank(r, sizes)) for r in range(world)},
+        rank_hosts={r: (hosts_of(r) if hosts_of else 0) for r in range(world)},
+        rank_hostnames=hostnames or {},
+        source="env",
+    )
+
+
+def _step_row(step, ms):
+    return {
+        "step": step,
+        "timestamp": 100.0 + step,
+        "clock": "host",
+        "events": {T.STEP_TIME: {"cpu_ms": ms, "count": 1}},
+    }
+
+
+# -- reduction goldens ---------------------------------------------------
+
+
+def _assert_bitwise(fast, ref):
+    for key in ("sum", "count", "mean", "min", "max"):
+        assert np.array_equal(fast[key], ref[key], equal_nan=True), key
+
+
+def test_reduce_cube_matches_reference_bitwise_ragged():
+    rng = np.random.default_rng(1234)
+    r, s, g = 17, 23, 5
+    cube = rng.uniform(1.0, 250.0, size=(r, s))
+    group_index = rng.integers(0, g, size=r)
+    mask = rng.random((r, s)) > 0.2
+    mask[3, :] = False  # a rank with no data at all
+    mask[:, 7] = False  # a step missing on every rank
+    _assert_bitwise(
+        reduce_cube(cube, group_index, g, mask=mask),
+        reduce_cube_reference(cube, group_index, g, mask=mask),
+    )
+    # dense path too (mask=None)
+    _assert_bitwise(
+        reduce_cube(cube, group_index, g),
+        reduce_cube_reference(cube, group_index, g),
+    )
+
+
+def test_reduce_cube_accumulation_order_is_rank_ascending():
+    # values chosen so pairwise summation would differ from the
+    # left-fold: tiny + huge + tiny loses the tiny terms in a different
+    # order than (tiny + huge) + tiny
+    cube = np.array([[1e-16], [1.0], [1e-16], [-1.0]])
+    gi = np.zeros(4, dtype=np.int64)
+    fast = reduce_cube(cube, gi, 1)
+    ref = reduce_cube_reference(cube, gi, 1)
+    assert fast["sum"][0, 0] == ref["sum"][0, 0]
+
+
+def test_reduce_cube_empty_group_markers():
+    cube = np.array([[1.0, 2.0], [3.0, 4.0]])
+    red = reduce_cube(cube, np.array([0, 0]), 2)
+    assert np.isnan(red["mean"][1]).all()
+    assert (red["min"][1] == np.inf).all()
+    assert (red["max"][1] == -np.inf).all()
+    assert (red["count"][1] == 0).all()
+
+
+def test_reduce_window_by_grouping_scalar_window():
+    rank_rows = {
+        r: [_step_row(s, 100.0 + (40.0 if r >= 2 else 0.0)) for s in range(8)]
+        for r in range(4)
+    }
+    w = build_step_time_window(rank_rows, max_steps=8)
+    topo = _mesh("data:2@dcn,fsdp:2", world=4)
+    groupings = {g.kind: g for g in candidate_groupings(topo, list(range(4)))}
+    out = reduce_window_by_grouping(w, groupings["dcn_side"], key=STEP_KEY)
+    assert out["kind"] == "dcn_side" and out["axis"] == "data"
+    assert [g["ranks"] for g in out["groups"]] == [[0, 1], [2, 3]]
+    assert out["dispersion"] == pytest.approx([40.0] * 8)
+    # the orthogonal axis mixes fast+slow into every group: no spread
+    flat = reduce_window_by_grouping(w, groupings["axis"], key=STEP_KEY)
+    assert flat["dispersion"] == pytest.approx([0.0] * 8)
+
+
+def test_reduce_window_by_grouping_masks_unplaced_ranks():
+    rank_rows = {
+        r: [_step_row(s, 100.0 + r) for s in range(4)] for r in range(3)
+    }
+    w = build_step_time_window(rank_rows, max_steps=4)
+    part = Grouping(kind="host", label="host", axis=None,
+                    groups={0: [0], 1: [1]})  # rank 2 unplaced
+    out = reduce_window_by_grouping(w, part, key=STEP_KEY)
+    assert [g["ranks"] for g in out["groups"]] == [[0], [1]]
+    assert out["groups"][0]["mean"] == pytest.approx([100.0] * 4)
+    assert out["groups"][1]["mean"] == pytest.approx([101.0] * 4)
+
+
+# -- capture -------------------------------------------------------------
+
+
+def test_parse_mesh_spec_grammar():
+    axes = parse_mesh_spec("data:4@dcn, fsdp:8")
+    assert [(a.name, a.size, a.kind) for a in axes] == [
+        ("data", 4, "dcn"), ("fsdp", 8, "ici"),
+    ]
+    # all-or-nothing on any malformed entry
+    assert parse_mesh_spec("data:4,bogus") == []
+    assert parse_mesh_spec("data:0") == []
+    assert parse_mesh_spec("data:4@wat") == []
+    assert parse_mesh_spec("") == []
+
+
+def test_capture_local_topology_env_override(monkeypatch):
+    from traceml_tpu.utils.topology import reset_recorded_mesh_for_tests
+
+    # a prior test's make_mesh may have latched a process-global Mesh
+    reset_recorded_mesh_for_tests()
+    monkeypatch.setenv("TRACEML_MESH", "data:2@dcn,fsdp:2")
+    payload = capture_local_topology(global_rank=3, world_size=4)
+    assert payload["source"] == "env"
+    assert payload["coords"] == [1, 1]  # row-major placement
+    assert [a["kind"] for a in payload["axes"]] == ["dcn", "ici"]
+    monkeypatch.setenv("TRACEML_MESH", "broken")
+    assert capture_local_topology(0, 4) is None  # no recorded mesh either
+
+
+# -- attribution fixtures ------------------------------------------------
+
+
+def test_attribution_host_outlier():
+    topo = _mesh(
+        "data:2,fsdp:4", world=8, hosts_of=lambda r: r // 4,
+        hostnames={4: "tpu-host-b"},
+    )
+    values = {r: 100.0 + (35.0 if r >= 4 else 0.0) for r in range(8)}
+    attr = attribute_ranks(values, topo)
+    assert attr is not None
+    assert attr.kind == "host" and attr.ranks == [4, 5, 6, 7]
+    assert attr.label == "all 4 ranks of host 1 (tpu-host-b)"
+    assert attr.explained >= 0.99
+
+
+def test_attribution_dcn_boundary_side():
+    # single host: the host grouping never forms, the DCN axis explains
+    topo = _mesh("data:2@dcn,fsdp:4", world=8)
+    values = {r: 100.0 + (35.0 if r >= 4 else 0.0) for r in range(8)}
+    attr = attribute_ranks(values, topo)
+    assert attr is not None
+    assert attr.kind == "dcn_side" and attr.axis == "data"
+    assert attr.ranks == [4, 5, 6, 7] and attr.group == "1"
+    assert "DCN boundary" in attr.label
+
+
+def test_attribution_model_axis_imbalance():
+    topo = _mesh("data:2,model:4", world=8)
+    # model coord 2 (ranks 2 and 6) runs hot — an ICI-axis shard issue
+    values = {r: 100.0 for r in range(8)}
+    values[2] = values[6] = 160.0
+    attr = attribute_ranks(values, topo)
+    assert attr is not None
+    assert attr.kind == "axis" and attr.axis == "model"
+    assert attr.ranks == [2, 6]
+    assert "shard imbalance" in attr.label
+
+
+def test_attribution_flat_fallback_on_noise():
+    topo = _mesh("data:2@dcn,fsdp:4", world=8, hosts_of=lambda r: r // 4)
+    rng = random.Random(7)
+    # one hot rank only: no grouping explains >= 60% of the variance
+    values = {r: 100.0 + rng.uniform(-1, 1) for r in range(8)}
+    values[5] = 180.0
+    attr = attribute_ranks(values, topo)
+    assert attr is None
+
+
+def test_attribution_tie_breaks_toward_host():
+    # host boundary == DCN boundary: both explain 100%; host is listed
+    # first and ties break on strictly-greater, so host wins
+    topo = _mesh("data:2@dcn,fsdp:4", world=8, hosts_of=lambda r: r // 4)
+    values = {r: 100.0 + (35.0 if r >= 4 else 0.0) for r in range(8)}
+    attr = attribute_ranks(values, topo)
+    assert attr is not None and attr.kind == "host"
+
+
+def test_attribution_degenerate_inputs():
+    topo = _mesh("data:2,fsdp:2", world=4)
+    assert attribute_ranks({}, topo) is None
+    assert attribute_ranks({0: 1.0, 1: 2.0}, topo) is None  # < 3 ranks
+    assert attribute_ranks({r: 5.0 for r in range(4)}, topo) is None  # no spread
+    assert attribute_ranks({r: float(r) for r in range(4)}, None) is None
+
+
+# -- attach_attribution --------------------------------------------------
+
+
+def _result(ranks, summary="Rank skew detected"):
+    return DiagnosticResult(
+        domain="step_time",
+        issues=[
+            DiagnosticIssue(
+                kind="COMPUTE_STRAGGLER",
+                severity=SEVERITY_WARNING,
+                status=STATUS_ISSUE,
+                summary=summary,
+                ranks=list(ranks),
+            )
+        ],
+    )
+
+
+def test_attach_attribution_annotates_subset_issue():
+    topo = _mesh("data:2@dcn,fsdp:4", world=8)
+    values = {r: 100.0 + (35.0 if r >= 4 else 0.0) for r in range(8)}
+    result = attach_attribution(_result([4, 5, 6, 7]), topo, values)
+    issue = result.diagnosis
+    assert issue.attribution is not None
+    assert issue.attribution["kind"] == "dcn_side"
+    assert issue.summary.endswith(f"— {issue.attribution['label']}.")
+    d = issue.to_dict()
+    assert d["attribution"]["ranks"] == [4, 5, 6, 7]
+
+
+def test_attach_attribution_skips_issue_outside_group():
+    topo = _mesh("data:2@dcn,fsdp:4", world=8)
+    values = {r: 100.0 + (35.0 if r >= 4 else 0.0) for r in range(8)}
+    # issue blames rank 0 — the grouping explains ranks 4..7, not it
+    result = attach_attribution(_result([0]), topo, values)
+    assert result.diagnosis.attribution is None
+
+
+def test_attach_attribution_none_topology_is_identity():
+    result = _result([1, 2])
+    before = json.dumps(result.to_dict(), sort_keys=True)
+    out = attach_attribution(result, None, {1: 2.0, 2: 3.0})
+    assert out is result
+    assert json.dumps(out.to_dict(), sort_keys=True) == before
+
+
+# -- back-compat pins ----------------------------------------------------
+
+
+def test_issue_to_dict_omits_attribution_when_none():
+    d = DiagnosticIssue(kind="X", summary="s").to_dict()
+    assert "attribution" not in d
+    assert "confidence_label" in d
+
+
+def test_diagnose_without_topology_is_byte_identical():
+    rng = random.Random(11)
+    rank_rows = {
+        r: [
+            _step_row(s, 100.0 + (45.0 if r == 3 else 0.0) + rng.uniform(0, 1))
+            for s in range(1, 61)
+        ]
+        for r in range(4)
+    }
+    base = json.dumps(
+        diagnose_rank_rows(rank_rows, mode="summary").to_dict(), sort_keys=True
+    )
+    again = json.dumps(
+        diagnose_rank_rows(rank_rows, mode="summary", topology=None).to_dict(),
+        sort_keys=True,
+    )
+    assert base == again
+    assert '"attribution"' not in base
+
+
+def test_diagnose_with_topology_only_adds_attribution():
+    rng = random.Random(11)
+    rank_rows = {
+        r: [
+            _step_row(s, 100.0 + (45.0 if r >= 2 else 0.0) + rng.uniform(0, 1))
+            for s in range(1, 61)
+        ]
+        for r in range(4)
+    }
+    topo = _mesh("data:2@dcn,fsdp:2", world=4)
+    result = diagnose_rank_rows(rank_rows, mode="summary", topology=topo)
+    attributed = [i for i in result.issues if i.attribution]
+    assert attributed, [i.kind for i in result.issues]
+    assert all(i.attribution["kind"] == "dcn_side" for i in attributed)
+    # stripping the new fields recovers the flat result exactly
+    flat = diagnose_rank_rows(rank_rows, mode="summary")
+    stripped = json.loads(json.dumps(result.to_dict()))
+    for issue in [stripped["diagnosis"], *stripped["issues"]]:
+        if "attribution" in issue:
+            label = issue.pop("attribution")["label"]
+            assert issue["summary"].endswith(f"— {label}.")
+            issue["summary"] = issue["summary"][: -len(f" — {label}.")]
+    assert json.dumps(stripped, sort_keys=True) == json.dumps(
+        flat.to_dict(), sort_keys=True
+    )
+
+
+# -- store / DB round-trip ----------------------------------------------
+
+
+def _ident(rank=0, node=0, world=2):
+    return SenderIdentity(
+        session_id="s1",
+        global_rank=rank,
+        local_rank=rank % 4,
+        world_size=world,
+        node_rank=node,
+        hostname=f"host-{node}",
+        pid=100 + rank,
+    )
+
+
+def _mesh_envelope(rank, coords, axes, node=0, world=4, source="env"):
+    """The aggregator-side re-wrap of a MESH_TOPOLOGY control message
+    (trace_aggregator._handle_control): identity meta minus seq, one
+    row in the ``mesh_topology`` table."""
+    meta = _ident(rank, node=node, world=world).to_meta()
+    meta.pop("seq", None)
+    meta["sampler"] = "mesh_topology"
+    row = {
+        "timestamp": time.time(),
+        "source": source,
+        "axes_json": json.dumps(axes),
+        "coords_json": json.dumps(coords),
+    }
+    return TelemetryEnvelope(meta=meta, tables={"mesh_topology": [row]})
+
+
+_AXES_2X2 = [
+    {"name": "data", "size": 2, "kind": "dcn"},
+    {"name": "fsdp", "size": 2, "kind": "ici"},
+]
+
+
+def test_store_without_mesh_rows_has_no_mesh_key(tmp_path):
+    db = tmp_path / "t.sqlite"
+    w = SQLiteWriter(db)
+    w.start()
+    store = LiveSnapshotStore(db, window_steps=20)
+    w.ingest(
+        build_telemetry_envelope(
+            "step_time",
+            {"step_time": [_step_row(s, 100.0) for s in range(5)]},
+            _ident(0),
+        )
+    )
+    assert w.force_flush()
+    store.refresh()
+    topo = store.topology()
+    assert "mesh" not in topo
+    assert store.mesh_topology() is None
+    w.finalize()
+
+
+def test_store_merges_mesh_rows_keep_latest(tmp_path):
+    db = tmp_path / "t.sqlite"
+    w = SQLiteWriter(db)
+    w.start()
+    store = LiveSnapshotStore(db, window_steps=20)
+    for rank in range(4):
+        w.ingest(_mesh_envelope(rank, _coords_for_rank(rank, [2, 2]),
+                                _AXES_2X2, node=rank // 2))
+    # rank 0 republishes (spool replay): latest row wins, still 4 ranks
+    w.ingest(_mesh_envelope(0, [0, 0], _AXES_2X2, node=0))
+    assert w.force_flush()
+    store.refresh()
+    topo = store.mesh_topology()
+    assert topo is not None
+    assert sorted(topo.rank_coords) == [0, 1, 2, 3]
+    assert topo.rank_coords[3] == (1, 1)
+    assert topo.rank_hosts == {0: 0, 1: 0, 2: 1, 3: 1}
+    assert [a.kind for a in topo.axes] == ["dcn", "ici"]
+    meta = store.topology()
+    assert meta["mesh"]["ranks"] == 4 and meta["mesh"]["hosts"] == 2
+    # one-shot loader sees the same merged view
+    w.finalize()
+    loaded = load_mesh_topology(db)
+    assert loaded is not None
+    assert loaded.rank_coords == topo.rank_coords
+
+
+def test_loader_returns_none_for_pre_topology_db(tmp_path):
+    import sqlite3
+
+    db = tmp_path / "old.sqlite"
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE step_time_samples (id INTEGER PRIMARY KEY)")
+    conn.commit()
+    conn.close()
+    assert load_mesh_topology(db) is None
+
+
+def test_topology_from_rank_rows_skips_malformed():
+    rows = [
+        {"global_rank": 0, "node_rank": 0, "hostname": "h0",
+         "source": "env", "axes_json": json.dumps(_AXES_2X2),
+         "coords_json": json.dumps([0, 0])},
+        {"global_rank": 1, "node_rank": 0, "hostname": "h0",
+         "source": "env", "axes_json": "not json", "coords_json": "[0,1]"},
+    ]
+    topo = topology_from_rank_rows(rows)
+    assert topo is not None
+    assert sorted(topo.rank_coords) == [0]
+
+
+def test_payload_round_trip():
+    topo = _mesh("data:2@dcn,fsdp:4", world=8, hosts_of=lambda r: r // 4)
+    back = MeshTopology.from_payload(topo.to_payload())
+    assert back is not None
+    assert back.rank_coords == topo.rank_coords
+    assert back.rank_hosts == topo.rank_hosts
+    assert [a.to_dict() for a in back.axes] == [a.to_dict() for a in topo.axes]

@@ -47,6 +47,15 @@ function fleetRanks(r){
   keys.sort((a,b)=>(order.indexOf(a)+1||99)-(order.indexOf(b)+1||99));
   return keys.map(k=>`${esc(k.toLowerCase())} ${esc(r[k])}`).join(" · ");
 }
+function fleetMesh(s){
+  const m=s.mesh;
+  if(!m||!m.axes||!m.axes.length)return"";
+  const axes=m.axes.map(a=>esc(a.name)+"×"+esc(a.size)+
+    (a.kind==="dcn"?" (dcn)":"")).join(" · ");
+  const hosts=m.hosts?
+    (" · "+esc(m.hosts)+" host"+(m.hosts!==1?"s":"")):"";
+  return '<br><span class="muted">mesh '+axes+hosts+'</span>';
+}
 function fleetDiag(s){
   const p=s.primary_diagnosis;
   if(!p)return'<span class="muted">—</span>';
@@ -64,7 +73,7 @@ function fleetRow(s){
     <td><a style="color:var(--accent)" href="/?session=${
       encodeURIComponent(s.session)}">${esc(s.session)}</a></td>
     <td>${total?esc(total):'<span class="muted">—</span>'}
-      <span class="muted">${fleetRanks(s.ranks)}</span></td>
+      <span class="muted">${fleetRanks(s.ranks)}</span>${fleetMesh(s)}</td>
     <td>${state}</td>
     <td>${fleetDiag(s)}</td>
     <td class="num cmeta">${esc(upd)}</td></tr>`;

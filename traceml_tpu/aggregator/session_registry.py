@@ -176,6 +176,11 @@ class SessionRegistry:
                         k: primary.get(k)
                         for k in ("kind", "severity", "summary")
                     }
+                mesh = ((summary.get("meta") or {}).get("topology") or {}).get(
+                    "mesh"
+                )
+                if mesh:
+                    entry["mesh"] = mesh
         else:
             # live session: peek at an already-open publisher's diagnosis
             # fragment — the index never force-opens a publisher (that
@@ -190,6 +195,9 @@ class SessionRegistry:
                         k: issue.get(k)
                         for k in ("kind", "severity", "summary")
                     }
+                mesh = (pub.fragment("meta") or {}).get("mesh")
+                if mesh:
+                    entry["mesh"] = mesh
         return entry
 
     def fleet_index(self) -> Dict[str, Any]:

@@ -8,6 +8,7 @@ Uniform contract per module: ``accepts_sampler(name)``,
 
 from traceml_tpu.aggregator.sqlite_writers import (  # noqa: F401
     collectives_writer,
+    mesh_topology_writer,
     process_writer,
     step_memory_writer,
     step_time_writer,
@@ -22,6 +23,7 @@ ALL_WRITERS = [
     step_memory_writer,
     collectives_writer,
     stdout_writer,
+    mesh_topology_writer,
 ]
 
 

@@ -33,6 +33,7 @@ from traceml_tpu.aggregator.summary_service import FinalSummaryService
 from traceml_tpu.runtime.settings import TraceMLSettings
 from traceml_tpu.sdk import protocol
 from traceml_tpu.telemetry.control import (
+    MESH_TOPOLOGY,
     PRODUCER_STATS,
     RANK_FINISHED,
     RANK_HEARTBEAT,
@@ -356,6 +357,39 @@ class TraceMLAggregator:
             # later snapshots are cumulative — keep only the latest
             self._producer_stats[rank] = stats
             self.liveness.observe(rank)
+        elif kind == MESH_TOPOLOGY:
+            meta = payload.get("meta") or {}
+            topo = payload.get("topology")
+            if not isinstance(topo, dict):
+                return
+            try:
+                rank = int(meta.get("global_rank", meta.get("rank")))
+            except (TypeError, ValueError):
+                return
+            self._seen_ranks.add(rank)
+            self.liveness.observe(rank)
+            # persist through the normal writer path: the control meta is
+            # already identity-shaped, and carrying NO seq bypasses the
+            # writer's dedup lane (spool replay may re-deliver this;
+            # readers keep the latest row per rank, so appends are
+            # idempotent at read time)
+            try:
+                env_meta = dict(meta)
+                env_meta.pop("seq", None)
+                env_meta["sampler"] = "mesh_topology"
+                row = {
+                    "timestamp": float(payload.get("timestamp") or time.time()),
+                    "source": str(topo.get("source") or "mesh"),
+                    "axes_json": json.dumps(topo.get("axes") or []),
+                    "coords_json": json.dumps(topo.get("coords")),
+                }
+                self.writer.ingest(
+                    TelemetryEnvelope(
+                        meta=env_meta, tables={"mesh_topology": [row]}
+                    )
+                )
+            except Exception as exc:
+                get_error_log().warning("mesh_topology persist failed", exc)
 
     # -- loop ------------------------------------------------------------
     def _loop(self) -> None:

@@ -1,0 +1,58 @@
+"""Shared topology attribution for diagnostics packs
+(docs/developer_guide/topology-attribution.md).
+
+Every pack ends its findings in a flat rank list.  When the session
+captured a mesh topology, :func:`attach_attribution` re-reads each
+fired issue against the per-rank anomaly values the pack already
+computed, asks :func:`traceml_tpu.utils.topology.attribute_ranks`
+whether a physical grouping (host / mesh-axis coordinate / DCN side)
+explains enough of the cross-rank variance, and when one does:
+
+* sets ``issue.attribution`` to the grouping dict, and
+* appends the human phrase to ``issue.summary``
+  ("… — all 8 ranks of host 3").
+
+With ``topology=None`` (no mesh captured — every pre-topology session)
+the function returns the result UNCHANGED, object-identical, so the
+serialized output stays byte-identical to the pre-topology contract
+(pinned by tests/utils/test_topology_attribution.py).  Everything here
+is fail-open: attribution is garnish, never a reason to lose a
+diagnosis.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Optional
+
+from traceml_tpu.diagnostics.common import DiagnosticResult, STATUS_ISSUE
+from traceml_tpu.utils.topology import MeshTopology, attribute_ranks
+
+
+def attach_attribution(
+    result: DiagnosticResult,
+    topology: Optional[MeshTopology],
+    per_rank_values: Optional[Mapping[int, float]],
+) -> DiagnosticResult:
+    """Annotate fired issues in ``result`` with the best-explaining
+    physical grouping; no-op without a topology or per-rank values."""
+    if topology is None or not per_rank_values:
+        return result
+    try:
+        attr = attribute_ranks(per_rank_values, topology)
+    except Exception:
+        return result
+    if attr is None:
+        return result
+    attr_dict = attr.to_dict()
+    for issue in result.issues:
+        if issue.status != STATUS_ISSUE or not issue.ranks:
+            continue
+        # only attribute issues whose flagged ranks live inside the
+        # outlier group — a grouping that explains the window's variance
+        # says nothing about an issue pointing elsewhere
+        if not set(issue.ranks) <= set(attr.ranks):
+            continue
+        issue.attribution = dict(attr_dict)
+        if attr.label and attr.label not in issue.summary:
+            issue.summary = f"{issue.summary.rstrip()} — {attr.label}."
+    return result

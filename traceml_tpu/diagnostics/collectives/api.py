@@ -31,7 +31,11 @@ def diagnose_collectives_window(
     window: Optional[CollectivesWindow],
     mode: str = "summary",
     step_time_ms: Optional[float] = None,
+    topology: Optional[Any] = None,
 ) -> DiagnosticResult:
+    """``topology``: the captured mesh (or None).  Fired issues whose
+    ranks map onto a host / axis / DCN-side grouping of per-rank
+    exposed comm time gain an ``attribution`` block."""
     policy = policy_for(mode)
     if window is None or window.n_steps < policy.min_steps:
         return DiagnosticResult(
@@ -51,7 +55,19 @@ def diagnose_collectives_window(
             ],
         )
     ctx = build_context(window, policy, step_time_ms=step_time_ms)
-    return run_rules(DOMAIN, DEFAULT_RULES, ctx)
+    result = run_rules(DOMAIN, DEFAULT_RULES, ctx)
+    if topology is not None:
+        from traceml_tpu.diagnostics.attribution import attach_attribution
+
+        result = attach_attribution(
+            result,
+            topology,
+            {
+                r: float(v.get("exposed_ms", 0.0) or 0.0)
+                for r, v in window.per_rank.items()
+            },
+        )
+    return result
 
 
 def diagnose_rank_rows(
@@ -59,6 +75,9 @@ def diagnose_rank_rows(
     mode: str = "summary",
     max_steps: int = 200,
     step_time_ms: Optional[float] = None,
+    topology: Optional[Any] = None,
 ) -> DiagnosticResult:
     window = build_collectives_window_rows(rank_rows, max_steps=max_steps)
-    return diagnose_collectives_window(window, mode=mode, step_time_ms=step_time_ms)
+    return diagnose_collectives_window(
+        window, mode=mode, step_time_ms=step_time_ms, topology=topology
+    )

@@ -42,9 +42,17 @@ class DiagnosticIssue:
     # DIAGNOSIS.md documents our formula).  None = rule predates the
     # confidence contract or has no meaningful margin.
     confidence: Optional[float] = None
+    # topology attribution: {kind, label, group, axis, ranks, explained}
+    # when the anomaly maps onto physical structure (a host, a DCN side,
+    # a mesh-axis shard — diagnostics/attribution.py); None keeps the
+    # flat rank list AND the serialized dict byte-identical to the
+    # pre-topology contract (the key is omitted, see to_dict).
+    attribution: Optional[Dict[str, Any]] = None
 
     def to_dict(self) -> Dict[str, Any]:
         d = dataclasses.asdict(self)
+        if d.get("attribution") is None:
+            d.pop("attribution", None)
         d["confidence_label"] = confidence_label(self.confidence)
         return d
 

@@ -25,7 +25,12 @@ DOMAIN = "liveness"
 def diagnose_rank_status(
     snapshot: Optional[Dict[str, Any]],
     mode: str = "summary",
+    topology: Optional[Any] = None,
 ) -> DiagnosticResult:
+    """``topology``: the captured mesh (or None).  A lost/stale cohort
+    that maps onto one host or one DCN side gains an ``attribution``
+    block (a whole host dropping is a very different page than eight
+    scattered ranks)."""
     policy = policy_for(mode)
     if not snapshot or not isinstance(snapshot.get("ranks"), dict):
         return DiagnosticResult(
@@ -46,4 +51,19 @@ def diagnose_rank_status(
     ctx = build_context(snapshot, policy)
     if len(ctx.ranks) < policy.min_ranks:
         return DiagnosticResult(domain=DOMAIN, issues=[])
-    return run_rules(DOMAIN, DEFAULT_RULES, ctx)
+    result = run_rules(DOMAIN, DEFAULT_RULES, ctx)
+    if topology is not None:
+        from traceml_tpu.diagnostics.attribution import attach_attribution
+
+        # binary per-rank indicator: unhealthy (lost/stale) vs fine —
+        # η² then measures how cleanly the dead set tiles a grouping
+        values = {}
+        for rank_s, info in (snapshot.get("ranks") or {}).items():
+            try:
+                rank = int(rank_s)
+            except (TypeError, ValueError):
+                continue
+            state = str((info or {}).get("state", "")).upper()
+            values[rank] = 1.0 if state in ("LOST", "STALE") else 0.0
+        result = attach_attribution(result, topology, values)
+    return result

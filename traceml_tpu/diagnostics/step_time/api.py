@@ -23,9 +23,14 @@ def diagnose_window(
     window: Optional[StepTimeWindow],
     mode: str = "summary",
     efficiency: Optional[Mapping[str, Any]] = None,
+    topology: Optional[Any] = None,
 ) -> DiagnosticResult:
     """``efficiency`` is the section's MFU block (mfu_median etc.) when
-    model FLOPs were declared — feeds the LowMfuRule."""
+    model FLOPs were declared — feeds the LowMfuRule.  ``topology`` is
+    the captured :class:`~traceml_tpu.utils.topology.MeshTopology` (or
+    None): fired issues whose ranks map onto a host / mesh-axis / DCN
+    grouping gain an ``attribution`` block; None leaves the result
+    byte-identical to the pre-topology contract."""
     policy = policy_for(mode)
     if window is None or window.n_steps < policy.min_steps:
         return DiagnosticResult(
@@ -45,7 +50,16 @@ def diagnose_window(
         )
     ctx = build_context(window, policy, efficiency=efficiency)
     result = run_rules(DOMAIN, DEFAULT_RULES, ctx)
-    return _prefer_cause_over_symptom(result)
+    result = _prefer_cause_over_symptom(result)
+    if topology is not None:
+        from traceml_tpu.diagnostics.attribution import attach_attribution
+        from traceml_tpu.utils.step_time_window import STEP_KEY
+
+        step = window.metric(STEP_KEY)
+        result = attach_attribution(
+            result, topology, step.per_rank_avg_ms if step else None
+        )
+    return result
 
 
 #: kinds that EXPLAIN idleness — when one fires at the symptom's
@@ -96,6 +110,7 @@ def diagnose_rank_rows(
     rank_rows: Mapping[int, Sequence[Mapping[str, Any]]],
     mode: str = "summary",
     max_steps: int = 200,
+    topology: Optional[Any] = None,
 ) -> DiagnosticResult:
     window = build_step_time_window(rank_rows, max_steps=max_steps)
-    return diagnose_window(window, mode=mode)
+    return diagnose_window(window, mode=mode, topology=topology)

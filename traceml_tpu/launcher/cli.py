@@ -95,7 +95,9 @@ def _build_parser() -> argparse.ArgumentParser:
     cmp_.add_argument("--output", default=None)
 
     insp = sub.add_parser("inspect", help="decode per-rank disk backups")
-    insp.add_argument("path", help="a rank data dir or .msgpack file")
+    insp.add_argument(
+        "path", help="a rank data dir, .msgpack file, or session dir"
+    )
     insp.add_argument("--limit", type=int, default=20)
     insp.add_argument(
         "--domain",
@@ -103,7 +105,8 @@ def _build_parser() -> argparse.ArgumentParser:
         help=(
             "only rows from this telemetry domain (table name, e.g. "
             "collectives — which also gains a derived overlap_efficiency "
-            "column)"
+            "column); 'topology' prints the captured mesh (axes, "
+            "rank→host table, ICI/DCN boundaries) from the session DB"
         ),
     )
 

@@ -11,6 +11,12 @@ row is printed with a ``table`` field naming its origin.
 ``overlap_efficiency`` column (``1 − exposed_ms/duration_ms``, 1.0 for
 zero-duration rows) so overlap quality is readable straight off the
 backups.
+
+``--domain topology`` is special: instead of scanning msgpack backups
+it reads the captured mesh out of the session's ``telemetry.sqlite``
+(the one-shot ``mesh_topology`` control rows) and prints axis
+names/sizes, interconnect kind per axis, and the rank→host→coords
+table — with a clean message for pre-topology session DBs.
 """
 
 from __future__ import annotations
@@ -37,10 +43,69 @@ def _enrich_row(table: Optional[str], row: Dict[str, Any]) -> Dict[str, Any]:
     return row
 
 
+def _find_session_db(path: Path) -> Optional[Path]:
+    """telemetry.sqlite at/under ``path``: the path itself, a session
+    dir holding one, or the first one found below (logs dirs)."""
+    if path.is_file() and path.suffix == ".sqlite":
+        return path
+    if path.is_dir():
+        direct = path / "telemetry.sqlite"
+        if direct.exists():
+            return direct
+        hits = sorted(path.rglob("telemetry.sqlite"))
+        if hits:
+            return hits[0]
+    return None
+
+
+def _inspect_topology(path: Path) -> int:
+    from traceml_tpu.reporting.loaders import load_mesh_topology
+
+    db = _find_session_db(path)
+    if db is None:
+        print(f"no telemetry.sqlite at or under {path}")
+        return 1
+    try:
+        topo = load_mesh_topology(db)
+    except Exception as exc:
+        print(f"failed to read mesh topology from {db}: {exc}")
+        return 1
+    if topo is None:
+        print(
+            f"no mesh topology captured in {db}\n"
+            "(pre-topology session, or the run never built a mesh — "
+            "set TRACEML_MESH or call parallel.mesh.make_mesh)"
+        )
+        return 1
+    print(f"── mesh topology ({db})")
+    print(f"source: {topo.source}")
+    axes = "  ·  ".join(
+        f"{a.name}×{a.size} [{a.kind}]" for a in topo.axes
+    )
+    print(f"axes:   {axes}")
+    hosts = sorted(set(topo.rank_hosts.values()))
+    if hosts:
+        print(f"hosts:  {len(hosts)}")
+    print(f"ranks:  {len(topo.rank_coords)}")
+    coord_hdr = ",".join(a.name for a in topo.axes)
+    print(f"{'rank':>6}  {'host':>6}  hostname{'':<12} ({coord_hdr})")
+    for rank in sorted(topo.rank_coords):
+        host = topo.rank_hosts.get(rank)
+        name = topo.rank_hostnames.get(rank, "")
+        coords = ",".join(str(c) for c in topo.rank_coords[rank])
+        print(
+            f"{rank:>6}  {'' if host is None else host:>6}  "
+            f"{name:<20} ({coords})"
+        )
+    return 0
+
+
 def run_inspect(
     path: Path, limit: int = 20, domain: Optional[str] = None
 ) -> int:
     path = Path(path)
+    if domain == "topology":
+        return _inspect_topology(path)
     files = []
     if path.is_file():
         files = [path]

@@ -28,7 +28,7 @@ class _WatchState:
 
         self.store = LiveSnapshotStore(db_path, window_steps=120)
         self._lines: List[str] = []
-        self._version: Optional[int] = None
+        self._version: Optional[tuple] = None
 
     def close(self) -> None:
         self.store.close()
@@ -38,10 +38,26 @@ class _WatchState:
         from traceml_tpu.utils.formatting import fmt_ms
 
         self.store.refresh()
-        version = self.store.versions["step_time"]
+        # topology version joins the gate: a late mesh_topology message
+        # must re-render so the mesh strip + attribution appear
+        version = (
+            self.store.versions["step_time"],
+            self.store.versions["topology"],
+        )
         if version == self._version:
             return self._lines
         lines: List[str] = []
+        mesh = None
+        try:
+            mesh = self.store.mesh_topology()
+        except Exception:
+            pass
+        if mesh is not None:
+            axes = " · ".join(
+                f"{a.name}×{a.size}" + (" (dcn)" if a.kind == "dcn" else "")
+                for a in mesh.axes
+            )
+            lines.append(f"mesh: {axes}")
         if self.store.has_step_time_rows():
             w = self.store.build_step_time_window(max_steps=120)
             if w:
@@ -53,7 +69,7 @@ class _WatchState:
                 )
                 # one window build feeds both the stats line and the
                 # diagnosis (the seed built it twice per poll)
-                result = diagnose_window(w, mode="live")
+                result = diagnose_window(w, mode="live", topology=mesh)
                 d = result.diagnosis
                 lines.append(
                     f"diagnosis: [{d.severity}] {d.kind} — {d.summary}"

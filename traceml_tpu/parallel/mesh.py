@@ -80,7 +80,18 @@ def make_mesh(
             )
         sizes = [n if ax == "fsdp" else 1 for ax in axes]
     arr = np.array(devices).reshape(sizes)
-    return Mesh(arr, axes)
+    mesh = Mesh(arr, axes)
+    try:
+        # remember the mesh for the topology attribution layer (the
+        # runtime ships it once as a mesh_topology control message;
+        # docs/developer_guide/topology-attribution.md) — fail-open,
+        # mesh construction must never depend on observability
+        from traceml_tpu.utils.topology import record_mesh
+
+        record_mesh(mesh)
+    except Exception:
+        pass
+    return mesh
 
 
 def batch_sharding(mesh) -> "object":

@@ -55,6 +55,27 @@ def _append_rank_strip(header: Text, payload: Dict[str, Any]) -> None:
         header.append(f" (rank {shown}{more})", style="red")
 
 
+def _append_mesh_strip(header: Text, payload: Dict[str, Any]) -> None:
+    """Topology strip: the captured mesh at a glance ("mesh: data×4
+    (dcn) · fsdp×8 · 4 hosts") — only when a mesh_topology message
+    arrived; pre-topology sessions render the exact historical header."""
+    mesh = (payload.get("topology") or {}).get("mesh")
+    if not mesh or not mesh.get("axes"):
+        return
+    parts = []
+    for ax in mesh["axes"]:
+        label = f"{ax.get('name')}×{ax.get('size')}"
+        if ax.get("kind") == "dcn":
+            label += " (dcn)"
+        parts.append(label)
+    header.append("   mesh: ", style="dim")
+    header.append(" · ".join(parts), style="cyan")
+    hosts = mesh.get("hosts")
+    if hosts:
+        header.append(f" · {hosts} host{'s' if hosts != 1 else ''}",
+                      style="dim")
+
+
 def dashboard(payload: Dict[str, Any], session: str) -> Group:
     header = Text(f"TraceML-TPU — live · session {session}", style="bold")
     # staleness = age of the NEWEST telemetry row, not of the payload
@@ -65,6 +86,7 @@ def dashboard(payload: Dict[str, Any], session: str) -> Group:
         if age > 5.0:  # staleness badge (reference: display staleness)
             header.append(f"   ⚠ telemetry {age:.0f}s stale", style="yellow")
     _append_rank_strip(header, payload)
+    _append_mesh_strip(header, payload)
     parts = [header, step_time_panel(payload), diagnostics_panel(payload)]
     cluster = cluster_panel(payload)
     if cluster is not None:

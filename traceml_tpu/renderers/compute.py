@@ -33,8 +33,10 @@ from traceml_tpu.reporting.snapshot_store import LiveSnapshotStore
 _DOMAIN_DEPS: Dict[str, Tuple[Tuple[str, ...], Optional[str]]] = {
     "topology": (("topology",), None),
     "step_time": (("step_time", "model_stats", "topology"), "step_time"),
-    "memory": (("step_memory",), "memory"),
-    "collectives": (("collectives", "step_time"), "collectives"),
+    # memory/collectives also depend on topology: a late mesh_topology
+    # message must re-run their diagnoses so attribution attaches
+    "memory": (("step_memory", "topology"), "memory"),
+    "collectives": (("collectives", "step_time", "topology"), "collectives"),
     "system": (("system", "topology"), "system"),
     "process": (("process",), "process"),
     "stdout": (("stdout",), None),
@@ -138,6 +140,15 @@ class LiveComputer:
         except Exception:
             pass
 
+    def _mesh_topology(self):
+        """The store's merged MeshTopology, or None — passed into every
+        diagnose call so findings attach physical attribution when a
+        mesh was captured (fail-open: attribution is garnish)."""
+        try:
+            return self._store.mesh_topology()
+        except Exception:
+            return None
+
     # -- per-domain builders ---------------------------------------------
     # Each returns (top-level payload updates, typed view or None) and
     # mirrors the seed's error contract: a failing domain degrades to an
@@ -175,7 +186,10 @@ class LiveComputer:
                 "latest_row_ts": latest,
                 "step_time": {
                     "window": window,
-                    "diagnosis": diagnose_window(window, mode="live")
+                    "diagnosis": diagnose_window(
+                        window, mode="live",
+                        topology=self._mesh_topology(),
+                    )
                     if self._store.has_step_time_rows()
                     else None,
                 },
@@ -194,10 +208,14 @@ class LiveComputer:
                 diagnose_rank_rows as diagnose_memory,
             )
 
+            mesh = self._mesh_topology()
             if mem_cols is not None:
-                diagnosis = diagnose_memory_columns(mem_cols)
+                diagnosis = diagnose_memory_columns(mem_cols, topology=mesh)
             else:
-                diagnosis = diagnose_memory(mem_rows) if mem_rows else None
+                diagnosis = (
+                    diagnose_memory(mem_rows, topology=mesh)
+                    if mem_rows else None
+                )
             updates = {
                 "step_memory": mem_rows,
                 "step_memory_diagnosis": diagnosis,
@@ -231,7 +249,8 @@ class LiveComputer:
                 "collectives": {
                     "window": window,
                     "diagnosis": diagnose_collectives_window(
-                        window, mode="live", step_time_ms=step_time_ms
+                        window, mode="live", step_time_ms=step_time_ms,
+                        topology=self._mesh_topology(),
                     )
                     if self._store.has_collectives_rows()
                     else None,

@@ -41,7 +41,7 @@ _FRAGMENT_KEYS: Dict[str, Tuple[str, ...]] = {
     "process": ("process",),
     "stdout": ("stdout",),
     "diagnosis": ("diagnosis", "findings"),
-    "meta": ("ingest", "rank_status"),
+    "meta": ("ingest", "rank_status", "mesh"),
 }
 
 #: serving order — also the position of each counter in the version token
@@ -54,8 +54,8 @@ FRAGMENT_ORDER: Tuple[str, ...] = tuple(_FRAGMENT_KEYS)
 #: so both are content-compared instead of version-gated.
 FRAGMENT_DEPS: Dict[str, Tuple[str, ...]] = {
     "step_time": ("step_time", "model_stats", "topology"),
-    "memory": ("step_memory",),
-    "collectives": ("collectives", "step_time"),
+    "memory": ("step_memory", "topology"),
+    "collectives": ("collectives", "step_time", "topology"),
     "system": ("system", "topology"),
     "process": ("process",),
     "stdout": ("stdout",),
@@ -69,7 +69,7 @@ FRAGMENT_DEPS: Dict[str, Tuple[str, ...]] = {
 def _issue_dict(issue: Any) -> Dict[str, Any]:
     from traceml_tpu.diagnostics.common import confidence_label
 
-    return {
+    out = {
         "kind": issue.kind,
         "severity": issue.severity,
         "summary": issue.summary,
@@ -79,6 +79,12 @@ def _issue_dict(issue: Any) -> Dict[str, Any]:
             getattr(issue, "confidence", None)
         ),
     }
+    # topology attribution rides only when present: pre-topology
+    # sessions serialize the exact historical shape (back-compat pin)
+    attribution = getattr(issue, "attribution", None)
+    if attribution:
+        out["attribution"] = attribution
+    return out
 
 
 def _view_fragment(payload: Dict[str, Any], key: str) -> Dict[str, Any]:
@@ -156,6 +162,13 @@ def _meta_fragment(
             }
     except Exception:
         pass
+    # mesh strip: the compact axes/source/host-count block the topology
+    # reader attached to the store snapshot — only when a mesh was
+    # captured (the meta fragment is content-compared, so a late mesh
+    # message republishes it; absent key == pre-topology shape)
+    mesh = (payload.get("topology") or {}).get("mesh")
+    if mesh:
+        out["mesh"] = mesh
     return out
 
 

@@ -122,7 +122,7 @@ def _efficiency_block(store, window: StepTimeWindow, steady) -> Optional[Dict[st
     return build_efficiency(store.model_stats(), per_rank_step)
 
 
-def _build_step_time_section(store, mode: str, identities=None):
+def _build_step_time_section(store, mode: str, identities=None, topology=None):
     if not store.has_step_time_rows():
         return _no_data_section("step_time"), None
     # columnar build off the store's ring buffers (scalar fallback
@@ -135,7 +135,9 @@ def _build_step_time_section(store, mode: str, identities=None):
     efficiency = (
         _efficiency_block(store, window, steady) if window else None
     )
-    result = diagnose_window(window, mode=mode, efficiency=efficiency)
+    result = diagnose_window(
+        window, mode=mode, efficiency=efficiency, topology=topology
+    )
     section: Dict[str, Any] = {
         "status": "OK" if window else "NO_DATA",
         "diagnosis": result.diagnosis.to_dict(),
@@ -225,11 +227,11 @@ def _build_step_time_section(store, mode: str, identities=None):
     return section, result
 
 
-def _build_step_memory_section(store, identities=None):
+def _build_step_memory_section(store, identities=None, topology=None):
     rank_rows = store.step_memory_rows()
     if not rank_rows:
         return _no_data_section("step_memory"), None
-    result = diagnose_memory(rank_rows)
+    result = diagnose_memory(rank_rows, topology=topology)
     from traceml_tpu.analytics.trends.core import compute_window_trend
 
     identities = identities or {}
@@ -299,12 +301,13 @@ def _build_step_memory_section(store, identities=None):
     return section, result
 
 
-def _build_collectives_section(store, mode: str, step_time_ms=None):
+def _build_collectives_section(store, mode: str, step_time_ms=None,
+                               topology=None):
     if not store.has_collectives_rows():
         return _no_data_section("collectives"), None
     window = store.build_collectives_window(max_steps=200)
     result = diagnose_collectives_window(
-        window, mode=mode, step_time_ms=step_time_ms
+        window, mode=mode, step_time_ms=step_time_ms, topology=topology
     )
     section: Dict[str, Any] = {
         "status": "OK" if window else "NO_DATA",
@@ -855,7 +858,7 @@ def render_text_summary(payload: Dict[str, Any]) -> str:
     return "\n".join(out) + "\n"
 
 
-def _build_liveness_section(session_dir: Path, mode: str):
+def _build_liveness_section(session_dir: Path, mode: str, topology=None):
     """Rank liveness + data-gap annotation from the aggregator's
     persisted snapshots (rank_status.json, finalization_warning.json) —
     file-backed, not DB-backed: a SIGKILLed rank left no closing rows,
@@ -863,7 +866,7 @@ def _build_liveness_section(session_dir: Path, mode: str):
     snap = loaders.load_rank_status(session_dir)
     if not snap:
         return _no_data_section("liveness"), None
-    result = diagnose_rank_status(snap, mode=mode)
+    result = diagnose_rank_status(snap, mode=mode, topology=topology)
     ranks = snap.get("ranks") or {}
     # data gaps: a lost rank's telemetry is trustworthy only up to its
     # last contact — downstream cross-rank aggregates past gap_from_ts
@@ -959,8 +962,18 @@ def generate_summary(
     except Exception:
         identities = {}
 
+    # the captured mesh (or None): threaded into every diagnosing
+    # section so findings carry physical attribution — None keeps each
+    # diagnose byte-identical to the pre-topology contract
+    try:
+        mesh = store.mesh_topology()
+    except Exception:
+        mesh = None
+
     def run_step_time():
-        section, result = _build_step_time_section(store, mode, identities)
+        section, result = _build_step_time_section(
+            store, mode, identities, topology=mesh
+        )
         results["step_time"] = result
         return section
 
@@ -977,13 +990,15 @@ def generate_summary(
         except Exception:
             pass
         section, result = _build_collectives_section(
-            store, mode, step_time_ms=step_time_ms
+            store, mode, step_time_ms=step_time_ms, topology=mesh
         )
         results["collectives"] = result
         return section
 
     def run_step_memory():
-        section, result = _build_step_memory_section(store, identities)
+        section, result = _build_step_memory_section(
+            store, identities, topology=mesh
+        )
         results["step_memory"] = result
         return section
 
@@ -998,7 +1013,9 @@ def generate_summary(
         return section
 
     def run_liveness():
-        section, result = _build_liveness_section(session_dir, mode)
+        section, result = _build_liveness_section(
+            session_dir, mode, topology=mesh
+        )
         results["liveness"] = result
         return section
 

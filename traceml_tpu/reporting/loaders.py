@@ -199,6 +199,29 @@ def load_topology(
     }
 
 
+def load_mesh_topology(
+    db_path: Path, conn: Optional[sqlite3.Connection] = None
+):
+    """The merged mesh topology from the one-shot ``mesh_topology``
+    control rows, or None for pre-topology session DBs (the table never
+    existed) and sessions that captured no mesh.  Keep-latest per rank:
+    ascending-id scan, later rows overwrite."""
+    from traceml_tpu.utils.topology import topology_from_rank_rows
+
+    with _reading(db_path, conn) as c:
+        if not _table_exists(c, "mesh_topology"):
+            return None
+        latest: Dict[int, Dict[str, Any]] = {}
+        for r in c.execute(
+            "SELECT global_rank, node_rank, hostname, source,"
+            " axes_json, coords_json FROM mesh_topology ORDER BY id ASC"
+        ):
+            latest[int(r["global_rank"])] = dict(r)
+    if not latest:
+        return None
+    return topology_from_rank_rows([latest[r] for r in sorted(latest)])
+
+
 def load_rank_identities(
     db_path: Path, conn: Optional[sqlite3.Connection] = None
 ) -> Dict[int, Dict[str, Any]]:

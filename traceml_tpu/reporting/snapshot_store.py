@@ -341,6 +341,12 @@ class LiveSnapshotStore:
         self._topology_cache: Optional[Dict[str, Any]] = None
         self._topology_cache_version = -1
 
+        # mesh_topology control rows: keep-latest per rank (replay may
+        # append duplicates; table is never trimmed)
+        self._mesh_rows: Dict[int, Dict[str, Any]] = {}
+        self._mesh_cache: Any = None
+        self._mesh_cache_version = -1
+
     # -- connection ------------------------------------------------------
 
     @property
@@ -435,6 +441,7 @@ class LiveSnapshotStore:
                 ("process_device_samples", self._read_process_dev, "process"),
                 ("stdout_samples", self._read_stdout, "stdout"),
                 ("model_stats_samples", self._read_model_stats, "model_stats"),
+                ("mesh_topology", self._read_mesh_topology, "topology"),
             )
             for table, reader, domain in readers:
                 try:
@@ -799,6 +806,21 @@ class LiveSnapshotStore:
         )
         return bool(rows) or evicted
 
+    def _read_mesh_topology(self, conn, table, dirty) -> bool:
+        """One-shot per-rank mesh placement; keep the latest row per
+        rank (no trims — the table is not retained-pruned)."""
+        cur = self._cursors.get(table, 0)
+        rows = conn.execute(
+            f"SELECT id, global_rank, node_rank, hostname, source,"
+            f" axes_json, coords_json FROM {table}"
+            " WHERE id > ? ORDER BY id",
+            (cur,),
+        ).fetchall()
+        for r in rows:
+            self._mesh_rows[int(r["global_rank"])] = dict(r)
+        self._advance_cursor(table, rows)
+        return bool(rows)
+
     # -- accessors (loader-shaped) --------------------------------------
 
     def step_time_rows(self) -> Dict[int, List[Dict[str, Any]]]:
@@ -1006,6 +1028,41 @@ class LiveSnapshotStore:
                     "nodes": len(src.nodes),
                     "hostnames": sorted(src.hostnames),
                 }
+            mesh = self._mesh_topology_locked()
+            if mesh is not None:
+                # only-when-captured: pre-topology sessions keep the
+                # exact historical dict shape (back-compat pin in
+                # tests/utils/test_topology_attribution.py)
+                out["mesh"] = {
+                    "axes": [a.to_dict() for a in mesh.axes],
+                    "source": mesh.source,
+                    "ranks": len(mesh.rank_coords),
+                    "hosts": len(set(mesh.rank_hosts.values())),
+                }
             self._topology_cache = out
             self._topology_cache_version = self._versions["topology"]
             return out
+
+    def _mesh_topology_locked(self):
+        if (
+            self._mesh_cache_version == self._versions["topology"]
+            and self._mesh_cache is not None
+        ):
+            return self._mesh_cache
+        if not self._mesh_rows:
+            return None
+        from traceml_tpu.utils.topology import topology_from_rank_rows
+
+        self._mesh_cache = topology_from_rank_rows(
+            [self._mesh_rows[r] for r in sorted(self._mesh_rows)]
+        )
+        self._mesh_cache_version = self._versions["topology"]
+        return self._mesh_cache
+
+    def mesh_topology(self):
+        """The merged :class:`~traceml_tpu.utils.topology.MeshTopology`,
+        or None when no rank ever shipped a ``mesh_topology`` message —
+        the signal every diagnose call site uses to stay on flat rank
+        lists."""
+        with self._lock:
+            return self._mesh_topology_locked()

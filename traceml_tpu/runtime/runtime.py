@@ -20,7 +20,7 @@ from traceml_tpu.runtime.state import RecordingState
 from traceml_tpu.runtime.stdout_capture import StreamCapture
 from traceml_tpu.samplers.base_sampler import BaseSampler
 from traceml_tpu.sdk.state import get_state
-from traceml_tpu.telemetry.control import build_rank_finished
+from traceml_tpu.telemetry.control import build_mesh_topology, build_rank_finished
 from traceml_tpu.transport.tcp_transport import TCPClient
 from traceml_tpu.utils.error_log import get_error_log
 
@@ -45,6 +45,7 @@ class TraceMLRuntime:
         self._stop_evt = threading.Event()
         self._started = False
         self._finished_sent = False
+        self._mesh_sent = False
         self._paused = threading.Event()
         self._tick_lock = threading.Lock()  # pause() waits on in-flight ticks
         self._lock = threading.Lock()
@@ -170,6 +171,38 @@ class TraceMLRuntime:
             )
         ]
 
+    def _take_mesh_topology(self) -> Optional[list]:
+        """The send-once mesh_topology control message, or None while no
+        mesh is discoverable (the user may build the mesh any number of
+        steps into the run, so every tick retries until capture
+        succeeds, then latches)."""
+        with self._lock:
+            if self._mesh_sent:
+                return None
+        try:
+            from traceml_tpu.utils.topology import capture_local_topology
+
+            topo = capture_local_topology(
+                self.identity.global_rank, self.identity.world_size
+            )
+        except Exception as exc:
+            get_error_log().warning("mesh topology capture failed", exc)
+            with self._lock:
+                self._mesh_sent = True  # broken capture: stop retrying
+            return None
+        if topo is None:
+            return None
+        with self._lock:
+            if self._mesh_sent:
+                return None
+            self._mesh_sent = True
+        return [
+            build_mesh_topology(
+                self.identity.to_sender_identity(self.settings.session_id).to_meta(),
+                topo,
+            )
+        ]
+
     # -- pause (measurement quiescence) --------------------------------
     def pause(self) -> None:
         """Suspend tick work (sampling + publishing) without tearing the
@@ -206,9 +239,14 @@ class TraceMLRuntime:
                 if getattr(getattr(s, "_spec", None), "drain_on_recording_stop", False):
                     s.drain()
             self.recording.mark_drained()
-        extra = None
+        extra: Optional[list] = None
+        mesh = self._take_mesh_topology()
+        if mesh:
+            extra = mesh
         if self.recording.phase == "COMPLETE":
-            extra = self._take_rank_finished()
+            finished = self._take_rank_finished()
+            if finished:
+                extra = (extra or []) + finished
         if self.publisher is not None and (
             self.recording.phase != "COMPLETE" or extra
         ):
@@ -242,7 +280,10 @@ class TraceMLRuntime:
             # final=True force-flushes every writer (even throttled ones)
             # so the disk backup holds the full run, and ships the last
             # producer_stats snapshot
-            self.publisher.publish(self._take_rank_finished(), final=True)
+            extra = (self._take_mesh_topology() or []) + (
+                self._take_rank_finished() or []
+            )
+            self.publisher.publish(extra or None, final=True)
 
 
 class NoOpRuntime:

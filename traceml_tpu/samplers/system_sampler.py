@@ -32,7 +32,10 @@ TABLE_HOST = "system"
 TABLE_DEVICE = "system_device"
 
 
-def build_system_manifest() -> Dict[str, Any]:
+def build_system_manifest(include_devices: bool = True) -> Dict[str, Any]:
+    """``include_devices=False`` skips the jax device probe entirely —
+    the probe would force-initialize jax, which the sampler thread must
+    never do (see SystemSampler._ensure_manifest's timeout path)."""
     manifest: Dict[str, Any] = {
         "hostname": platform.node(),
         "os": platform.platform(),
@@ -47,6 +50,9 @@ def build_system_manifest() -> Dict[str, Any]:
         manifest["host_memory_total_bytes"] = psutil.virtual_memory().total
     except Exception:
         pass
+    if not include_devices:
+        manifest["platform"] = "unknown"
+        return manifest
     try:
         import jax
 
@@ -101,6 +107,9 @@ class SystemSampler(BaseSampler):
         super().__init__(*args, **kw)
         self._manifest_path = manifest_path
         self._manifest_written = False
+        self._manifest_degraded = False  # wrote the timeout note; a
+        # later jax init upgrades the manifest with real devices
+        self._manifest_wait_started = time.monotonic()
         self._backend_holder = {"backend": memory_backend}
         self._tpu_metrics: Any = None  # None=untried, False=unavailable
         try:
@@ -111,8 +120,16 @@ class SystemSampler(BaseSampler):
         except Exception:
             self._psutil = None
 
+    #: how long to wait for the user's process to initialize jax before
+    #: writing the manifest without device inventory (a script that
+    #: never touches jax would otherwise silently get NO manifest at
+    #: all — the wait must time out into an explicit note, not a hole)
+    _MANIFEST_WAIT_SEC = 30.0
+
     def _ensure_manifest(self) -> None:
-        if self._manifest_written or self._manifest_path is None:
+        if self._manifest_path is None:
+            return
+        if self._manifest_written and not self._manifest_degraded:
             return
         from traceml_tpu.utils.step_memory import jax_is_initialized
 
@@ -120,10 +137,37 @@ class SystemSampler(BaseSampler):
         # process has initialized jax itself (never force init from the
         # sampler thread — see jax_is_initialized).  Written on the first
         # tick after that.
-        if not jax_is_initialized():
+        manifest: Optional[Dict[str, Any]] = None
+        if jax_is_initialized():
+            manifest = build_system_manifest()
+            self._manifest_degraded = False
+        elif self._manifest_written:
+            return  # degraded note already on disk; keep waiting for jax
+        elif (
+            time.monotonic() - self._manifest_wait_started
+            >= self._MANIFEST_WAIT_SEC
+        ):
+            # one-shot topology_unavailable note: the host block is
+            # still valuable, and the explicit reason beats a silently
+            # missing device inventory (include_devices=False — probing
+            # here would force-init jax, the exact thing we waited on)
+            manifest = build_system_manifest(include_devices=False)
+            manifest["topology_unavailable"] = {
+                "reason": (
+                    "jax was never initialized by the traced process "
+                    f"within {self._MANIFEST_WAIT_SEC:.0f}s; device "
+                    "inventory omitted (the sampler never force-inits "
+                    "jax from its thread)"
+                ),
+                "waited_sec": round(
+                    time.monotonic() - self._manifest_wait_started, 1
+                ),
+            }
+            self._manifest_degraded = True
+        if manifest is None:
             return
         try:
-            atomic_write_json(self._manifest_path, build_system_manifest())
+            atomic_write_json(self._manifest_path, manifest)
             self._manifest_written = True
         except Exception as exc:
             get_error_log().warning("system manifest write failed", exc)

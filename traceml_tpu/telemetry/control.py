@@ -14,10 +14,14 @@ Three control messages today:
   idle ticks so a silent-but-alive rank stays distinguishable from a
   dead one (aggregator/liveness.py drives STALE→LOST transitions off
   last-seen; docs/developer_guide/fault-tolerance.md).
+* ``mesh_topology`` — one-shot per-rank mesh placement (axis
+  names/sizes, ICI/DCN kind per axis, this rank's coordinates),
+  captured by utils/topology.py and persisted so diagnoses can be
+  attributed to physical structure
+  (docs/developer_guide/topology-attribution.md).
 
-All three are idempotent on replay (set-add / keep-latest / last-seen
-max), so the durable-send spool may re-deliver them without a dedup
-table.
+All are idempotent on replay (set-add / keep-latest / last-seen max),
+so the durable-send spool may re-deliver them without a dedup table.
 """
 
 from __future__ import annotations
@@ -29,6 +33,7 @@ CONTROL_KEY = "_traceml_control"
 RANK_FINISHED = "rank_finished"
 PRODUCER_STATS = "producer_stats"
 RANK_HEARTBEAT = "rank_heartbeat"
+MESH_TOPOLOGY = "mesh_topology"
 
 
 def build_rank_finished(identity_meta: Mapping[str, Any]) -> Dict[str, Any]:
@@ -54,6 +59,17 @@ def build_producer_stats(
         CONTROL_KEY: PRODUCER_STATS,
         "meta": dict(identity_meta),
         "stats": dict(stats),
+        "timestamp": time.time(),
+    }
+
+
+def build_mesh_topology(
+    identity_meta: Mapping[str, Any], topology: Mapping[str, Any]
+) -> Dict[str, Any]:
+    return {
+        CONTROL_KEY: MESH_TOPOLOGY,
+        "meta": dict(identity_meta),
+        "topology": dict(topology),
         "timestamp": time.time(),
     }
 

@@ -281,6 +281,16 @@ class MemoryColumns(_CompactRing):
     def column(self, c: int) -> np.ndarray:
         return self._data[self._start : self._end, c]
 
+    def last_used(self) -> float:
+        """``step_peak_bytes or current_bytes or 0`` of the newest row —
+        the scalar rules' ``rows[-1]`` read (-1 == NULL, falsy like the
+        scalar ``or`` chain treats None and 0)."""
+        d = self.data_view()
+        if d.shape[0] == 0:
+            return 0.0
+        sp, cur = int(d[-1, C_SPEAK]), int(d[-1, C_CUR])
+        return float(sp if sp > 0 else (cur if cur > 0 else 0))
+
 
 class _ColumnarData:
     """Raw arrays behind a built window (the ``window.col`` namespace
@@ -571,6 +581,84 @@ def window_to_plain(w: Optional[StepTimeWindow]) -> Optional[Dict[str, Any]]:
             }
             for r, rw in w.rank_windows.items()
         },
+    }
+
+
+def window_series_cube(
+    window: StepTimeWindow, key: str = STEP_KEY
+) -> "tuple[List[int], np.ndarray]":
+    """``(ranks, (rank × step) cube)`` for one series key of a window,
+    rows in ``window.ranks`` order.  Columnar windows hand out a view of
+    the value cube; scalar windows materialize the same array from their
+    per-rank series lists, so the topology reduction below works on
+    either path.  The cube is dense by construction — suffix alignment
+    keeps only steps present in EVERY rank."""
+    if key not in KEY_INDEX:
+        raise KeyError(key)
+    col = getattr(window, "col", None)
+    if col is not None:
+        return list(col.ranks), col.series_cube[:, KEY_INDEX[key], :]
+    ranks = list(window.ranks)
+    cube = np.array(
+        [window.rank_windows[r].series[key] for r in ranks],
+        dtype=np.float64,
+    ).reshape(len(ranks), window.n_steps)
+    return ranks, cube
+
+
+def reduce_window_by_grouping(
+    window: StepTimeWindow, grouping: Any, key: str = STEP_KEY
+) -> Dict[str, Any]:
+    """(rank × step) → (axis-group × step): reshape one series of a
+    window along a topology grouping (``utils.topology.Grouping`` —
+    host / axis-coordinate / DCN-side) and return per-group aggregates
+    plus a per-step dispersion series.
+
+    Ranks outside the grouping are masked out rather than folded into a
+    catch-all group.  Output::
+
+        {"kind", "axis", "steps": [...],
+         "groups": [{"key", "ranks", "mean": [...S], "min": [...S],
+                     "max": [...S]}, ...],       # grouping-key order
+         "dispersion": [...S]}                   # max-min of group means
+
+    ``dispersion`` is the step-wise spread of the group means — the
+    signal the attribution scorer explains: near-zero means the grouping
+    does not separate the ranks on this series.
+    """
+    from traceml_tpu.utils.topology import reduce_cube
+
+    ranks, cube = window_series_cube(window, key)
+    row_of = {int(r): i for i, r in enumerate(ranks)}
+    keys = sorted(grouping.groups, key=lambda k: str(k))
+    group_index = np.zeros(len(ranks), dtype=np.int64)
+    member = np.zeros(len(ranks), dtype=bool)
+    for g, k in enumerate(keys):
+        for r in grouping.groups[k]:
+            i = row_of.get(int(r))
+            if i is not None:
+                group_index[i] = g
+                member[i] = True
+    mask = np.broadcast_to(member[:, None], cube.shape)
+    red = reduce_cube(cube, group_index, len(keys), mask=mask)
+    means = red["mean"]
+    with np.errstate(invalid="ignore"):
+        spread = np.nanmax(means, axis=0) - np.nanmin(means, axis=0)
+    return {
+        "kind": grouping.kind,
+        "axis": grouping.axis,
+        "steps": list(window.steps),
+        "groups": [
+            {
+                "key": str(k),
+                "ranks": sorted(int(r) for r in grouping.groups[k]),
+                "mean": means[g].tolist(),
+                "min": red["min"][g].tolist(),
+                "max": red["max"][g].tolist(),
+            }
+            for g, k in enumerate(keys)
+        ],
+        "dispersion": np.where(np.isfinite(spread), spread, 0.0).tolist(),
     }
 
 
