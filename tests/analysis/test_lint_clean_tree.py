@@ -1,0 +1,124 @@
+"""The real ``traceml_tpu`` tree must pass its own linter, and the
+runner's exit-code / JSON / baseline contract is what CI keys off."""
+
+from __future__ import annotations
+
+import io
+import json
+from pathlib import Path
+
+from traceml_tpu.analysis.common import load_baseline
+from traceml_tpu.analysis.runner import (
+    default_baseline_path,
+    default_package_root,
+    run_lint,
+    run_passes,
+    summarize,
+)
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+
+
+def test_real_tree_is_clean_against_checked_in_baseline():
+    root = default_package_root()
+    findings = run_passes(root)
+    baseline = load_baseline(default_baseline_path(root))
+    summary = summarize(findings, baseline)
+    new = [
+        f.format_text()
+        for f in findings
+        if f.severity == "error" and not f.suppressed and f.key not in baseline
+    ]
+    assert summary["new_errors"] == [], "un-baselined lint errors:\n" + "\n".join(new)
+    # the baseline is a tolerance list, not a dumping ground: keep it to
+    # a handful of triaged keys and never let it go stale
+    assert len(baseline) <= 8, sorted(baseline)
+    assert summary["stale_baseline_keys"] == []
+
+
+def test_real_tree_suppressions_all_carry_reasons():
+    findings = run_passes(default_package_root())
+    suppressed = [f for f in findings if f.suppressed]
+    assert suppressed, "expected the known inline unguarded() suppressions"
+    for f in suppressed:
+        assert f.suppress_reason and f.suppress_reason.strip(), f.format_text()
+
+
+def test_run_lint_exit_codes_and_json(tmp_path, capsys):
+    # a tiny real package with one planted race error
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("", encoding="utf-8")
+    (pkg / "racy.py").write_text(
+        "import threading\n"
+        "\n"
+        "\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.n = 0\n"
+        "\n"
+        "    def _locked(self):\n"
+        "        with self._lock:\n"
+        "            self.n += 1\n"
+        "\n"
+        "    def bump(self):\n"
+        "        self.n += 1\n",
+        encoding="utf-8",
+    )
+    baseline_path = tmp_path / "baseline.json"
+
+    out = io.StringIO()
+    rc = run_lint(
+        package_root=pkg,
+        passes=["race"],
+        fmt="json",
+        baseline_path=baseline_path,
+        out=out,
+    )
+    assert rc == 1
+    payload = json.loads(out.getvalue())
+    assert payload["counts"]["errors"] == 1
+    assert payload["counts"]["new_errors"] == 1
+    assert len(payload["new_error_keys"]) == 1
+    assert payload["new_error_keys"][0].startswith("TLR001:")
+
+    # --update-baseline writes the key and exits 0
+    out = io.StringIO()
+    rc = run_lint(
+        package_root=pkg,
+        passes=["race"],
+        baseline_path=baseline_path,
+        update_baseline=True,
+        out=out,
+    )
+    assert rc == 0
+    assert set(load_baseline(baseline_path)) == set(payload["new_error_keys"])
+
+    # with the baseline in place the same tree now gates clean
+    out = io.StringIO()
+    rc = run_lint(
+        package_root=pkg, passes=["race"], baseline_path=baseline_path, out=out
+    )
+    assert rc == 0
+    assert "[baselined]" in out.getvalue()
+
+    # a missing package root is an analyzer failure, not "clean"
+    assert run_lint(package_root=tmp_path / "nope", out=io.StringIO()) == 2
+
+
+def test_run_lint_reports_stale_baseline_keys(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "clean.py").write_text("x = 1\n", encoding="utf-8")
+    baseline_path = tmp_path / "baseline.json"
+    baseline_path.write_text(
+        json.dumps({"keys": {"TLR001:pkg/gone.py:C.m:attr": "fixed ages ago"}}),
+        encoding="utf-8",
+    )
+    out = io.StringIO()
+    rc = run_lint(
+        package_root=pkg, passes=["race"], baseline_path=baseline_path, out=out
+    )
+    assert rc == 0
+    assert "no longer fire" in out.getvalue()

@@ -33,7 +33,16 @@ try:
 except Exception:
     pass
 
+import faulthandler  # noqa: E402
+import threading  # noqa: E402
+import time  # noqa: E402
+
 import pytest  # noqa: E402
+
+# A hung collective / wedged transport thread turns into a silent CI
+# timeout without this: dump every thread's stack on SIGABRT so the
+# killed run still says WHERE it was stuck.
+faulthandler.enable()
 
 
 @pytest.fixture()
@@ -41,3 +50,30 @@ def tmp_session_dir(tmp_path):
     d = tmp_path / "session"
     d.mkdir()
     return d
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _no_leaked_threads():
+    """Every subsystem in this package promises clean teardown
+    (close()/stop() joins its workers).  A non-daemon thread that
+    outlives the whole test session broke that promise somewhere —
+    fail loudly with the survivors' names instead of letting pytest
+    hang at interpreter exit."""
+    yield
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        leaked = [
+            t
+            for t in threading.enumerate()
+            if t is not threading.main_thread()
+            and t.is_alive()
+            and not t.daemon
+        ]
+        if not leaked:
+            return
+        time.sleep(0.05)
+    names = ", ".join(sorted(t.name for t in leaked))
+    pytest.fail(
+        f"non-daemon thread(s) survived session teardown: {names}",
+        pytrace=False,
+    )

@@ -34,7 +34,7 @@ function render_cluster(d){
     card.style.display="";
     document.getElementById("cluster-sub").textContent=
       `${s.nodes.length}/${s.expected_nodes} nodes`+
-      (s.missing_nodes?` · ${s.missing_nodes} MISSING`:"");
+      (s.missing_nodes?` · ${esc(s.missing_nodes)} MISSING`:"");
     let cr=`<table><tr><th>metric</th><th class="num">min</th>
       <th class="num">median</th><th class="num">max</th><th>max node</th></tr>`;
     for(const r of s.rollups){

@@ -113,11 +113,11 @@ function runContext(d){{
   const bits=[];
   const st=d.step_time;
   if(st&&st.coverage&&st.coverage.world_size)
-    bits.push(`world ${{st.coverage.world_size}}`);
+    bits.push(`world ${{esc(st.coverage.world_size)}}`);
   const s=d.system;
   if(s&&s.nodes&&s.nodes.length){{
     const devs=s.nodes.reduce((a,n)=>a+(n.devices||[]).length,0);
-    if(devs)bits.push(`${{devs}} chip${{devs>1?"s":""}}`);
+    if(devs)bits.push(`${{esc(devs)}} chip${{devs>1?"s":""}}`);
     bits.push(String(s.nodes[0].hostname).split(".")[0])}}
   document.getElementById("runctx").textContent=bits.join(" · ")}}
 function renderAll(d){{

@@ -184,8 +184,8 @@ function meter(frac,warn,crit){
   return`<span class="meter"><i class="${cls}" style="width:${w}%"></i></span>
     <span class="muted">${(frac*100).toFixed(0)}%</span>`}
 function kpiTile(key,label,acc){
-  return`<div class="kpi" style="--acc:${acc}"><span class="klab">${label}</span>
-    <div class="kval" id="kpi-${key}">—</div></div>`}
+  return`<div class="kpi" style="--acc:${esc(acc)}"><span class="klab">${esc(label)}</span>
+    <div class="kval" id="kpi-${esc(key)}">—</div></div>`}
 function setKpi(key,num,unit){
   const e=document.getElementById("kpi-"+key);if(!e)return;
   e.innerHTML=num==null?"—":`${esc(num)}<span class="kunit">${esc(unit||"")}</span>`}

@@ -28,12 +28,13 @@ rank that finished before the crash stays FINISHED.
 
 from __future__ import annotations
 
-import os
 import time
 from typing import Any, Dict, Mapping, Optional
 
-ENV_STALE_SEC = "TRACEML_LIVENESS_STALE_SEC"
-ENV_LOST_SEC = "TRACEML_LIVENESS_LOST_SEC"
+from traceml_tpu.config import flags
+
+ENV_STALE_SEC = flags.LIVENESS_STALE_SEC.name
+ENV_LOST_SEC = flags.LIVENESS_LOST_SEC.name
 
 DEFAULT_STALE_SEC = 10.0  # ~3 missed heartbeats at the 3s default
 DEFAULT_LOST_SEC = 30.0
@@ -42,13 +43,6 @@ STATE_ACTIVE = "active"
 STATE_STALE = "stale"
 STATE_LOST = "lost"
 STATE_FINISHED = "finished"
-
-
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, default))
-    except (TypeError, ValueError):
-        return default
 
 
 class RankLivenessTracker:
@@ -64,13 +58,13 @@ class RankLivenessTracker:
         self.stale_after = (
             stale_after
             if stale_after is not None
-            else _env_float(ENV_STALE_SEC, DEFAULT_STALE_SEC)
+            else flags.LIVENESS_STALE_SEC.get_float(DEFAULT_STALE_SEC)
         )
         self.lost_after = max(
             self.stale_after,
             lost_after
             if lost_after is not None
-            else _env_float(ENV_LOST_SEC, DEFAULT_LOST_SEC),
+            else flags.LIVENESS_LOST_SEC.get_float(DEFAULT_LOST_SEC),
         )
         self._first_seen: Dict[int, float] = {}
         self._last_seen: Dict[int, float] = {}

@@ -314,6 +314,7 @@ class SQLiteWriter:
             drop = dict(self._drop_by_domain)
             unknown = dict(self._unknown_by_domain)
             hwm = list(self._queue_hwm)
+            dropped = self.dropped
         queues = {}
         for pri, name in enumerate(PRIORITY_NAMES):
             q = self._queues[pri]
@@ -324,7 +325,7 @@ class SQLiteWriter:
             }
         return {
             "enqueued": self.enqueued,
-            "dropped": self.dropped,
+            "dropped": dropped,
             "written": self.written,
             "enqueued_by_domain": enq,
             "dropped_by_domain": drop,

@@ -271,7 +271,7 @@ class TraceMLAggregator:
         """Drain to empty in bounded slices (settle/shutdown path: no UI
         between batches, but each slice stays interruptible by the GIL)."""
         total = self._drain_once()
-        while self._last_drain_frames >= _DRAIN_BATCH_FRAMES:
+        while self._last_drain_frames >= _DRAIN_BATCH_FRAMES:  # tracelint: unguarded(single int read; a stale value only defers or adds one bounded drain slice)
             total += self._drain_once()
         return total
 
@@ -280,10 +280,12 @@ class TraceMLAggregator:
         (every ``_stats_interval`` seconds) so a live observer sees
         backpressure building, not just the post-mortem at stop()."""
         wstats = self.writer.stats()
+        with self._ingest_cond:
+            ingested = self.envelopes_ingested
         atomic_write_json(
             self.settings.session_dir / "ingest_stats.json",
             {
-                "envelopes_ingested": self.envelopes_ingested,
+                "envelopes_ingested": ingested,
                 "frames_received": self.server.frames_received,
                 "decode_errors": self.server.decode_errors,
                 "corrupt_frame_drops": dict(self.server.corrupt_frame_drops),
@@ -418,7 +420,7 @@ class TraceMLAggregator:
                                 "periodic ingest stats write failed", exc
                             )
                     if (
-                        self._last_drain_frames < _DRAIN_BATCH_FRAMES
+                        self._last_drain_frames < _DRAIN_BATCH_FRAMES  # tracelint: unguarded(single int read; a stale value only defers backlog catch-up to the next loop tick)
                         or self._stop_evt.is_set()
                     ):
                         break

@@ -1,0 +1,17 @@
+"""Zero-runtime-dependency static analysis for the traceml_tpu tree.
+
+Four passes over the package source (stdlib ``ast``/``tokenize`` only,
+no project imports at analysis time):
+
+* race — lock-discipline inference (``TLR*``);
+* wiring — domain registry contract across the seven layers (``TLW*``);
+* flags — the ``TRACEML_*`` env-var registry (``TLF*``);
+* escape — browser-section HTML escaping coverage (``TLE*``).
+
+Run as ``traceml lint`` or ``python -m traceml_tpu.analysis``.
+"""
+
+from traceml_tpu.analysis.common import Finding
+from traceml_tpu.analysis.runner import PASSES, run_lint, run_passes
+
+__all__ = ["Finding", "PASSES", "run_lint", "run_passes"]

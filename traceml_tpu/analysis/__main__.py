@@ -1,0 +1,103 @@
+"""``python -m traceml_tpu.analysis`` — same gate as ``traceml lint``,
+importable from a bare checkout (the CI lint job runs it without
+installing the package).
+
+``--self-time`` is the perf smoke: run the full-package analysis and
+fail if it exceeds the budget (default 5s) — the gate must stay cheap
+enough to run on every PR.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m traceml_tpu.analysis",
+        description="traceml static analyzer (race/wiring/flags/escape)",
+    )
+    p.add_argument(
+        "--root",
+        type=Path,
+        default=None,
+        help="package root to analyze (default: the installed traceml_tpu)",
+    )
+    p.add_argument(
+        "--pass",
+        dest="passes",
+        action="append",
+        choices=("race", "wiring", "flags", "escape"),
+        default=None,
+        help="run only this pass (repeatable; default: all four)",
+    )
+    p.add_argument(
+        "--format",
+        choices=("text", "json"),
+        default="text",
+        help="output format (default: text)",
+    )
+    p.add_argument(
+        "--baseline",
+        type=Path,
+        default=None,
+        help="baseline file (default: tracelint_baseline.json at repo root)",
+    )
+    p.add_argument(
+        "--update-baseline",
+        action="store_true",
+        help="rewrite the baseline from current findings and exit 0",
+    )
+    p.add_argument(
+        "--show-suppressed",
+        action="store_true",
+        help="include suppressed findings in text output",
+    )
+    p.add_argument(
+        "--self-time",
+        nargs="?",
+        type=float,
+        const=5.0,
+        default=None,
+        metavar="BUDGET_SEC",
+        help=(
+            "perf smoke: run the full analysis and fail if it takes "
+            "longer than BUDGET_SEC (default 5.0)"
+        ),
+    )
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    from traceml_tpu.analysis.runner import run_lint, run_passes
+    from traceml_tpu.analysis.runner import default_package_root
+
+    if args.self_time is not None:
+        root = args.root or default_package_root()
+        t0 = time.monotonic()
+        findings = run_passes(root)
+        elapsed = time.monotonic() - t0
+        ok = elapsed <= args.self_time
+        print(
+            f"traceml lint --self-time: {len(findings)} finding(s) in "
+            f"{elapsed:.2f}s (budget {args.self_time:.1f}s) — "
+            f"{'OK' if ok else 'OVER BUDGET'}"
+        )
+        return 0 if ok else 1
+
+    return run_lint(
+        package_root=args.root,
+        passes=args.passes,
+        fmt=args.format,
+        baseline_path=args.baseline,
+        update_baseline=args.update_baseline,
+        show_suppressed=args.show_suppressed,
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

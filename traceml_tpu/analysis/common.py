@@ -1,0 +1,189 @@
+"""Shared plumbing for ``traceml lint``: findings, suppressions,
+baseline, and the package file walker.
+
+Everything in ``traceml_tpu/analysis/`` is stdlib-only and import-cheap
+on purpose — the lint CI job runs from a bare checkout (no jax, no
+numpy) and the whole-package run is budgeted under ~5 seconds
+(``python -m traceml_tpu.analysis --self-time``).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import re
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Tuple
+
+SEVERITY_ERROR = "error"
+SEVERITY_WARNING = "warning"
+SEVERITY_INFO = "info"
+
+#: suppression marker grammar: ``# tracelint: <marker>(<reason>)``.
+#: The reason is REQUIRED — a suppression is a claim ("this race is a
+#: monotonic stats counter") and the claim must be on the line.
+_SUPPRESS_RE = re.compile(
+    r"tracelint:\s*(?P<marker>[a-z-]+)\s*\((?P<reason>[^)]*)\)"
+)
+
+#: marker → rule-id prefix it silences
+SUPPRESS_MARKERS = {
+    "unguarded": "TLR",   # lock-discipline race pass
+    "rawhtml": "TLE",     # escape-coverage pass
+    "flag-ok": "TLF",     # env-flag registry pass
+    "wiring-ok": "TLW",   # domain-wiring contract pass
+}
+
+
+@dataclasses.dataclass
+class Finding:
+    """One analyzer finding.
+
+    ``key`` is the stable baseline identity: rule + file + symbol, no
+    line number, so a finding survives unrelated edits above it.
+    """
+
+    rule: str
+    severity: str
+    path: str          # repo-relative, forward slashes
+    line: int
+    message: str
+    key: str
+    suppressed: bool = False
+    suppress_reason: Optional[str] = None
+
+    def to_dict(self) -> Dict[str, object]:
+        d = {
+            "rule": self.rule,
+            "severity": self.severity,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+            "key": self.key,
+        }
+        if self.suppressed:
+            d["suppressed"] = True
+            d["suppress_reason"] = self.suppress_reason
+        return d
+
+    def format_text(self) -> str:
+        tag = " (suppressed)" if self.suppressed else ""
+        return (
+            f"{self.path}:{self.line}: {self.rule} "
+            f"[{self.severity}]{tag} {self.message}"
+        )
+
+
+class SourceFile:
+    """One parsed module: text, lines, AST, and per-line suppressions."""
+
+    def __init__(self, path: Path, rel: str) -> None:
+        self.path = path
+        self.rel = rel
+        self.text = path.read_text(encoding="utf-8")
+        self.lines = self.text.splitlines()
+        self.tree: Optional[ast.Module] = None
+        self.parse_error: Optional[str] = None
+        try:
+            self.tree = ast.parse(self.text, filename=str(path))
+        except SyntaxError as exc:  # surfaced as a TLX000 finding
+            self.parse_error = f"{exc.msg} (line {exc.lineno})"
+        # line → (marker, reason); comments only, so a marker inside a
+        # string constant does not silence anything
+        self.suppressions: Dict[int, Tuple[str, str]] = {}
+        self._scan_suppressions()
+
+    def _scan_suppressions(self) -> None:
+        import tokenize
+        from io import StringIO
+
+        try:
+            tokens = tokenize.generate_tokens(StringIO(self.text).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _SUPPRESS_RE.search(tok.string)
+                if m:
+                    self.suppressions[tok.start[0]] = (
+                        m.group("marker"),
+                        m.group("reason").strip(),
+                    )
+        except (tokenize.TokenError, IndentationError):
+            pass
+
+    def suppression_for(self, line: int, rule: str) -> Optional[str]:
+        """Reason string when ``line`` carries a marker matching
+        ``rule``'s family, else None."""
+        entry = self.suppressions.get(line)
+        if entry is None:
+            return None
+        marker, reason = entry
+        prefix = SUPPRESS_MARKERS.get(marker)
+        if prefix is not None and rule.startswith(prefix):
+            return reason or "(no reason given)"
+        return None
+
+
+def walk_package(
+    root: Path, skip_dirs: Iterable[str] = ("__pycache__",)
+) -> List[SourceFile]:
+    """Every ``.py`` file under ``root`` as a parsed :class:`SourceFile`,
+    sorted for deterministic finding order."""
+    skip = set(skip_dirs)
+    out: List[SourceFile] = []
+    for path in sorted(root.rglob("*.py")):
+        if any(part in skip for part in path.parts):
+            continue
+        rel = path.relative_to(root.parent).as_posix()
+        out.append(SourceFile(path, rel))
+    return out
+
+
+def apply_suppressions(
+    findings: List[Finding], files_by_rel: Dict[str, SourceFile]
+) -> None:
+    """Mark findings whose line carries a matching tracelint marker."""
+    for f in findings:
+        src = files_by_rel.get(f.path)
+        if src is None:
+            continue
+        reason = src.suppression_for(f.line, f.rule)
+        if reason is not None:
+            f.suppressed = True
+            f.suppress_reason = reason
+
+
+# --------------------------------------------------------------------
+# baseline: pre-existing findings accepted by a reviewer.  Keys only —
+# the workflow is `traceml lint --update-baseline` after triage, then
+# the gate fails solely on NEW error keys.
+# --------------------------------------------------------------------
+
+def load_baseline(path: Path) -> Dict[str, str]:
+    """{finding key: note}.  Missing file = empty baseline."""
+    try:
+        data = json.loads(path.read_text(encoding="utf-8"))
+    except (OSError, ValueError):
+        return {}
+    keys = data.get("keys", {})
+    if isinstance(keys, list):  # tolerate the bare-list form
+        return {str(k): "" for k in keys}
+    return {str(k): str(v) for k, v in keys.items()}
+
+
+def save_baseline(path: Path, findings: List[Finding]) -> None:
+    keys = {
+        f.key: f"{f.path}:{f.line} {f.message}"
+        for f in findings
+        if f.severity == SEVERITY_ERROR and not f.suppressed
+    }
+    payload = {
+        "comment": (
+            "traceml lint baseline: pre-existing error findings the "
+            "gate tolerates.  Regenerate with `traceml lint "
+            "--update-baseline` ONLY after triaging each key."
+        ),
+        "keys": dict(sorted(keys.items())),
+    }
+    path.write_text(json.dumps(payload, indent=2) + "\n", encoding="utf-8")

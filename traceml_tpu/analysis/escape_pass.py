@@ -1,0 +1,781 @@
+"""Escape-coverage pass (rules ``TLE001``/``TLE002``).
+
+The browser dashboard is served live and unauthenticated, and every
+section module in ``aggregator/display_drivers/browser_sections/``
+builds HTML from telemetry payloads — session ids, stdout lines,
+diagnosis strings — inside JS template literals embedded in Python
+strings.  The standing contract (see ``browser_sections/theme.py``) is
+that EVERY interpolated value routes through ``esc()`` (or
+``encodeURIComponent`` in URL position).  This pass enforces it:
+
+* ``TLE001`` (error) — a ``${…}`` interpolation in a section module's
+  string constants whose expression is not provably safe;
+* ``TLE002`` (error) — a Python f-string that builds HTML (contains a
+  tag) interpolating a value that is not provably trusted.
+
+"Provably safe" for JS is a recursive grammar over the expression text:
+
+* wrapped in ``esc(…)`` / ``encodeURIComponent(…)``;
+* a known numeric formatter (``pct``, ``fmtMs``, ``fmtBytes``,
+  ``fmt*``), a ``….toFixed(n)`` chain, or any ``Math.…`` /
+  ``new Date(…).toLocale…()`` expression — numbers and locale time
+  strings can't carry markup;
+* a pure arithmetic expression over identifiers (``*/%-``, ``||``,
+  ``.length`` — crucially NOT ``+``, which concatenates strings in JS);
+* a plain string literal, or an ALL-CAPS const-map lookup with a
+  literal or const-map fallback (``COLORS[k]||"#888"``) — values come
+  from tables in the section source, not the payload;
+* a call to a function *defined in the section modules themselves*
+  (``fleetDiag``, ``sparkPath``, ``meter``, …): its body lives in the
+  same scanned source, so its own interpolations are checked at the
+  definition site — flagging there and trusting call sites is the
+  factorization that keeps one fix from needing N suppressions;
+* a local ``const``/``let`` whose every initializer in the module is
+  itself safe; a ternary / ``+``-concat / ``||``-fallback whose
+  branches are all safe; a nested template literal is a safe
+  *container* (its own ``${…}`` groups are scanned independently);
+  a ``….map(x=>`…`).join("…")`` row builder (same container logic).
+
+Interpolations inside a template literal assigned to ``…textContent =``
+or ``document.title =`` are exempt: those sinks never parse markup.
+
+For Python f-strings, trusted means: string/number literals, nested
+f-strings (containers — scanned on their own), ALL-CAPS module
+constants (authored code, e.g. ``CSS``, ``FLEET_JS``), attributes named
+``html``/``js``/``css`` (the ``Section`` fields holding module-authored
+markup — never payload data, by convention), ``esc()``-style calls and
+``theme.head()``, ``"".join(…)`` over trusted elements, and locals /
+parameters / same-module helper calls that resolve to trusted values.
+
+Anything else is flagged.  False positives are silenced inline with
+``# tracelint: rawhtml(reason)`` on the offending line — the reason is
+the reviewable claim that the value cannot carry attacker-controlled
+markup.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from traceml_tpu.analysis.common import (
+    Finding,
+    SEVERITY_ERROR,
+    SourceFile,
+)
+
+RULE_UNESCAPED_JS = "TLE001"
+RULE_UNESCAPED_FSTRING = "TLE002"
+
+#: modules scanned: the live browser section fragments
+SECTION_DIR_MARKER = "browser_sections"
+
+_SAFE_WRAPPERS = ("esc(", "encodeURIComponent(")
+_SAFE_FORMATTERS_RE = re.compile(r"^(pct|fmt[A-Z]\w*|fmt)\(")
+_TOFIXED_RE = re.compile(
+    r"^[\w$.\[\]()\s+\-*/%,]*\.toFixed\(\s*\d*\s*\)$"
+)
+_MATH_CHAIN_RE = re.compile(r"^Math\.\w+\(")
+_DATE_FMT_RE = re.compile(
+    r"^new\s+Date\([^`\"']*\)\s*\.\s*to(Locale\w*|ISOString|UTCString)\(\s*\)$"
+)
+_NUMERIC_RE = re.compile(r"^[\d\s+\-*/%().]+$")
+_STRING_LITERAL_RE = re.compile(r'^("[^"\\]*"|\'[^\'\\]*\')$')
+_CONST_MAP_RE = re.compile(
+    r"^[A-Z][A-Z0-9_]*\[[^\]]+\]\s*"
+    r"(\|\|\s*(\"[^\"`]*\"|'[^'`]*'|[A-Z][A-Z0-9_]*\.\w+))?$"
+)
+_MAP_JOIN_RE = re.compile(
+    r"^[^`\"']+\.map\(.*`.*\)\s*\.join\(\s*(\"[^\"]*\"|'[^']*'|``)\s*\)$",
+    re.S,
+)
+_IDENT_LENGTH_RE = re.compile(r"^[\w$.\[\]()|\s]+\.(length|size)$")
+
+#: JS function/arrow definitions — collected across ALL section modules
+#: (shared helpers live in theme.HELPERS_JS, used by every section)
+_JS_FN_DEF_RE = re.compile(r"\bfunction\s+([A-Za-z_$][\w$]*)\s*\(")
+_JS_ARROW_DEF_RE = re.compile(
+    r"\b(?:const|let|var)\s+([A-Za-z_$][\w$]*)\s*=\s*"
+    r"(?:\([^)`\"']*\)|[A-Za-z_$][\w$]*)\s*=>"
+)
+#: local binding sites: const/let/var NAME = … and NAME += …
+_JS_BINDING_RE = re.compile(
+    r"\b(?:(?:const|let|var)\s+)?([A-Za-z_$][\w$]*)\s*(\+?=)(?![=>])"
+)
+
+#: template literals assigned to these sinks never parse markup
+_SAFE_SINK_RE = re.compile(r"(?:\.textContent|document\.title)\s*=\s*$")
+
+_MAX_DEPTH = 8
+
+
+def _iter_interpolations(text: str) -> List[Tuple[int, str]]:
+    """Every ``${…}`` group in ``text`` (at any template nesting depth)
+    as (offset-of-``$``, expression)."""
+    out: List[Tuple[int, str]] = []
+    i = 0
+    n = len(text)
+    while i < n - 1:
+        if text[i] == "$" and text[i + 1] == "{":
+            depth = 1
+            j = i + 2
+            quote: Optional[str] = None
+            while j < n and depth > 0:
+                c = text[j]
+                if quote is not None:
+                    if c == "\\":
+                        j += 2
+                        continue
+                    if c == quote:
+                        quote = None
+                elif c in "\"'":
+                    quote = c
+                elif c == "{":
+                    depth += 1
+                elif c == "}":
+                    depth -= 1
+                j += 1
+            if depth == 0:
+                out.append((i, text[i + 2 : j - 1]))
+                i = i + 2  # rescan inside for nested groups
+            else:
+                break
+        else:
+            i += 1
+    return out
+
+
+def _outer_template_spans(text: str) -> List[Tuple[int, int]]:
+    """(start, end) offsets of OUTERMOST backtick template literals.
+    A template nested inside another template's ``${…}`` belongs to the
+    outer one's value, so the outer sink governs it."""
+    spans: List[Tuple[int, int]] = []
+    stack: List[str] = []  # '`' = template, '{' = ${ } expression
+    quote: Optional[str] = None
+    start = -1
+    i = 0
+    n = len(text)
+    while i < n:
+        c = text[i]
+        if quote is not None:
+            if c == "\\":
+                i += 2
+                continue
+            if c == quote:
+                quote = None
+            i += 1
+            continue
+        if not stack:
+            if c in "\"'":
+                quote = c
+            elif c == "`":
+                start = i
+                stack.append("`")
+            i += 1
+            continue
+        if stack[-1] == "`":
+            if c == "\\":
+                i += 2
+                continue
+            if c == "`":
+                stack.pop()
+                if not stack:
+                    spans.append((start, i))
+            elif c == "$" and i + 1 < n and text[i + 1] == "{":
+                stack.append("{")
+                i += 2
+                continue
+            i += 1
+        else:  # inside ${ } expression
+            if c in "\"'":
+                quote = c
+            elif c == "`":
+                stack.append("`")
+            elif c == "{":
+                stack.append("{")
+            elif c == "}":
+                stack.pop()
+            i += 1
+    return spans
+
+
+def _split_top(expr: str, sep: str) -> List[str]:
+    """Split on ``sep`` at paren/bracket/quote/backtick depth 0.
+    ``sep`` may be one or two chars (``+`` / ``||``)."""
+    parts: List[str] = []
+    depth = 0
+    quote: Optional[str] = None
+    last = 0
+    i = 0
+    n = len(expr)
+    w = len(sep)
+    while i < n:
+        c = expr[i]
+        if quote is not None:
+            if c == "\\":
+                i += 2
+                continue
+            if c == quote:
+                quote = None
+        elif c in "\"'`":
+            quote = c
+        elif c in "([{":
+            depth += 1
+        elif c in ")]}":
+            depth -= 1
+        elif depth == 0 and expr[i : i + w] == sep:
+            # don't split `+` inside `=>` arrows or `++`
+            if sep == "+" and (
+                (i > 0 and expr[i - 1] == "+") or expr[i + 1 : i + 2] == "+"
+            ):
+                i += 1
+                continue
+            parts.append(expr[last:i])
+            last = i + w
+            i += w
+            continue
+        i += 1
+    parts.append(expr[last:])
+    return parts
+
+
+def _split_ternary(expr: str) -> Optional[Tuple[str, str, str]]:
+    """``cond ? a : b`` split at depth 0, honoring nested ternaries."""
+    depth = 0
+    quote: Optional[str] = None
+    q_pos = -1
+    i = 0
+    n = len(expr)
+    while i < n:
+        c = expr[i]
+        if quote is not None:
+            if c == "\\":
+                i += 2
+                continue
+            if c == quote:
+                quote = None
+        elif c in "\"'`":
+            quote = c
+        elif c in "([{":
+            depth += 1
+        elif c in ")]}":
+            depth -= 1
+        elif depth == 0 and c == "?" and q_pos < 0:
+            # skip optional-chaining `?.` and nullish `??`
+            if expr[i + 1 : i + 2] not in (".", "?"):
+                q_pos = i
+        elif depth == 0 and c == ":" and q_pos >= 0:
+            return (expr[:q_pos], expr[q_pos + 1 : i], expr[i + 1 :])
+        i += 1
+    return None
+
+
+def _is_numeric_valued(e: str, depth: int = 0) -> bool:
+    """True when the JS expression provably evaluates to a number:
+    a top-level ``- * / %`` coerces both operands (unlike ``+``, which
+    concatenates strings), ``||`` is numeric iff every branch is, and
+    ``.length``/``.size`` chains are counts.  Quotes, backticks, and
+    ``+`` disqualify immediately."""
+    e = e.strip()
+    if not e or depth > 6:
+        return False
+    while e.startswith("(") and e.endswith(")") and _is_balanced(e[1:-1]):
+        e = e[1:-1].strip()
+    if _NUMERIC_RE.match(e):
+        return True
+    if any(c in e for c in "`\"'+"):
+        return False
+    parts = _split_top(e, "||")
+    if len(parts) > 1:
+        return all(_is_numeric_valued(p, depth + 1) for p in parts)
+    for op in ("*", "/", "%", "-"):
+        if len(_split_top(e, op)) > 1:
+            return True
+    if _IDENT_LENGTH_RE.match(e):
+        return True
+    return False
+
+
+def _is_balanced(expr: str) -> bool:
+    depth = 0
+    quote: Optional[str] = None
+    i = 0
+    n = len(expr)
+    while i < n:
+        c = expr[i]
+        if quote is not None:
+            if c == "\\":
+                i += 2
+                continue
+            if c == quote:
+                quote = None
+        elif c in "\"'`":
+            quote = c
+        elif c in "([{":
+            depth += 1
+        elif c in ")]}":
+            depth -= 1
+            if depth < 0:
+                return False
+        i += 1
+    return depth == 0
+
+
+class JsScope:
+    """Cross-module JS context for safety judgments: the names of
+    functions defined anywhere in the section modules, and this
+    module's local const/let bindings (name → initializer texts)."""
+
+    def __init__(
+        self,
+        fn_names: Set[str],
+        bindings: Dict[str, List[str]],
+    ) -> None:
+        self.fn_names = fn_names
+        self.bindings = bindings
+        self._memo: Dict[str, bool] = {}
+
+    def binding_safe(self, name: str, depth: int) -> bool:
+        if name in self._memo:
+            return self._memo[name]
+        inits = self.bindings.get(name)
+        if not inits:
+            return False
+        self._memo[name] = False  # cycle guard
+        ok = all(is_safe_expression(e, self, depth + 1) for e in inits)
+        self._memo[name] = ok
+        return ok
+
+
+_EMPTY_SCOPE = JsScope(set(), {})
+
+
+def collect_js_fn_names(texts: List[str]) -> Set[str]:
+    out: Set[str] = set()
+    for t in texts:
+        out.update(_JS_FN_DEF_RE.findall(t))
+        out.update(_JS_ARROW_DEF_RE.findall(t))
+    return out
+
+
+_JS_KEYWORDS = {
+    "if", "for", "while", "return", "new", "typeof", "in", "of",
+    "else", "switch", "case", "do", "try", "catch", "function",
+}
+
+
+def collect_js_bindings(text: str) -> Dict[str, List[str]]:
+    """``const/let NAME = init`` / ``NAME += init`` sites with the
+    initializer text up to the terminating ``;``/``}``/newline at
+    depth 0.  A name is later judged safe only if EVERY binding is."""
+    out: Dict[str, List[str]] = {}
+    for m in _JS_BINDING_RE.finditer(text):
+        name = m.group(1)
+        if name in _JS_KEYWORDS:
+            continue
+        i = m.end()
+        depth = 0
+        quote: Optional[str] = None
+        n = min(len(text), i + 2000)
+        j = i
+        while j < n:
+            c = text[j]
+            if quote is not None:
+                if c == "\\":
+                    j += 2
+                    continue
+                if c == quote:
+                    quote = None
+            elif c in "\"'`":
+                quote = c
+            elif c in "([{":
+                depth += 1
+            elif c in ")]}":
+                if depth == 0:
+                    break
+                depth -= 1
+            elif depth == 0 and c == ";":
+                break
+            j += 1
+        init = text[i:j].strip()
+        if init:
+            out.setdefault(name, []).append(init)
+    return out
+
+
+def is_safe_expression(
+    expr: str, scope: JsScope = _EMPTY_SCOPE, depth: int = 0
+) -> bool:
+    e = expr.strip()
+    if not e:
+        return True
+    if depth > _MAX_DEPTH:
+        return False
+    # strip redundant outer parens: (x?:"a":"b")
+    while (
+        e.startswith("(")
+        and e.endswith(")")
+        and _is_balanced(e[1:-1])
+    ):
+        e = e[1:-1].strip()
+    for w in _SAFE_WRAPPERS:
+        if e.startswith(w) and e.endswith(")"):
+            return True
+    if _SAFE_FORMATTERS_RE.match(e) and e.endswith(")"):
+        return True
+    if _TOFIXED_RE.match(e):
+        return True
+    if _MATH_CHAIN_RE.match(e) and "`" not in e:
+        return True
+    if _DATE_FMT_RE.match(e):
+        return True
+    if _NUMERIC_RE.match(e):
+        return True
+    if _is_numeric_valued(e):
+        return True
+    if _STRING_LITERAL_RE.match(e):
+        return True
+    if _CONST_MAP_RE.match(e):
+        return True
+    if _IDENT_LENGTH_RE.match(e):
+        return True
+    if e.startswith("`") and e.endswith("`"):
+        return True  # container: inner ${…} groups are scanned directly
+    if _MAP_JOIN_RE.match(e):
+        return True
+    # a call to a function defined in the section modules: its body is
+    # in the scanned source, so its interpolations are checked there
+    m = re.match(r"^([A-Za-z_$][\w$]*)\(", e)
+    if m and e.endswith(")") and m.group(1) in scope.fn_names:
+        return True
+    # a local const/let whose every initializer is safe
+    if re.match(r"^[A-Za-z_$][\w$]*$", e) and scope.binding_safe(e, depth):
+        return True
+    t = _split_ternary(e)
+    if t is not None:
+        _cond, a, b = t
+        return is_safe_expression(a, scope, depth + 1) and is_safe_expression(
+            b, scope, depth + 1
+        )
+    for sep in ("||", "+"):
+        parts = _split_top(e, sep)
+        if len(parts) > 1 and all(
+            is_safe_expression(p, scope, depth + 1) for p in parts
+        ):
+            return True
+    return False
+
+
+def _line_of_offset(node_line: int, text: str, offset: int) -> int:
+    return node_line + text[:offset].count("\n")
+
+
+def _scan_string_constant(
+    src: SourceFile,
+    node: ast.Constant,
+    scope: JsScope,
+    findings: List[Finding],
+) -> None:
+    text = node.value
+    safe_spans: List[Tuple[int, int]] = []
+    prev_end = -1
+    prev_safe = False
+    for start, end in _outer_template_spans(text):
+        prefix = text[max(0, start - 60) : start]
+        between = text[prev_end + 1 : start] if prev_end >= 0 else ""
+        safe = bool(_SAFE_SINK_RE.search(prefix)) or (
+            # `` `a ${x}` + `b ${y}` `` — a concat continuation of a
+            # template already flowing into a safe sink
+            prev_safe
+            and re.fullmatch(r"\s*\+\s*", between) is not None
+        )
+        if safe:
+            safe_spans.append((start, end))
+        prev_end, prev_safe = end, safe
+    for offset, expr in _iter_interpolations(text):
+        if any(s <= offset < e for s, e in safe_spans):
+            continue
+        if is_safe_expression(expr, scope):
+            continue
+        line = _line_of_offset(node.lineno, text, offset)
+        snippet = expr.strip().replace("\n", " ")
+        if len(snippet) > 60:
+            snippet = snippet[:57] + "..."
+        findings.append(
+            Finding(
+                rule=RULE_UNESCAPED_JS,
+                severity=SEVERITY_ERROR,
+                path=src.rel,
+                line=line,
+                message=(
+                    f"interpolation `${{{snippet}}}` reaches the DOM "
+                    f"without esc()/encodeURIComponent — wrap it, or "
+                    f"mark the line `# tracelint: rawhtml(reason)` if "
+                    f"the value provably cannot carry markup"
+                ),
+                key=(
+                    f"{RULE_UNESCAPED_JS}:{src.rel}:"
+                    f"{re.sub(r'[^A-Za-z0-9_.]+', '_', snippet)[:80]}"
+                ),
+            )
+        )
+
+
+# ---------------------------------------------------------------------------
+# TLE002: Python f-strings that assemble HTML pages
+# ---------------------------------------------------------------------------
+
+_HTML_TAG_RE = re.compile(r"<[a-zA-Z!/]")
+_SAFE_PY_CALLS = {"esc", "html_escape", "escape", "quote", "len", "head"}
+#: attribute names holding module-authored markup by convention
+#: (Section.html / Section.js are static strings written in the
+#: section modules themselves — never payload data)
+_TRUSTED_ATTRS = {"html", "js", "css"}
+_ALL_CAPS_RE = re.compile(r"^[A-Z][A-Z0-9_]*$")
+
+
+class PyModuleCtx:
+    """Per-module context for TLE002: local function defs, their call
+    sites, and memoized judgments for parameters and return values."""
+
+    def __init__(self, tree: ast.Module) -> None:
+        self.functions: Dict[str, ast.FunctionDef] = {}
+        self.calls: Dict[str, List[ast.Call]] = {}
+        self.enclosing: Dict[int, ast.FunctionDef] = {}
+        for node in tree.body:
+            if isinstance(node, ast.FunctionDef):
+                self.functions[node.name] = node
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Name
+            ):
+                self.calls.setdefault(node.func.id, []).append(node)
+            if isinstance(node, ast.FunctionDef):
+                for inner in ast.walk(node):
+                    self.enclosing.setdefault(id(inner), node)
+        self._ret_memo: Dict[str, bool] = {}
+        self._param_memo: Dict[Tuple[str, str], bool] = {}
+
+    def safe_returning(self, fname: str, depth: int) -> bool:
+        if fname in self._ret_memo:
+            return self._ret_memo[fname]
+        fn = self.functions.get(fname)
+        if fn is None or depth > _MAX_DEPTH:
+            return False
+        self._ret_memo[fname] = False  # cycle guard
+        rets = [
+            n
+            for n in ast.walk(fn)
+            if isinstance(n, ast.Return) and n.value is not None
+        ]
+        ok = bool(rets) and all(
+            _py_safe(r.value, self, fn, depth + 1) for r in rets
+        )
+        self._ret_memo[fname] = ok
+        return ok
+
+    def param_safe(self, fn: ast.FunctionDef, pname: str, depth: int) -> bool:
+        key = (fn.name, pname)
+        if key in self._param_memo:
+            return self._param_memo[key]
+        if depth > _MAX_DEPTH:
+            return False
+        self._param_memo[key] = False  # cycle guard
+        args = fn.args
+        names = [a.arg for a in args.args]
+        if pname not in names:
+            return False
+        idx = names.index(pname)
+        # the default, if any, must be safe
+        n_defaults = len(args.defaults)
+        if n_defaults and idx >= len(names) - n_defaults:
+            d = args.defaults[idx - (len(names) - n_defaults)]
+            if not _py_safe(d, self, fn, depth + 1):
+                return False
+        calls = self.calls.get(fn.name)
+        if not calls:
+            # never called in-module: only the default vouches for it
+            ok = bool(
+                n_defaults and idx >= len(names) - n_defaults
+            )
+            self._param_memo[key] = ok
+            return ok
+        for call in calls:
+            supplied = False
+            if idx < len(call.args):
+                if not _py_safe(call.args[idx], self, None, depth + 1):
+                    return False
+                supplied = True
+            for kw in call.keywords:
+                if kw.arg == pname:
+                    if not _py_safe(kw.value, self, None, depth + 1):
+                        return False
+                    supplied = True
+            if not supplied and not (
+                n_defaults and idx >= len(names) - n_defaults
+            ):
+                return False
+        self._param_memo[key] = True
+        return True
+
+
+def _py_safe(
+    node: ast.AST,
+    ctx: PyModuleCtx,
+    enclosing: Optional[ast.FunctionDef],
+    depth: int = 0,
+) -> bool:
+    if depth > _MAX_DEPTH:
+        return False
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.JoinedStr):
+        return True  # container: its own values are scanned separately
+    if isinstance(node, ast.Name):
+        if _ALL_CAPS_RE.match(node.id):
+            return True
+        fn = enclosing or ctx.enclosing.get(id(node))
+        if fn is not None:
+            assigns = []
+            for n in ast.walk(fn):
+                if isinstance(n, ast.Assign):
+                    for t in n.targets:
+                        if isinstance(t, ast.Name) and t.id == node.id:
+                            assigns.append(n.value)
+                elif isinstance(n, ast.AugAssign):
+                    if (
+                        isinstance(n.target, ast.Name)
+                        and n.target.id == node.id
+                    ):
+                        assigns.append(n.value)
+            if assigns and all(
+                _py_safe(v, ctx, fn, depth + 1) for v in assigns
+            ):
+                return True
+            if ctx.param_safe(fn, node.id, depth + 1):
+                return True
+        return False
+    if isinstance(node, ast.Attribute):
+        return (
+            _ALL_CAPS_RE.match(node.attr) is not None
+            or node.attr in _TRUSTED_ATTRS
+        )
+    if isinstance(node, ast.Call):
+        f = node.func
+        fname = (
+            f.id
+            if isinstance(f, ast.Name)
+            else f.attr if isinstance(f, ast.Attribute) else ""
+        )
+        if fname in _SAFE_PY_CALLS:
+            return True
+        if isinstance(f, ast.Name) and ctx.safe_returning(f.id, depth + 1):
+            return True
+        # "sep".join(<iterable of safe>)
+        if (
+            fname == "join"
+            and isinstance(f, ast.Attribute)
+            and isinstance(f.value, ast.Constant)
+            and len(node.args) == 1
+        ):
+            a = node.args[0]
+            if isinstance(a, (ast.GeneratorExp, ast.ListComp)):
+                return _py_safe(a.elt, ctx, enclosing, depth + 1)
+            return _py_safe(a, ctx, enclosing, depth + 1)
+        return False
+    if isinstance(node, ast.BinOp) and isinstance(
+        node.op, (ast.Add, ast.Mod)
+    ):
+        return _py_safe(node.left, ctx, enclosing, depth + 1) and _py_safe(
+            node.right, ctx, enclosing, depth + 1
+        )
+    if isinstance(node, ast.IfExp):
+        return _py_safe(node.body, ctx, enclosing, depth + 1) and _py_safe(
+            node.orelse, ctx, enclosing, depth + 1
+        )
+    if isinstance(node, (ast.List, ast.Tuple)):
+        return all(_py_safe(e, ctx, enclosing, depth + 1) for e in node.elts)
+    return False
+
+
+def _scan_fstring(
+    src: SourceFile,
+    node: ast.JoinedStr,
+    ctx: PyModuleCtx,
+    findings: List[Finding],
+) -> None:
+    literal_text = "".join(
+        part.value
+        for part in node.values
+        if isinstance(part, ast.Constant) and isinstance(part.value, str)
+    )
+    if not _HTML_TAG_RE.search(literal_text):
+        return
+    for part in node.values:
+        if not isinstance(part, ast.FormattedValue):
+            continue
+        if _py_safe(part.value, ctx, ctx.enclosing.get(id(part))):
+            continue
+        try:
+            expr_txt = ast.unparse(part.value)
+        except Exception:
+            expr_txt = "<expr>"
+        findings.append(
+            Finding(
+                rule=RULE_UNESCAPED_FSTRING,
+                severity=SEVERITY_ERROR,
+                path=src.rel,
+                line=part.lineno,
+                message=(
+                    f"f-string interpolates {{{expr_txt}}} into HTML "
+                    f"without esc() — escape it, or mark the line "
+                    f"`# tracelint: rawhtml(reason)`"
+                ),
+                key=(
+                    f"{RULE_UNESCAPED_FSTRING}:{src.rel}:"
+                    f"{re.sub(r'[^A-Za-z0-9_.]+', '_', expr_txt)[:80]}"
+                ),
+            )
+        )
+
+
+def _module_string_constants(tree: ast.Module) -> List[str]:
+    return [
+        node.value
+        for node in ast.walk(tree)
+        if isinstance(node, ast.Constant) and isinstance(node.value, str)
+    ]
+
+
+def run_escape_pass(files: List[SourceFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    section_files = [
+        src
+        for src in files
+        if SECTION_DIR_MARKER in src.rel and src.tree is not None
+    ]
+    # JS context comes from the string constants only (never Python
+    # code); shared helpers (theme.HELPERS_JS) are used by every
+    # section, so function names are collected across all modules
+    per_file_js = {
+        src.rel: _module_string_constants(src.tree) for src in section_files
+    }
+    fn_names = collect_js_fn_names(
+        [t for texts in per_file_js.values() for t in texts]
+    )
+    for src in section_files:
+        bindings: Dict[str, List[str]] = {}
+        for t in per_file_js[src.rel]:
+            for name, inits in collect_js_bindings(t).items():
+                bindings.setdefault(name, []).extend(inits)
+        scope = JsScope(fn_names, bindings)
+        ctx = PyModuleCtx(src.tree)
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Constant) and isinstance(
+                node.value, str
+            ):
+                _scan_string_constant(src, node, scope, findings)
+            elif isinstance(node, ast.JoinedStr):
+                _scan_fstring(src, node, ctx, findings)
+    return findings

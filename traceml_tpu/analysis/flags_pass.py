@@ -1,0 +1,312 @@
+"""Env-flag registry pass (rules ``TLF001``–``TLF004``).
+
+``traceml_tpu/config/flags.py`` is the single declared registry of
+every ``TRACEML_*`` environment variable: name, raw-string default, and
+a one-line doc.  This pass closes the loop mechanically:
+
+* ``TLF001`` (error) — a ``TRACEML_*`` string literal anywhere else in
+  the package that is not declared in the registry.  Covers reads
+  *and* writes (the launcher exporting an undeclared name into child
+  env is the same contract rot as reading one).
+* ``TLF002`` (error) — a declared flag whose doc line is empty.
+* ``TLF003`` (warning) — a declared flag referenced nowhere outside
+  ``flags.py``: neither by literal name nor through its flag object —
+  a dead kill switch nobody can trip.
+* ``TLF004`` (error) — an ``os.environ`` / ``os.getenv`` read of a
+  ``TRACEML_*`` name outside ``flags.py``: the read bypasses the
+  registry's defaults and typed coercion; call
+  ``<FLAG>.raw()/enabled()/truthy()/get_*()`` instead.
+
+Flag-object references are tracked through both import styles
+(``from traceml_tpu.config.flags import COLLECTIVES`` and
+``from traceml_tpu.config import flags; flags.COLLECTIVES``), so
+migrated call sites keep their flags "alive" without any literal.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set
+
+from traceml_tpu.analysis.common import (
+    Finding,
+    SEVERITY_ERROR,
+    SEVERITY_WARNING,
+    SourceFile,
+)
+
+RULE_UNDECLARED = "TLF001"
+RULE_UNDOCUMENTED = "TLF002"
+RULE_DEAD_FLAG = "TLF003"
+RULE_BYPASS_READ = "TLF004"
+
+_FLAG_NAME_RE = re.compile(r"^TRACEML_[A-Z0-9][A-Z0-9_]*$")
+_FLAGS_MODULE_SUFFIX = "config/flags.py"
+
+
+def _is_flags_module(src: SourceFile) -> bool:
+    return src.rel.endswith(_FLAGS_MODULE_SUFFIX)
+
+
+def parse_registry(src: SourceFile) -> Dict[str, Dict[str, object]]:
+    """``declare("NAME", default, "doc")`` calls → {name: {doc, line,
+    var}} where ``var`` is the module-level name the Flag is bound to."""
+    out: Dict[str, Dict[str, object]] = {}
+    if src.tree is None:
+        return out
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        call = node.value
+        if not (
+            isinstance(call, ast.Call)
+            and isinstance(call.func, ast.Name)
+            and call.func.id == "declare"
+        ):
+            continue
+        args = list(call.args)
+        for kw in call.keywords:
+            if kw.arg == "name":
+                args.insert(0, kw.value)
+            elif kw.arg == "doc":
+                args.append(kw.value)
+        if not args or not isinstance(args[0], ast.Constant):
+            continue
+        name = args[0].value
+        if not isinstance(name, str):
+            continue
+        doc = ""
+        if len(args) >= 3 and isinstance(args[2], ast.Constant):
+            doc = str(args[2].value or "")
+        var = None
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                var = tgt.id
+        out[name] = {"doc": doc.strip(), "line": node.lineno, "var": var}
+    return out
+
+
+def _env_read_call_names(node: ast.Call) -> Optional[ast.AST]:
+    """For ``os.getenv(X)`` / ``os.environ.get(X)``, the name arg."""
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        # os.getenv(X) / environ.get(X) / os.environ.get(X)
+        if fn.attr == "getenv":
+            if isinstance(fn.value, ast.Name) and fn.value.id == "os":
+                return node.args[0] if node.args else None
+        if fn.attr in ("get", "pop"):
+            recv = fn.value
+            if (
+                isinstance(recv, ast.Attribute)
+                and recv.attr == "environ"
+                and isinstance(recv.value, ast.Name)
+                and recv.value.id == "os"
+            ):
+                return node.args[0] if node.args else None
+            if isinstance(recv, ast.Name) and recv.id == "environ":
+                return node.args[0] if node.args else None
+    elif isinstance(fn, ast.Name) and fn.id == "getenv":
+        return node.args[0] if node.args else None
+    return None
+
+
+def _env_subscript_name(node: ast.Subscript) -> Optional[ast.AST]:
+    recv = node.value
+    if (
+        isinstance(recv, ast.Attribute)
+        and recv.attr == "environ"
+        and isinstance(recv.value, ast.Name)
+        and recv.value.id == "os"
+    ) or (isinstance(recv, ast.Name) and recv.id == "environ"):
+        return node.slice
+    return None
+
+
+class _ModuleScan(ast.NodeVisitor):
+    """Collects TRACEML_* literals, env-read sites, and flag-object
+    references in one module."""
+
+    def __init__(self, flag_vars: Dict[str, str]) -> None:
+        # module-level string constants, for resolving ENV_X = "TRACEML_X"
+        self.const_names: Dict[str, str] = {}
+        self.literals: List[tuple] = []        # (name, line)
+        self.env_reads: List[tuple] = []       # (name, line)
+        self.flag_vars = flag_vars             # var name → flag name
+        self.local_flag_vars: Dict[str, str] = {}  # imported alias → flag
+        self.flags_module_aliases: Set[str] = set()
+        self.flag_refs: Set[str] = set()       # flag names referenced
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        mod = node.module or ""
+        if mod.endswith("config.flags"):
+            for alias in node.names:
+                flag_name = self.flag_vars.get(alias.name)
+                if flag_name is not None:
+                    self.local_flag_vars[alias.asname or alias.name] = (
+                        flag_name
+                    )
+        elif mod.endswith("traceml_tpu.config") or mod == "config":
+            for alias in node.names:
+                if alias.name == "flags":
+                    self.flags_module_aliases.add(alias.asname or "flags")
+        self.generic_visit(node)
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            if alias.name.endswith("config.flags"):
+                self.flags_module_aliases.add(
+                    alias.asname or alias.name.split(".")[0]
+                )
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if isinstance(node.value, ast.Constant) and isinstance(
+            node.value.value, str
+        ):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    self.const_names[tgt.id] = node.value.value
+        self.generic_visit(node)
+
+    def visit_Constant(self, node: ast.Constant) -> None:
+        if isinstance(node.value, str) and _FLAG_NAME_RE.match(node.value):
+            self.literals.append((node.value, node.lineno))
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if isinstance(node.ctx, ast.Load):
+            flag = self.local_flag_vars.get(node.id)
+            if flag is not None:
+                self.flag_refs.add(flag)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if (
+            isinstance(node.value, ast.Name)
+            and node.value.id in self.flags_module_aliases
+        ):
+            flag = self.flag_vars.get(node.attr)
+            if flag is not None:
+                self.flag_refs.add(flag)
+        self.generic_visit(node)
+
+    def _resolve(self, arg: Optional[ast.AST]) -> Optional[tuple]:
+        if arg is None:
+            return None
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            return (arg.value, arg.lineno)
+        if isinstance(arg, ast.Name):
+            v = self.const_names.get(arg.id)
+            if v is not None:
+                return (v, arg.lineno)
+        return None
+
+    def visit_Call(self, node: ast.Call) -> None:
+        resolved = self._resolve(_env_read_call_names(node))
+        if resolved is not None and _FLAG_NAME_RE.match(resolved[0]):
+            self.env_reads.append(resolved)
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        if isinstance(node.ctx, ast.Load):
+            resolved = self._resolve(_env_subscript_name(node))
+            if resolved is not None and _FLAG_NAME_RE.match(resolved[0]):
+                self.env_reads.append(resolved)
+        self.generic_visit(node)
+
+
+def run_flags_pass(files: List[SourceFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    flags_src: Optional[SourceFile] = None
+    for src in files:
+        if _is_flags_module(src):
+            flags_src = src
+            break
+
+    registry: Dict[str, Dict[str, object]] = {}
+    flag_vars: Dict[str, str] = {}
+    if flags_src is not None:
+        registry = parse_registry(flags_src)
+        flag_vars = {
+            str(meta["var"]): name
+            for name, meta in registry.items()
+            if meta["var"]
+        }
+
+    # TLF002: declared but undocumented
+    for name, meta in sorted(registry.items()):
+        if not meta["doc"]:
+            findings.append(
+                Finding(
+                    rule=RULE_UNDOCUMENTED,
+                    severity=SEVERITY_ERROR,
+                    path=flags_src.rel,
+                    line=int(meta["line"]),
+                    message=(
+                        f"flag {name} is declared without a doc line — "
+                        f"every TRACEML_* variable must say what it does"
+                    ),
+                    key=f"{RULE_UNDOCUMENTED}:{name}",
+                )
+            )
+
+    referenced: Set[str] = set()
+    for src in files:
+        if src.tree is None or _is_flags_module(src):
+            continue
+        scan = _ModuleScan(flag_vars)
+        scan.visit(src.tree)
+        referenced.update(scan.flag_refs)
+        referenced.update(name for name, _line in scan.literals)
+
+        seen_undeclared: Set[str] = set()
+        for name, line in scan.literals:
+            if name not in registry and name not in seen_undeclared:
+                seen_undeclared.add(name)
+                findings.append(
+                    Finding(
+                        rule=RULE_UNDECLARED,
+                        severity=SEVERITY_ERROR,
+                        path=src.rel,
+                        line=line,
+                        message=(
+                            f"{name} is not declared in "
+                            f"traceml_tpu/config/flags.py — declare it "
+                            f"(name, default, doc) before use"
+                        ),
+                        key=f"{RULE_UNDECLARED}:{src.rel}:{name}",
+                    )
+                )
+        for name, line in scan.env_reads:
+            findings.append(
+                Finding(
+                    rule=RULE_BYPASS_READ,
+                    severity=SEVERITY_ERROR,
+                    path=src.rel,
+                    line=line,
+                    message=(
+                        f"direct environ read of {name} bypasses the "
+                        f"flag registry — use the declared Flag's "
+                        f".raw()/.enabled()/.truthy()/.get_*() accessor"
+                    ),
+                    key=f"{RULE_BYPASS_READ}:{src.rel}:{name}",
+                )
+            )
+
+    # TLF003: declared but referenced nowhere outside flags.py
+    for name, meta in sorted(registry.items()):
+        if name not in referenced:
+            findings.append(
+                Finding(
+                    rule=RULE_DEAD_FLAG,
+                    severity=SEVERITY_WARNING,
+                    path=flags_src.rel,
+                    line=int(meta["line"]),
+                    message=(
+                        f"flag {name} is declared but never referenced "
+                        f"outside the registry — dead flag (delete the "
+                        f"declaration or wire the feature)"
+                    ),
+                    key=f"{RULE_DEAD_FLAG}:{name}",
+                )
+            )
+    return findings

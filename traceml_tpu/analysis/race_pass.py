@@ -1,0 +1,351 @@
+"""Lock-discipline race detector (rules ``TLR001``/``TLR002``).
+
+The project's processes are full of long-lived threads (TCP selector,
+group-commit writer, publisher shards, the runtime agent tick loop),
+and the r12 exactly-once / r13 delta-protocol guarantees hinge on
+per-class lock discipline that historically lived only in reviewers'
+heads.  This pass mechanizes it, per class:
+
+1. **Lock discovery** — ``self.<attr> = threading.Lock/RLock/
+   Condition(...)`` (bare ``Lock()`` from ``from threading import
+   Lock`` counts too) marks ``<attr>`` as a lock attribute.
+2. **Guarded-set inference** — any instance attribute *written* inside
+   a ``with self.<lock>:`` body (outside ``__init__``) is considered
+   lock-guarded: somebody, somewhere, thought that write needed the
+   lock.
+3. **Entry points** — methods handed to ``threading.Thread(target=…)``
+   / ``threading.Timer(…)`` are thread entries; everything reachable
+   from them through intra-class ``self.…()`` calls runs on that
+   thread.  Additionally, in a class that owns a lock, every *public*
+   method (no ``_`` prefix) is treated as a potential cross-thread
+   entry — a lock in the class is evidence its API is called
+   concurrently (the aggregator's consumer thread calling
+   ``TCPServer.drain`` while the selector thread appends is exactly
+   the shape this catches).
+4. **Findings** — a read (``TLR002``, warning) or write (``TLR001``,
+   error) of a guarded attribute outside any lock, in a reachable
+   method, is flagged.  Helper methods whose every intra-class call
+   site already holds the lock are recognized as lock-held helpers
+   (fixpoint over the call graph) and not flagged.
+
+Known limits (documented in docs/developer_guide/static-analysis.md):
+module-level locks and cross-class reachability are out of scope;
+``.acquire()``/``.release()`` pairing is not tracked — a method that
+manually acquires any lock is treated as fully locked.  Escape hatches:
+``# tracelint: unguarded(reason)`` on the access line, or the baseline
+file for pre-existing findings.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+from traceml_tpu.analysis.common import (
+    Finding,
+    SEVERITY_ERROR,
+    SEVERITY_WARNING,
+    SourceFile,
+)
+
+RULE_UNGUARDED_WRITE = "TLR001"
+RULE_UNGUARDED_READ = "TLR002"
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+_THREAD_FACTORIES = {"Thread", "Timer"}
+
+
+def _is_self_attr(node: ast.AST) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _is_lock_factory(call: ast.AST) -> bool:
+    if not isinstance(call, ast.Call):
+        return False
+    fn = call.func
+    if isinstance(fn, ast.Attribute) and fn.attr in _LOCK_FACTORIES:
+        return isinstance(fn.value, ast.Name) and fn.value.id == "threading"
+    if isinstance(fn, ast.Name) and fn.id in _LOCK_FACTORIES:
+        return True
+    return False
+
+
+def _thread_target_methods(call: ast.Call) -> List[str]:
+    """Method names passed as thread entry points to Thread/Timer."""
+    fn = call.func
+    name = None
+    if isinstance(fn, ast.Attribute):
+        name = fn.attr
+    elif isinstance(fn, ast.Name):
+        name = fn.id
+    if name not in _THREAD_FACTORIES:
+        return []
+    out = []
+    candidates = list(call.args)
+    for kw in call.keywords:
+        if kw.arg in ("target", "function"):
+            candidates.append(kw.value)
+    for c in candidates:
+        attr = _is_self_attr(c)
+        if attr is not None:
+            out.append(attr)
+    return out
+
+
+@dataclasses.dataclass
+class _Access:
+    attr: str
+    write: bool
+    line: int
+    locked: bool
+
+
+@dataclasses.dataclass
+class _MethodInfo:
+    name: str
+    accesses: List[_Access] = dataclasses.field(default_factory=list)
+    #: (callee, call-site-holds-lock)
+    calls: List[Tuple[str, bool]] = dataclasses.field(default_factory=list)
+    manual_lock_ops: bool = False
+
+
+class _MethodVisitor(ast.NodeVisitor):
+    """Collects self-attribute accesses and self-calls with the
+    lock-held flag at each site."""
+
+    def __init__(self, lock_attrs: Set[str], method_names: Set[str]) -> None:
+        self.lock_attrs = lock_attrs
+        self.method_names = method_names
+        self.info: Optional[_MethodInfo] = None
+        self._depth = 0
+
+    def run(self, fn: ast.AST, name: str) -> _MethodInfo:
+        self.info = _MethodInfo(name=name)
+        self._depth = 0
+        for stmt in getattr(fn, "body", []):
+            self.visit(stmt)
+        return self.info
+
+    # -- lock contexts -------------------------------------------------
+    def visit_With(self, node: ast.With) -> None:
+        takes_lock = 0
+        for item in node.items:
+            attr = _is_self_attr(item.context_expr)
+            if attr is not None and attr in self.lock_attrs:
+                takes_lock += 1
+            else:
+                self.visit(item.context_expr)
+            if item.optional_vars is not None:
+                self.visit(item.optional_vars)
+        self._depth += takes_lock
+        for stmt in node.body:
+            self.visit(stmt)
+        self._depth -= takes_lock
+
+    # nested defs run later, on an unknown thread, without this lock
+    def _visit_nested(self, node: ast.AST) -> None:
+        saved = self._depth
+        self._depth = 0
+        for stmt in getattr(node, "body", []):
+            self.visit(stmt)
+        self._depth = saved
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._visit_nested(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._visit_nested(node)
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        saved = self._depth
+        self._depth = 0
+        self.visit(node.body)
+        self._depth = saved
+
+    # -- accesses and calls -------------------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        assert self.info is not None
+        fn = node.func
+        attr = _is_self_attr(fn)
+        if attr is not None:
+            if attr in self.method_names:
+                self.info.calls.append((attr, self._depth > 0))
+                # fall through: don't record the method name as a data
+                # attribute access
+                for a in node.args:
+                    self.visit(a)
+                for kw in node.keywords:
+                    self.visit(kw.value)
+                return
+        # self._lock.acquire()/release(): manual pairing is untracked —
+        # treat the whole method as locked rather than guess wrong
+        if (
+            isinstance(fn, ast.Attribute)
+            and fn.attr in ("acquire", "release", "wait", "notify", "notify_all")
+        ):
+            inner = _is_self_attr(fn.value)
+            if inner is not None and inner in self.lock_attrs:
+                if fn.attr in ("acquire", "release"):
+                    self.info.manual_lock_ops = True
+                for a in node.args:
+                    self.visit(a)
+                for kw in node.keywords:
+                    self.visit(kw.value)
+                return
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        assert self.info is not None
+        attr = _is_self_attr(node)
+        if attr is not None and attr not in self.lock_attrs:
+            write = isinstance(node.ctx, (ast.Store, ast.Del))
+            self.info.accesses.append(
+                _Access(attr, write, node.lineno, self._depth > 0)
+            )
+        self.generic_visit(node)
+
+
+def _class_lock_attrs(cls: ast.ClassDef) -> Set[str]:
+    locks: Set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and _is_lock_factory(node.value):
+            for tgt in node.targets:
+                attr = _is_self_attr(tgt)
+                if attr is not None:
+                    locks.add(attr)
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            if _is_lock_factory(node.value):
+                attr = _is_self_attr(node.target)
+                if attr is not None:
+                    locks.add(attr)
+    return locks
+
+
+def _analyze_class(
+    src: SourceFile, cls: ast.ClassDef
+) -> List[Finding]:
+    lock_attrs = _class_lock_attrs(cls)
+    if not lock_attrs:
+        return []
+
+    methods: Dict[str, ast.AST] = {}
+    for stmt in cls.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            methods[stmt.name] = stmt
+    method_names = set(methods)
+
+    visitor = _MethodVisitor(lock_attrs, method_names)
+    infos: Dict[str, _MethodInfo] = {
+        name: visitor.run(fn, name) for name, fn in methods.items()
+    }
+
+    # guarded set: attributes somebody writes while holding a lock
+    guarded: Set[str] = set()
+    for name, info in infos.items():
+        if name == "__init__":
+            continue
+        for acc in info.accesses:
+            if acc.write and acc.locked:
+                guarded.add(acc.attr)
+    if not guarded:
+        return []
+
+    # thread entry points: explicit Thread/Timer targets anywhere in
+    # the class, plus every public method (lock ⇒ concurrent API)
+    entries: Set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Call):
+            for m in _thread_target_methods(node):
+                if m in method_names:
+                    entries.add(m)
+    for name in method_names:
+        if not name.startswith("_") or name == "run":
+            entries.add(name)
+
+    # reachability over intra-class calls
+    reachable: Set[str] = set()
+    stack = [e for e in entries if e in infos]
+    while stack:
+        m = stack.pop()
+        if m in reachable:
+            continue
+        reachable.add(m)
+        for callee, _locked in infos[m].calls:
+            if callee in infos and callee not in reachable:
+                stack.append(callee)
+
+    # lock-held helpers: every intra-class call site holds the lock
+    # (directly or via an already-locked caller); entry points are
+    # callable from outside and never qualify
+    call_sites: Dict[str, List[Tuple[str, bool]]] = {}
+    for caller, info in infos.items():
+        for callee, locked in info.calls:
+            call_sites.setdefault(callee, []).append((caller, locked))
+    locked_methods: Set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for name in method_names:
+            if name in locked_methods or name in entries:
+                continue
+            sites = call_sites.get(name)
+            if not sites:
+                continue
+            if all(
+                locked or caller in locked_methods for caller, locked in sites
+            ):
+                locked_methods.add(name)
+                changed = True
+
+    findings: List[Finding] = []
+    for name in sorted(reachable):
+        if name in ("__init__", "__del__") or name in locked_methods:
+            continue
+        info = infos[name]
+        if info.manual_lock_ops:
+            continue
+        seen: Set[Tuple[str, bool]] = set()
+        for acc in info.accesses:
+            if acc.locked or acc.attr not in guarded:
+                continue
+            dedup = (acc.attr, acc.write)
+            if dedup in seen:
+                continue
+            seen.add(dedup)
+            rule = RULE_UNGUARDED_WRITE if acc.write else RULE_UNGUARDED_READ
+            verb = "written" if acc.write else "read"
+            findings.append(
+                Finding(
+                    rule=rule,
+                    severity=(
+                        SEVERITY_ERROR if acc.write else SEVERITY_WARNING
+                    ),
+                    path=src.rel,
+                    line=acc.line,
+                    message=(
+                        f"'{cls.name}.{acc.attr}' is lock-guarded elsewhere "
+                        f"but {verb} without a lock in thread-reachable "
+                        f"method '{name}'"
+                    ),
+                    key=f"{rule}:{src.rel}:{cls.name}.{name}:{acc.attr}",
+                )
+            )
+    return findings
+
+
+def run_race_pass(files: List[SourceFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    for src in files:
+        if src.tree is None:
+            continue
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ClassDef):
+                findings.extend(_analyze_class(src, node))
+    return findings

@@ -1,0 +1,192 @@
+"""``traceml lint`` orchestration: run the four passes, apply
+suppressions and the baseline, format text/JSON, pick the exit code.
+
+The gate's contract (CI relies on it):
+
+* exit 0 — no *new* error findings (baselined errors and warnings do
+  not fail the gate);
+* exit 1 — at least one error finding whose key is not in the
+  baseline;
+* exit 2 — the analyzer itself failed (unparseable package, bad args).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from traceml_tpu.analysis.common import (
+    Finding,
+    SEVERITY_ERROR,
+    apply_suppressions,
+    load_baseline,
+    save_baseline,
+    walk_package,
+)
+from traceml_tpu.analysis.escape_pass import run_escape_pass
+from traceml_tpu.analysis.flags_pass import run_flags_pass
+from traceml_tpu.analysis.race_pass import run_race_pass
+from traceml_tpu.analysis.wiring_pass import run_wiring_pass
+
+PASSES = ("race", "wiring", "flags", "escape")
+
+#: default baseline location: repo root, next to pyproject.toml
+BASELINE_FILENAME = "tracelint_baseline.json"
+
+
+def default_package_root() -> Path:
+    return Path(__file__).resolve().parents[1]
+
+
+def default_baseline_path(package_root: Optional[Path] = None) -> Path:
+    root = package_root or default_package_root()
+    return root.parent / BASELINE_FILENAME
+
+
+def run_passes(
+    package_root: Path, passes: Optional[List[str]] = None
+) -> List[Finding]:
+    """All findings from the selected passes, suppressions applied."""
+    selected = list(PASSES if passes is None else passes)
+    files = walk_package(package_root)
+    files_by_rel = {f.rel: f for f in files}
+
+    findings: List[Finding] = []
+    for src in files:
+        if src.parse_error is not None:
+            findings.append(
+                Finding(
+                    rule="TLX000",
+                    severity=SEVERITY_ERROR,
+                    path=src.rel,
+                    line=1,
+                    message=f"file does not parse: {src.parse_error}",
+                    key=f"TLX000:{src.rel}",
+                )
+            )
+    if "race" in selected:
+        findings.extend(run_race_pass(files))
+    if "wiring" in selected:
+        findings.extend(run_wiring_pass(package_root))
+    if "flags" in selected:
+        findings.extend(run_flags_pass(files))
+    if "escape" in selected:
+        findings.extend(run_escape_pass(files))
+
+    apply_suppressions(findings, files_by_rel)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.key))
+    return findings
+
+
+def summarize(
+    findings: List[Finding], baseline: Dict[str, str]
+) -> Dict[str, object]:
+    errors = [
+        f for f in findings if f.severity == SEVERITY_ERROR and not f.suppressed
+    ]
+    new_errors = [f for f in errors if f.key not in baseline]
+    warnings = [
+        f
+        for f in findings
+        if f.severity != SEVERITY_ERROR and not f.suppressed
+    ]
+    suppressed = [f for f in findings if f.suppressed]
+    stale_baseline = sorted(
+        set(baseline) - {f.key for f in errors}
+    )
+    return {
+        "errors": errors,
+        "new_errors": new_errors,
+        "warnings": warnings,
+        "suppressed": suppressed,
+        "stale_baseline_keys": stale_baseline,
+    }
+
+
+def run_lint(
+    package_root: Optional[Path] = None,
+    passes: Optional[List[str]] = None,
+    fmt: str = "text",
+    baseline_path: Optional[Path] = None,
+    update_baseline: bool = False,
+    show_suppressed: bool = False,
+    out=None,
+) -> int:
+    """The ``traceml lint`` entry point (also ``python -m
+    traceml_tpu.analysis``).  Returns the process exit code."""
+    import sys
+
+    out = out or sys.stdout
+    root = package_root or default_package_root()
+    if not root.is_dir():
+        print(f"traceml lint: package root not found: {root}", file=out)
+        return 2
+    bl_path = baseline_path or default_baseline_path(root)
+
+    t0 = time.monotonic()
+    findings = run_passes(root, passes)
+    elapsed = time.monotonic() - t0
+
+    if update_baseline:
+        save_baseline(bl_path, findings)
+        print(
+            f"baseline written: {bl_path} "
+            f"({sum(1 for f in findings if f.severity == SEVERITY_ERROR and not f.suppressed)} error key(s))",
+            file=out,
+        )
+        return 0
+
+    baseline = load_baseline(bl_path)
+    summary = summarize(findings, baseline)
+    new_errors: List[Finding] = summary["new_errors"]  # type: ignore[assignment]
+
+    if fmt == "json":
+        payload = {
+            "version": 1,
+            "package_root": str(root),
+            "elapsed_sec": round(elapsed, 3),
+            "counts": {
+                "errors": len(summary["errors"]),        # type: ignore[arg-type]
+                "new_errors": len(new_errors),
+                "baselined_errors": (
+                    len(summary["errors"]) - len(new_errors)  # type: ignore[arg-type]
+                ),
+                "warnings": len(summary["warnings"]),    # type: ignore[arg-type]
+                "suppressed": len(summary["suppressed"]),  # type: ignore[arg-type]
+            },
+            "findings": [f.to_dict() for f in findings],
+            "new_error_keys": [f.key for f in new_errors],
+            "stale_baseline_keys": summary["stale_baseline_keys"],
+        }
+        print(json.dumps(payload, indent=2), file=out)
+    else:
+        shown = [
+            f
+            for f in findings
+            if show_suppressed or not f.suppressed
+        ]
+        for f in shown:
+            marker = (
+                ""
+                if f.severity != SEVERITY_ERROR or f.suppressed
+                else (" [baselined]" if f.key in baseline else " [NEW]")
+            )
+            print(f.format_text() + marker, file=out)
+        print(
+            f"traceml lint: {len(summary['errors'])} error(s) "          # type: ignore[arg-type]
+            f"({len(new_errors)} new, "
+            f"{len(summary['errors']) - len(new_errors)} baselined), "   # type: ignore[arg-type]
+            f"{len(summary['warnings'])} warning(s), "                   # type: ignore[arg-type]
+            f"{len(summary['suppressed'])} suppressed "                  # type: ignore[arg-type]
+            f"in {elapsed:.2f}s",
+            file=out,
+        )
+        if summary["stale_baseline_keys"]:
+            print(
+                f"note: {len(summary['stale_baseline_keys'])} baseline "  # type: ignore[arg-type]
+                f"key(s) no longer fire — run --update-baseline to prune",
+                file=out,
+            )
+    return 1 if new_errors else 0

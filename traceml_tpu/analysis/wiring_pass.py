@@ -1,0 +1,345 @@
+"""Domain-wiring contract checker (rules ``TLW001``/``TLW002``/``TLW000``).
+
+A telemetry domain is not "done" when its sampler lands — rounds 9–14
+established a hard cross-file contract: sampler → v2 wire → watermark-
+retained SQLite writer → snapshot-store cursor/version → columnar ring →
+renderer fragment → diagnostics package → DIAGNOSIS.md entry.  This pass
+parses each layer's registry *as source* (AST / markdown, zero imports)
+and reports any domain present in one layer but missing from another.
+
+Layers parsed:
+
+========== ===========================================================
+sampler     ``SamplerSpec("<key>", …)`` calls in
+            ``runtime/sampler_registry.py`` (+ the explicitly wired
+            ``stdout_stderr`` sampler)
+writer      module names in ``ALL_WRITERS`` of
+            ``aggregator/sqlite_writers/__init__.py`` (``_writer``
+            suffix stripped)
+store       the ``DOMAINS`` tuple in ``reporting/snapshot_store.py``
+ring        ``class <Name>Columns`` definitions in ``utils/columnar.py``
+fragment    ``_FRAGMENT_KEYS`` dict keys in ``renderers/web_payload.py``
+diag_pkg    subdirectories of ``diagnostics/``
+diagnosis   ``## <Title>`` headings in ``diagnostics/DIAGNOSIS.md``
+========== ===========================================================
+
+The expected shape lives in :data:`CONTRACT` — every canonical domain
+names the layers it must appear in.  Adding a domain to any layer
+without declaring it here is ``TLW001``; declaring it but missing a
+required layer is ``TLW002``.  The contract is code on purpose: the
+diff that adds a domain must also state, reviewably, how far it is
+wired.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Dict, List, Optional, Set, Tuple
+
+from traceml_tpu.analysis.common import Finding, SEVERITY_ERROR
+
+RULE_LAYER_UNPARSEABLE = "TLW000"
+RULE_UNDECLARED_DOMAIN = "TLW001"
+RULE_MISSING_LAYER = "TLW002"
+
+LAYERS = (
+    "sampler", "writer", "store", "ring", "fragment", "diag_pkg", "diagnosis"
+)
+
+#: canonical domain → layers it must be wired through.  ``topology``
+#: ships as a control message (no sampler) and rides the payload meta
+#: fragment (no fragment key of its own); ``stdout`` has no ring or
+#: diagnosis; ``model_stats`` is a store-side join fed by control
+#: messages; ``liveness`` is aggregator-side only (rank_status.json →
+#: diagnostics), with no sampler/writer/ring/fragment.
+CONTRACT: Dict[str, Set[str]] = {
+    "step_time": {
+        "sampler", "writer", "store", "ring", "fragment", "diag_pkg",
+        "diagnosis",
+    },
+    "step_memory": {
+        "sampler", "writer", "store", "ring", "fragment", "diag_pkg",
+        "diagnosis",
+    },
+    "collectives": {
+        "sampler", "writer", "store", "ring", "fragment", "diag_pkg",
+        "diagnosis",
+    },
+    "system": {"sampler", "writer", "store", "fragment", "diag_pkg",
+               "diagnosis"},
+    "process": {"sampler", "writer", "store", "fragment", "diag_pkg",
+                "diagnosis"},
+    "stdout": {"sampler", "writer", "store", "fragment"},
+    "topology": {"writer", "store"},
+    "model_stats": {"store"},
+    "liveness": {"diag_pkg", "diagnosis"},
+}
+
+#: per-layer translation of layer-local names to canonical domains
+ALIASES: Dict[str, Dict[str, str]] = {
+    "sampler": {"stdout_stderr": "stdout"},
+    "writer": {"mesh_topology": "topology"},
+    "ring": {"memory": "step_memory"},
+    "fragment": {"memory": "step_memory"},
+}
+
+#: layer names that are infrastructure, not domains
+IGNORED: Dict[str, Set[str]] = {
+    "fragment": {"header", "meta", "diagnosis"},
+    "diag_pkg": {"__pycache__"},
+    "diagnosis": set(),
+}
+
+#: layer → file parsed (relative to the package root)
+LAYER_FILES: Dict[str, str] = {
+    "sampler": "runtime/sampler_registry.py",
+    "writer": "aggregator/sqlite_writers/__init__.py",
+    "store": "reporting/snapshot_store.py",
+    "ring": "utils/columnar.py",
+    "fragment": "renderers/web_payload.py",
+    "diag_pkg": "diagnostics",
+    "diagnosis": "diagnostics/DIAGNOSIS.md",
+}
+
+
+def _parse(path: Path) -> Optional[ast.Module]:
+    try:
+        return ast.parse(path.read_text(encoding="utf-8"), filename=str(path))
+    except (OSError, SyntaxError):
+        return None
+
+
+def _parse_sampler_layer(path: Path) -> Optional[Set[str]]:
+    tree = _parse(path)
+    if tree is None:
+        return None
+    keys: Set[str] = set()
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "SamplerSpec"
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            keys.add(node.args[0].value)
+    return keys or None
+
+
+def _parse_writer_layer(path: Path) -> Optional[Set[str]]:
+    tree = _parse(path)
+    if tree is None:
+        return None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            names = [
+                t.id for t in node.targets if isinstance(t, ast.Name)
+            ]
+            if "ALL_WRITERS" in names and isinstance(
+                node.value, (ast.List, ast.Tuple)
+            ):
+                out = set()
+                for elt in node.value.elts:
+                    if isinstance(elt, ast.Name):
+                        out.add(re.sub(r"_writer$", "", elt.id))
+                    elif isinstance(elt, ast.Attribute):
+                        out.add(re.sub(r"_writer$", "", elt.attr))
+                return out or None
+    return None
+
+
+def _parse_store_layer(path: Path) -> Optional[Set[str]]:
+    tree = _parse(path)
+    if tree is None:
+        return None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            names = [t.id for t in node.targets if isinstance(t, ast.Name)]
+            if "DOMAINS" in names and isinstance(
+                node.value, (ast.Tuple, ast.List)
+            ):
+                out = {
+                    e.value
+                    for e in node.value.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str)
+                }
+                return out or None
+    return None
+
+
+def _snake(name: str) -> str:
+    return re.sub(r"(?<!^)(?=[A-Z])", "_", name).lower()
+
+
+def _parse_ring_layer(path: Path) -> Optional[Set[str]]:
+    tree = _parse(path)
+    if tree is None:
+        return None
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name.endswith("Columns"):
+            out.add(_snake(node.name[: -len("Columns")]))
+    return out or None
+
+
+def _parse_fragment_layer(path: Path) -> Optional[Set[str]]:
+    tree = _parse(path)
+    if tree is None:
+        return None
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = (
+                node.targets
+                if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            names = [t.id for t in targets if isinstance(t, ast.Name)]
+            if "_FRAGMENT_KEYS" in names and isinstance(
+                node.value, ast.Dict
+            ):
+                out = {
+                    k.value
+                    for k in node.value.keys
+                    if isinstance(k, ast.Constant) and isinstance(k.value, str)
+                }
+                return out or None
+    return None
+
+
+def _parse_diag_pkg_layer(path: Path) -> Optional[Set[str]]:
+    if not path.is_dir():
+        return None
+    return {
+        p.name
+        for p in path.iterdir()
+        if p.is_dir() and (p / "__init__.py").exists()
+    } or None
+
+
+#: DIAGNOSIS.md section title → canonical domain
+_DIAGNOSIS_TITLES = {
+    "step time": "step_time",
+    "step memory": "step_memory",
+    "collectives": "collectives",
+    "system": "system",
+    "process": "process",
+    "liveness": "liveness",
+}
+
+
+def _parse_diagnosis_layer(path: Path) -> Optional[Set[str]]:
+    try:
+        text = path.read_text(encoding="utf-8")
+    except OSError:
+        return None
+    out: Set[str] = set()
+    for m in re.finditer(r"^##\s+([^(\n]+)", text, re.M):
+        title = m.group(1).strip().lower()
+        domain = _DIAGNOSIS_TITLES.get(title)
+        if domain is None:
+            # unknown headings ("Run-level promotion", …) are prose, but
+            # a heading that snake-cases onto a contract domain counts
+            slug = re.sub(r"\W+", "_", title).strip("_")
+            domain = slug if slug in CONTRACT else None
+        if domain is not None:
+            out.add(domain)
+    return out or None
+
+
+_PARSERS = {
+    "sampler": _parse_sampler_layer,
+    "writer": _parse_writer_layer,
+    "store": _parse_store_layer,
+    "ring": _parse_ring_layer,
+    "fragment": _parse_fragment_layer,
+    "diag_pkg": _parse_diag_pkg_layer,
+    "diagnosis": _parse_diagnosis_layer,
+}
+
+
+def run_wiring_pass(
+    package_root: Path,
+    contract: Optional[Dict[str, Set[str]]] = None,
+    layer_files: Optional[Dict[str, str]] = None,
+) -> List[Finding]:
+    """Check every layer registry against :data:`CONTRACT` (overridable
+    for fixture trees in tests)."""
+    contract = CONTRACT if contract is None else contract
+    layer_files = LAYER_FILES if layer_files is None else layer_files
+    pkg_rel = package_root.name
+    findings: List[Finding] = []
+    parsed: Dict[str, Tuple[str, Set[str]]] = {}
+
+    for layer, rel in layer_files.items():
+        path = package_root / rel
+        rel_repo = f"{pkg_rel}/{rel}"
+        result = _PARSERS[layer](path)
+        if result is None:
+            findings.append(
+                Finding(
+                    rule=RULE_LAYER_UNPARSEABLE,
+                    severity=SEVERITY_ERROR,
+                    path=rel_repo,
+                    line=1,
+                    message=(
+                        f"wiring layer '{layer}' could not be parsed from "
+                        f"{rel} (file missing, syntax error, or registry "
+                        f"structure changed — update analysis/wiring_pass.py)"
+                    ),
+                    key=f"{RULE_LAYER_UNPARSEABLE}:{rel_repo}:{layer}",
+                )
+            )
+            continue
+        aliases = ALIASES.get(layer, {})
+        ignored = IGNORED.get(layer, set())
+        canonical = {
+            aliases.get(name, name)
+            for name in result
+            if name not in ignored
+        }
+        parsed[layer] = (rel_repo, canonical)
+
+    # TLW001: a layer carries a domain the contract has never heard of
+    for layer, (rel_repo, domains) in sorted(parsed.items()):
+        for d in sorted(domains - set(contract)):
+            findings.append(
+                Finding(
+                    rule=RULE_UNDECLARED_DOMAIN,
+                    severity=SEVERITY_ERROR,
+                    path=rel_repo,
+                    line=1,
+                    message=(
+                        f"domain '{d}' appears in the {layer} layer but is "
+                        f"not declared in the wiring contract "
+                        f"(analysis/wiring_pass.py CONTRACT) — declare it "
+                        f"and wire the remaining layers"
+                    ),
+                    key=f"{RULE_UNDECLARED_DOMAIN}:{layer}:{d}",
+                )
+            )
+
+    # TLW002: the contract requires a layer the domain is missing from
+    for domain, required in sorted(contract.items()):
+        for layer in sorted(required):
+            if layer not in parsed:
+                continue  # TLW000 already reported
+            rel_repo, domains = parsed[layer]
+            if domain not in domains:
+                findings.append(
+                    Finding(
+                        rule=RULE_MISSING_LAYER,
+                        severity=SEVERITY_ERROR,
+                        path=rel_repo,
+                        line=1,
+                        message=(
+                            f"domain '{domain}' is declared in the wiring "
+                            f"contract but missing from the {layer} layer "
+                            f"({LAYER_FILES.get(layer, layer)})"
+                        ),
+                        key=f"{RULE_MISSING_LAYER}:{layer}:{domain}",
+                    )
+                )
+    return findings

@@ -50,7 +50,9 @@ import signal
 import threading
 from typing import Any, Dict, List, Optional
 
-ENV_FAULT_PLAN = "TRACEML_FAULT_PLAN"
+from traceml_tpu.config import flags
+
+ENV_FAULT_PLAN = flags.FAULT_PLAN.name
 
 #: Known points — call sites assert membership in tests so a typo in a
 #: plan or a call site can't silently never fire.
@@ -137,7 +139,7 @@ def parse_plan(text: str) -> FaultPlan:
 # children, and a mid-process env edit changing fault behavior would
 # break the determinism the harness exists for.
 _PLAN: Optional[FaultPlan] = None
-_plan_text = os.environ.get(ENV_FAULT_PLAN)
+_plan_text = flags.FAULT_PLAN.raw()
 if _plan_text:
     try:
         _PLAN = parse_plan(_plan_text)

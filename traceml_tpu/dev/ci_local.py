@@ -32,6 +32,8 @@ import sys
 import time
 from pathlib import Path
 
+from traceml_tpu.config import flags
+
 REPO = Path(__file__).resolve().parents[2]
 
 
@@ -135,7 +137,7 @@ def main() -> int:
         ], timeout=600)
         all_ok &= record("test-e2e", "dryrun_multichip(8)", proc, dt)
         env = _env()
-        env["TRACEML_BENCH_NO_PROBE"] = "1"
+        env[flags.BENCH_NO_PROBE.name] = "1"
         t0 = time.monotonic()
         proc = subprocess.run(
             [sys.executable, "bench.py", "--rounds", "2", "--steps", "4"],

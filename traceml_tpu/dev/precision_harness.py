@@ -46,6 +46,7 @@ from typing import Any, Callable, Dict, List, Optional
 REPO = Path(__file__).resolve().parents[2]
 
 _SHIM = """
+from traceml_tpu.config import flags
 from traceml_tpu.dev.demo.scenarios import run_scenario
 run_scenario({name!r}, steps={steps})
 """
@@ -185,7 +186,7 @@ def _cpu_env(nprocs: int = 1) -> dict:
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = str(REPO)
     if nprocs > 1 and _can_pin(nprocs):
-        env["TRACEML_PIN_RANK_CPUS"] = "1"
+        env[flags.PIN_RANK_CPUS.name] = "1"
     return env
 
 

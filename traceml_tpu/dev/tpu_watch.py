@@ -44,6 +44,7 @@ from pathlib import Path
 
 REPO = Path(__file__).resolve().parents[2]
 
+from traceml_tpu.config import flags  # noqa: E402
 from traceml_tpu.utils.atomic_io import atomic_write_json  # noqa: E402
 from traceml_tpu.utils.probe_cache import write_cache  # noqa: E402
 
@@ -96,7 +97,7 @@ def _device_env() -> dict:
     """Env for children that must SEE the tunnel (restores the axon
     trigger the daemon's own launcher scrubbed to keep itself safe)."""
     env = dict(os.environ)
-    saved = env.pop("TRACEML_AXON_SAVED_POOL_IPS", None)
+    saved = env.pop(flags.AXON_SAVED_POOL_IPS.name, None)
     if saved and "PALLAS_AXON_POOL_IPS" not in env:
         env["PALLAS_AXON_POOL_IPS"] = saved
     return env

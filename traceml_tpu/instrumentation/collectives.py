@@ -34,10 +34,10 @@ no-op (and unregisters the sampler — see runtime/sampler_registry.py).
 from __future__ import annotations
 
 import functools
-import os
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from traceml_tpu.config import flags
 from traceml_tpu.utils.error_log import get_error_log
 from traceml_tpu.utils.timing import BoundedDropQueue
 
@@ -90,11 +90,7 @@ _QUEUE_MAX = 8192
 
 
 def collectives_enabled() -> bool:
-    return os.environ.get("TRACEML_COLLECTIVES", "1").strip().lower() not in (
-        "0",
-        "false",
-        "off",
-    )
+    return flags.COLLECTIVES.enabled()
 
 
 # Global queue shared by the recorders above and CollectivesSampler.

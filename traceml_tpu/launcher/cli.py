@@ -110,6 +110,45 @@ def _build_parser() -> argparse.ArgumentParser:
         ),
     )
 
+    lint = sub.add_parser(
+        "lint",
+        help=(
+            "static project-invariant checks: lock discipline, domain "
+            "wiring, env-flag registry, HTML escape coverage"
+        ),
+    )
+    lint.add_argument(
+        "--root",
+        default=None,
+        help="package root to analyze (default: this traceml_tpu checkout)",
+    )
+    lint.add_argument(
+        "--pass",
+        dest="passes",
+        action="append",
+        choices=("race", "wiring", "flags", "escape"),
+        default=None,
+        help="run only this pass (repeatable; default: all four)",
+    )
+    lint.add_argument("--format", choices=("text", "json"), default="text")
+    lint.add_argument(
+        "--baseline",
+        default=None,
+        help="baseline file (default: tracelint_baseline.json at repo root)",
+    )
+    lint.add_argument(
+        "--update-baseline",
+        dest="update_baseline",
+        action="store_true",
+        help="rewrite the baseline from current findings",
+    )
+    lint.add_argument(
+        "--show-suppressed",
+        dest="show_suppressed",
+        action="store_true",
+        help="include suppressed findings in text output",
+    )
+
     prof = sub.add_parser(
         "profile",
         help="capture an XLA profiler trace from a RUNNING session",
@@ -178,6 +217,17 @@ def main(argv: Optional[List[str]] = None) -> int:
             Path(args.session_dir),
             interval=args.interval,
             browser=args.browser,
+        )
+    if args.command == "lint":
+        from traceml_tpu.launcher.lint_cmd import run_lint_cmd
+
+        return run_lint_cmd(
+            root=Path(args.root) if args.root else None,
+            passes=args.passes,
+            fmt=args.format,
+            baseline=Path(args.baseline) if args.baseline else None,
+            update_baseline=args.update_baseline,
+            show_suppressed=args.show_suppressed,
         )
     if args.command == "profile":
         from traceml_tpu.sdk.profile_capture import request_profile_and_wait

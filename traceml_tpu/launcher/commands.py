@@ -18,6 +18,7 @@ import time
 from pathlib import Path
 from typing import Any, Dict, List, Optional
 
+from traceml_tpu.config import flags
 from traceml_tpu.config.yaml_loader import load_yaml_config
 from traceml_tpu.launcher import manifest as mf
 from traceml_tpu.launcher.process import (
@@ -42,7 +43,7 @@ from traceml_tpu.sdk import protocol
 # bounded aggregator crash-resume: how many times the launcher respawns
 # a dead aggregator (pinned to its original port so the ranks' backoff
 # reconnects land) before degrading to untraced
-ENV_AGG_MAX_RESTARTS = "TRACEML_AGG_MAX_RESTARTS"
+ENV_AGG_MAX_RESTARTS = flags.AGG_MAX_RESTARTS.name
 DEFAULT_AGG_MAX_RESTARTS = 3
 
 
@@ -286,12 +287,7 @@ def launch_process(
     exit_code = 0
     launcher_stopped: set = set()  # pids WE terminated (victims, not crashes)
     agg_restarts = 0
-    try:
-        agg_max_restarts = int(
-            os.environ.get(ENV_AGG_MAX_RESTARTS, DEFAULT_AGG_MAX_RESTARTS)
-        )
-    except ValueError:
-        agg_max_restarts = DEFAULT_AGG_MAX_RESTARTS
+    agg_max_restarts = flags.AGG_MAX_RESTARTS.get_int(DEFAULT_AGG_MAX_RESTARTS)
     try:
         while True:
             alive = [p for p in procs if p.poll() is None]

@@ -1,0 +1,31 @@
+"""``traceml-tpu lint`` — run the project-invariant static analyzer.
+
+Thin adapter over :mod:`traceml_tpu.analysis`: the CLI owns argument
+spelling, the analysis package owns the passes and the exit-code
+contract (0 clean, 1 new errors, 2 analyzer failure).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import List, Optional
+
+
+def run_lint_cmd(
+    root: Optional[Path] = None,
+    passes: Optional[List[str]] = None,
+    fmt: str = "text",
+    baseline: Optional[Path] = None,
+    update_baseline: bool = False,
+    show_suppressed: bool = False,
+) -> int:
+    from traceml_tpu.analysis.runner import run_lint
+
+    return run_lint(
+        package_root=root,
+        passes=passes,
+        fmt=fmt,
+        baseline_path=baseline,
+        update_baseline=update_baseline,
+        show_suppressed=show_suppressed,
+    )

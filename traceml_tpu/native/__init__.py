@@ -18,6 +18,8 @@ import threading
 from pathlib import Path
 from typing import Optional
 
+from traceml_tpu.config import flags
+
 _lock = threading.Lock()
 _cached = None
 _attempted = False
@@ -76,7 +78,7 @@ def get_framing() -> Optional[object]:
         if _cached is not None or _attempted:
             return _cached
         _attempted = True
-        if os.environ.get("TRACEML_NO_NATIVE", "").strip() in ("1", "true"):
+        if flags.NO_NATIVE.truthy():
             return None
         mod = _try_import()
         if mod is None and _build():

@@ -104,7 +104,8 @@ class SessionPublisher:
 
     @property
     def closed(self) -> bool:
-        return self._closed
+        with self._cond:
+            return self._closed
 
     @property
     def token(self) -> str:

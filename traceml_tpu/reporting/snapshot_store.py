@@ -354,11 +354,13 @@ class LiveSnapshotStore:
         """The shared read-only connection (None until the DB exists).
         One-shot loaders may reuse it via their ``conn=`` parameter;
         hold no expectations about transactions — autocommit reads."""
-        return self._conn
+        with self._lock:
+            return self._conn
 
     @property
     def connected(self) -> bool:
-        return self._conn is not None
+        with self._lock:
+            return self._conn is not None
 
     def _connect(self) -> Optional[sqlite3.Connection]:
         if self._conn is not None:
@@ -397,7 +399,8 @@ class LiveSnapshotStore:
     def data_version(self) -> int:
         """Monotonically increasing; bumps once per refresh that
         observed any change (new rows or a retention trim)."""
-        return self._data_version
+        with self._lock:
+            return self._data_version
 
     @property
     def versions(self) -> Dict[str, int]:

@@ -23,6 +23,7 @@ import traceback
 from pathlib import Path
 
 from traceml_tpu.runtime import lifecycle
+from traceml_tpu.config import flags
 from traceml_tpu.runtime.settings import (
     ENV_SCRIPT,
     ENV_SCRIPT_ARGS,
@@ -74,7 +75,7 @@ def _maybe_pin_cpu() -> bool:
     metric (dev/precision_harness.py; VERDICT r3 item 5a).  No-op when
     cores < local world size (pinning would serialize ranks worse than
     timesharing) or on platforms without sched_setaffinity."""
-    if os.environ.get("TRACEML_PIN_RANK_CPUS") != "1":
+    if not flags.PIN_RANK_CPUS.truthy():
         return False
     if not hasattr(os, "sched_setaffinity"):
         return False

@@ -8,10 +8,10 @@ DRAINING) → stop: final drain + ``rank_finished`` control marker.
 
 from __future__ import annotations
 
-import os
 import threading
 from typing import List, Optional
 
+from traceml_tpu.config import flags
 from traceml_tpu.runtime.identity import RuntimeIdentity, resolve_runtime_identity
 from traceml_tpu.runtime.sampler_registry import build_samplers
 from traceml_tpu.runtime.sender import TelemetryPublisher
@@ -71,12 +71,7 @@ class TraceMLRuntime:
                 self.settings.aggregator.port,
             )
         sender_identity = self.identity.to_sender_identity(self.settings.session_id)
-        try:
-            heartbeat_s = float(
-                os.environ.get("TRACEML_HEARTBEAT_INTERVAL_SEC", 3.0)
-            )
-        except ValueError:
-            heartbeat_s = 3.0
+        heartbeat_s = flags.HEARTBEAT_INTERVAL_SEC.get_float(3.0)
         self.publisher = TelemetryPublisher(
             self.samplers,
             self.client,

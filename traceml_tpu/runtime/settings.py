@@ -9,29 +9,32 @@ from __future__ import annotations
 import dataclasses
 import os
 from pathlib import Path
-from typing import Any, Dict, Optional
+from typing import Dict, Optional
+
+from traceml_tpu.config import flags
 
 ENV_PREFIX = "TRACEML_"
 
-# canonical env var names
-ENV_SESSION_ID = "TRACEML_SESSION_ID"
-ENV_LOGS_DIR = "TRACEML_LOGS_DIR"
-ENV_MODE = "TRACEML_MODE"  # cli | summary
-ENV_AGG_HOST = "TRACEML_AGGREGATOR_HOST"
-ENV_AGG_BIND_HOST = "TRACEML_AGGREGATOR_BIND_HOST"
-ENV_AGG_PORT = "TRACEML_AGGREGATOR_PORT"
-ENV_SAMPLER_INTERVAL = "TRACEML_SAMPLER_INTERVAL_SEC"
-ENV_MAX_STEPS = "TRACEML_TRACE_MAX_STEPS"
-ENV_DISABLE = "TRACEML_DISABLE"
-ENV_DISK_BACKUP = "TRACEML_DISK_BACKUP"
-ENV_CAPTURE_STDERR = "TRACEML_CAPTURE_STDERR"
-ENV_RUN_NAME = "TRACEML_RUN_NAME"
-ENV_EXPECTED_WORLD_SIZE = "TRACEML_EXPECTED_WORLD_SIZE"
-ENV_FINALIZE_TIMEOUT = "TRACEML_FINALIZE_TIMEOUT_SEC"
-ENV_SUMMARY_WINDOW_ROWS = "TRACEML_SUMMARY_WINDOW_ROWS"
-ENV_SERVE_MAX_SESSIONS = "TRACEML_SERVE_MAX_SESSIONS"
-ENV_SCRIPT = "TRACEML_SCRIPT"
-ENV_SCRIPT_ARGS = "TRACEML_SCRIPT_ARGS"
+# canonical env var names — aliases into the declared registry
+# (config/flags.py) so every name exists in exactly one place
+ENV_SESSION_ID = flags.SESSION_ID.name
+ENV_LOGS_DIR = flags.LOGS_DIR.name
+ENV_MODE = flags.MODE.name  # cli | summary
+ENV_AGG_HOST = flags.AGGREGATOR_HOST.name
+ENV_AGG_BIND_HOST = flags.AGGREGATOR_BIND_HOST.name
+ENV_AGG_PORT = flags.AGGREGATOR_PORT.name
+ENV_SAMPLER_INTERVAL = flags.SAMPLER_INTERVAL_SEC.name
+ENV_MAX_STEPS = flags.TRACE_MAX_STEPS.name
+ENV_DISABLE = flags.DISABLE.name
+ENV_DISK_BACKUP = flags.DISK_BACKUP.name
+ENV_CAPTURE_STDERR = flags.CAPTURE_STDERR.name
+ENV_RUN_NAME = flags.RUN_NAME.name
+ENV_EXPECTED_WORLD_SIZE = flags.EXPECTED_WORLD_SIZE.name
+ENV_FINALIZE_TIMEOUT = flags.FINALIZE_TIMEOUT_SEC.name
+ENV_SUMMARY_WINDOW_ROWS = flags.SUMMARY_WINDOW_ROWS.name
+ENV_SERVE_MAX_SESSIONS = flags.SERVE_MAX_SESSIONS.name
+ENV_SCRIPT = flags.SCRIPT.name
+ENV_SCRIPT_ARGS = flags.SCRIPT_ARGS.name
 
 
 @dataclasses.dataclass(frozen=True)
@@ -94,41 +97,30 @@ class TraceMLSettings:
         return self.session_dir / "control"
 
 
-def _env_bool(env: Dict[str, str], name: str, default: bool) -> bool:
-    v = env.get(name)
-    if v is None:
-        return default
-    return str(v).strip().lower() in ("1", "true", "yes", "on")
-
-
 def settings_from_env(env: Optional[Dict[str, str]] = None) -> TraceMLSettings:
     e = dict(os.environ) if env is None else dict(env)
-
-    def get(name: str, default: Any = None) -> Any:
-        return e.get(name, default)
-
-    max_steps = get(ENV_MAX_STEPS)
-    expected_ws = get(ENV_EXPECTED_WORLD_SIZE)
-    connect_host = get(ENV_AGG_HOST, "127.0.0.1")
+    max_steps = flags.TRACE_MAX_STEPS.raw(e)
+    expected_ws = flags.EXPECTED_WORLD_SIZE.raw(e)
+    connect_host = flags.AGGREGATOR_HOST.raw(e) or "127.0.0.1"
     return TraceMLSettings(
-        session_id=get(ENV_SESSION_ID, "local"),
-        logs_dir=Path(get(ENV_LOGS_DIR, "./traceml_logs")),
-        mode=get(ENV_MODE, "cli"),
+        session_id=flags.SESSION_ID.raw(e) or "local",
+        logs_dir=Path(flags.LOGS_DIR.raw(e) or "./traceml_logs"),
+        mode=flags.MODE.raw(e) or "cli",
         aggregator=AggregatorEndpoint(
             connect_host=connect_host,
-            bind_host=get(ENV_AGG_BIND_HOST, connect_host),
-            port=int(get(ENV_AGG_PORT, 0) or 0),
+            bind_host=flags.AGGREGATOR_BIND_HOST.raw(e) or connect_host,
+            port=flags.AGGREGATOR_PORT.get_int(0, e),
         ),
-        sampler_interval_sec=float(get(ENV_SAMPLER_INTERVAL, 1.0) or 1.0),
+        sampler_interval_sec=flags.SAMPLER_INTERVAL_SEC.get_float(1.0, e),
         trace_max_steps=int(max_steps) if max_steps else None,
-        disabled=_env_bool(e, ENV_DISABLE, False),
-        disk_backup=_env_bool(e, ENV_DISK_BACKUP, False),
-        capture_stderr=_env_bool(e, ENV_CAPTURE_STDERR, True),
-        run_name=get(ENV_RUN_NAME) or None,
+        disabled=flags.DISABLE.truthy(e),
+        disk_backup=flags.DISK_BACKUP.truthy(e),
+        capture_stderr=flags.CAPTURE_STDERR.truthy(e),
+        run_name=flags.RUN_NAME.raw(e) or None,
         expected_world_size=int(expected_ws) if expected_ws else None,
-        finalize_timeout_sec=float(get(ENV_FINALIZE_TIMEOUT, 300.0) or 300.0),
-        summary_window_rows=int(get(ENV_SUMMARY_WINDOW_ROWS, 10000) or 10000),
-        serve_max_sessions=int(get(ENV_SERVE_MAX_SESSIONS, 8) or 8),
+        finalize_timeout_sec=flags.FINALIZE_TIMEOUT_SEC.get_float(300.0, e),
+        summary_window_rows=flags.SUMMARY_WINDOW_ROWS.get_int(10000, e),
+        serve_max_sessions=flags.SERVE_MAX_SESSIONS.get_int(8, e),
     )
 
 

@@ -89,7 +89,7 @@ class TraceState:
             return self.step_counter
 
     def ensure_mem_tracker(self) -> StepMemoryTracker:
-        mt = self.mem_tracker  # lock-free fast path (hot: 2×/step)
+        mt = self.mem_tracker  # tracelint: unguarded(double-checked init fast path; None race falls through to the locked slow path)
         if mt is not None:
             return mt
         with self._lock:

@@ -67,9 +67,9 @@ class WrappedStepFn:
         self._state = state or get_state()
         self._phase = phase_name
         if estimate_flops is None:
-            import os
+            from traceml_tpu.config import flags
 
-            estimate_flops = os.environ.get("TRACEML_NO_FLOPS_ESTIMATE") != "1"
+            estimate_flops = not flags.NO_FLOPS_ESTIMATE.truthy()
         self._flops_pending = bool(estimate_flops)
 
         if hasattr(fn, "lower") and callable(getattr(fn, "lower")):

@@ -276,8 +276,11 @@ class TCPServer:
         return payloads
 
     def _count_corrupt(self, peer: str) -> None:
-        n = self.corrupt_frame_drops.get(peer, 0) + 1
-        self.corrupt_frame_drops[peer] = n
+        # called from the consumer thread; _read (selector thread) also
+        # mutates this dict, so both sides take the lock
+        with self._lock:
+            n = self.corrupt_frame_drops.get(peer, 0) + 1
+            self.corrupt_frame_drops[peer] = n
         get_error_log().warning(
             f"undecodable frame from {peer} skipped "
             f"({n} corrupt frame(s) from this client so far)"
@@ -359,9 +362,10 @@ class TCPServer:
             # loss attributed to it); a corrupt BODY with intact framing
             # survives to decode_tagged, which skips just that frame
             get_error_log().warning(f"dropping client with bad frame: {exc}")
-            self.corrupt_frame_drops[peer] = (
-                self.corrupt_frame_drops.get(peer, 0) + 1
-            )
+            with self._lock:
+                self.corrupt_frame_drops[peer] = (
+                    self.corrupt_frame_drops.get(peer, 0) + 1
+                )
             try:
                 self._selector.unregister(conn)
             except Exception:
@@ -377,8 +381,8 @@ class TCPServer:
             return
         # NO decode here: this is the selector thread, shared by every
         # client.  Frames are handed to the consumer as-is.
-        self.frames_received += len(frames)
         with self._lock:
+            self.frames_received += len(frames)
             for frame in frames:
                 self._pending.append((peer, frame))
             if len(self._pending) > self.pending_hwm:
@@ -502,7 +506,8 @@ class TCPClient:
         try:
             body = msgpack_codec.encode_batch(payloads)
         except Exception:
-            self.batches_dropped += 1
+            with self._lock:
+                self.batches_dropped += 1
             return False
         return self.send_encoded_body(body)
 
@@ -519,10 +524,11 @@ class TCPClient:
             elif fault.action == "reset":
                 with self._lock:
                     self._teardown_locked()
-                self.batches_dropped += 1
+                    self.batches_dropped += 1
                 return False
         if self._ensure_connected() is None:
-            self.batches_dropped += 1
+            with self._lock:
+                self.batches_dropped += 1
             return False
         with self._framebuf_lock:
             buf = self._framebuf

@@ -45,11 +45,11 @@ Kill switch: ``TRACEML_COLUMNAR_WINDOW=0`` forces the scalar path.
 from __future__ import annotations
 
 import dataclasses
-import os
 from typing import Any, Dict, List, Mapping, Optional
 
 import numpy as np
 
+from traceml_tpu.config import flags
 from traceml_tpu.utils import timing as T
 from traceml_tpu.utils.step_time_window import (
     ACCOUNTED_PHASES,
@@ -74,11 +74,7 @@ _NAN = float("nan")
 
 
 def columnar_window_enabled() -> bool:
-    return os.environ.get("TRACEML_COLUMNAR_WINDOW", "1").strip().lower() not in (
-        "0",
-        "false",
-        "off",
-    )
+    return flags.COLUMNAR_WINDOW.enabled()
 
 
 class ColumnarFallback(Exception):

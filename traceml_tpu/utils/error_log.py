@@ -31,7 +31,7 @@ class ErrorLog:
 
     @property
     def path(self) -> Optional[Path]:
-        return self._path
+        return self._path  # tracelint: unguarded(single ref read; set_path happens once at startup and a stale None only delays first log line)
 
     def error(self, message: str, exc: Optional[BaseException] = None) -> None:
         self._write("ERROR", message, exc)

@@ -130,8 +130,10 @@ class MarkerResolver:
         of the resolver's scheduling luck.  Cost: a handful of local
         ``is_ready()`` calls, microseconds.
         """
-        if not self._pending:  # unlocked fast path: hot loops with the
-            return 0           # governor subsampling usually have none
+        if not self._pending:  # tracelint: unguarded(emptiness probe on the hot step path; a racing append is swept next step)
+            return 0
+        # (unlocked fast path: hot loops with the governor subsampling
+        # usually have no pending markers)
         with self._lock:
             pending = list(self._pending[:max_n])
         if not pending:

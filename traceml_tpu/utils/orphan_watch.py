@@ -25,6 +25,8 @@ import os
 import threading
 from typing import Callable, Optional
 
+from traceml_tpu.config import flags
+
 _POLL_S = 2.0
 
 
@@ -37,7 +39,7 @@ def arm_parent_death_watch(
     original parent exits.  Returns the thread, or None when disarmed
     (opt-out env, or already orphaned at arm time — a deliberately
     detached daemon must not be killed by its own watchdog)."""
-    if os.environ.get("TRACEML_NO_PPID_WATCH") == "1":
+    if flags.NO_PPID_WATCH.truthy():
         return None
     parent = os.getppid()
     if parent <= 1:

@@ -34,7 +34,7 @@ tick per step.
 
 from __future__ import annotations
 
-import os
+from traceml_tpu.config import flags
 
 _DEF_BUDGET = 0.01           # tracer share of wall clock
 _DEF_INLINE_CEILING = 100e-6  # s; inline sweeps off above this per-probe cost
@@ -63,10 +63,7 @@ class OverheadGovernor:
         inline_probe_ceiling: float = _DEF_INLINE_CEILING,
     ) -> None:
         if budget is None:
-            try:
-                budget = float(os.environ.get("TRACEML_OVERHEAD_BUDGET", _DEF_BUDGET))
-            except ValueError:
-                budget = _DEF_BUDGET
+            budget = flags.OVERHEAD_BUDGET.get_float(_DEF_BUDGET)
         self.budget = max(1e-4, float(budget))
         self.inline_probe_ceiling = float(inline_probe_ceiling)
         # optimistic prior: local-backend probe cost.  The first sweeps

@@ -32,10 +32,11 @@ from __future__ import annotations
 
 import dataclasses
 import json
-import os
 from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
+
+from traceml_tpu.config import flags
 
 #: minimum share of anomaly variance a grouping must explain before a
 #: finding is attributed to it (below: flat rank list, no false blame)
@@ -245,7 +246,7 @@ def capture_local_topology(
     discoverable yet (callers retry on later ticks; never forces jax
     initialization).  Precedence: ``TRACEML_MESH`` env override (CI,
     meshes built outside our helper), then the recorded Mesh."""
-    spec = os.environ.get("TRACEML_MESH")
+    spec = flags.MESH.raw()
     if spec:
         axes = parse_mesh_spec(spec)
         if axes:
