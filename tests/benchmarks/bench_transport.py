@@ -1,0 +1,290 @@
+"""Transport tier: same-host shm ring vs TCP loopback, plus the zstd
+envelope-compression arm (docs/developer_guide/native-transport.md).
+
+Golden first: every arm must decode to the SAME envelope payload list
+as the source batches before any timing is reported — a fast transport
+that reorders or mangles envelopes is worthless.
+
+Timed arms are interleaved (tcp, shm, tcp, shm, ...) with min-of-N per
+arm: the workload is deterministic, so shared-host noise only ever ADDS
+time and min is the faithful estimator; interleaving keeps a sustained
+co-tenant burst from landing on one arm only.
+
+Workload: realistic v2 (columnar) ``step_time`` envelope batches — the
+steady-state frame shape a training rank actually ships.
+
+Emits bench_common JSON lines (collected into BENCH_LOCAL_* records):
+
+* ``tcp_mb_per_s`` / ``shm_mb_per_s`` and ``shm_vs_tcp_speedup``
+  (end-to-end publish→drain, single producer, gate: >= 2x);
+* ``<codec>_compression_ratio`` (bytes reduction on v2 step_time
+  bodies, gate: >= 2x for the best codec) plus compress/decompress
+  throughput.
+"""
+
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent))
+# standalone `python tests/benchmarks/bench_transport.py` support
+sys.path.insert(1, str(Path(__file__).parent.parent.parent))
+import bench_common  # noqa: E402
+
+from traceml_tpu.transport import compression  # noqa: E402
+from traceml_tpu.transport.shm_ring import (  # noqa: E402
+    ShmRingClient,
+    ShmRingConsumer,
+)
+from traceml_tpu.transport.tcp_transport import TCPClient, TCPServer  # noqa: E402
+from traceml_tpu.utils import msgpack_codec  # noqa: E402
+
+pytestmark = pytest.mark.slow
+
+BENCH = "transport"
+N_ENVELOPES = 2000
+STEPS_PER_ENV = 8
+# one envelope per wire frame — the live-streaming publisher shape
+# (each step flushed as it completes); per-frame transport overhead is
+# exactly what the shm ring removes, so this is the regime the tier is
+# built for (batched frames converge toward memcpy-bound parity)
+BATCH_ENVELOPES = 1
+REPEATS = 3
+RING_BYTES = 1 << 20
+_ARM_TIMEOUT_S = 60.0
+
+
+def _payload(seq: int, steps_per_env: int = STEPS_PER_ENV) -> dict:
+    """One v2 (columnar) step_time envelope, the shape
+    DBIncrementalSender ships on every publisher flush."""
+    base = seq * steps_per_env
+    steps = list(range(base, base + steps_per_env))
+    return {
+        "meta": {
+            "schema": 2,
+            "session_id": "bench-session",
+            "sampler": "step_time",
+            "timestamp": 1700000000.0 + seq * 0.25,
+            "rank": 0,
+            "global_rank": 0,
+            "local_rank": 0,
+            "world_size": 8,
+            "node_rank": 0,
+            "hostname": "bench-host-0",
+            "pid": 4242,
+            "platform": "tpu",
+            "device_kind": "TPU v5p",
+            "seq": seq,
+        },
+        "body": {
+            "tables": {
+                "step_time": {
+                    "cols": ["step", "timestamp", "clock", "events"],
+                    "vals": [
+                        steps,
+                        [1700000000.0 + s * 0.012 for s in steps],
+                        ["device"] * steps_per_env,
+                        [
+                            {
+                                "_traceml_internal:step_time": {
+                                    "cpu_ms": 11.5 + (s % 7) * 0.25,
+                                    "device_ms": 11.1 + (s % 5) * 0.25,
+                                    "count": 1,
+                                }
+                            }
+                            for s in steps
+                        ],
+                    ],
+                }
+            }
+        },
+    }
+
+
+def _workload(steps_per_env: int = STEPS_PER_ENV):
+    """(flat payload list, pre-encoded wire bodies) — bodies are built
+    once so both transports move byte-identical frames."""
+    payloads = [_payload(seq, steps_per_env) for seq in range(N_ENVELOPES)]
+    bodies = [
+        msgpack_codec.encode_batch(payloads[i : i + BATCH_ENVELOPES])
+        for i in range(0, len(payloads), BATCH_ENVELOPES)
+    ]
+    return payloads, bodies
+
+
+# -- arms ---------------------------------------------------------------
+
+
+def _tcp_arm(bodies):
+    """Publish every body through a REAL loopback socket pair and drain
+    it out of the server; returns (seconds, decoded payloads)."""
+    server = TCPServer(host="127.0.0.1", port=0)
+    server.start()
+    client = TCPClient("127.0.0.1", server.port)
+    try:
+        # prime the connection outside the timed window (dial + accept)
+        assert client.send_encoded_body(bodies[0])
+        deadline = time.monotonic() + _ARM_TIMEOUT_S
+        while server.pending_frames() < 1:
+            assert time.monotonic() < deadline, "tcp prime stalled"
+            server.wait_for_data(0.05)
+        server.drain()
+
+        got = []
+        t0 = time.perf_counter()
+        for body in bodies:
+            assert client.send_encoded_body(body), "tcp send failed"
+        while len(got) < len(bodies):
+            server.wait_for_data(0.05)
+            got.extend(server.drain())
+            assert time.monotonic() < deadline, "tcp drain stalled"
+        dt = time.perf_counter() - t0
+    finally:
+        client.close()
+        server.stop()
+    payloads, errors = msgpack_codec.decode_batch(got)
+    assert errors == 0
+    return dt, payloads
+
+
+def _shm_arm(bodies, tmp_path, rep):
+    """Publish every body through a shm ring segment and drain it from
+    the consumer side; returns (seconds, decoded payloads)."""
+    path = Path(tmp_path) / f"bench_{rep}.ring"
+    client = ShmRingClient(path, capacity=RING_BYTES)
+    consumer = ShmRingConsumer(path, 0)
+    try:
+        got = []
+        deadline = time.monotonic() + _ARM_TIMEOUT_S
+        t0 = time.perf_counter()
+        for body in bodies:
+            while not client.send_encoded_body(body):  # ring full: drain
+                got.extend(consumer.drain())
+                assert time.monotonic() < deadline, "shm backpressure stalled"
+        while len(got) < len(bodies):
+            got.extend(consumer.drain())
+            assert time.monotonic() < deadline, "shm drain stalled"
+        dt = time.perf_counter() - t0
+    finally:
+        client.close()
+        consumer.close()
+        try:
+            path.unlink()
+        except OSError:
+            pass
+    payloads, errors = msgpack_codec.decode_batch(got)
+    assert errors == 0
+    return dt, payloads
+
+
+# -- cases --------------------------------------------------------------
+
+
+def _run_drain_case(tmp_path):
+    payloads, bodies = _workload()
+    total_mb = sum(len(b) for b in bodies) / 1e6
+
+    # golden BEFORE timing: both transports must deliver the exact
+    # envelope stream (content and order)
+    _, tcp_decoded = _tcp_arm(bodies)
+    _, shm_decoded = _shm_arm(bodies, tmp_path, "golden")
+    assert tcp_decoded == payloads, "tcp arm diverged from source payloads"
+    assert shm_decoded == payloads, "shm arm diverged from source payloads"
+
+    tcp_s = shm_s = None
+    for rep in range(REPEATS):
+        dt, _ = _tcp_arm(bodies)
+        tcp_s = dt if tcp_s is None else min(tcp_s, dt)
+        dt, _ = _shm_arm(bodies, tmp_path, rep)
+        shm_s = dt if shm_s is None else min(shm_s, dt)
+
+    tcp_mbps = total_mb / tcp_s
+    shm_mbps = total_mb / shm_s
+    extra = {
+        "envelopes": N_ENVELOPES,
+        "frames": len(bodies),
+        "frame_bytes": int(total_mb * 1e6 / len(bodies)),
+        "ring_bytes": RING_BYTES,
+        "repeats": REPEATS,
+    }
+    bench_common.emit(BENCH, "tcp_mb_per_s", tcp_mbps, "MB/s", **extra)
+    bench_common.emit(BENCH, "shm_mb_per_s", shm_mbps, "MB/s", **extra)
+    bench_common.emit(
+        BENCH, "shm_vs_tcp_speedup", shm_mbps / tcp_mbps, "x", **extra
+    )
+    return shm_mbps / tcp_mbps
+
+
+def _run_compression_case():
+    # the zstd tier only engages on the cross-host TCP link, where the
+    # durable sender batches whole flush intervals per envelope — more
+    # rows per body than the same-host live-streaming shape
+    payloads, bodies = _workload(steps_per_env=32)
+    encs = [msgpack_codec.preencode(p) for p in payloads]
+    if encs[0].raw is None:
+        return None  # JSON-fallback host: nothing to compress
+
+    best = compression.available_codecs()[0]
+    ratios = {}
+    for codec in compression.available_codecs():
+        comp = compression.EnvelopeCompressor(codec)
+        t0 = time.perf_counter()
+        wrapped = [comp.wrap(e) for e in encs]
+        compress_s = time.perf_counter() - t0
+        bytes_in, bytes_out = comp.bytes_in, comp.bytes_out
+        assert comp.envelopes_compressed == len(encs), (
+            f"{codec}: {comp.envelopes_passthrough} envelopes passed through"
+        )
+        # golden: every carrier must round-trip to the source envelope
+        t0 = time.perf_counter()
+        unwrapped = [compression.unwrap_payload(w.obj) for w in wrapped]
+        decompress_s = time.perf_counter() - t0
+        assert unwrapped == payloads, f"{codec} round-trip diverged"
+
+        ratio = bytes_in / max(1, bytes_out)
+        ratios[codec] = ratio
+        mb_in = bytes_in / 1e6
+        extra = {
+            "envelopes": N_ENVELOPES,
+            "bytes_in": bytes_in,
+            "bytes_out": bytes_out,
+        }
+        bench_common.emit(
+            BENCH, f"{codec}_compression_ratio", ratio, "x", **extra
+        )
+        bench_common.emit(
+            BENCH, f"{codec}_compress_mb_per_s", mb_in / compress_s,
+            "MB/s", **extra,
+        )
+        bench_common.emit(
+            BENCH, f"{codec}_decompress_mb_per_s", mb_in / decompress_s,
+            "MB/s", **extra,
+        )
+    return best, ratios
+
+
+def test_shm_drain_beats_tcp_2x(tmp_path):
+    speedup = _run_drain_case(tmp_path)
+    assert speedup >= 2.0, f"shm only {speedup:.2f}x over tcp"
+
+
+def test_compression_halves_v2_step_time_bytes():
+    result = _run_compression_case()
+    if result is None:
+        pytest.skip("JSON-fallback host: no raw bodies to compress")
+    best, ratios = result
+    assert ratios[best] >= 2.0, f"{best} ratio only {ratios[best]:.2f}x"
+
+
+if __name__ == "__main__":
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        speedup = _run_drain_case(tmp)
+        result = _run_compression_case()
+        print(f"# shm vs tcp {speedup:.1f}x", file=sys.stderr)
+        if result:
+            best, ratios = result
+            print(f"# {best} ratio {ratios[best]:.1f}x", file=sys.stderr)

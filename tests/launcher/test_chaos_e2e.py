@@ -86,8 +86,14 @@ def _step_coverage(session):
     return {(r, s) for r, s in rows}, len(rows)
 
 
-def test_aggregator_kill9_restart_no_loss_no_duplicates(tmp_path):
-    baseline_session, _ = _run(tmp_path, "baseline", steps=60)
+def _kill9_restart_case(tmp_path, transport):
+    """Shared body: fault-free baseline vs aggregator-kill9 run over the
+    given transport must land IDENTICAL (rank, step) coverage with zero
+    duplicate rows."""
+    env = {"TRACEML_TRANSPORT": transport}
+    baseline_session, _ = _run(
+        tmp_path, f"baseline_{transport}", steps=60, extra_env=env
+    )
     base_cov, base_rows = _step_coverage(baseline_session)
     assert base_rows == len(base_cov)  # sanity: fault-free has no dupes
 
@@ -95,8 +101,8 @@ def test_aggregator_kill9_restart_no_loss_no_duplicates(tmp_path):
         [{"point": "aggregator.ingest", "action": "kill9", "after": 40}]
     )
     chaos_session, proc = _run(
-        tmp_path, "aggkill", steps=60,
-        extra_env={"TRACEML_FAULT_PLAN": plan},
+        tmp_path, f"aggkill_{transport}", steps=60,
+        extra_env=dict(env, TRACEML_FAULT_PLAN=plan),
     )
     manifest = json.loads((chaos_session / "manifest.json").read_text())
     assert manifest["status"] == "completed"
@@ -114,6 +120,26 @@ def test_aggregator_kill9_restart_no_loss_no_duplicates(tmp_path):
     # the report survived the crash too
     summary = json.loads((chaos_session / "final_summary.json").read_text())
     assert sorted(summary["meta"]["topology"]["ranks_seen"]) == [0, 1]
+    return chaos_session
+
+
+def test_aggregator_kill9_restart_no_loss_no_duplicates(tmp_path):
+    # pinned to tcp: the pre-transport-tier golden arm
+    _kill9_restart_case(tmp_path, "tcp")
+
+
+def test_aggregator_kill9_restart_over_shm_ring(tmp_path):
+    """The r12 contract over the shm fast path: the restarted aggregator
+    re-attaches the rings (consumer-generation flip → one failed send →
+    spooled replay), and coverage stays exactly-once."""
+    session = _kill9_restart_case(tmp_path, "shm")
+    # prove the run actually rode the ring, not a silent tcp fallback
+    stats = json.loads((session / "ingest_stats.json").read_text())
+    transports = stats["transports"]
+    assert transports["frames_by_kind"].get("shm", 0) > 0, transports
+    assert all(
+        h["transport"] == "shm" for h in transports["ranks"].values()
+    ), transports["ranks"]
 
 
 def test_rank_sigkill_reported_lost_with_data_gap(tmp_path):

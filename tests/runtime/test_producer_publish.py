@@ -52,7 +52,8 @@ def test_single_encode_wire_and_disk_share_bytes(tmp_path):
     pub = TelemetryPublisher([s], client, SenderIdentity(global_rank=3))
     s.sample()
     s.sample()
-    assert pub.publish() == 1
+    # 2 = one telemetry envelope + the one-shot transport_hello announce
+    assert pub.publish() == 2
     pub.publish(final=True)  # force the backup buffer out
     # wire: one batch frame decoding to one envelope with both rows
     payloads, errors = msgpack_codec.decode_batch(client.bodies)

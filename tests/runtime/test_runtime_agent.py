@@ -57,6 +57,10 @@ def _run_runtime_session(tmp_path, max_steps=None, steps=4):
         aggregator=AggregatorEndpoint(port=server.port),
         sampler_interval_sec=0.05,
         trace_max_steps=max_steps,
+        # the harness emulates the aggregator with a bare TCPServer (no
+        # ring registry), so pin the tcp arm — auto would pick shm on
+        # loopback and publish into a ring nothing drains
+        transport="tcp",
     )
     rt = TraceMLRuntime(settings, RuntimeIdentity(global_rank=0))
     rt.start()
@@ -122,6 +126,7 @@ def test_runtime_without_aggregator_never_raises(tmp_path, fresh_state):
         mode="summary",
         aggregator=AggregatorEndpoint(port=1),  # nothing listens
         sampler_interval_sec=0.05,
+        transport="tcp",  # the point is a dead TCP endpoint, not a ring
     )
     rt = TraceMLRuntime(settings, RuntimeIdentity(global_rank=0))
     rt.start()

@@ -66,6 +66,6 @@ def test_no_native_env_disables(monkeypatch):
     import traceml_tpu.native as nat
 
     monkeypatch.setenv("TRACEML_NO_NATIVE", "1")
-    monkeypatch.setattr(nat, "_cached", None)
-    monkeypatch.setattr(nat, "_attempted", False)
+    monkeypatch.setattr(nat, "_cached", {})
     assert nat.get_framing() is None
+    assert nat.get_ring() is None

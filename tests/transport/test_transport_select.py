@@ -1,0 +1,263 @@
+"""Transport selection + compression policy
+(docs/developer_guide/native-transport.md).
+
+The load-bearing contract: ``TRACEML_TRANSPORT=tcp`` must restore the
+pre-transport-tier behavior exactly — plain TCPClient, no compression
+on loopback, no UDS listener, no ring registry.
+"""
+
+import types
+from pathlib import Path
+
+import pytest
+
+from traceml_tpu.transport import compression
+from traceml_tpu.transport.select import (
+    choose_transport,
+    create_transport_client,
+    default_uds_path,
+    is_same_host,
+    resolve_compression,
+    server_transport_config,
+)
+from traceml_tpu.transport.shm_ring import ShmRingClient
+from traceml_tpu.transport.tcp_transport import TCPClient, UDSClient
+from traceml_tpu.utils import msgpack_codec
+
+
+def _settings(tmp_path, **kw):
+    agg = types.SimpleNamespace(
+        connect_host=kw.pop("connect_host", "127.0.0.1"),
+        port=kw.pop("port", 59999),
+    )
+    base = dict(
+        transport="auto",
+        transport_compress="auto",
+        shm_ring_bytes=1 << 20,
+        shm_dir=str(tmp_path / "shmdir"),
+        uds_path=None,
+        session_dir=tmp_path / "session",
+    )
+    base.update(kw)
+    return types.SimpleNamespace(aggregator=agg, **base)
+
+
+# -- choose_transport ----------------------------------------------------
+
+
+def test_choose_transport_matrix():
+    assert choose_transport("auto", "127.0.0.1", None) == "shm"
+    assert choose_transport("auto", "localhost", None) == "shm"
+    assert choose_transport("auto", "10.0.0.7", None) == "tcp"
+    assert choose_transport("auto", "10.0.0.7", "/tmp/x.sock") == "uds"
+    assert choose_transport("tcp", "127.0.0.1", "/tmp/x.sock") == "tcp"
+    assert choose_transport("uds", "10.0.0.7", None) == "uds"
+    assert choose_transport("shm", "10.0.0.7", None) == "shm"
+    assert choose_transport("", "127.0.0.1", None) == "shm"  # empty → auto
+
+
+def test_is_same_host():
+    assert is_same_host("127.0.0.1")
+    assert is_same_host("LOCALHOST")
+    assert not is_same_host("10.0.0.7")
+    assert not is_same_host("tpu-worker-3")
+
+
+# -- compression policy --------------------------------------------------
+
+
+def test_resolve_compression_matrix():
+    best = compression.available_codecs()[0]
+    # auto compresses ONLY the genuinely cross-host tcp link
+    assert resolve_compression("tcp", "auto", "10.0.0.7") == best
+    assert resolve_compression("tcp", "auto", "127.0.0.1") is None
+    assert resolve_compression("uds", "auto", "10.0.0.7") is None
+    assert resolve_compression("shm", "auto", "127.0.0.1") is None
+    # explicit codec forces it on any stream transport — never on shm
+    assert resolve_compression("uds", "zlib", "127.0.0.1") == "zlib"
+    assert resolve_compression("tcp", "zlib", "127.0.0.1") == "zlib"
+    assert resolve_compression("shm", "zlib", "127.0.0.1") is None
+    # off spellings (empty string means unset → auto)
+    for off in ("0", "off", "none", "false"):
+        assert resolve_compression("tcp", off, "10.0.0.7") is None
+
+
+def test_default_uds_path_short_and_deterministic(tmp_path):
+    deep = tmp_path / ("x" * 80) / "session"
+    a = default_uds_path(deep)
+    assert a == default_uds_path(deep)
+    assert len(a) < 100  # AF_UNIX path cap is ~107 bytes
+    assert a != default_uds_path(tmp_path / "other")
+
+
+# -- create_transport_client ---------------------------------------------
+
+
+def test_no_port_means_no_client(tmp_path):
+    client, info = create_transport_client(_settings(tmp_path, port=0), 0)
+    assert client is None
+    assert info == {"kind": None, "compression": None}
+
+
+def test_auto_loopback_selects_shm(tmp_path):
+    client, info = create_transport_client(_settings(tmp_path), 0)
+    try:
+        assert isinstance(client, ShmRingClient)
+        assert info["kind"] == "shm"
+        assert info["compression"] is None
+        # the discovery descriptor landed in the rank dir
+        desc = _settings(tmp_path).session_dir / "rank_0" / "shm_ring.json"
+        assert desc.exists()
+    finally:
+        client.close()
+
+
+def test_forced_tcp_is_pre_transport_tier_exactly(tmp_path):
+    """TRACEML_TRANSPORT=tcp: plain TCPClient, no compression wrap on a
+    loopback link even with compress=auto — byte-identical old wire."""
+    client, info = create_transport_client(
+        _settings(tmp_path, transport="tcp"), 0
+    )
+    try:
+        assert type(client) is TCPClient
+        assert info == {"kind": "tcp", "compression": None}
+    finally:
+        client.close()
+
+
+def test_auto_cross_host_selects_tcp_with_compression(tmp_path):
+    client, info = create_transport_client(
+        _settings(tmp_path, connect_host="10.0.0.7"), 0
+    )
+    try:
+        assert type(client) is TCPClient
+        assert info["kind"] == "tcp"
+        assert info["compression"] == compression.available_codecs()[0]
+    finally:
+        client.close()
+
+
+def test_forced_uds_uses_default_session_path(tmp_path):
+    settings = _settings(tmp_path, transport="uds")
+    client, info = create_transport_client(settings, 0)
+    try:
+        assert isinstance(client, UDSClient)
+        assert info["kind"] == "uds"
+        assert client._path == default_uds_path(settings.session_dir)
+    finally:
+        client.close()
+
+
+def test_shm_setup_failure_falls_back_to_tcp(tmp_path):
+    """A broken ring dir must degrade to the golden TCP path with the
+    failure recorded, never into training code."""
+    blocker = tmp_path / "not_a_dir"
+    blocker.write_text("")  # segment parent is a FILE → mkdir raises
+    client, info = create_transport_client(
+        _settings(tmp_path, shm_dir=str(blocker)), 0
+    )
+    try:
+        assert type(client) is TCPClient
+        assert info["kind"] == "tcp"
+        assert info["fallback_from"] == "shm"
+    finally:
+        client.close()
+
+
+# -- server_transport_config ---------------------------------------------
+
+
+def test_server_config_matrix(tmp_path):
+    s = _settings(tmp_path)
+    auto = server_transport_config(s)
+    assert auto["enable_rings"] is True
+    assert auto["uds_path"] == default_uds_path(s.session_dir)
+
+    tcp = server_transport_config(_settings(tmp_path, transport="tcp"))
+    assert tcp == {"uds_path": None, "enable_rings": False}
+
+    uds = server_transport_config(
+        _settings(tmp_path, transport="uds", uds_path="/tmp/explicit.sock")
+    )
+    assert uds["uds_path"] == "/tmp/explicit.sock"
+    assert uds["enable_rings"] is False
+
+    shm = server_transport_config(_settings(tmp_path, transport="shm"))
+    assert shm["uds_path"] is None
+    assert shm["enable_rings"] is True
+
+
+# -- compression carrier units -------------------------------------------
+
+
+def _envelope(seq=7, pad=400):
+    return {
+        "meta": {
+            "seq": seq,
+            "session_id": "s",
+            "sampler": "step_time",
+            "global_rank": 2,
+        },
+        "data": {"values": [1.0] * pad},
+    }
+
+
+@pytest.mark.parametrize("codec", compression.available_codecs())
+def test_roundtrip_per_codec(codec):
+    raw = b"columnar telemetry " * 100
+    z = compression.compress_bytes(raw, codec)
+    assert len(z) < len(raw)
+    assert compression.decompress_bytes(z, codec, len(raw)) == raw
+
+
+def test_carrier_wrap_unwrap_identity():
+    if msgpack_codec.preencode({}).raw is None:
+        pytest.skip("JSON-fallback host: no raw bodies to compress")
+    payload = _envelope()
+    enc = msgpack_codec.preencode(payload)
+    comp = compression.EnvelopeCompressor("zlib", min_bytes=0)
+    wrapped = comp.wrap(enc)
+    assert wrapped is not enc
+    assert compression.is_compressed_payload(wrapped.obj)
+    # meta rides OUTSIDE the compressed body: spool seq bookkeeping and
+    # rank attribution must never pay a decompress
+    assert wrapped.obj["meta"]["seq"] == 7
+    assert wrapped.obj["meta"]["global_rank"] == 2
+    assert wrapped.obj["meta"]["compression"] == "zlib"
+    assert compression.unwrap_payload(wrapped.obj) == payload
+    assert comp.stats()["ratio"] > 1.0
+
+
+def test_small_and_incompressible_pass_through():
+    import os as _os
+
+    comp = compression.EnvelopeCompressor("zlib")
+    small = msgpack_codec.preencode({"meta": {"seq": 1}})
+    assert comp.wrap(small) is small  # below min_bytes
+    noise = msgpack_codec.preencode(
+        {"meta": {"seq": 2}, "data": {"blob": _os.urandom(4096)}}
+    )
+    assert comp.wrap(noise) is noise  # no size win
+    assert comp.envelopes_compressed == 0
+    assert comp.envelopes_passthrough == 2
+
+
+def test_corrupt_carrier_raises():
+    enc = msgpack_codec.preencode(_envelope())
+    if enc.raw is None:
+        pytest.skip("JSON-fallback host")
+    wrapped = compression.EnvelopeCompressor("zlib", min_bytes=0).wrap(enc)
+    carrier = dict(wrapped.obj)
+    carrier["z"] = b"\x00" * len(carrier["z"])
+    with pytest.raises(compression.CompressionError):
+        compression.unwrap_payload(carrier)
+    # declared-size bomb guard
+    carrier2 = dict(wrapped.obj)
+    carrier2["n"] = compression.MAX_DECOMPRESSED_BYTES + 1
+    with pytest.raises(compression.CompressionError):
+        compression.unwrap_payload(carrier2)
+
+
+def test_unwrap_passes_plain_payloads_through():
+    p = _envelope()
+    assert compression.unwrap_payload(p) is p

@@ -148,6 +148,13 @@ class SQLiteWriter:
         self.dropped = 0
         self.written = 0
         self._batches = 0
+        # envelopes permanently resolved: group-committed (including
+        # dedup'd replays / unknown domains — they will never be
+        # retried) plus queue-full drops.  The aggregator gates shm
+        # ring-tail commits on this watermark: a ring frame's space is
+        # reclaimable only once every envelope drained before it can no
+        # longer be lost by a crash.
+        self._settled = 0
 
         self._stats_lock = threading.Lock()
         self._enq_by_domain: Dict[str, int] = {}
@@ -227,6 +234,7 @@ class SQLiteWriter:
         warn_count = 0
         with self._stats_lock:
             self.dropped += 1
+            self._settled += 1  # shed = resolved: it will never be written
             self._drop_by_domain[sampler] = (
                 self._drop_by_domain.get(sampler, 0) + 1
             )
@@ -245,6 +253,12 @@ class SQLiteWriter:
                 f"{_DROP_WARN_INTERVAL_S:.0f}s (latest sampler="
                 f"{sampler}); dropped by domain so far: {totals}"
             )
+
+    def settled_envelopes(self) -> int:
+        """Cumulative envelopes permanently resolved (committed batches
+        + queue-full drops).  Monotonic; safe to read from any thread."""
+        with self._stats_lock:
+            return self._settled
 
     def _record_unknown_domain(self, sampler: str) -> None:
         """An envelope named a table with no registered writer.  Neither
@@ -633,6 +647,11 @@ class SQLiteWriter:
             return
         finally:
             self._batches += 1
+            # the whole batch is resolved — committed, dedup'd, unknown,
+            # or (on the rollback path above) permanently lost; none of
+            # it will ever be retried, so the watermark may advance
+            with self._stats_lock:
+                self._settled += len(batch)
         lat = (time.perf_counter() - t0) * 1000.0
         self._commit_lat_ms.append(lat)
         if lat > self._commit_max_ms:

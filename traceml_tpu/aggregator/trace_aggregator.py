@@ -23,6 +23,7 @@ from __future__ import annotations
 import json
 import threading
 import time
+from collections import deque
 from typing import Any, Dict, List, Optional, Set
 
 from traceml_tpu.aggregator.display_drivers import resolve_display_driver
@@ -37,10 +38,12 @@ from traceml_tpu.telemetry.control import (
     PRODUCER_STATS,
     RANK_FINISHED,
     RANK_HEARTBEAT,
+    TRANSPORT_HELLO,
     control_kind,
     is_control_message,
 )
 from traceml_tpu.telemetry.envelope import TelemetryEnvelope, normalize_telemetry_envelope
+from traceml_tpu.transport.select import server_transport_config
 from traceml_tpu.transport.tcp_transport import TCPServer
 from traceml_tpu.utils.atomic_io import atomic_write_json
 from traceml_tpu.utils.error_log import get_error_log
@@ -56,9 +59,25 @@ _DRAIN_BATCH_FRAMES = 512
 class TraceMLAggregator:
     def __init__(self, settings: TraceMLSettings) -> None:
         self.settings = settings
+        # transport tier: in auto mode the ingest server also stands up
+        # a UDS listener and polls same-host shm rings; TRACEML_TRANSPORT
+        # =tcp yields exactly the plain pre-transport-tier TCPServer
+        # (docs/developer_guide/native-transport.md)
+        transport_cfg = server_transport_config(settings)
         self.server = TCPServer(
-            host=settings.aggregator.bind_host, port=settings.aggregator.port
+            host=settings.aggregator.bind_host,
+            port=settings.aggregator.port,
+            uds_path=transport_cfg.get("uds_path"),
         )
+        self.ring_registry = None
+        if transport_cfg.get("enable_rings"):
+            try:
+                from traceml_tpu.transport.shm_ring import ShmRingRegistry
+
+                self.ring_registry = ShmRingRegistry(settings.session_dir)
+                self.server.attach_ring_registry(self.ring_registry)
+            except Exception as exc:
+                get_error_log().warning("shm ring registry unavailable", exc)
         self.db_path = settings.session_dir / "telemetry.sqlite"
         self.writer = SQLiteWriter(
             self.db_path, summary_window_rows=settings.summary_window_rows
@@ -85,6 +104,8 @@ class TraceMLAggregator:
         # latest producer_stats snapshot per rank (publisher self-
         # observability: collect/encode/flush cost, idle-tick ratio)
         self._producer_stats: Dict[int, Dict[str, Any]] = {}
+        # per-rank transport_hello announcements (kind + codec chosen)
+        self._transport_hellos: Dict[int, Dict[str, Any]] = {}
         # _drain_lock now guards ONLY the frame handoff (server.drain +
         # ticket issue); decode runs unlocked and ingest is ordered by
         # ticket under _ingest_cond — see _drain_once
@@ -92,6 +113,14 @@ class TraceMLAggregator:
         self._ingest_cond = threading.Condition()
         self._drain_ticket = 0
         self._ingest_next = 0
+        # shm durable-consumption watermarks: ring tails advance only
+        # after the writer settles the envelopes drained up to a cursor
+        # snapshot, so an aggregator kill -9 between drain and commit
+        # re-delivers the window to the next incarnation (seq dedup
+        # absorbs the overlap).  Guarded by _ingest_cond; the drained-
+        # frame counter by _drain_lock.
+        self._shm_frames_drained = 0
+        self._ring_watermarks: "deque" = deque()
         self._last_drain_frames = 0
         self._last_ui_tick = 0.0
         self._last_stats_write = 0.0
@@ -217,6 +246,16 @@ class TraceMLAggregator:
             frames = self.server.drain_tagged(max_frames)
             ticket = self._drain_ticket
             self._drain_ticket += 1
+            cursors = None
+            if self.ring_registry is not None and frames:
+                shm_n = sum(1 for tag, _f in frames if tag.startswith("shm:"))
+                if shm_n:
+                    self._shm_frames_drained += shm_n
+                    # newest ring-cursor snapshot fully covered by the
+                    # frames this (and earlier) drain slices pulled out
+                    cursors = self.ring_registry.take_marks(
+                        self._shm_frames_drained
+                    )
         payloads: List[Any] = []
         try:
             if frames:
@@ -246,6 +285,14 @@ class TraceMLAggregator:
                         n += 1
                     self.envelopes_ingested += n
                     self._last_drain_frames = len(frames)
+                    if cursors:
+                        # ticket ordering guarantees envelopes_ingested
+                        # now covers every frame drained before this
+                        # cursor snapshot — commit the tails once the
+                        # writer has settled that many envelopes
+                        self._ring_watermarks.append(
+                            (self.envelopes_ingested, cursors)
+                        )
                 finally:
                     # the ticket advances even when decode/ingest raised,
                     # or every later caller would deadlock at the gate
@@ -266,6 +313,22 @@ class TraceMLAggregator:
                 chaos.fire("aggregator.ingest")
         except ImportError:  # pragma: no cover
             pass
+
+    def _commit_rings(self) -> None:
+        """Advance shm ring tails for every watermark the writer has
+        settled (see _ring_watermarks).  Cheap when nothing is eligible;
+        called from the loop tick and after each force_flush."""
+        if self.ring_registry is None:
+            return
+        with self._ingest_cond:
+            if not self._ring_watermarks:
+                return
+            settled = self.writer.settled_envelopes()
+            cursors = None
+            while self._ring_watermarks and self._ring_watermarks[0][0] <= settled:
+                cursors = self._ring_watermarks.popleft()[1]
+        if cursors:
+            self.ring_registry.commit(cursors)
 
     def _drain_all(self) -> int:
         """Drain to empty in bounded slices (settle/shutdown path: no UI
@@ -306,11 +369,33 @@ class TraceMLAggregator:
                     str(rank): stats
                     for rank, stats in sorted(self._producer_stats.items())
                 },
+                "transports": self._transport_stats(),
                 "final": final,
                 "ts": time.time(),
             },
         )
         self._write_rank_status()
+
+    def _transport_stats(self) -> Dict[str, Any]:
+        """Transport-tier observability: frames per arrival path, the
+        decompression counters, shm ring registry health, and each
+        rank's announced (kind, codec)."""
+        out: Dict[str, Any] = {
+            "frames_by_kind": dict(self.server.frames_by_transport),
+            "compression": {
+                "envelopes": self.server.compressed_envelopes,
+                "bytes_in": self.server.compressed_bytes_in,
+                "bytes_decoded": self.server.decompressed_bytes,
+                "errors": self.server.decompress_errors,
+            },
+            "ranks": {
+                str(rank): hello
+                for rank, hello in sorted(self._transport_hellos.items())
+            },
+        }
+        if self.ring_registry is not None:
+            out["shm"] = self.ring_registry.stats()
+        return out
 
     def _write_rank_status(self) -> None:
         """Persist the liveness snapshot.  Written on the stats cadence
@@ -359,6 +444,23 @@ class TraceMLAggregator:
             # later snapshots are cumulative — keep only the latest
             self._producer_stats[rank] = stats
             self.liveness.observe(rank)
+        elif kind == TRANSPORT_HELLO:
+            meta = payload.get("meta") or {}
+            try:
+                rank = int(meta.get("global_rank", meta.get("rank")))
+            except (TypeError, ValueError):
+                return
+            self._seen_ranks.add(rank)
+            self.liveness.observe(rank)
+            # keep-latest: a restarted rank may re-announce with a
+            # different tier (e.g. fell back from shm to tcp)
+            hello = {
+                "transport": payload.get("transport"),
+                "compression": payload.get("compression"),
+            }
+            if payload.get("fallback_from"):
+                hello["fallback_from"] = payload.get("fallback_from")
+            self._transport_hellos[rank] = hello
         elif kind == MESH_TOPOLOGY:
             meta = payload.get("meta") or {}
             topo = payload.get("topology")
@@ -403,6 +505,7 @@ class TraceMLAggregator:
                 # loop never parks in wait_for_data with frames pending
                 while True:
                     self._drain_once()
+                    self._commit_rings()
                     now = time.monotonic()
                     if now - self._last_ui_tick >= _RENDER_INTERVAL:
                         self._last_ui_tick = now
@@ -440,6 +543,7 @@ class TraceMLAggregator:
         deadline = time.monotonic() + timeout
         self._drain_all()
         self.writer.force_flush(timeout=max(0.5, deadline - time.monotonic()))
+        self._commit_rings()
 
     def _settle_end_of_run(self, deadline: float) -> None:
         """Wait for all expected rank_finished markers or the deadline
@@ -452,6 +556,7 @@ class TraceMLAggregator:
             time.sleep(_SETTLE_POLL)
         self._drain_all()
         self.writer.force_flush(timeout=max(1.0, deadline - time.monotonic()))
+        self._commit_rings()
         missing = sorted(
             set(range(expected)) - self._finished_ranks
         )

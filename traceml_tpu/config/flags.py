@@ -179,6 +179,25 @@ SCRIPT_ARGS = declare(
     "shell-quoted argv tail for the user training script")
 
 # --------------------------------------------------------------------
+# transport tier (docs/developer_guide/native-transport.md)
+# --------------------------------------------------------------------
+TRANSPORT = declare(
+    "TRACEML_TRANSPORT", "auto",
+    "telemetry transport: auto | shm | uds | tcp (auto = same-host shm)")
+TRANSPORT_COMPRESS = declare(
+    "TRACEML_TRANSPORT_COMPRESS", "auto",
+    "cross-host envelope compression: auto | zstd | zlib | 0 (off)")
+SHM_RING_BYTES = declare(
+    "TRACEML_SHM_RING_BYTES", "4194304",
+    "per-rank shared-memory ring capacity in bytes (same-host transport)")
+SHM_DIR = declare(
+    "TRACEML_SHM_DIR", None,
+    "directory for ring segment files (default /dev/shm, else rank dir)")
+UDS_PATH = declare(
+    "TRACEML_UDS_PATH", None,
+    "Unix-domain socket path for the uds transport (default derived)")
+
+# --------------------------------------------------------------------
 # fault tolerance / liveness
 # --------------------------------------------------------------------
 AGG_MAX_RESTARTS = declare(

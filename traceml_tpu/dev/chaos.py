@@ -25,7 +25,8 @@ Rule fields:
 Points and the actions their call sites implement:
 
 ======================  =====================================================
-``client.send``         per ``TCPClient.send_batch`` attempt (rank side).
+``client.send``         per ``TCPClient.send_batch`` attempt (rank side;
+                        the UDS client inherits this point).
                         ``reset`` — tear the socket down and fail the send;
                         ``stall`` — sleep ``arg`` seconds (default 0.2) before
                         sending; ``corrupt`` — flip a byte inside the frame
@@ -34,6 +35,16 @@ Points and the actions their call sites implement:
                         reset (receiver-side stream desync).
 ``rank.tick``           per runtime sampler tick (rank side). ``kill9``.
 ``aggregator.ingest``   per telemetry envelope ingested. ``kill9``.
+``shm.write``           per shm-ring frame publish (rank side). ``kill9`` —
+                        die mid-ring-write (the unpublished frame must
+                        never surface); ``stall``; ``corrupt`` — flip a
+                        byte in the frame body before publish;
+                        ``reset``/``truncate`` — fail the publish (the
+                        durable sender spools).
+``shm.attach``          per aggregator ring attach. ``corrupt`` — zero the
+                        segment magic before validation (torn-header
+                        reattach: the ring is quarantined and the rank
+                        fails over to a stream transport).
 ======================  =====================================================
 
 Determinism: counters are per-rule and event-based (never time-based),
@@ -56,7 +67,9 @@ ENV_FAULT_PLAN = flags.FAULT_PLAN.name
 
 #: Known points — call sites assert membership in tests so a typo in a
 #: plan or a call site can't silently never fire.
-POINTS = frozenset({"client.send", "rank.tick", "aggregator.ingest"})
+POINTS = frozenset(
+    {"client.send", "rank.tick", "aggregator.ingest", "shm.write", "shm.attach"}
+)
 ACTIONS = frozenset({"reset", "stall", "corrupt", "truncate", "kill9"})
 
 

@@ -151,7 +151,33 @@ def resolve_settings(cli: Dict[str, Any]) -> TraceMLSettings:
         expected_world_size=int(cli.get("nprocs") or 1) * nnodes,
         finalize_timeout_sec=float(pick("finalize_timeout_sec", 300.0)),
         summary_window_rows=int(pick("summary_window_rows", 10000)),
+        # transport tier: yaml/env-configurable, defaults resolve in
+        # transport/select.py (same-host → shm ring, else TCP)
+        transport=str(pick("transport", "auto")),
+        transport_compress=str(pick("transport_compress", "auto")),
+        shm_ring_bytes=int(pick("shm_ring_bytes", 4194304)),
+        shm_dir=pick("shm_dir") or None,
+        uds_path=pick("uds_path") or None,
     )
+
+
+def _cleanup_ring_segments(session_dir: Path) -> None:
+    """End-of-run hygiene: remove the shm ring segment files the ranks
+    created (they live outside the session dir, typically /dev/shm, so
+    nothing else would ever reap them)."""
+    try:
+        from traceml_tpu.transport.shm_ring import scan_ring_descriptors
+
+        for desc in scan_ring_descriptors(session_dir):
+            for name in (desc.get("path"), desc.get("_descriptor")):
+                if not name:
+                    continue
+                try:
+                    Path(name).unlink()
+                except OSError:
+                    pass
+    except Exception:
+        pass
 
 
 def launch_process(
@@ -368,6 +394,8 @@ def launch_process(
                     terminate(agg_child.proc, grace_sec=2.0)
             if crash_logs:
                 mf.update_run_manifest(session_dir, crash_logs=crash_logs)
+            if owner:
+                _cleanup_ring_segments(session_dir)
         finally:
             if old_sigterm is not None:
                 try:

@@ -1,10 +1,12 @@
 """Native fast paths with build-on-first-use and pure-Python fallback.
 
-``get_framing()`` returns the compiled ``_framing`` extension module or
-``None``.  The first call may invoke the C compiler (a few seconds,
-cached as a ``.so`` next to the source); any failure — no compiler, no
-headers, sandbox — silently falls back to the Python implementations in
-``transport/tcp_transport.py``.  Set ``TRACEML_NO_NATIVE=1`` to skip.
+``get_framing()`` returns the compiled ``_framing`` extension module
+(frame pack/drain for the socket transports) and ``get_ring()`` the
+``_ring`` extension (SPSC shared-memory ring ops) — or ``None``.  The
+first call for each may invoke the C compiler (a few seconds, cached as
+a ``.so`` next to the source); any failure — no compiler, no headers,
+sandbox — silently falls back to the Python implementations in
+``transport/``.  Set ``TRACEML_NO_NATIVE=1`` to skip both.
 """
 
 from __future__ import annotations
@@ -13,25 +15,23 @@ import importlib
 import importlib.util
 import os
 import subprocess
-import sys
 import threading
 from pathlib import Path
-from typing import Optional
+from typing import Dict, Optional
 
 from traceml_tpu.config import flags
 
 _lock = threading.Lock()
-_cached = None
-_attempted = False
+_cached: Dict[str, Optional[object]] = {}
 
 _HERE = Path(__file__).resolve().parent
 
 
-def _try_import() -> Optional[object]:
-    for so in _HERE.glob("_framing*.so"):
+def _try_import(mod_name: str) -> Optional[object]:
+    for so in _HERE.glob(f"{mod_name}*.so"):
         try:
-            # the name must match PyInit__framing
-            spec = importlib.util.spec_from_file_location("_framing", so)
+            # the name must match PyInit_<mod_name>
+            spec = importlib.util.spec_from_file_location(mod_name, so)
             mod = importlib.util.module_from_spec(spec)
             spec.loader.exec_module(mod)  # type: ignore[union-attr]
             return mod
@@ -40,15 +40,15 @@ def _try_import() -> Optional[object]:
     return None
 
 
-def _build() -> bool:
-    """Compile framing.c into this directory; True on success."""
+def _build(src_name: str, mod_name: str) -> bool:
+    """Compile one source file into this directory; True on success."""
     try:
         import sysconfig
 
         include = sysconfig.get_paths()["include"]
-        src = _HERE / "framing.c"
+        src = _HERE / src_name
         ext = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
-        out = _HERE / f"_framing{ext}"
+        out = _HERE / f"{mod_name}{ext}"
         cmd = [
             os.environ.get("CC", "cc"),
             "-O2",
@@ -67,21 +67,27 @@ def _build() -> bool:
         return False
 
 
-def get_framing() -> Optional[object]:
-    """The compiled extension, building it on first use; None on failure."""
-    global _cached, _attempted
-    if _cached is not None:
-        return _cached
-    if _attempted:
-        return None
+def _get(src_name: str, mod_name: str) -> Optional[object]:
+    if mod_name in _cached:
+        return _cached[mod_name]
     with _lock:
-        if _cached is not None or _attempted:
-            return _cached
-        _attempted = True
+        if mod_name in _cached:
+            return _cached[mod_name]
         if flags.NO_NATIVE.truthy():
+            _cached[mod_name] = None
             return None
-        mod = _try_import()
-        if mod is None and _build():
-            mod = _try_import()
-        _cached = mod
+        mod = _try_import(mod_name)
+        if mod is None and _build(src_name, mod_name):
+            mod = _try_import(mod_name)
+        _cached[mod_name] = mod
         return mod
+
+
+def get_framing() -> Optional[object]:
+    """The compiled framing extension, built on first use; None on failure."""
+    return _get("framing.c", "_framing")
+
+
+def get_ring() -> Optional[object]:
+    """The compiled SPSC ring extension, built on first use; None on failure."""
+    return _get("ring.c", "_ring")

@@ -143,7 +143,7 @@ def _meta_fragment(
                     "dropped_by_domain", "unknown_domain_drops", "queues",
                     "group_commit", "prune", "corrupt_frame_drops",
                     "replay_duplicates",
-                    "pending_frames_hwm", "producers", "ts",
+                    "pending_frames_hwm", "producers", "transports", "ts",
                 )
                 if k in stats
             }

@@ -1059,7 +1059,7 @@ def generate_summary(
                 "rows_written", "rows_dropped", "dropped_by_domain",
                 "unknown_domain_drops", "drop_warnings",
                 "pending_frames_hwm", "queues",
-                "group_commit", "prune", "producers",
+                "group_commit", "prune", "producers", "transports",
             )
             if k in stats
         }

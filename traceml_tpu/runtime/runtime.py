@@ -21,6 +21,7 @@ from traceml_tpu.runtime.stdout_capture import StreamCapture
 from traceml_tpu.samplers.base_sampler import BaseSampler
 from traceml_tpu.sdk.state import get_state
 from traceml_tpu.telemetry.control import build_mesh_topology, build_rank_finished
+from traceml_tpu.transport.select import create_transport_client
 from traceml_tpu.transport.tcp_transport import TCPClient
 from traceml_tpu.utils.error_log import get_error_log
 
@@ -39,6 +40,9 @@ class TraceMLRuntime:
             self.capture = StreamCapture(capture_stderr=settings.capture_stderr)
         self.samplers: List[BaseSampler] = []
         self.client: Optional[TCPClient] = None
+        # transport-tier selection result ({"kind", "compression", ...});
+        # the publisher announces it via a transport_hello control message
+        self.transport_info: dict = {}
         self.publisher: Optional[TelemetryPublisher] = None
         self._thread: Optional[threading.Thread] = None
         self._profile_service = None
@@ -66,9 +70,11 @@ class TraceMLRuntime:
             self.capture.start()
         self.samplers = build_samplers(self.settings, self.identity, self.capture)
         if self.settings.aggregator.port:
-            self.client = TCPClient(
-                self.settings.aggregator.connect_host,
-                self.settings.aggregator.port,
+            # transport tier: shm ring on the same host, UDS when a path
+            # is given, TCP as the golden fallback (TRACEML_TRANSPORT
+            # overrides; docs/developer_guide/native-transport.md)
+            self.client, self.transport_info = create_transport_client(
+                self.settings, self.identity.global_rank
             )
         sender_identity = self.identity.to_sender_identity(self.settings.session_id)
         heartbeat_s = flags.HEARTBEAT_INTERVAL_SEC.get_float(3.0)
@@ -85,6 +91,7 @@ class TraceMLRuntime:
                 else None
             ),
             heartbeat_interval_s=heartbeat_s,
+            transport_info=self.transport_info,
         )
         # max-steps lifecycle: observe sdk step flushes
         get_state().on_step_flushed.append(self.recording.on_step_flushed)

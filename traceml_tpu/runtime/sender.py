@@ -46,8 +46,10 @@ from traceml_tpu.samplers.base_sampler import BaseSampler
 from traceml_tpu.telemetry.control import (
     build_producer_stats,
     build_rank_heartbeat,
+    build_transport_hello,
 )
 from traceml_tpu.telemetry.envelope import SenderIdentity
+from traceml_tpu.transport import compression as transport_compression
 from traceml_tpu.transport.spool import DurableSender, ReplaySpool
 from traceml_tpu.transport.tcp_transport import TCPClient
 from traceml_tpu.utils import msgpack_codec
@@ -65,10 +67,24 @@ class TelemetryPublisher:
         stats_interval_s: float = 10.0,
         spool_dir: Optional[Path] = None,
         heartbeat_interval_s: float = DEFAULT_HEARTBEAT_INTERVAL_S,
+        transport_info: Optional[Dict[str, Any]] = None,
     ) -> None:
         self._samplers = samplers
         self._client = client
         self._identity = identity
+        # transport-tier selection ({"kind", "compression", ...}) — see
+        # transport/select.py; announced once via transport_hello and
+        # reported in stats()
+        self._transport_info = transport_info or {}
+        self._hello_pending = client is not None
+        codec = self._transport_info.get("compression")
+        # wire/spool compression: the disk backup keeps the plain enc
+        # (local reads should not pay a decompress), while the batch —
+        # and therefore the spool, whose frames store exactly the wire
+        # body — carries the compressed carrier
+        self._compressor = (
+            transport_compression.EnvelopeCompressor(codec) if codec else None
+        )
         for s in samplers:
             s.sender.set_identity(identity)
             # the publisher owns collection; the writer must never fall
@@ -149,7 +165,10 @@ class TelemetryPublisher:
                     st["encode_ns"] += t2 - t1
                     st["envelopes"] += 1
                     st["bytes"] += enc.size()
-                    batch.append(enc)
+                    if self._compressor is not None:
+                        batch.append(self._compressor.wrap(enc))
+                    else:
+                        batch.append(enc)
                     writer.append_envelope(enc)
                     t3 = perf()
                     writer.flush(force=final)
@@ -169,6 +188,10 @@ class TelemetryPublisher:
                 self._stamp_seq(p)
             batch.extend(extra_payloads)
         if batch:
+            hello = self._take_hello()
+            if hello is not None:
+                self._stamp_seq(hello)
+                batch.insert(0, hello)
             stats_msg = self._maybe_stats_message(final)
             if stats_msg is not None:
                 self._stamp_seq(stats_msg)
@@ -182,6 +205,23 @@ class TelemetryPublisher:
                 self.payloads_sent += len(batch)
                 self._last_heartbeat = time.monotonic()
         return len(batch)
+
+    def _take_hello(self) -> Optional[Dict[str, Any]]:
+        """The send-once transport_hello announcement (observability:
+        which tier and codec this rank selected)."""
+        if not self._hello_pending:
+            return None
+        self._hello_pending = False
+        try:
+            return build_transport_hello(
+                self._identity.to_meta(),
+                self._transport_info.get("kind")
+                or getattr(self._client, "kind", "tcp"),
+                self._transport_info.get("compression"),
+                self._transport_info.get("fallback_from"),
+            )
+        except Exception:
+            return None
 
     def _maybe_heartbeat(self) -> None:
         """Liveness beacon on idle ticks.  Transient (never spooled — a
@@ -197,10 +237,16 @@ class TelemetryPublisher:
         try:
             hb = build_rank_heartbeat(self._identity.to_meta())
             self._stamp_seq(hb)
+            msgs = [hb]
+            # a fully idle rank still announces its transport once
+            hello = self._take_hello()
+            if hello is not None:
+                self._stamp_seq(hello)
+                msgs.insert(0, hello)
             if self._durable is not None:
-                self._durable.send_transient([hb])
+                self._durable.send_transient(msgs)
             else:
-                self._client.send_batch([hb])
+                self._client.send_batch(msgs)
         except Exception as exc:
             get_error_log().warning("heartbeat send failed", exc)
 
@@ -244,10 +290,17 @@ class TelemetryPublisher:
             # getattr: embedders pass client doubles that predate these
             # counters; stats must never take down the publish tick
             transport = {
+                "kind": self._transport_info.get("kind")
+                or getattr(self._client, "kind", "tcp"),
                 "reconnects": getattr(self._client, "reconnects", 0),
                 "batches_sent": getattr(self._client, "batches_sent", 0),
                 "batches_dropped": getattr(self._client, "batches_dropped", 0),
             }
+            ring_full = getattr(self._client, "ring_full_drops", None)
+            if ring_full is not None:
+                transport["ring_full_drops"] = ring_full
+        if self._compressor is not None:
+            transport["compression"] = self._compressor.stats()
         if self._durable is not None:
             transport.update(self._durable.stats())
         if transport:

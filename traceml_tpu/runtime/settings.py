@@ -35,6 +35,11 @@ ENV_SUMMARY_WINDOW_ROWS = flags.SUMMARY_WINDOW_ROWS.name
 ENV_SERVE_MAX_SESSIONS = flags.SERVE_MAX_SESSIONS.name
 ENV_SCRIPT = flags.SCRIPT.name
 ENV_SCRIPT_ARGS = flags.SCRIPT_ARGS.name
+ENV_TRANSPORT = flags.TRANSPORT.name
+ENV_TRANSPORT_COMPRESS = flags.TRANSPORT_COMPRESS.name
+ENV_SHM_RING_BYTES = flags.SHM_RING_BYTES.name
+ENV_SHM_DIR = flags.SHM_DIR.name
+ENV_UDS_PATH = flags.UDS_PATH.name
 
 
 @dataclasses.dataclass(frozen=True)
@@ -67,6 +72,13 @@ class TraceMLSettings:
     # serving tier: max concurrently-open session publishers (LRU bound
     # on sqlite connections) when one aggregator serves a fleet
     serve_max_sessions: int = 8
+    # transport tier (docs/developer_guide/native-transport.md):
+    # auto | shm | uds | tcp, plus the compression / shm knobs
+    transport: str = "auto"
+    transport_compress: str = "auto"
+    shm_ring_bytes: int = 4194304
+    shm_dir: Optional[str] = None
+    uds_path: Optional[str] = None
 
     @property
     def session_dir(self) -> Path:
@@ -121,6 +133,11 @@ def settings_from_env(env: Optional[Dict[str, str]] = None) -> TraceMLSettings:
         finalize_timeout_sec=flags.FINALIZE_TIMEOUT_SEC.get_float(300.0, e),
         summary_window_rows=flags.SUMMARY_WINDOW_ROWS.get_int(10000, e),
         serve_max_sessions=flags.SERVE_MAX_SESSIONS.get_int(8, e),
+        transport=flags.TRANSPORT.raw(e) or "auto",
+        transport_compress=flags.TRANSPORT_COMPRESS.raw(e) or "auto",
+        shm_ring_bytes=flags.SHM_RING_BYTES.get_int(4194304, e),
+        shm_dir=flags.SHM_DIR.raw(e) or None,
+        uds_path=flags.UDS_PATH.raw(e) or None,
     )
 
 
@@ -149,4 +166,14 @@ def settings_to_env(s: TraceMLSettings) -> Dict[str, str]:
         env[ENV_RUN_NAME] = s.run_name
     if s.expected_world_size is not None:
         env[ENV_EXPECTED_WORLD_SIZE] = str(s.expected_world_size)
+    if s.transport != "auto":
+        env[ENV_TRANSPORT] = s.transport
+    if s.transport_compress != "auto":
+        env[ENV_TRANSPORT_COMPRESS] = s.transport_compress
+    if s.shm_ring_bytes != 4194304:
+        env[ENV_SHM_RING_BYTES] = str(s.shm_ring_bytes)
+    if s.shm_dir:
+        env[ENV_SHM_DIR] = s.shm_dir
+    if s.uds_path:
+        env[ENV_UDS_PATH] = s.uds_path
     return env

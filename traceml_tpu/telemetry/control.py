@@ -19,6 +19,11 @@ Three control messages today:
   captured by utils/topology.py and persisted so diagnoses can be
   attributed to physical structure
   (docs/developer_guide/topology-attribution.md).
+* ``transport_hello`` — one-shot per-rank announcement of the chosen
+  transport tier (shm/uds/tcp) and compression codec, surfaced in
+  ``ingest_stats.json`` → the report meta strip
+  (docs/developer_guide/native-transport.md).  Observability only:
+  the wire is self-describing, nothing is negotiated off this.
 
 All are idempotent on replay (set-add / keep-latest / last-seen max),
 so the durable-send spool may re-deliver them without a dedup table.
@@ -34,6 +39,7 @@ RANK_FINISHED = "rank_finished"
 PRODUCER_STATS = "producer_stats"
 RANK_HEARTBEAT = "rank_heartbeat"
 MESH_TOPOLOGY = "mesh_topology"
+TRANSPORT_HELLO = "transport_hello"
 
 
 def build_rank_finished(identity_meta: Mapping[str, Any]) -> Dict[str, Any]:
@@ -72,6 +78,24 @@ def build_mesh_topology(
         "topology": dict(topology),
         "timestamp": time.time(),
     }
+
+
+def build_transport_hello(
+    identity_meta: Mapping[str, Any],
+    kind: Optional[str],
+    compression: Optional[str],
+    fallback_from: Optional[str] = None,
+) -> Dict[str, Any]:
+    msg: Dict[str, Any] = {
+        CONTROL_KEY: TRANSPORT_HELLO,
+        "meta": dict(identity_meta),
+        "transport": kind,
+        "compression": compression,
+        "timestamp": time.time(),
+    }
+    if fallback_from:
+        msg["fallback_from"] = fallback_from
+    return msg
 
 
 def is_control_message(payload: Any) -> bool:

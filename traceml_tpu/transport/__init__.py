@@ -1,3 +1,19 @@
-"""Telemetry transport (reference: src/traceml_ai/transport/)."""
+"""Telemetry transport (reference: src/traceml_ai/transport/).
 
-from traceml_tpu.transport.tcp_transport import TCPServer, TCPClient  # noqa: F401
+Tiers (docs/developer_guide/native-transport.md): same-host shm ring
+(``shm_ring``), Unix-domain stream (``UDSClient``), TCP (the golden
+fallback), plus optional per-envelope compression (``compression``).
+``select.choose_transport`` picks automatically; ``TRACEML_TRANSPORT``
+overrides.
+"""
+
+from traceml_tpu.transport.tcp_transport import (  # noqa: F401
+    TCPServer,
+    TCPClient,
+    UDSClient,
+)
+from traceml_tpu.transport.select import (  # noqa: F401
+    choose_transport,
+    create_transport_client,
+    default_uds_path,
+)

@@ -1,0 +1,173 @@
+"""Transport selection: shm ring / UDS / TCP, automatic with override.
+
+Policy (``TRACEML_TRANSPORT``, declared in config/flags.py):
+
+``auto``  same-host detect (the aggregator connect host is loopback) →
+          shm ring; else UDS when an explicit socket path was given;
+          else TCP.  Any fast-path setup failure falls through to the
+          next tier and ultimately to TCP — the pure-Python TCP path is
+          the golden fallback, mirroring the ColumnarFallback pattern.
+``shm``   force the ring (setup failure still falls back to TCP rather
+          than dropping telemetry).
+``uds``   force the Unix-domain stream.
+``tcp``   force plain TCP — byte-for-byte the pre-transport-tier
+          behavior: no UDS listener, no ring registry, no compression
+          unless explicitly forced, 0.5 s selector tick.
+
+Compression (``TRACEML_TRANSPORT_COMPRESS``): ``auto`` enables the best
+available codec only on a cross-host TCP link (loopback and same-host
+fast paths gain nothing from shrinking bytes that never leave the
+machine); an explicit codec name forces it on any stream transport;
+shm frames are never compressed (the ring IS the same host).
+
+The selection is rank-side; the aggregator side mirrors it with
+:func:`server_transport_config` so both ends of the contract read the
+same flags.  Everything here is cheap and fail-open: a broken fast
+path must degrade to TCP, never into training code.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from pathlib import Path
+from typing import Any, Dict, Optional, Tuple
+
+from traceml_tpu.transport import compression
+from traceml_tpu.transport.tcp_transport import TCPClient, UDSClient
+from traceml_tpu.utils.error_log import get_error_log
+
+_LOOPBACK_HOSTS = ("127.0.0.1", "localhost", "::1", "0.0.0.0")
+
+
+def is_same_host(connect_host: str) -> bool:
+    """True when the aggregator is reachable without leaving the machine
+    (the launcher's default single-host topology)."""
+    return str(connect_host).strip().lower() in _LOOPBACK_HOSTS
+
+
+def default_uds_path(session_dir: Path) -> str:
+    """Deterministic socket path both ends derive from the session dir.
+
+    Short (AF_UNIX paths are capped at ~107 bytes and pytest tmp session
+    dirs routinely blow past that) and collision-free per (session, uid)
+    via digest.
+    """
+    digest = hashlib.sha1(
+        f"{Path(session_dir).resolve()}:{os.getuid()}".encode()
+    ).hexdigest()[:12]
+    return f"/tmp/traceml-{digest}.sock"
+
+
+def choose_transport(
+    transport: str, connect_host: str, uds_path: Optional[str]
+) -> str:
+    """Resolve the configured transport mode to a concrete kind."""
+    mode = (transport or "auto").strip().lower()
+    if mode in ("tcp", "uds", "shm"):
+        return mode
+    if is_same_host(connect_host):
+        return "shm"
+    if uds_path:
+        return "uds"
+    return "tcp"
+
+
+def resolve_compression(
+    transport_kind: str, requested: str, connect_host: str = ""
+) -> Optional[str]:
+    """The codec the publisher should wrap envelopes with, or None."""
+    req = (requested or "auto").strip().lower()
+    if req in ("", "0", "false", "off", "none"):
+        return None
+    if transport_kind == "shm":
+        # same-page-cache delivery: compressing would only add CPU
+        return None
+    if req in ("auto", "1", "true", "yes", "on"):
+        # auto: only a genuinely cross-host TCP link pays per byte —
+        # loopback TCP (incl. the forced TRACEML_TRANSPORT=tcp arm)
+        # stays byte-identical to the pre-transport-tier wire
+        if transport_kind != "tcp" or is_same_host(connect_host):
+            return None
+        return compression.resolve_codec("auto")
+    return compression.resolve_codec(req)
+
+
+def create_transport_client(
+    settings: Any, global_rank: int
+) -> Tuple[Optional[TCPClient], Dict[str, Any]]:
+    """Build the rank-side telemetry client for ``settings``.
+
+    Returns ``(client, info)`` where ``info`` carries ``kind``,
+    ``compression`` (codec name or None), and ``fallback_from`` when a
+    fast path failed setup and the tier below took over.  The client
+    quacks like :class:`TCPClient` for everything the publisher and
+    DurableSender touch.
+    """
+    connect_host = settings.aggregator.connect_host
+    port = settings.aggregator.port
+    if not port:
+        return None, {"kind": None, "compression": None}
+    kind = choose_transport(
+        getattr(settings, "transport", "auto"),
+        connect_host,
+        getattr(settings, "uds_path", None),
+    )
+    info: Dict[str, Any] = {"kind": kind, "compression": None}
+    client: Optional[TCPClient] = None
+    if kind == "shm":
+        try:
+            from traceml_tpu.transport import shm_ring
+
+            shm_dir = getattr(settings, "shm_dir", None)
+            path = shm_ring.ring_segment_path(
+                settings.session_dir,
+                global_rank,
+                Path(shm_dir) if shm_dir else None,
+            )
+            client = shm_ring.ShmRingClient(  # type: ignore[assignment]
+                path,
+                capacity=getattr(settings, "shm_ring_bytes", None),
+                session_dir=settings.session_dir,
+                global_rank=global_rank,
+            )
+        except Exception as exc:
+            # fallback-on-attach-failure: degrade to the golden path
+            get_error_log().warning(
+                "shm ring setup failed; falling back to tcp", exc
+            )
+            info["fallback_from"] = "shm"
+            kind = "tcp"
+            info["kind"] = "tcp"
+    if kind == "uds":
+        path = getattr(settings, "uds_path", None) or default_uds_path(
+            settings.session_dir
+        )
+        client = UDSClient(path)
+    elif client is None:
+        client = TCPClient(connect_host, port)
+        info["kind"] = kind = "tcp"
+    info["compression"] = resolve_compression(
+        kind, getattr(settings, "transport_compress", "auto"), connect_host
+    )
+    return client, info
+
+
+def server_transport_config(settings: Any) -> Dict[str, Any]:
+    """The aggregator-side mirror of the selection: which extra
+    listeners/registries the ingest server should stand up.
+
+    ``tcp`` mode returns the empty config — the server is then
+    byte-for-byte the pre-transport-tier TCPServer.
+    """
+    mode = (getattr(settings, "transport", "auto") or "auto").strip().lower()
+    out: Dict[str, Any] = {"uds_path": None, "enable_rings": False}
+    if mode == "tcp":
+        return out
+    if mode in ("auto", "uds"):
+        out["uds_path"] = getattr(settings, "uds_path", None) or default_uds_path(
+            settings.session_dir
+        )
+    if mode in ("auto", "shm"):
+        out["enable_rings"] = True
+    return out

@@ -30,6 +30,7 @@ connect, drop-on-failure, bounded reconnect backoff
 
 from __future__ import annotations
 
+import os
 import random
 import selectors
 import socket
@@ -38,6 +39,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from traceml_tpu.transport import compression
 from traceml_tpu.utils import msgpack_codec
 from traceml_tpu.utils.error_log import get_error_log
 
@@ -118,10 +120,21 @@ class TCPServer:
     that don't care about the split can use :meth:`drain_decoded`.
     """
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        uds_path: Optional[str] = None,
+    ) -> None:
         self._host = host
         self._requested_port = port
         self._sock: Optional[socket.socket] = None
+        # optional extra AF_UNIX listener on the same selector (the uds
+        # transport tier, docs/developer_guide/native-transport.md);
+        # peers accepted there are tagged "uds:<n>"
+        self._uds_path = uds_path
+        self._uds_sock: Optional[socket.socket] = None
+        self._uds_accepts = 0
         self._selector: Optional[selectors.DefaultSelector] = None
         self._thread: Optional[threading.Thread] = None
         self._running = threading.Event()
@@ -134,10 +147,21 @@ class TCPServer:
         self._data_event = threading.Event()
         self._clients: Dict[int, _ClientBuffer] = {}
         self._peers: Dict[int, str] = {}
+        # shm ring registry polled on the serve tick (attach_ring_registry);
+        # written before start() or from the serve thread only
+        self._rings = None
         self._stopped = False
         self.port: Optional[int] = None
         self.frames_received = 0
         self.decode_errors = 0
+        # frames by arrival path ("tcp" | "uds" | "shm"): the transport
+        # observability strip in ingest_stats.json reads this
+        self.frames_by_transport: Dict[str, int] = {}
+        # compressed-carrier accounting (decode-side of the zstd tier)
+        self.compressed_envelopes = 0
+        self.compressed_bytes_in = 0
+        self.decompressed_bytes = 0
+        self.decompress_errors = 0
         # per-peer count of frames that arrived but could not be decoded
         # (body corruption) or desynced the stream (length corruption);
         # the connection survives body corruption — only a framing
@@ -146,6 +170,11 @@ class TCPServer:
         # deepest the undrained-frame buffer ever got: a proxy for how
         # far the consumer fell behind the selector thread
         self.pending_hwm = 0
+
+    def attach_ring_registry(self, registry) -> None:
+        """Attach a :class:`~traceml_tpu.transport.shm_ring.ShmRingRegistry`
+        the serve loop polls each tick (call before :meth:`start`)."""
+        self._rings = registry
 
     # -- lifecycle -----------------------------------------------------
     def start(self) -> None:
@@ -165,6 +194,25 @@ class TCPServer:
         self._selector = selectors.DefaultSelector()
         self._selector.register(sock, selectors.EVENT_READ, ("accept", None))
         self._selector.register(self._wake_r, selectors.EVENT_READ, ("wake", None))
+        if self._uds_path:
+            try:
+                uds = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                try:
+                    os.unlink(self._uds_path)
+                except OSError:
+                    pass
+                uds.bind(self._uds_path)
+                uds.listen(128)
+                uds.setblocking(False)
+                self._uds_sock = uds
+                self._selector.register(
+                    uds, selectors.EVENT_READ, ("accept_uds", None)
+                )
+            except OSError as exc:
+                # the TCP listener is the golden path; a UDS bind failure
+                # (path too long, stale dir perms) degrades, not aborts
+                get_error_log().warning("uds listener bind failed", exc)
+                self._uds_sock = None
         self._running.set()
         self._thread = threading.Thread(
             target=self._serve, name="traceml-tcp-server", daemon=True
@@ -203,6 +251,21 @@ class TCPServer:
             except OSError:
                 pass
             self._sock = None
+        if self._uds_sock is not None:
+            try:
+                self._uds_sock.close()
+            except OSError:
+                pass
+            self._uds_sock = None
+            try:
+                os.unlink(self._uds_path)
+            except (OSError, TypeError):
+                pass
+        if self._rings is not None:
+            try:
+                self._rings.close()
+            except Exception:
+                pass
         for s in (self._wake_r, self._wake_w):
             try:
                 s.close()
@@ -254,7 +317,7 @@ class TCPServer:
             get_error_log().warning(
                 f"dropped {errors} undecodable frame(s) during drain"
             )
-        return payloads
+        return self._unwrap_compressed(payloads, "unknown")
 
     def decode_tagged(self, tagged: List[Tuple[str, bytes]]) -> List[Any]:
         """Per-frame decode of :meth:`drain_tagged` output.  A corrupt
@@ -270,10 +333,35 @@ class TCPServer:
                 self._count_corrupt(peer)
                 continue
             if isinstance(decoded, list):
-                payloads.extend(decoded)
+                payloads.extend(self._unwrap_compressed(decoded, peer))
             else:
-                payloads.append(decoded)
+                payloads.extend(self._unwrap_compressed([decoded], peer))
         return payloads
+
+    def _unwrap_compressed(self, payloads: List[Any], peer: str) -> List[Any]:
+        """Restore compressed carrier envelopes in place (consumer
+        thread).  Downstream of this point the pipeline sees payloads
+        byte-identical to the uncompressed arm; a corrupt carrier is
+        dropped like any other undecodable body, attributed to its
+        peer."""
+        out: List[Any] = []
+        for payload in payloads:
+            if not compression.is_compressed_payload(payload):
+                out.append(payload)
+                continue
+            z_len = len(payload.get("z") or b"")
+            try:
+                inner = compression.unwrap_payload(payload)
+            except compression.CompressionError:
+                self.decompress_errors += 1
+                self.decode_errors += 1
+                self._count_corrupt(peer)
+                continue
+            self.compressed_envelopes += 1
+            self.compressed_bytes_in += z_len
+            self.decompressed_bytes += payload.get("n") or 0
+            out.append(inner)
+        return out
 
     def _count_corrupt(self, peer: str) -> None:
         # called from the consumer thread; _read (selector thread) also
@@ -293,9 +381,13 @@ class TCPServer:
     # -- server thread -------------------------------------------------
     def _serve(self) -> None:
         assert self._selector is not None and self._sock is not None
+        # with a ring registry attached the select timeout drops so the
+        # ring poll below stays sub-tick without any futex/eventfd
+        # machinery — rings piggyback on the existing selector tick
+        timeout = 0.05 if self._rings is not None else 0.5
         while self._running.is_set():
             try:
-                events = self._selector.select(timeout=0.5)
+                events = self._selector.select(timeout=timeout)
             except OSError:
                 break
             for key, _mask in events:
@@ -307,8 +399,32 @@ class TCPServer:
                         pass
                 elif kind == "accept":
                     self._accept()
+                elif kind == "accept_uds":
+                    self._accept_uds()
                 else:
                     self._read(key.fileobj)
+            if self._rings is not None:
+                self._poll_rings()
+
+    def _poll_rings(self) -> None:
+        """Drain every attached shm ring into the pending queue (serve
+        thread only; frames are tagged "shm:<rank>")."""
+        try:
+            tagged = self._rings.poll()
+        except Exception as exc:  # registry scan/attach trouble
+            get_error_log().warning("shm ring poll failed", exc)
+            return
+        if not tagged:
+            return
+        with self._lock:
+            self.frames_received += len(tagged)
+            self.frames_by_transport["shm"] = (
+                self.frames_by_transport.get("shm", 0) + len(tagged)
+            )
+            self._pending.extend(tagged)
+            if len(self._pending) > self.pending_hwm:
+                self.pending_hwm = len(self._pending)
+        self._data_event.set()
 
     def _accept(self) -> None:
         assert self._sock is not None and self._selector is not None
@@ -327,6 +443,23 @@ class TCPServer:
         except BlockingIOError:
             return
         except OSError:
+            return
+
+    def _accept_uds(self) -> None:
+        assert self._uds_sock is not None and self._selector is not None
+        try:
+            while True:
+                conn, _addr = self._uds_sock.accept()
+                conn.setblocking(False)
+                fileno = conn.fileno()
+                self._clients[fileno] = _ClientBuffer()
+                # AF_UNIX peers have no address; number them at accept
+                self._uds_accepts += 1
+                self._peers[fileno] = f"uds:{self._uds_accepts}"
+                self._selector.register(
+                    conn, selectors.EVENT_READ, ("client", None)
+                )
+        except (BlockingIOError, OSError):
             return
 
     def _read(self, conn: socket.socket) -> None:
@@ -381,8 +514,12 @@ class TCPServer:
             return
         # NO decode here: this is the selector thread, shared by every
         # client.  Frames are handed to the consumer as-is.
+        kind = "uds" if peer.startswith("uds:") else "tcp"
         with self._lock:
             self.frames_received += len(frames)
+            self.frames_by_transport[kind] = (
+                self.frames_by_transport.get(kind, 0) + len(frames)
+            )
             for frame in frames:
                 self._pending.append((peer, frame))
             if len(self._pending) > self.pending_hwm:
@@ -402,6 +539,9 @@ class TCPClient:
     resets the window to zero (the first retry after a blip is
     immediate).
     """
+
+    #: transport kind reported in producer stats / transport_hello
+    kind = "tcp"
 
     def __init__(
         self,
@@ -439,6 +579,18 @@ class TCPClient:
         self.batches_sent = 0
         self.batches_dropped = 0
 
+    def _dial(self) -> socket.socket:
+        """Open one connected socket (raises OSError on failure).  The
+        transport-specific seam: :class:`UDSClient` overrides only this."""
+        sock = socket.create_connection(
+            (self._host, self._port), timeout=self._timeout
+        )
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+        return sock
+
     def _note_dial_failure_locked(self) -> None:
         self._last_fail = time.monotonic()
         self._fail_streak += 1
@@ -462,15 +614,12 @@ class TCPClient:
                 if self._gen != gen:
                     return None
             try:
-                sock = socket.create_connection(
-                    (self._host, self._port), timeout=self._timeout
-                )
+                sock = self._dial()
             except OSError:
                 with self._lock:
                     self._note_dial_failure_locked()
                 return None
             try:
-                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 sock.settimeout(self._timeout)
             except OSError:
                 pass
@@ -575,3 +724,44 @@ class TCPClient:
         with self._lock:
             self._gen += 1
             self._teardown_locked()
+
+
+class UDSClient(TCPClient):
+    """Unix-domain-socket variant of the best-effort sender.
+
+    Same framing, batching, backoff, chaos point (``client.send``), and
+    durable-sender integration as TCP — only the dial differs, so the
+    whole send path (including fault injection and replay splicing)
+    is exercised identically on both stream transports.
+    """
+
+    kind = "uds"
+
+    def __init__(
+        self,
+        path: str,
+        connect_timeout: float = 2.0,
+        reconnect_backoff: float = 1.0,
+        backoff_cap: float = 15.0,
+    ) -> None:
+        super().__init__(
+            host="",
+            port=0,
+            connect_timeout=connect_timeout,
+            reconnect_backoff=reconnect_backoff,
+            backoff_cap=backoff_cap,
+        )
+        self._path = str(path)
+
+    def _dial(self) -> socket.socket:
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        try:
+            sock.settimeout(self._timeout)
+            sock.connect(self._path)
+        except OSError:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise
+        return sock
