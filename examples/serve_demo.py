@@ -1,0 +1,64 @@
+"""Fake inference loop driving the serving telemetry domain end to end:
+
+    traceml-tpu run --mode summary examples/serve_demo.py healthy
+    traceml-tpu run --mode summary examples/serve_demo.py saturated
+
+No model, no accelerator — the point is the telemetry path: the five
+request-lifecycle recorders feed the serving sampler, the aggregator
+folds per-window aggregates, and the final summary gains a
+``sections.serving`` block with TTFT percentiles, the prefill/decode
+split, and per-replica tokens/s.
+
+``healthy``:   one arrival per serviced request with idle slack — the
+               queue drains every loop and the diagnosis stays quiet.
+``saturated``: three arrivals per serviced request — the backlog grows
+               for the whole run and QUEUE_SATURATED fires (critical:
+               arrival rate exceeds service rate, TTFT is queue wait).
+
+Deterministic by construction: fixed arrival ratio, fixed per-phase
+sleeps, no randomness — CI asserts on the resulting summary.
+"""
+
+import sys
+import time
+
+import traceml_tpu
+
+scenario = (sys.argv[1] if len(sys.argv) > 1 else "healthy").strip().lower()
+if scenario not in ("healthy", "saturated"):
+    raise SystemExit(f"unknown scenario {scenario!r} (healthy|saturated)")
+
+traceml_tpu.init(mode="auto")
+
+DURATION_S = 9.0       # ~9 one-second sampler windows per run
+PROMPT_TOKENS = 128
+PREFILL_S = 0.02       # fake prefill: one sleep, then the first token
+DECODE_TOKENS = 16     # fake decode loop: one token per sleep
+DECODE_TOKEN_S = 0.002
+
+ARRIVALS_PER_LOOP = 3 if scenario == "saturated" else 1
+IDLE_S = 0.0 if scenario == "saturated" else 0.03
+
+next_id = 0
+queue = []
+served = 0
+t_end = time.time() + DURATION_S
+while time.time() < t_end:
+    for _ in range(ARRIVALS_PER_LOOP):
+        rid = f"req-{next_id}"
+        next_id += 1
+        traceml_tpu.record_request_enqueued(rid)
+        queue.append(rid)
+    rid = queue.pop(0)
+    traceml_tpu.record_prefill_start(rid, prompt_tokens=PROMPT_TOKENS)
+    time.sleep(PREFILL_S)
+    traceml_tpu.record_prefill_end(rid)
+    for _ in range(DECODE_TOKENS):
+        time.sleep(DECODE_TOKEN_S)
+        traceml_tpu.record_decode_token(rid)
+    traceml_tpu.record_request_finished(rid)
+    served += 1
+    if IDLE_S:
+        time.sleep(IDLE_S)
+
+print(f"serve_demo[{scenario}]: {served} served, {len(queue)} still queued")

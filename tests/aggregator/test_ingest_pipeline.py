@@ -240,9 +240,12 @@ def test_store_trim_lockstep_without_global_min_movement(tmp_path):
 
 
 def test_ingest_priority_mapping():
-    # collectives joined the high lane in r11: per-step model telemetry
-    # must survive a low-value flood just like step time/memory
-    assert HIGH_PRIORITY_SAMPLERS == {"step_time", "step_memory", "collectives"}
+    # collectives joined the high lane in r11, serving in r16: telemetry
+    # that drives diagnosis must survive a low-value flood just like
+    # step time/memory
+    assert HIGH_PRIORITY_SAMPLERS == {
+        "step_time", "step_memory", "collectives", "serving"
+    }
     for sampler in HIGH_PRIORITY_SAMPLERS:
         assert ingest_priority(sampler) == 0
     for sampler in ("system", "process", "stdout_stderr", "mystery"):

@@ -1,0 +1,273 @@
+"""Serving domain ingest path: lifecycle-event fold → v2 envelope
+encode → SQLite ingest → ragged columnar window build, end to end.
+
+Shape (the acceptance load): 256 replicas × 120 windows × ragged
+request streams (0–4 arrivals per window, ~10% stay queued — the
+backlog signal) — ~380k raw lifecycle events.  Each replica flushes
+one window row per envelope (the live-streaming shape bench_ingest.py's
+r09 envelope was measured at), so the ``ServingAccumulator`` fold
+bounds the wire at ONE row per window per replica regardless of
+request fan-out.  Ingest drives the real ``SQLiteWriter._write_batch``
+synchronously in fixed 64-envelope batches — the same drain
+granularity bench_ingest.py times — and its per-batch p99 (first batch
+excluded: one-time schema init + WAL warm-up) must stay inside the r09
+ingest envelope (BENCH_LOCAL_r09's 256-rank watermark lane): the new
+domain must not cost more than the heaviest existing one at the same
+drain granularity.
+
+NOTE: ``bench_serving.py`` next door benches the r13 serving *tier*
+(the fleet aggregator's SSE/delta protocol); this file benches the r16
+serving telemetry *domain*.
+
+Golden first, timing second:
+
+* the accumulator rows driven through encode→ingest→store must fold to
+  a window IDENTICAL (``serving_window_to_plain``) to a direct scalar
+  fold over the pre-wire rows — the pipeline may not move a bit;
+* the store's ragged columnar window must equal the scalar reference
+  over the store's own rows (the engine's standing golden).
+
+Emits bench_common JSON lines (collected into BENCH_LOCAL_r16.json):
+
+* ``fold_events_per_s``  — accumulator-side fold of raw lifecycle events;
+* ``encode_envelopes_per_s`` / ``encode_total_ms``;
+* ``ingest_envelopes_per_s`` / ``ingest_batch_p99_ms`` /
+  ``ingest_batch_max_ms`` and ``r09_p99_envelope_ms`` (the bound);
+* ``window_cold_build_ms`` (refresh + first ragged columnar fold) and
+  ``window_warm_rebuild_us`` (dirty-gated rebuild, no new rows).
+"""
+
+import itertools
+import random
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent))
+# standalone `python tests/benchmarks/bench_serving_domain.py` support
+sys.path.insert(1, str(Path(__file__).parent.parent.parent))
+import bench_common  # noqa: E402
+
+from traceml_tpu.aggregator.sqlite_writer import SQLiteWriter  # noqa: E402
+from traceml_tpu.reporting.snapshot_store import LiveSnapshotStore  # noqa: E402
+from traceml_tpu.samplers.serving_sampler import ServingAccumulator  # noqa: E402
+from traceml_tpu.telemetry.envelope import (  # noqa: E402
+    SenderIdentity,
+    build_telemetry_envelope,
+)
+from traceml_tpu.utils.columnar import (  # noqa: E402
+    build_serving_window_rows,
+    serving_window_to_plain,
+)
+
+pytestmark = pytest.mark.slow
+
+BENCH = "serving_domain_ingest"
+REPLICAS = 256
+WINDOWS = 120
+MAX_ARRIVALS = 4       # per window per replica — ragged by construction
+WINDOW_S = 1.0         # one sampler tick per window
+BATCH_ENVELOPES = 64   # writer drain granularity (matches bench_ingest)
+REPEATS = 2            # min-of-N: deterministic work, noise only adds
+# the 256-rank watermark lane's per-batch p99 from BENCH_LOCAL_r09 —
+# the ingest envelope this domain must stay inside (2x headroom for the
+# shared-CI host; the local acceptance number is recorded in r16)
+R09_P99_ENVELOPE_MS = 10.9093
+
+
+def _stream_events(rng, rid_counter):
+    """Per-(replica, window) ragged lifecycle streams — what the five
+    recorders enqueue on a live replica.  ~10% of arrivals never reach
+    prefill inside their window (queue backlog carried across rolls)."""
+    windows = []
+    for w in range(WINDOWS):
+        t0 = 1000.0 + w * WINDOW_S
+        evs = []
+        for i in range(rng.randint(0, MAX_ARRIVALS)):
+            rid = f"r{next(rid_counter)}"
+            t = t0 + 0.05 + 0.2 * i
+            evs.append({"ev": "enq", "req": rid, "ts": t, "tokens": 0})
+            if rng.random() < 0.1:
+                continue  # stays queued — the backlog signal
+            evs.append({"ev": "prefill_start", "req": rid,
+                        "ts": t + 0.010, "tokens": 128})
+            evs.append({"ev": "prefill_end", "req": rid,
+                        "ts": t + 0.030, "tokens": 0})
+            evs.append({"ev": "decode", "req": rid,
+                        "ts": t + 0.080, "tokens": rng.randint(1, 32)})
+            evs.append({"ev": "finish", "req": rid,
+                        "ts": t + 0.090, "tokens": 1})
+        windows.append(evs)
+    return windows
+
+
+def _kv_for(rng, w):
+    """Half the replicas report KV/HBM headroom, half run with the -1
+    no-runtime sentinel — both shapes must ride the same pipeline."""
+    if rng.random() < 0.5:
+        return None
+    return {"kv_bytes": rng.randint(1 << 28, 1 << 30),
+            "kv_limit_bytes": 1 << 31,
+            "kv_headroom": rng.uniform(0.05, 0.9)}
+
+
+def _fold_rows(streams, kvs):
+    """One accumulator per replica, one window_row per tick — the
+    sampler loop without the runtime around it."""
+    rows = {}
+    for rank, windows in streams.items():
+        acc = ServingAccumulator(now=1000.0)
+        out = []
+        for w, evs in enumerate(windows):
+            acc.feed(evs)
+            row = acc.window_row(
+                now=1000.0 + (w + 1) * WINDOW_S, kv=kvs[rank][w]
+            )
+            if row is not None:
+                out.append(row)
+        rows[rank] = out
+    return rows
+
+
+def _ident(rank):
+    return SenderIdentity(
+        session_id="bench", global_rank=rank, local_rank=rank % 4,
+        world_size=REPLICAS, node_rank=rank // 4, hostname=f"h{rank // 4}",
+        pid=100 + rank,
+    )
+
+
+def _p99(lat):
+    s = sorted(lat)
+    return s[min(len(s) - 1, int(len(s) * 0.99))]
+
+
+def _run(tmp):
+    rng = random.Random(16)
+    rid_counter = itertools.count()
+    streams = {r: _stream_events(rng, rid_counter) for r in range(REPLICAS)}
+    kvs = {
+        r: [_kv_for(rng, w) for w in range(WINDOWS)] for r in range(REPLICAS)
+    }
+    n_events = sum(len(evs) for ws in streams.values() for evs in ws)
+
+    # -- stage 1: accumulator fold (events → one row per window) -------
+    fold_s = None
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        rows = _fold_rows(streams, kvs)
+        el = time.perf_counter() - t0
+        fold_s = el if fold_s is None else min(fold_s, el)
+    n_rows = sum(len(v) for v in rows.values())
+
+    # -- stage 2: v2 columnar envelope encode ---------------------------
+    encode_s = None
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        envs = [
+            build_telemetry_envelope("serving", {"serving": [row]}, _ident(rank))
+            for rank in range(REPLICAS)
+            for row in rows[rank]
+        ]
+        el = time.perf_counter() - t0
+        encode_s = el if encode_s is None else min(encode_s, el)
+    n_envs = len(envs)
+
+    # -- stage 3: SQLite ingest (sync drive of the writer internals) ---
+    batches = [
+        envs[i : i + BATCH_ENVELOPES]
+        for i in range(0, len(envs), BATCH_ENVELOPES)
+    ]
+    ingest_s = None
+    ingest_lat = None
+    for rep in range(REPEATS):
+        db = Path(tmp) / f"serv_{rep}.sqlite"
+        w = SQLiteWriter(db)
+        conn = w._connect()
+        lat = []
+        t_start = time.perf_counter()
+        for batch in batches:
+            t0 = time.perf_counter()
+            w._write_batch(conn, batch)
+            lat.append((time.perf_counter() - t0) * 1000.0)
+        el = time.perf_counter() - t_start
+        conn.execute("PRAGMA wal_checkpoint(TRUNCATE)")
+        conn.commit()
+        conn.close()
+        if ingest_s is None or el < ingest_s:
+            # first batch carries one-time schema init + WAL warm-up;
+            # the sustained envelope is the steady-state distribution
+            ingest_s, ingest_lat, final_db = el, lat[1:], db
+
+    # -- golden BEFORE timing is reported ------------------------------
+    store = LiveSnapshotStore(final_db, window_steps=WINDOWS)
+    t0 = time.perf_counter()
+    store.refresh()
+    win = store.build_serving_window(max_steps=WINDOWS)
+    cold_ms = (time.perf_counter() - t0) * 1000.0
+    # (a) ragged columnar engine vs scalar reference over the store's rows
+    scalar_store = build_serving_window_rows(
+        store.serving_rows(), max_steps=WINDOWS
+    )
+    assert serving_window_to_plain(win) == serving_window_to_plain(
+        scalar_store
+    ), "ragged columnar window diverged from the scalar reference"
+    # (b) end to end: the pipeline may not move a bit vs the pre-wire rows
+    expected = build_serving_window_rows(rows, max_steps=WINDOWS)
+    assert serving_window_to_plain(win) == serving_window_to_plain(
+        expected
+    ), "ingest pipeline changed the window payload"
+    assert len(win.ranks) == REPLICAS and win.n_steps >= WINDOWS - 1
+
+    # warm rebuild: no new rows → dirty-gated cursor read + cached fold
+    t0 = time.perf_counter()
+    for _ in range(50):
+        store.refresh()
+        store.build_serving_window(max_steps=WINDOWS)
+    warm_us = (time.perf_counter() - t0) * 1e6 / 50
+    store.close()
+
+    p99 = _p99(ingest_lat)
+    extra = {"replicas": REPLICAS, "windows": WINDOWS,
+             "raw_events": n_events, "rows": n_rows, "envelopes": n_envs,
+             "batch_envelopes": BATCH_ENVELOPES}
+    bench_common.emit(
+        BENCH, "fold_events_per_s", n_events / fold_s, "ev/s", **extra
+    )
+    bench_common.emit(
+        BENCH, "encode_envelopes_per_s", n_envs / encode_s, "env/s", **extra
+    )
+    bench_common.emit(BENCH, "encode_total_ms", encode_s * 1000.0, "ms", **extra)
+    bench_common.emit(
+        BENCH, "ingest_envelopes_per_s", n_envs / ingest_s, "env/s", **extra
+    )
+    bench_common.emit(BENCH, "ingest_batch_p99_ms", p99, "ms", **extra)
+    bench_common.emit(
+        BENCH, "ingest_batch_max_ms", max(ingest_lat), "ms", **extra
+    )
+    bench_common.emit(
+        BENCH, "r09_p99_envelope_ms", R09_P99_ENVELOPE_MS, "ms", **extra
+    )
+    bench_common.emit(BENCH, "window_cold_build_ms", cold_ms, "ms", **extra)
+    bench_common.emit(BENCH, "window_warm_rebuild_us", warm_us, "us", **extra)
+    return p99
+
+
+def test_serving_domain_ingest_bench(tmp_path):
+    p99 = _run(tmp_path)
+    # the serving lane must stay inside the r09 ingest envelope
+    # (2x headroom absorbs shared-CI scheduler noise; the local
+    # acceptance run in BENCH_LOCAL_r16.json is compared at 1x)
+    assert p99 <= R09_P99_ENVELOPE_MS * 2.0, p99
+
+
+if __name__ == "__main__":
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        p99 = _run(tmp)
+        within = "within" if p99 <= R09_P99_ENVELOPE_MS else "OUTSIDE"
+        print(f"# ingest p99 {p99:.2f} ms — {within} the r09 envelope "
+              f"({R09_P99_ENVELOPE_MS} ms)", file=sys.stderr)

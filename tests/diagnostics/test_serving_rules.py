@@ -1,0 +1,194 @@
+"""Serving diagnosis fixtures: the four rules, their gates, and the
+r14 topology attribution on REPLICA_SKEW
+(traceml_tpu/diagnostics/DIAGNOSIS.md "Serving").
+
+* QUEUE_SATURATED needs a backlog at window close AND backlog across
+  ≥50% of window slots — a burst that drained is not saturation
+* KV_CACHE_PRESSURE judges the minimum observed headroom; the -1
+  no-runtime sentinel never fires it
+* DECODE_BOUND is volume-gated (≥64 decode tokens)
+* REPLICA_SKEW needs ≥2 replicas and, with a mesh captured, carries an
+  attribution naming the physical structure of the deficit
+* below ``min_steps`` everything yields INSUFFICIENT_SERVING_DATA
+"""
+
+from traceml_tpu.diagnostics.serving.api import (
+    diagnose_rank_rows,
+    diagnose_serving_window,
+)
+from traceml_tpu.samplers.serving_sampler import pack_floats, percentile
+from traceml_tpu.utils.columnar import build_serving_window_rows
+from traceml_tpu.utils.topology import (
+    MeshTopology,
+    _coords_for_rank,
+    parse_mesh_spec,
+)
+
+
+# -- fixtures ------------------------------------------------------------
+
+
+def _row(step, enq=2, done=2, active=1, qd=0, dtok=32, pre=20.0, dec=40.0,
+         tps=100.0, kvh=None, ttft=None):
+    if ttft is None:
+        ttft = [30.0] * done
+    t_sorted = sorted(ttft)
+    return {
+        "step": step,
+        "timestamp": 100.0 + step,
+        "requests_enqueued": enq,
+        "requests_completed": done,
+        "requests_active": active,
+        "queue_depth": qd,
+        "decode_tokens": dtok,
+        "prefill_ms": pre,
+        "decode_ms": dec,
+        "tokens_per_s": tps,
+        "batch_occupancy": 0.4,
+        "ttft_p50_ms": percentile(t_sorted, 0.50),
+        "ttft_p95_ms": percentile(t_sorted, 0.95),
+        "ttft_p99_ms": percentile(t_sorted, 0.99),
+        "e2e_p50_ms": 0.0,
+        "e2e_p95_ms": 0.0,
+        "e2e_p99_ms": 0.0,
+        "kv_bytes": -1,
+        "kv_limit_bytes": -1,
+        "kv_headroom": -1.0 if kvh is None else kvh,
+        "ttft_ms_list": pack_floats(ttft),
+        "e2e_ms_list": pack_floats([60.0] * done),
+        "tokens_list": ",".join("16" for _ in range(done)),
+    }
+
+
+def _mesh(spec, world, hosts_of=None):
+    axes = parse_mesh_spec(spec)
+    assert axes, spec
+    sizes = [a.size for a in axes]
+    return MeshTopology(
+        axes=axes,
+        rank_coords={r: tuple(_coords_for_rank(r, sizes)) for r in range(world)},
+        rank_hosts={r: (hosts_of(r) if hosts_of else 0) for r in range(world)},
+        rank_hostnames={},
+        source="env",
+    )
+
+
+def _kinds(result):
+    return {i.kind for i in result.issues}
+
+
+# -- QUEUE_SATURATED -----------------------------------------------------
+
+
+def test_queue_saturated_fires_on_persistent_backlog():
+    # backlog every window and 20 queued at close: critical (≥16)
+    rows = [_row(s, enq=6, done=2, qd=10 + s) for s in range(1, 11)]
+    result = diagnose_rank_rows({0: rows}, mode="summary")
+    assert result.diagnosis.kind == "QUEUE_SATURATED"
+    assert result.diagnosis.severity == "critical"
+    ev = result.diagnosis.evidence
+    assert ev["queue_depth_last"] == 20 and ev["backlog_share"] == 1.0
+
+
+def test_queue_saturated_gated_by_backlog_share():
+    # one final burst (depth 20) after an empty-queue run: the backlog
+    # share gate (<50% of windows) keeps the rule silent
+    rows = [_row(s, qd=0) for s in range(1, 10)] + [_row(10, qd=20)]
+    result = diagnose_rank_rows({0: rows}, mode="summary")
+    assert "QUEUE_SATURATED" not in _kinds(result)
+    assert result.healthy
+
+
+# -- KV_CACHE_PRESSURE ---------------------------------------------------
+
+
+def test_kv_cache_pressure_on_low_headroom():
+    rows = [_row(s, kvh=0.30 - 0.028 * s) for s in range(1, 11)]  # min 0.02
+    result = diagnose_rank_rows({0: rows}, mode="summary")
+    issues = [i for i in result.issues if i.kind == "KV_CACHE_PRESSURE"]
+    assert issues and issues[0].severity == "critical"  # 0.02 ≤ 0.03
+    assert issues[0].evidence["kv_headroom_min"] == 0.02
+
+
+def test_kv_sentinel_stays_silent():
+    # no JAX runtime → -1 sentinels throughout; the rule must not read
+    # the sentinel as "zero headroom"
+    rows = [_row(s) for s in range(1, 11)]
+    result = diagnose_rank_rows({0: rows}, mode="summary")
+    assert "KV_CACHE_PRESSURE" not in _kinds(result)
+
+
+# -- DECODE_BOUND --------------------------------------------------------
+
+
+def test_decode_bound_fires_above_share_threshold():
+    # 960 ms decode vs 40 ms prefill per window → share 0.96 critical
+    rows = [_row(s, pre=40.0, dec=960.0, dtok=200) for s in range(1, 11)]
+    result = diagnose_rank_rows({0: rows}, mode="summary")
+    issues = [i for i in result.issues if i.kind == "DECODE_BOUND"]
+    assert issues and issues[0].severity == "critical"
+    assert issues[0].evidence["decode_share"] >= 0.95
+
+
+def test_decode_bound_volume_gate():
+    # same share but almost no decode volume (< 64 tokens total): a few
+    # chat turns must not diagnose the replica as decode-bound
+    rows = [_row(s, pre=1.0, dec=99.0, dtok=0, done=1) for s in range(1, 11)]
+    rows[0]["decode_tokens"] = 10
+    result = diagnose_rank_rows({0: rows}, mode="summary")
+    assert "DECODE_BOUND" not in _kinds(result)
+
+
+# -- REPLICA_SKEW --------------------------------------------------------
+
+
+def _skew_rows(world=8, slow=range(4, 8), slow_tps=40.0, fast_tps=100.0):
+    return {
+        r: [
+            _row(s, tps=(slow_tps if r in slow else fast_tps))
+            for s in range(1, 11)
+        ]
+        for r in range(world)
+    }
+
+
+def test_replica_skew_fires_and_names_lagging_replicas():
+    result = diagnose_rank_rows(_skew_rows(), mode="summary")
+    issues = [i for i in result.issues if i.kind == "REPLICA_SKEW"]
+    # median 70, worst 40 → skew ≈ 0.43 (warning, < 0.60)
+    assert issues and issues[0].severity == "warning"
+    assert issues[0].ranks == [4, 5, 6, 7]
+    assert issues[0].attribution is None  # no mesh captured
+
+
+def test_replica_skew_silent_on_single_replica():
+    result = diagnose_rank_rows(_skew_rows(world=1, slow=()), mode="summary")
+    assert "REPLICA_SKEW" not in _kinds(result)
+
+
+def test_replica_skew_carries_topology_attribution():
+    # the slow half is exactly host 1: the deficit grouping explains it
+    # and the issue gains the r14 attribution block
+    topo = _mesh("data:2,fsdp:4", world=8, hosts_of=lambda r: r // 4)
+    window = build_serving_window_rows(_skew_rows(), max_steps=60)
+    result = diagnose_serving_window(window, mode="summary", topology=topo)
+    issues = [i for i in result.issues if i.kind == "REPLICA_SKEW"]
+    assert issues and issues[0].attribution is not None
+    attr = issues[0].attribution
+    assert attr["kind"] == "host" and attr["ranks"] == [4, 5, 6, 7]
+    assert issues[0].summary.endswith(f"— {attr['label']}.")
+
+
+# -- insufficient data ---------------------------------------------------
+
+
+def test_insufficient_data_below_min_steps():
+    rows = [_row(s, qd=50, enq=9, done=1) for s in (1, 2)]  # 2 < 3 (summary)
+    result = diagnose_rank_rows({0: rows}, mode="summary")
+    assert result.diagnosis.kind == "INSUFFICIENT_SERVING_DATA"
+    assert diagnose_serving_window(None).diagnosis.kind == (
+        "INSUFFICIENT_SERVING_DATA"
+    )
+    # live mode lowers the bar to 2 windows — the same rows diagnose
+    live = diagnose_rank_rows({0: rows}, mode="live")
+    assert live.diagnosis.kind == "QUEUE_SATURATED"

@@ -233,14 +233,15 @@ from traceml_tpu.aggregator.display_drivers.browser_sections.fleet import (  # n
 
 _FLEET_PAGE = build_fleet_page()
 _FLEET_SAFE = _SAFE_MARKERS + ("encodeURIComponent(",)
-# audited locals: fleetRanks/fleetDiag/fleetMesh esc() every payload
-# string internally (fleetMesh builds by concatenation, no raw
-# interpolation); `state` is a ternary over badge HTML literals; the two
+# audited locals: fleetRanks/fleetDiag/fleetMesh/fleetWorkload esc()
+# every payload string internally (fleetMesh and fleetWorkload build by
+# concatenation, no raw interpolation); `state` is a ternary over badge HTML literals; the two
 # tick() interpolations land in textContent (inert) and are numeric/Date
 _FLEET_VETTED = {
     "fleetRanks(s.ranks)",
     "fleetDiag(s)",
     "fleetMesh(s)",
+    "fleetWorkload(s)",
     "state",
     "(x.sessions||[]).length",
     "new Date(x.ts*1000).toLocaleTimeString()",

@@ -1,0 +1,432 @@
+"""Serving window engine: golden equivalence vs the scalar path, plus
+the domain's core invariants.
+
+Contract (docs/developer_guide/serving-domain.md): for any input the
+scalar builder accepts, the ragged columnar engine either produces a
+bit-identical window (``serving_window_to_plain`` compares the full
+payload) or raises ``ColumnarFallback``.  Domain invariants pinned here:
+
+* ragged arrivals — window seqs are the UNION across replicas, and
+  latency percentiles re-rank the concatenated RAW per-request
+  populations (never percentiles of the row-level percentiles)
+* window seqs are STRICTLY increasing per replica (unlike training
+  steps, repeats are a producer bug) — duplicates flag fallback
+* the ``-1`` KV sentinel never feeds ``kv_headroom_min``
+* ring eviction stays in lockstep with a deque of the same maxlen
+  through ragged-buffer compaction
+* ``parse(pack(x))`` is bit-stable and both paths share ONE percentile
+  formula (``serving_sampler.percentile``)
+* ``TRACEML_SERVING=0`` kills recording and sampler registration;
+  ``TRACEML_COLUMNAR_WINDOW=0`` forces the scalar path
+"""
+
+import random
+from collections import deque
+
+import pytest
+
+from traceml_tpu.aggregator.sqlite_writer import SQLiteWriter
+from traceml_tpu.instrumentation import serving as ISV
+from traceml_tpu.reporting.snapshot_store import LiveSnapshotStore
+from traceml_tpu.samplers.serving_sampler import (
+    ServingAccumulator,
+    pack_floats,
+    percentile,
+)
+from traceml_tpu.telemetry.envelope import SenderIdentity, build_telemetry_envelope
+from traceml_tpu.utils.columnar import (
+    ColumnarFallback,
+    RaggedEventColumns,
+    _population_percentile,
+    build_columnar_serving_window,
+    build_serving_window_rows,
+    parse_float_list,
+    serving_window_to_plain,
+)
+
+
+# -- row factories -------------------------------------------------------
+
+
+def _row(step, enq=2, done=2, active=1, qd=0, dtok=32, pre=20.0, dec=40.0,
+         tps=100.0, kvh=None, ttft=None, e2e=None, toks=None):
+    """One serving sampler aggregate row (the window_row() shape).  The
+    per-request populations default to ``done`` deterministic values;
+    ``kvh=None`` writes the -1 no-runtime sentinels."""
+    if ttft is None:
+        ttft = [10.0 + step + i for i in range(done)]
+    if e2e is None:
+        e2e = [50.0 + step + i for i in range(done)]
+    if toks is None:
+        toks = [16] * done
+    t_sorted = sorted(ttft)
+    e_sorted = sorted(e2e)
+    return {
+        "step": step,
+        "timestamp": 100.0 + step,
+        "requests_enqueued": enq,
+        "requests_completed": done,
+        "requests_active": active,
+        "queue_depth": qd,
+        "decode_tokens": dtok,
+        "prefill_ms": pre,
+        "decode_ms": dec,
+        "tokens_per_s": tps,
+        "batch_occupancy": 0.4,
+        "ttft_p50_ms": percentile(t_sorted, 0.50),
+        "ttft_p95_ms": percentile(t_sorted, 0.95),
+        "ttft_p99_ms": percentile(t_sorted, 0.99),
+        "e2e_p50_ms": percentile(e_sorted, 0.50),
+        "e2e_p95_ms": percentile(e_sorted, 0.95),
+        "e2e_p99_ms": percentile(e_sorted, 0.99),
+        "kv_bytes": -1 if kvh is None else 1 << 30,
+        "kv_limit_bytes": -1 if kvh is None else 2 << 30,
+        "kv_headroom": -1.0 if kvh is None else kvh,
+        "ttft_ms_list": pack_floats(ttft),
+        "e2e_ms_list": pack_floats(e2e),
+        "tokens_list": ",".join(str(int(t)) for t in toks),
+    }
+
+
+def _rand_rows(rng, steps):
+    rows = []
+    for s in steps:  # steps must be strictly increasing per replica
+        done = rng.randint(0, 5)
+        rows.append(
+            _row(
+                s,
+                enq=rng.randint(0, 6),
+                done=done,
+                active=rng.randint(0, 4),
+                qd=rng.randint(0, 8),
+                dtok=rng.randint(0, 256),
+                pre=rng.uniform(0.0, 50.0),
+                dec=rng.uniform(0.0, 200.0),
+                tps=rng.uniform(0.0, 500.0),
+                kvh=rng.uniform(0.0, 0.9) if rng.random() < 0.5 else None,
+                ttft=[rng.uniform(1.0, 500.0) for _ in range(done)],
+                e2e=[rng.uniform(1.0, 1000.0) for _ in range(done)],
+                toks=[rng.randint(0, 64) for _ in range(done)],
+            )
+        )
+    return rows
+
+
+def _cols_for(rank_rows, cap=512):
+    out = {}
+    for rank, rows in rank_rows.items():
+        c = RaggedEventColumns(cap)
+        for row in rows:
+            c.append(row)
+        out[rank] = c
+    return out
+
+
+def _assert_golden(rank_rows, max_steps, cap=512):
+    scalar = build_serving_window_rows(rank_rows, max_steps=max_steps)
+    columnar = build_columnar_serving_window(_cols_for(rank_rows, cap), max_steps)
+    assert serving_window_to_plain(scalar) == serving_window_to_plain(columnar)
+    return columnar
+
+
+# -- golden edge cases ---------------------------------------------------
+
+
+def test_ragged_arrivals_union_of_window_seqs():
+    rng = random.Random(31)
+    rank_rows = {
+        r: _rand_rows(rng, range(rng.randint(0, 6), 40)) for r in range(6)
+    }
+    # one replica reports only even seqs — the union keeps the odd ones
+    rank_rows[6] = _rand_rows(rng, range(0, 40, 2))
+    w = _assert_golden(rank_rows, max_steps=30)
+    assert w is not None and w.n_steps == 30
+    assert w.ranks == list(range(7))
+
+
+def test_percentiles_rerank_raw_populations():
+    # replica 0: 99 fast requests in one window; replica 1: one slow
+    # request.  Percentile-of-percentiles would blend the two row p99s;
+    # re-ranking the pooled population puts the slow request at the tail
+    fast = [10.0] * 99
+    rank_rows = {
+        0: [_row(1, done=99, ttft=fast, e2e=fast, toks=[1] * 99)],
+        1: [_row(1, done=1, ttft=[900.0], e2e=[900.0], toks=[1])],
+    }
+    w = _assert_golden(rank_rows, max_steps=10)
+    pooled = sorted(fast + [900.0])
+    assert w.totals["ttft_p99_ms"] == _population_percentile(pooled, 0.99)
+    assert w.totals["ttft_p99_ms"] == 900.0
+    assert w.totals["ttft_p50_ms"] == 10.0
+
+
+def test_kv_sentinel_never_feeds_headroom_min():
+    rank_rows = {
+        0: [_row(1), _row(2), _row(3)],  # all -1 sentinels
+        1: [_row(1, kvh=0.42), _row(2), _row(3, kvh=0.17)],
+    }
+    w = _assert_golden(rank_rows, max_steps=10)
+    assert w.totals["kv_headroom_min"] == 0.17
+    assert w.per_rank[0]["kv_headroom"] == -1.0
+    assert w.per_rank[1]["kv_headroom"] == 0.17
+    # a window with ONLY sentinels keeps the -1 (rendered as "no data")
+    w0 = _assert_golden({0: [_row(1), _row(2)]}, max_steps=10)
+    assert w0.totals["kv_headroom_min"] == -1.0
+
+
+def test_empty_population_rows_round_trip():
+    # windows that completed nothing (pure queueing) carry empty packed
+    # lists; percentiles over an empty pooled population read 0.0
+    rows = [_row(s, done=0, qd=5, ttft=[], e2e=[], toks=[]) for s in (1, 2, 3)]
+    w = _assert_golden({0: rows}, max_steps=10)
+    assert w.totals["requests_completed"] == 0
+    assert w.totals["ttft_p99_ms"] == 0.0 and w.totals["e2e_p50_ms"] == 0.0
+    assert w.totals["queue_depth_last"] == 5
+
+
+def test_ring_eviction_matches_deque_maxlen():
+    rng = random.Random(32)
+    cap = 16
+    cols = RaggedEventColumns(cap)
+    rows = deque(maxlen=cap)
+    step = 0
+    for _ in range(3 * cap + 5):  # force ring AND value-buffer compaction
+        step += rng.randint(1, 3)  # strictly increasing window seqs
+        done = rng.randint(0, 8)
+        row = _row(
+            step,
+            done=done,
+            qd=rng.randint(0, 6),
+            tps=rng.uniform(0.0, 300.0),
+            ttft=[rng.uniform(1.0, 400.0) for _ in range(done)],
+            e2e=[rng.uniform(1.0, 800.0) for _ in range(done)],
+            toks=[rng.randint(0, 32) for _ in range(done)],
+        )
+        cols.append(row)
+        rows.append(row)
+        scalar = build_serving_window_rows({0: list(rows)}, max_steps=12)
+        columnar = build_columnar_serving_window({0: cols}, 12)
+        assert serving_window_to_plain(scalar) == serving_window_to_plain(
+            columnar
+        )
+    assert len(cols) == cap and cols.columnar_ok
+
+
+# -- fallback flagging ---------------------------------------------------
+
+
+def test_out_of_order_window_seq_flags_fallback():
+    cols = RaggedEventColumns(16)
+    cols.append(_row(5))
+    cols.append(_row(3))
+    assert not cols.columnar_ok
+    with pytest.raises(ColumnarFallback):
+        build_columnar_serving_window({0: cols}, 10)
+
+
+def test_duplicate_window_seq_flags_fallback():
+    # serving seqs are strictly increasing — a repeat is a producer bug
+    # (training domains tolerate repeats; this domain must not)
+    cols = RaggedEventColumns(16)
+    cols.append(_row(5))
+    cols.append(_row(5))
+    assert not cols.columnar_ok
+
+
+def test_malformed_values_flag_fallback():
+    base = _row(1)
+    for bad in (
+        dict(base, requests_enqueued=-1),               # negative count
+        dict(base, decode_tokens=2**60),                # beyond exact float64
+        dict(base, step=True),                          # bool step
+        dict(base, requests_completed="two"),           # non-int count
+        dict(base, prefill_ms=-0.5),                    # negative phase time
+        dict(base, ttft_ms_list="1.0,bogus"),           # malformed packed list
+        dict(base, e2e_ms_list=pack_floats([1.0])),     # len != completed
+    ):
+        cols = RaggedEventColumns(16)
+        cols.append(bad)
+        assert not cols.columnar_ok
+
+
+# -- shared formulas -----------------------------------------------------
+
+
+def test_percentile_formula_parity_and_pack_round_trip():
+    rng = random.Random(33)
+    for n in (1, 2, 7, 100, 997):
+        vals = sorted(rng.uniform(0.0, 5000.0) for _ in range(n))
+        for q in (0.50, 0.95, 0.99):
+            assert percentile(vals, q) == _population_percentile(vals, q)
+    assert percentile([], 0.99) == 0.0 == _population_percentile([], 0.99)
+    # pack/parse is bit-stable: the %.3f text IS the canonical value
+    vals = [rng.uniform(0.0, 5000.0) for _ in range(64)]
+    packed = pack_floats(vals)
+    assert pack_floats(parse_float_list(packed)) == packed
+    assert parse_float_list("") == [] and parse_float_list(None) == []
+
+
+# -- accumulator fold ----------------------------------------------------
+
+
+def test_accumulator_folds_lifecycle_into_window_row():
+    acc = ServingAccumulator(now=1000.0)
+    assert acc.window_row(now=1001.0) is None  # no events ever → NOTHING
+    acc.feed(
+        [
+            {"ev": "enq", "req": "a", "ts": 1000.0, "tokens": 0},
+            {"ev": "prefill_start", "req": "a", "ts": 1000.1, "tokens": 128},
+            {"ev": "prefill_end", "req": "a", "ts": 1000.3, "tokens": 0},
+            {"ev": "decode", "req": "a", "ts": 1000.4, "tokens": 10},
+            {"ev": "finish", "req": "a", "ts": 1000.5, "tokens": 1},
+            {"ev": "enq", "req": "b", "ts": 1000.6, "tokens": 0},  # queued
+        ]
+    )
+    row = acc.window_row(now=1001.0, kv={"kv_bytes": 10, "kv_limit_bytes": 100,
+                                         "kv_headroom": 0.9})
+    assert row["step"] == 0
+    assert row["requests_enqueued"] == 2
+    assert row["requests_completed"] == 1
+    assert row["requests_active"] == 1 and row["queue_depth"] == 1
+    assert row["decode_tokens"] == 10
+    assert row["ttft_p50_ms"] == pytest.approx(300.0)  # prefill_end − enq
+    assert row["e2e_p50_ms"] == pytest.approx(500.0)
+    assert row["prefill_ms"] == pytest.approx(200.0)
+    assert row["decode_ms"] == pytest.approx(200.0)
+    assert row["kv_headroom"] == 0.9
+    assert parse_float_list(row["ttft_ms_list"]) == [300.0]
+    # the next window rolls the seq and carries the queued request over
+    row2 = acc.window_row(now=1002.0)
+    assert row2["step"] == 1 and row2["requests_enqueued"] == 0
+    assert row2["requests_active"] == 1
+
+
+# -- kill switches -------------------------------------------------------
+
+
+def test_kill_switch_disables_recording_and_sampler(tmp_path, monkeypatch):
+    monkeypatch.setenv("TRACEML_SERVING", "0")
+    assert not ISV.serving_enabled()
+    assert ISV.record_request_enqueued("r1") is False
+    assert ISV.record_decode_token("r1") is False
+    assert ISV.GLOBAL_SERVING_QUEUE.drain() == []
+
+    from traceml_tpu.runtime.identity import RuntimeIdentity
+    from traceml_tpu.runtime.sampler_registry import build_samplers
+    from traceml_tpu.runtime.settings import TraceMLSettings
+
+    settings = TraceMLSettings(session_id="s", logs_dir=tmp_path)
+    ident = RuntimeIdentity(global_rank=0, local_rank=0)
+    names = {type(s).__name__ for s in build_samplers(settings, ident)}
+    assert "ServingSampler" not in names
+
+    # the gate is checked per build (not at registration): re-enabling
+    # the env brings the sampler back without re-registering
+    monkeypatch.setenv("TRACEML_SERVING", "1")
+    names = {type(s).__name__ for s in build_samplers(settings, ident)}
+    assert "ServingSampler" in names
+
+
+def test_recorders_enqueue_lifecycle_records(monkeypatch):
+    monkeypatch.delenv("TRACEML_SERVING", raising=False)
+    ISV.GLOBAL_SERVING_QUEUE.drain()
+    assert ISV.record_request_enqueued("q1", ts=5.0)
+    assert ISV.record_prefill_start("q1", prompt_tokens=64, ts=5.1)
+    assert ISV.record_decode_token("q1", n=3, ts=5.2)
+    recs = ISV.GLOBAL_SERVING_QUEUE.drain()
+    assert [r["ev"] for r in recs] == ["enq", "prefill_start", "decode"]
+    assert recs[1]["tokens"] == 64 and recs[2]["tokens"] == 3
+    assert all(r["req"] == "q1" for r in recs)
+
+
+def test_columnar_kill_switch_forces_scalar_path(tmp_path, monkeypatch):
+    rng = random.Random(34)
+    db = tmp_path / "t.sqlite"
+    w = SQLiteWriter(db)
+    w.start()
+    store = LiveSnapshotStore(db, window_steps=40)
+    _ingest(w, 0, _rand_rows(rng, range(1, 21)))
+    assert w.force_flush()
+    store.refresh()
+    monkeypatch.setenv("TRACEML_COLUMNAR_WINDOW", "0")
+    win = store.build_serving_window(max_steps=15)
+    scalar = build_serving_window_rows(store.serving_rows(), max_steps=15)
+    assert serving_window_to_plain(win) == serving_window_to_plain(scalar)
+    w.finalize()
+    store.close()
+
+
+# -- store-level integration (ingest → cursor read → trim lockstep) ------
+
+
+def _ident(rank=0):
+    return SenderIdentity(
+        session_id="s1",
+        global_rank=rank,
+        local_rank=rank,
+        world_size=2,
+        node_rank=0,
+        hostname="host-0",
+        pid=100 + rank,
+    )
+
+
+def _ingest(w, rank, rows):
+    w.ingest(
+        build_telemetry_envelope("serving", {"serving": rows}, _ident(rank))
+    )
+
+
+def test_store_columnar_window_matches_scalar_rows(tmp_path):
+    rng = random.Random(35)
+    db = tmp_path / "t.sqlite"
+    w = SQLiteWriter(db)
+    w.start()
+    store = LiveSnapshotStore(db, window_steps=40)
+    for rank in (0, 1):
+        _ingest(w, rank, _rand_rows(rng, range(1, 31)))
+    assert w.force_flush()
+    store.refresh()
+
+    assert store.has_serving_rows()
+    assert store.latest_serving_ts() == 130.0  # timestamp of seq 30
+    win = store.build_serving_window(max_steps=20)
+    scalar = build_serving_window_rows(store.serving_rows(), max_steps=20)
+    assert serving_window_to_plain(win) == serving_window_to_plain(scalar)
+
+    # incremental append advances the window identically (dirty-gated
+    # cursor read + ring/deque lockstep through eviction)
+    for rank in (0, 1):
+        _ingest(w, rank, _rand_rows(rng, range(31, 41)))
+    assert w.force_flush()
+    store.refresh()
+    win2 = store.build_serving_window(max_steps=20)
+    scalar2 = build_serving_window_rows(store.serving_rows(), max_steps=20)
+    assert serving_window_to_plain(win2) == serving_window_to_plain(scalar2)
+    assert win2.steps[-1] == 40
+    w.finalize()
+    store.close()
+
+
+def test_training_only_store_has_no_serving_rows(tmp_path):
+    # the byte-identity anchor: no serving envelope → no rows, no window
+    db = tmp_path / "t.sqlite"
+    w = SQLiteWriter(db)
+    w.start()
+    store = LiveSnapshotStore(db, window_steps=40)
+    w.ingest(
+        build_telemetry_envelope(
+            "step_time",
+            {"step_time": [{"step": 1, "timestamp": 100.0, "clock": "host",
+                            "events": {}}]},
+            _ident(0),
+        )
+    )
+    assert w.force_flush()
+    store.refresh()
+    assert not store.has_serving_rows()
+    assert store.serving_rows() == {}
+    assert store.latest_serving_ts() is None
+    assert store.build_serving_window(max_steps=20) is None
+    w.finalize()
+    store.close()

@@ -35,6 +35,12 @@ _API_SYMBOLS = (
     "patch_lax_collectives",
     "record_collective",
     "wrap_checkpoint",
+    "instrument_generate",
+    "record_request_enqueued",
+    "record_prefill_start",
+    "record_prefill_end",
+    "record_decode_token",
+    "record_request_finished",
     "current_step",
     "enable_ici_stats",
     "request_profile",

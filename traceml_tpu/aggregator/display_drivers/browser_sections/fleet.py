@@ -56,6 +56,10 @@ function fleetMesh(s){
     (" · "+esc(m.hosts)+" host"+(m.hosts!==1?"s":"")):"";
   return '<br><span class="muted">mesh '+axes+hosts+'</span>';
 }
+function fleetWorkload(s){
+  if(!s.workload)return"";
+  return '<br><span class="muted">workload '+esc(s.workload)+'</span>';
+}
 function fleetDiag(s){
   const p=s.primary_diagnosis;
   if(!p)return'<span class="muted">—</span>';
@@ -71,7 +75,8 @@ function fleetRow(s){
     new Date(s.last_update_ts*1000).toLocaleTimeString():"—";
   return`<tr>
     <td><a style="color:var(--accent)" href="/?session=${
-      encodeURIComponent(s.session)}">${esc(s.session)}</a></td>
+      encodeURIComponent(s.session)}">${esc(s.session)}</a>${
+      fleetWorkload(s)}</td>
     <td>${total?esc(total):'<span class="muted">—</span>'}
       <span class="muted">${fleetRanks(s.ranks)}</span>${fleetMesh(s)}</td>
     <td>${state}</td>

@@ -181,6 +181,17 @@ class SessionRegistry:
                 )
                 if mesh:
                     entry["mesh"] = mesh
+                # workload kind for the fleet page: a serving section only
+                # exists when the session recorded serving telemetry
+                sections = summary.get("sections")
+                if isinstance(sections, dict):
+                    kinds = []
+                    if (sections.get("step_time") or {}).get("status") == "OK":
+                        kinds.append("training")
+                    if "serving" in sections:
+                        kinds.append("serving")
+                    if kinds:
+                        entry["workload"] = "+".join(kinds)
         else:
             # live session: peek at an already-open publisher's diagnosis
             # fragment — the index never force-opens a publisher (that
@@ -198,6 +209,13 @@ class SessionRegistry:
                 mesh = (pub.fragment("meta") or {}).get("mesh")
                 if mesh:
                     entry["mesh"] = mesh
+                kinds = []
+                if (pub.fragment("step_time") or {}).get("step_time"):
+                    kinds.append("training")
+                if (pub.fragment("serving") or {}).get("serving"):
+                    kinds.append("serving")
+                if kinds:
+                    entry["workload"] = "+".join(kinds)
         return entry
 
     def fleet_index(self) -> Dict[str, Any]:

@@ -61,7 +61,9 @@ _QUEUE_LOW_MAX = 10_000
 # stdout_stderr, unknown samplers) sheds first under overload.  Control
 # messages never reach this queue: the aggregator handles them inline,
 # ahead of any telemetry backpressure.
-HIGH_PRIORITY_SAMPLERS = frozenset({"step_time", "step_memory", "collectives"})
+HIGH_PRIORITY_SAMPLERS = frozenset(
+    {"step_time", "step_memory", "collectives", "serving"}
+)
 PRIORITY_NAMES = ("high", "low")
 
 # group-commit thresholds: commit when this many envelopes are pending,

@@ -10,6 +10,7 @@ from traceml_tpu.aggregator.sqlite_writers import (  # noqa: F401
     collectives_writer,
     mesh_topology_writer,
     process_writer,
+    serving_writer,
     step_memory_writer,
     step_time_writer,
     stdout_writer,
@@ -22,6 +23,7 @@ ALL_WRITERS = [
     step_time_writer,
     step_memory_writer,
     collectives_writer,
+    serving_writer,
     stdout_writer,
     mesh_topology_writer,
 ]

@@ -1,0 +1,139 @@
+"""serving projection → ``serving_samples``.
+
+One row per (replica, window): the per-window aggregates the serving
+sampler emits — request counts, queue depth, prefill/decode time split,
+TTFT / end-to-end latency percentiles, KV-cache headroom — plus the
+packed per-request populations (``ttft_ms_list`` / ``e2e_ms_list`` /
+``tokens_list``).  The packed lists are what make cross-window
+percentiles exact: the ragged window build (utils/columnar.py
+``RaggedEventColumns``) re-ranks the raw populations instead of
+averaging row-level p99s.  ``step`` is the replica's monotone window
+sequence number, so watermark retention and the (rank × step) cube
+work unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from traceml_tpu.aggregator.sqlite_writers.common import (
+    IDENTITY_SCHEMA,
+    identity_tuple,
+)
+from traceml_tpu.telemetry.envelope import TelemetryEnvelope
+
+TABLE = "serving_samples"
+RETENTION_TABLES = (TABLE,)
+
+
+def accepts_sampler(name: str) -> bool:
+    return name == "serving"
+
+
+def init_schema(conn) -> None:
+    conn.execute(
+        f"""CREATE TABLE IF NOT EXISTS {TABLE} (
+            id INTEGER PRIMARY KEY AUTOINCREMENT,
+            {IDENTITY_SCHEMA},
+            step INTEGER,
+            timestamp REAL,
+            requests_enqueued INTEGER,
+            requests_completed INTEGER,
+            requests_active INTEGER,
+            queue_depth INTEGER,
+            decode_tokens INTEGER,
+            prefill_ms REAL,
+            decode_ms REAL,
+            tokens_per_s REAL,
+            batch_occupancy REAL,
+            ttft_p50_ms REAL,
+            ttft_p95_ms REAL,
+            ttft_p99_ms REAL,
+            e2e_p50_ms REAL,
+            e2e_p95_ms REAL,
+            e2e_p99_ms REAL,
+            kv_bytes INTEGER,
+            kv_limit_bytes INTEGER,
+            kv_headroom REAL,
+            ttft_ms_list TEXT,
+            e2e_ms_list TEXT,
+            tokens_list TEXT
+        )"""
+    )
+    conn.execute(
+        f"CREATE INDEX IF NOT EXISTS idx_{TABLE}_rank_step "
+        f"ON {TABLE} (session_id, global_rank, step)"
+    )
+
+
+def insert_sql(table: str) -> str:
+    return (
+        f"INSERT INTO {TABLE} (session_id, global_rank, local_rank, world_size,"
+        " local_world_size, node_rank, hostname, pid, step, timestamp,"
+        " requests_enqueued, requests_completed, requests_active, queue_depth,"
+        " decode_tokens, prefill_ms, decode_ms, tokens_per_s, batch_occupancy,"
+        " ttft_p50_ms, ttft_p95_ms, ttft_p99_ms, e2e_p50_ms, e2e_p95_ms,"
+        " e2e_p99_ms, kv_bytes, kv_limit_bytes, kv_headroom, ttft_ms_list,"
+        " e2e_ms_list, tokens_list)"
+        " VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)"
+    )
+
+
+def build_rows(env: TelemetryEnvelope) -> Dict[str, List[Tuple]]:
+    ident = identity_tuple(env)
+    tables: Dict[str, List[Tuple]] = {}
+    v = env.column_view("serving")
+    if v:
+        steps = v.ints("step")
+        ts = v.floats("timestamp")
+        enq = v.ints("requests_enqueued")
+        done = v.ints("requests_completed")
+        active = v.ints("requests_active")
+        qdepth = v.ints("queue_depth")
+        dtok = v.ints("decode_tokens")
+        pre_ms = v.floats("prefill_ms")
+        dec_ms = v.floats("decode_ms")
+        tps = v.floats("tokens_per_s")
+        occ = v.floats("batch_occupancy")
+        t50 = v.floats("ttft_p50_ms")
+        t95 = v.floats("ttft_p95_ms")
+        t99 = v.floats("ttft_p99_ms")
+        e50 = v.floats("e2e_p50_ms")
+        e95 = v.floats("e2e_p95_ms")
+        e99 = v.floats("e2e_p99_ms")
+        kvb = v.ints("kv_bytes")
+        kvl = v.ints("kv_limit_bytes")
+        kvh = v.floats("kv_headroom")
+        ttft_l = v.strs("ttft_ms_list", "")
+        e2e_l = v.strs("e2e_ms_list", "")
+        tok_l = v.strs("tokens_list", "")
+        tables[TABLE] = [
+            ident
+            + (
+                steps[i],
+                ts[i],
+                enq[i] or 0,
+                done[i] or 0,
+                active[i] or 0,
+                qdepth[i] or 0,
+                dtok[i] or 0,
+                pre_ms[i] or 0.0,
+                dec_ms[i] or 0.0,
+                tps[i] or 0.0,
+                occ[i] or 0.0,
+                t50[i] or 0.0,
+                t95[i] or 0.0,
+                t99[i] or 0.0,
+                e50[i] or 0.0,
+                e95[i] or 0.0,
+                e99[i] or 0.0,
+                kvb[i] if kvb[i] is not None else -1,
+                kvl[i] if kvl[i] is not None else -1,
+                kvh[i] if kvh[i] is not None else -1.0,
+                ttft_l[i],
+                e2e_l[i],
+                tok_l[i],
+            )
+            for i in range(len(v))
+        ]
+    return tables

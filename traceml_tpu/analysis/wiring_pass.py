@@ -67,6 +67,10 @@ CONTRACT: Dict[str, Set[str]] = {
         "sampler", "writer", "store", "ring", "fragment", "diag_pkg",
         "diagnosis",
     },
+    "serving": {
+        "sampler", "writer", "store", "ring", "fragment", "diag_pkg",
+        "diagnosis",
+    },
     "system": {"sampler", "writer", "store", "fragment", "diag_pkg",
                "diagnosis"},
     "process": {"sampler", "writer", "store", "fragment", "diag_pkg",
@@ -81,7 +85,9 @@ CONTRACT: Dict[str, Set[str]] = {
 ALIASES: Dict[str, Dict[str, str]] = {
     "sampler": {"stdout_stderr": "stdout"},
     "writer": {"mesh_topology": "topology"},
-    "ring": {"memory": "step_memory"},
+    # RaggedEventColumns is the serving domain's ring: CSR-style ragged
+    # per-request latency lists riding the same compacting ring engine
+    "ring": {"memory": "step_memory", "ragged_event": "serving"},
     "fragment": {"memory": "step_memory"},
 }
 

@@ -23,6 +23,14 @@ from traceml_tpu.instrumentation.collectives import (  # noqa: F401
     patch_lax_collectives,
     record_collective,
 )
+from traceml_tpu.instrumentation.serving import (  # noqa: F401
+    instrument_generate,
+    record_decode_token,
+    record_prefill_end,
+    record_prefill_start,
+    record_request_enqueued,
+    record_request_finished,
+)
 from traceml_tpu.sdk.summary_client import (  # noqa: F401
     final_summary,
     live_metrics,

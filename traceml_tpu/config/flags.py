@@ -225,6 +225,12 @@ COLLECTIVES = declare(
 COLUMNAR_WINDOW = declare(
     "TRACEML_COLUMNAR_WINDOW", "1",
     "0 forces the scalar window-build reference path")
+SERVING = declare(
+    "TRACEML_SERVING", "1",
+    "0 turns every serving-capture entry point into a no-op")
+SERVING_QUEUE_MAX = declare(
+    "TRACEML_SERVING_QUEUE_MAX", "8192",
+    "serving domain: bounded request-event queue capacity per rank")
 NO_NATIVE = declare(
     "TRACEML_NO_NATIVE", None,
     "1 skips the optional C framing extension (pure-Python fallback)")

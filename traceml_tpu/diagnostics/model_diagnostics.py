@@ -23,7 +23,7 @@ from traceml_tpu.diagnostics.common import (
 # pluggable domain registry (reference: DiagnosticDomainRegistry)
 DOMAIN_REGISTRY = Registry("diagnostic-domains")
 
-MODEL_DOMAINS = ("step_time", "step_memory", "collectives")
+MODEL_DOMAINS = ("step_time", "step_memory", "collectives", "serving")
 ENV_DOMAINS = ("system", "process")
 
 

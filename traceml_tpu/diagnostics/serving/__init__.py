@@ -1,0 +1,18 @@
+"""Serving-domain diagnosis: QUEUE_SATURATED, KV_CACHE_PRESSURE,
+DECODE_BOUND, REPLICA_SKEW (see diagnostics/DIAGNOSIS.md)."""
+
+from traceml_tpu.diagnostics.serving.api import (  # noqa: F401
+    DOMAIN,
+    diagnose_serving_window,
+)
+from traceml_tpu.diagnostics.serving.policy import (  # noqa: F401
+    LIVE_POLICY,
+    SUMMARY_POLICY,
+    ServingPolicy,
+    policy_for,
+)
+from traceml_tpu.diagnostics.serving.rules import (  # noqa: F401
+    DEFAULT_RULES,
+    ServingContext,
+    build_context,
+)

@@ -1,0 +1,82 @@
+"""Serving diagnosis entrypoint.
+
+REPLICA_SKEW joins the r14 topology hook here: when the session
+captured a mesh, per-replica tokens/s *deficits* (median − replica)
+feed ``attach_attribution`` so a skew verdict names the host or DCN
+side carrying the slow replicas instead of a flat rank list.
+"""
+
+from __future__ import annotations
+
+import statistics
+from typing import Any, Mapping, Optional, Sequence
+
+from traceml_tpu.diagnostics.common import (
+    DiagnosticIssue,
+    DiagnosticResult,
+    SEVERITY_INFO,
+    run_rules,
+)
+from traceml_tpu.diagnostics.serving.policy import policy_for
+from traceml_tpu.diagnostics.serving.rules import DEFAULT_RULES, build_context
+from traceml_tpu.utils.columnar import (
+    ServingWindow,
+    build_serving_window_rows,
+)
+
+DOMAIN = "serving"
+
+
+def diagnose_serving_window(
+    window: Optional[ServingWindow],
+    mode: str = "summary",
+    topology: Optional[Any] = None,
+) -> DiagnosticResult:
+    """``topology``: the captured mesh (or None).  Fired issues whose
+    replicas map onto a host / axis / DCN-side grouping of per-replica
+    tokens/s deficit gain an ``attribution`` block."""
+    policy = policy_for(mode)
+    if window is None or window.n_steps < policy.min_steps:
+        return DiagnosticResult(
+            domain=DOMAIN,
+            issues=[
+                DiagnosticIssue(
+                    kind="INSUFFICIENT_SERVING_DATA",
+                    severity=SEVERITY_INFO,
+                    status="ok",
+                    summary=(
+                        "Not enough serving windows for a reliable "
+                        "diagnosis (have "
+                        f"{0 if window is None else window.n_steps}, "
+                        f"need {policy.min_steps})."
+                    ),
+                )
+            ],
+        )
+    ctx = build_context(window, policy)
+    result = run_rules(DOMAIN, DEFAULT_RULES, ctx)
+    if topology is not None:
+        from traceml_tpu.diagnostics.attribution import attach_attribution
+
+        rank_tps = {
+            r: float(v.get("tokens_per_s", 0.0) or 0.0)
+            for r, v in window.per_rank.items()
+        }
+        if len(rank_tps) >= 2:
+            med = statistics.median(rank_tps.values())
+            result = attach_attribution(
+                result,
+                topology,
+                {r: max(0.0, med - v) for r, v in rank_tps.items()},
+            )
+    return result
+
+
+def diagnose_rank_rows(
+    rank_rows: Mapping[int, Sequence[Mapping[str, Any]]],
+    mode: str = "summary",
+    max_steps: int = 200,
+    topology: Optional[Any] = None,
+) -> DiagnosticResult:
+    window = build_serving_window_rows(rank_rows, max_steps=max_steps)
+    return diagnose_serving_window(window, mode=mode, topology=topology)

@@ -1,0 +1,59 @@
+"""Serving thresholds, live vs summary.
+
+The framing follows the Gemma-on-TPU lifecycle view (serving and
+training share the hardware, so serving health is a first-class
+diagnosis target): a replica is *queue-saturated* when requests wait
+faster than they drain, *KV-pressured* when live cache bytes leave
+single-digit HBM headroom (the next long prompt OOMs or forces
+preemption), *decode-bound* when almost all service time is the
+sequential token loop (batching/speculation headroom), and *skewed*
+when replicas serving the same traffic disagree on tokens/s (a host or
+interconnect problem, not a traffic problem).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingPolicy:
+    # QUEUE_SATURATED: cluster backlog at window close, plus the share
+    # of window slots that carried any backlog (a single burst that
+    # drained is not saturation)
+    queue_depth_warn: int
+    queue_depth_critical: int
+    backlog_share_gate: float = 0.50
+    # KV_CACHE_PRESSURE: minimum observed HBM headroom fraction
+    kv_headroom_warn: float = 0.10
+    kv_headroom_critical: float = 0.03
+    # DECODE_BOUND: decode share of total phase time, judged only with
+    # meaningful decode volume
+    decode_share_warn: float = 0.85
+    decode_share_critical: float = 0.95
+    min_decode_tokens: int = 64
+    # REPLICA_SKEW: (median − min) / median over per-replica tokens/s
+    skew_warn: float = 0.30
+    skew_critical: float = 0.60
+    min_steps: int = 3
+    # coverage denominator for confidence_from
+    full_window_steps: int = 60
+
+
+LIVE_POLICY = ServingPolicy(
+    queue_depth_warn=4,
+    queue_depth_critical=16,
+    min_steps=2,
+    full_window_steps=30,
+)
+
+SUMMARY_POLICY = ServingPolicy(
+    queue_depth_warn=4,
+    queue_depth_critical=16,
+    min_steps=3,
+    full_window_steps=60,
+)
+
+
+def policy_for(mode: str) -> ServingPolicy:
+    return SUMMARY_POLICY if mode == "summary" else LIVE_POLICY

@@ -1,0 +1,275 @@
+"""Serving rules: QUEUE_SATURATED, KV_CACHE_PRESSURE, DECODE_BOUND,
+REPLICA_SKEW.
+
+All four consume one :class:`ServingContext` built from the
+cross-replica :class:`~traceml_tpu.utils.columnar.ServingWindow` —
+queue depth and KV headroom are state signals, the decode share and
+per-replica tokens/s are rate signals over the same window."""
+
+from __future__ import annotations
+
+import dataclasses
+import statistics
+from typing import Any, Dict, List, Optional
+
+from traceml_tpu.diagnostics.common import (
+    DiagnosticIssue,
+    SEVERITY_CRITICAL,
+    SEVERITY_WARNING,
+    confidence_from,
+)
+from traceml_tpu.diagnostics.serving.policy import ServingPolicy
+from traceml_tpu.utils.columnar import ServingWindow
+
+
+@dataclasses.dataclass
+class ServingContext:
+    window: ServingWindow
+    policy: ServingPolicy
+    n_steps: int = 0
+    queue_depth_last: int = 0
+    queue_depth_max: int = 0
+    backlog_share: float = 0.0
+    requests_enqueued: int = 0
+    requests_completed: int = 0
+    decode_tokens: int = 0
+    decode_share: float = 0.0
+    kv_headroom_min: float = -1.0
+    tokens_per_s: float = 0.0
+    coverage: float = 0.0
+
+
+def build_context(window: ServingWindow, policy: ServingPolicy) -> ServingContext:
+    qd = window.per_step.get("queue_depth") or []
+    backlog_share = (
+        sum(1 for v in qd if v > 0) / len(qd) if qd else 0.0
+    )
+    t = window.totals
+    return ServingContext(
+        window=window,
+        policy=policy,
+        n_steps=window.n_steps,
+        queue_depth_last=int(t.get("queue_depth_last", 0)),
+        queue_depth_max=int(t.get("queue_depth_max", 0)),
+        backlog_share=backlog_share,
+        requests_enqueued=int(t.get("requests_enqueued", 0)),
+        requests_completed=int(t.get("requests_completed", 0)),
+        decode_tokens=int(t.get("decode_tokens", 0)),
+        decode_share=float(t.get("decode_share", 0.0)),
+        kv_headroom_min=float(t.get("kv_headroom_min", -1.0)),
+        tokens_per_s=float(t.get("tokens_per_s", 0.0)),
+        coverage=min(1.0, window.n_steps / max(1, policy.full_window_steps)),
+    )
+
+
+class QueueSaturatedRule:
+    """Requests arrive faster than replicas drain them: a persistent
+    backlog at window close plus backlog across most of the window —
+    TTFT is queue wait, not model speed."""
+
+    def evaluate(self, ctx: ServingContext) -> List[DiagnosticIssue]:
+        p = ctx.policy
+        depth = ctx.queue_depth_last
+        if depth < p.queue_depth_warn or ctx.backlog_share < p.backlog_share_gate:
+            return []
+        severity = (
+            SEVERITY_CRITICAL
+            if depth >= p.queue_depth_critical
+            else SEVERITY_WARNING
+        )
+        t = ctx.window.totals
+        return [
+            DiagnosticIssue(
+                kind="QUEUE_SATURATED",
+                severity=severity,
+                summary=(
+                    f"{depth} request(s) queued at window close with backlog "
+                    f"in {ctx.backlog_share:.0%} of windows "
+                    f"({ctx.requests_enqueued} arrived vs "
+                    f"{ctx.requests_completed} completed) — arrival rate "
+                    "exceeds service rate and TTFT is queue wait."
+                ),
+                action=(
+                    "Add replicas or shed load: scale the serving pool, "
+                    "enable continuous batching, or cap admission — the "
+                    f"p99 TTFT ({t.get('ttft_p99_ms', 0.0):.0f} ms) is "
+                    "dominated by queueing, not compute."
+                ),
+                metric="queue_depth",
+                score=float(depth) / max(1.0, float(p.queue_depth_warn)),
+                confidence=confidence_from(
+                    float(depth),
+                    float(p.queue_depth_warn),
+                    coverage=ctx.coverage,
+                ),
+                evidence={
+                    "queue_depth_last": depth,
+                    "queue_depth_max": ctx.queue_depth_max,
+                    "backlog_share": round(ctx.backlog_share, 4),
+                    "requests_enqueued": ctx.requests_enqueued,
+                    "requests_completed": ctx.requests_completed,
+                    "ttft_p99_ms": round(float(t.get("ttft_p99_ms", 0.0)), 3),
+                },
+            )
+        ]
+
+
+class KvCachePressureRule:
+    """Live KV-cache bytes leave single-digit HBM headroom: the next
+    long prompt forces preemption/eviction (or OOMs outright)."""
+
+    def evaluate(self, ctx: ServingContext) -> List[DiagnosticIssue]:
+        p = ctx.policy
+        headroom = ctx.kv_headroom_min
+        if headroom < 0.0 or headroom > p.kv_headroom_warn:
+            return []
+        severity = (
+            SEVERITY_CRITICAL
+            if headroom <= p.kv_headroom_critical
+            else SEVERITY_WARNING
+        )
+        pressure = 1.0 - headroom
+        return [
+            DiagnosticIssue(
+                kind="KV_CACHE_PRESSURE",
+                severity=severity,
+                summary=(
+                    f"HBM headroom bottomed at {headroom:.1%} — the KV "
+                    "cache is within one long prompt of eviction or OOM."
+                ),
+                action=(
+                    "Free cache headroom: shorten max context, enable "
+                    "paged/quantized KV cache, lower max batch size, or "
+                    "shard sessions across more replicas."
+                ),
+                metric="kv_headroom",
+                score=float(pressure),
+                confidence=confidence_from(
+                    pressure,
+                    1.0 - p.kv_headroom_warn,
+                    coverage=ctx.coverage,
+                ),
+                evidence={
+                    "kv_headroom_min": round(headroom, 4),
+                },
+            )
+        ]
+
+
+class DecodeBoundRule:
+    """Almost all service time is the sequential decode loop — prefill
+    is a rounding error, so throughput scales with batching and
+    speculative decoding, not with a faster prefill."""
+
+    def evaluate(self, ctx: ServingContext) -> List[DiagnosticIssue]:
+        p = ctx.policy
+        if (
+            ctx.decode_tokens < p.min_decode_tokens
+            or ctx.requests_completed <= 0
+        ):
+            return []
+        share = ctx.decode_share
+        if share < p.decode_share_warn:
+            return []
+        severity = (
+            SEVERITY_CRITICAL
+            if share >= p.decode_share_critical
+            else SEVERITY_WARNING
+        )
+        t = ctx.window.totals
+        return [
+            DiagnosticIssue(
+                kind="DECODE_BOUND",
+                severity=severity,
+                summary=(
+                    f"{share:.0%} of serving time is the decode loop "
+                    f"({t.get('decode_ms', 0.0):.0f} ms decode vs "
+                    f"{t.get('prefill_ms', 0.0):.0f} ms prefill) — "
+                    "throughput is bounded by sequential token generation."
+                ),
+                action=(
+                    "Raise decode parallelism: grow the decode batch "
+                    "(continuous batching), add speculative decoding, or "
+                    "cap output lengths — prefill optimization will not "
+                    "move tokens/s here."
+                ),
+                metric="decode_share",
+                score=float(share),
+                share_pct=float(share),
+                confidence=confidence_from(
+                    share, p.decode_share_warn, coverage=ctx.coverage
+                ),
+                evidence={
+                    "decode_share": round(share, 4),
+                    "decode_tokens": ctx.decode_tokens,
+                    "tokens_per_s": round(ctx.tokens_per_s, 3),
+                },
+            )
+        ]
+
+
+class ReplicaSkewRule:
+    """Replicas serving the same traffic disagree on tokens/s: the slow
+    replica drags the pool's tail latency — a host or interconnect
+    problem, not a traffic problem (topology attribution names it)."""
+
+    def evaluate(self, ctx: ServingContext) -> List[DiagnosticIssue]:
+        p = ctx.policy
+        rank_tps = {
+            r: float(v.get("tokens_per_s", 0.0) or 0.0)
+            for r, v in ctx.window.per_rank.items()
+        }
+        if len(rank_tps) < 2:
+            return []
+        med = statistics.median(rank_tps.values())
+        if med <= 0.0:
+            return []
+        worst = min(rank_tps.values())
+        skew = (med - worst) / med
+        if skew < p.skew_warn:
+            return []
+        severity = (
+            SEVERITY_CRITICAL if skew >= p.skew_critical else SEVERITY_WARNING
+        )
+        lag = sorted(
+            r for r, v in rank_tps.items() if (med - v) / med >= p.skew_warn
+        )
+        evidence: Dict[str, Any] = {
+            "median_tokens_per_s": round(med, 3),
+            "min_tokens_per_s": round(worst, 3),
+            "skew": round(skew, 4),
+            "lagging_replicas": lag[:16],
+        }
+        return [
+            DiagnosticIssue(
+                kind="REPLICA_SKEW",
+                severity=severity,
+                summary=(
+                    f"{len(lag)} replica(s) decode {skew:.0%} below the "
+                    f"median ({worst:.1f} vs {med:.1f} tokens/s) — the "
+                    "pool's tail latency is one slow replica."
+                ),
+                action=(
+                    "Inspect the lagging replica's host (thermal "
+                    "throttling, noisy neighbor, NUMA/IRQ placement) and "
+                    "its interconnect path; drain and replace it if the "
+                    "deficit persists."
+                ),
+                metric="tokens_per_s_skew",
+                score=float(skew),
+                skew_pct=float(skew),
+                ranks=lag,
+                confidence=confidence_from(
+                    skew, p.skew_warn, coverage=ctx.coverage
+                ),
+                evidence=evidence,
+            )
+        ]
+
+
+DEFAULT_RULES = (
+    QueueSaturatedRule(),
+    KvCachePressureRule(),
+    DecodeBoundRule(),
+    ReplicaSkewRule(),
+)

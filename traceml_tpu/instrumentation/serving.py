@@ -1,0 +1,281 @@
+"""Inference/serving request-lifecycle capture
+(motivated by the Gemma-on-TPU lifecycle framing, arXiv:2605.25645 —
+fine-tuning and serving are one lifecycle on the same hardware).
+
+Training telemetry is regular: one step, one row.  Serving traffic is
+ragged — requests arrive whenever, prefill and decode have wildly
+different shapes, and a replica's health is a property of the request
+*population* (TTFT percentiles, queue depth, tokens/s), not of any one
+call.  This module is the capture side of that population view: five
+lifecycle recorders feed one bounded queue of per-event records that
+:class:`~traceml_tpu.samplers.serving_sampler.ServingSampler` folds
+into per-window aggregates on every tick.
+
+Lifecycle (all timestamps are host wall clock, one record each)::
+
+    record_request_enqueued(req)          # arrival, enters the queue
+    record_prefill_start(req, tokens)     # leaves queue, prompt tokens
+    record_prefill_end(req)               # first token ready (TTFT)
+    record_decode_token(req, n)           # n tokens streamed
+    record_request_finished(req, ok)      # leaves the system
+
+Every record is a flat uniform dict (plays well with the r10 columnar
+producer accumulators)::
+
+    {"ev", "req", "ts", "tokens"}
+
+:func:`instrument_generate` wraps a generate callable so call sites need
+no per-event plumbing: streaming generators get a true prefill/decode
+split (first yield == TTFT), one-shot jit'd generate loops are recorded
+with prefill_end at call return (TTFT == e2e — the honest reading when
+the loop is opaque).  :func:`sample_kv_cache` reads KV-cache/HBM
+headroom from JAX live-array accounting, fail-open.
+
+Kill switch: ``TRACEML_SERVING=0`` turns every entry point into a no-op
+(and unregisters the sampler — see runtime/sampler_registry.py).
+"""
+
+from __future__ import annotations
+
+import functools
+import itertools
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+from traceml_tpu.config import flags
+from traceml_tpu.utils.error_log import get_error_log
+from traceml_tpu.utils.timing import BoundedDropQueue
+
+# canonical event vocabulary — pinned by tests/samplers/test_serving_sampler.py
+EV_ENQUEUED = "enq"
+EV_PREFILL_START = "prefill_start"
+EV_PREFILL_END = "prefill_end"
+EV_DECODE = "decode"
+EV_FINISHED = "finish"
+
+EV_KINDS = (
+    EV_ENQUEUED,
+    EV_PREFILL_START,
+    EV_PREFILL_END,
+    EV_DECODE,
+    EV_FINISHED,
+)
+
+
+def serving_enabled() -> bool:
+    return flags.SERVING.enabled()
+
+
+# Global queue shared by the recorders and ServingSampler.  Capacity is
+# per-event (a request is ~4 + tokens/batch events), so the default 8192
+# absorbs a deep burst before the drop counter starts ticking.
+GLOBAL_SERVING_QUEUE = BoundedDropQueue(
+    "serving", maxsize=flags.SERVING_QUEUE_MAX.get_int(8192)
+)
+
+
+def _record(ev: str, request_id: Any, tokens: int, ts: Optional[float]) -> bool:
+    """Build + enqueue one lifecycle record.  Never raises; returns
+    whether the record was enqueued (False: disabled or queue full)."""
+    if not serving_enabled():
+        return False
+    try:
+        rec = {
+            "ev": ev,
+            "req": str(request_id),
+            "ts": float(ts) if ts is not None else time.time(),
+            "tokens": max(0, int(tokens)),
+        }
+    except Exception as exc:
+        get_error_log().warning("serving record failed", exc)
+        return False
+    return GLOBAL_SERVING_QUEUE.put(rec)
+
+
+def record_request_enqueued(
+    request_id: Any, ts: Optional[float] = None
+) -> bool:
+    """A request arrived and is waiting for a prefill slot."""
+    return _record(EV_ENQUEUED, request_id, 0, ts)
+
+
+def record_prefill_start(
+    request_id: Any, prompt_tokens: int = 0, ts: Optional[float] = None
+) -> bool:
+    """The request left the queue; ``prompt_tokens`` sizes the prefill."""
+    return _record(EV_PREFILL_START, request_id, prompt_tokens, ts)
+
+
+def record_prefill_end(request_id: Any, ts: Optional[float] = None) -> bool:
+    """Prefill done — the first token exists.  This stamp is TTFT."""
+    return _record(EV_PREFILL_END, request_id, 0, ts)
+
+
+def record_decode_token(
+    request_id: Any, n: int = 1, ts: Optional[float] = None
+) -> bool:
+    """``n`` decode tokens were produced (batch decode may emit >1)."""
+    return _record(EV_DECODE, request_id, n, ts)
+
+
+def record_request_finished(
+    request_id: Any, ok: bool = True, ts: Optional[float] = None
+) -> bool:
+    """The request left the system (``ok=False``: cancelled/errored)."""
+    return _record(EV_FINISHED, request_id, 1 if ok else 0, ts)
+
+
+# --- KV-cache / HBM headroom from live-array accounting ---------------------
+
+#: substrings that mark a live array as KV-cache state.  Serving stacks
+#: name their cache buffers; anything unnamed still counts toward the
+#: total live bytes the headroom is computed from.
+_KV_NAME_HINTS = ("kv_cache", "kvcache", "cache_k", "cache_v", "k_cache", "v_cache")
+
+
+def sample_kv_cache() -> Optional[Dict[str, Any]]:
+    """Best-effort ``{"kv_bytes", "kv_limit_bytes", "kv_headroom"}``
+    from JAX live-array accounting: total live on-device bytes (the KV
+    cache dominates a serving replica's steady state), the device memory
+    limit, and the remaining headroom fraction.  Returns None when no
+    JAX runtime (or no addressable device) is available — the domain
+    keeps working without it, rows carry ``-1`` sentinels."""
+    if not serving_enabled():
+        return None
+    try:
+        import jax
+
+        live = 0
+        kv = 0
+        for arr in jax.live_arrays():
+            try:
+                n = int(arr.nbytes)
+            except Exception:
+                continue
+            live += n
+            name = str(getattr(arr, "_traceml_name", "") or "").lower()
+            if name and any(h in name for h in _KV_NAME_HINTS):
+                kv += n
+        limit = 0
+        for dev in jax.local_devices():
+            stats = getattr(dev, "memory_stats", None)
+            if stats is None:
+                continue
+            try:
+                s = stats() or {}
+            except Exception:
+                continue
+            limit += int(s.get("bytes_limit", 0) or 0)
+        headroom = (1.0 - live / limit) if limit > 0 else -1.0
+        return {
+            "kv_bytes": kv if kv > 0 else live,
+            "kv_limit_bytes": limit,
+            "kv_headroom": headroom,
+        }
+    except Exception:
+        return None
+
+
+# --- generate-loop wrapper --------------------------------------------------
+
+_req_counter = itertools.count(1)
+_req_lock = threading.Lock()
+
+
+def _next_request_id() -> str:
+    with _req_lock:
+        return f"gen-{next(_req_counter)}"
+
+
+def _count_tokens(out: Any) -> int:
+    """Best-effort decoded-token count of a generate result: trailing
+    array dim (the sequence axis of a (batch, seq) output), else len()."""
+    shape = getattr(out, "shape", None)
+    if shape:
+        try:
+            return max(0, int(shape[-1]))
+        except Exception:
+            pass
+    try:
+        return max(0, len(out))
+    except Exception:
+        return 0
+
+
+def instrument_generate(
+    fn: Callable,
+    *,
+    prompt_tokens: Optional[Callable[..., int]] = None,
+    token_count: Optional[Callable[[Any], int]] = None,
+) -> Callable:
+    """Wrap a generate callable so every call records a full request
+    lifecycle without per-event plumbing at the call site.
+
+    * Generator results get the true phase split: prefill_end is stamped
+      at the FIRST yield (TTFT), each subsequent yield records a decode
+      token, exhaustion records finished.
+    * Plain results (a jit'd generate loop returning the whole sequence)
+      record prefill_end at call return and the decoded tokens in one
+      decode record — TTFT equals end-to-end latency, the honest reading
+      when the loop is opaque to the host.
+
+    ``prompt_tokens(*args, **kwargs)`` sizes the prefill;
+    ``token_count(result)`` overrides the decoded-token estimate.
+    Idempotent; fail-open — recording errors never reach user code.
+    """
+    if getattr(fn, "_traceml_serving_instrumented", False):
+        return fn
+
+    @functools.wraps(fn)
+    def wrapped(*args: Any, **kwargs: Any):
+        if not serving_enabled():
+            return fn(*args, **kwargs)
+        req = _next_request_id()
+        try:
+            n_prompt = int(prompt_tokens(*args, **kwargs)) if prompt_tokens else 0
+        except Exception:
+            n_prompt = 0
+        record_request_enqueued(req)
+        record_prefill_start(req, prompt_tokens=n_prompt)
+        try:
+            out = fn(*args, **kwargs)
+        except Exception:
+            record_request_finished(req, ok=False)
+            raise
+        if hasattr(out, "__next__"):
+            return _wrap_stream(out, req)
+        try:
+            record_prefill_end(req)
+            n = token_count(out) if token_count else _count_tokens(out)
+            if n > 0:
+                record_decode_token(req, n)
+            record_request_finished(req, ok=True)
+        except Exception as exc:  # never raise into user code
+            get_error_log().warning("instrument_generate record failed", exc)
+        return out
+
+    wrapped._traceml_serving_instrumented = True  # type: ignore[attr-defined]
+    return wrapped
+
+
+def _wrap_stream(it: Any, req: str):
+    """Token-stream path: first yield stamps TTFT, each yield is one
+    decode token, exhaustion (or caller abandonment) finishes."""
+    first = True
+    ok = True
+    try:
+        for item in it:
+            if first:
+                record_prefill_end(req)
+                first = False
+            record_decode_token(req, 1)
+            yield item
+    except Exception:
+        ok = False
+        raise
+    finally:
+        if first:
+            # stream died before the first token — still close the request
+            record_prefill_end(req)
+        record_request_finished(req, ok=ok)

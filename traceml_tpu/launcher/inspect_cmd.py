@@ -17,6 +17,12 @@ it reads the captured mesh out of the session's ``telemetry.sqlite``
 (the one-shot ``mesh_topology`` control rows) and prints axis
 names/sizes, interconnect kind per axis, and the rank→host→coords
 table — with a clean message for pre-topology session DBs.
+
+``--domain serving`` is special the same way: it folds the session's
+``serving_samples`` rows through the shared window build and prints the
+pooled request/latency totals plus a per-replica table (requests,
+TTFT p99, tokens/s, queue depth, KV headroom) — with a clean message
+for training-only sessions.
 """
 
 from __future__ import annotations
@@ -100,12 +106,76 @@ def _inspect_topology(path: Path) -> int:
     return 0
 
 
+def _inspect_serving(path: Path) -> int:
+    from traceml_tpu.reporting.snapshot_store import LiveSnapshotStore
+
+    db = _find_session_db(path)
+    if db is None:
+        print(f"no telemetry.sqlite at or under {path}")
+        return 1
+    store = LiveSnapshotStore(db, window_steps=600)
+    try:
+        store.refresh()
+        if not store.has_serving_rows():
+            print(
+                f"no serving telemetry in {db}\n"
+                "(training-only session, or the run set TRACEML_SERVING=0)"
+            )
+            return 1
+        window = store.build_serving_window(max_steps=600)
+    finally:
+        store.close()
+    if window is None:
+        print(f"no serving windows could be folded from {db}")
+        return 1
+    t = window.totals
+    print(f"── serving ({db})")
+    print(
+        f"windows: {window.n_steps}   replicas: {len(window.ranks)}   "
+        f"requests: {t.get('requests_completed', 0)}/"
+        f"{t.get('requests_enqueued', 0)} done/enqueued"
+    )
+    print(
+        f"tokens/s: {t.get('tokens_per_s', 0.0):.1f}   "
+        f"decode share: {t.get('decode_share', 0.0):.0%}   "
+        f"queue depth: {t.get('queue_depth_last', 0)} last / "
+        f"{t.get('queue_depth_max', 0)} max"
+    )
+    print(
+        f"TTFT p50/p95/p99: {t.get('ttft_p50_ms', 0.0):.1f} / "
+        f"{t.get('ttft_p95_ms', 0.0):.1f} / "
+        f"{t.get('ttft_p99_ms', 0.0):.1f} ms   "
+        f"e2e p99: {t.get('e2e_p99_ms', 0.0):.1f} ms"
+    )
+    kvh = float(t.get("kv_headroom_min", -1.0))
+    if kvh >= 0.0:
+        print(f"min KV-cache headroom: {kvh:.1%}")
+    print(
+        f"{'replica':>8}  {'done':>6}  {'active':>6}  {'tok/s':>9}  "
+        f"{'ttft p99':>10}  {'queue':>6}  {'kv hdrm':>8}"
+    )
+    for rank in sorted(window.per_rank):
+        v = window.per_rank[rank]
+        h = float(v.get("kv_headroom", -1.0))
+        print(
+            f"{rank:>8}  {int(v.get('requests_completed', 0)):>6}  "
+            f"{int(v.get('requests_active', 0)):>6}  "
+            f"{float(v.get('tokens_per_s', 0.0)):>9.1f}  "
+            f"{float(v.get('ttft_p99_ms', 0.0)):>7.1f} ms  "
+            f"{int(v.get('queue_depth', 0)):>6}  "
+            f"{(f'{h:.0%}' if h >= 0.0 else 'n/a'):>8}"
+        )
+    return 0
+
+
 def run_inspect(
     path: Path, limit: int = 20, domain: Optional[str] = None
 ) -> int:
     path = Path(path)
     if domain == "topology":
         return _inspect_topology(path)
+    if domain == "serving":
+        return _inspect_serving(path)
     files = []
     if path.is_file():
         files = [path]

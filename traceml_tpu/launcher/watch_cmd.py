@@ -39,10 +39,12 @@ class _WatchState:
 
         self.store.refresh()
         # topology version joins the gate: a late mesh_topology message
-        # must re-render so the mesh strip + attribution appear
+        # must re-render so the mesh strip + attribution appear; serving
+        # joins so an inference session's line tracks its own writes
         version = (
             self.store.versions["step_time"],
             self.store.versions["topology"],
+            self.store.versions["serving"],
         )
         if version == self._version:
             return self._lines
@@ -76,6 +78,21 @@ class _WatchState:
                 )
         else:
             lines.append("no step telemetry yet")
+        # serving line only for sessions that actually serve: watch on a
+        # training-only session renders exactly the pre-serving output
+        if self.store.has_serving_rows():
+            try:
+                sw = self.store.build_serving_window(max_steps=120)
+            except Exception:
+                sw = None
+            if sw is not None:
+                t = sw.totals
+                lines.append(
+                    f"serving: {len(sw.ranks)} replica(s)  "
+                    f"{t.get('tokens_per_s', 0.0):.1f} tok/s  "
+                    f"ttft p99 {t.get('ttft_p99_ms', 0.0):.0f} ms  "
+                    f"queue {int(t.get('queue_depth_last', 0))}"
+                )
         self._lines = lines
         self._version = version
         return lines

@@ -37,6 +37,9 @@ _DOMAIN_DEPS: Dict[str, Tuple[Tuple[str, ...], Optional[str]]] = {
     # message must re-run their diagnoses so attribution attaches
     "memory": (("step_memory", "topology"), "memory"),
     "collectives": (("collectives", "step_time", "topology"), "collectives"),
+    # serving also depends on topology: REPLICA_SKEW attaches mesh
+    # attribution, so a late mesh_topology message must re-run it
+    "serving": (("serving", "topology"), "serving"),
     "system": (("system", "topology"), "system"),
     "process": (("process",), "process"),
     "stdout": (("stdout",), None),
@@ -259,6 +262,33 @@ class LiveComputer:
             return updates, view
         except Exception as exc:
             return {"collectives": {"error": str(exc)}}, None
+
+    def _compute_serving(self) -> Tuple[Dict[str, Any], Any]:
+        try:
+            window = self._store.build_serving_window(
+                max_steps=self.window_steps
+            )
+            view = V.build_serving_view(
+                window, latest_ts=self._store.latest_serving_ts()
+            )
+            from traceml_tpu.diagnostics.serving.api import (
+                diagnose_serving_window,
+            )
+
+            updates = {
+                "serving": {
+                    "window": window,
+                    "diagnosis": diagnose_serving_window(
+                        window, mode="live",
+                        topology=self._mesh_topology(),
+                    )
+                    if self._store.has_serving_rows()
+                    else None,
+                },
+            }
+            return updates, view
+        except Exception as exc:
+            return {"serving": {"error": str(exc)}}, None
 
     def _compute_system(self) -> Tuple[Dict[str, Any], Any]:
         nodes = int((self._store.topology() or {}).get("nodes") or 0)

@@ -415,6 +415,128 @@ def build_collectives_view(
 
 
 # ---------------------------------------------------------------------------
+# serving (inference request lifecycle)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class ServingReplicaStat:
+    """Window aggregates for one serving replica."""
+
+    rank: int
+    requests_completed: int
+    requests_active: int
+    decode_tokens: int
+    tokens_per_s: float
+    queue_depth: int
+    ttft_p99_ms: float
+    kv_headroom: Optional[float]  # None when never sampled
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingView:
+    n_steps: int
+    replicas_present: int
+    steps: List[int]                      # window seqs (tail)
+    queue_depth_series: List[int]         # per-window cluster backlog
+    completed_series: List[int]           # per-window completed requests
+    tokens_per_s_series: List[float]      # per-window cluster tokens/s
+    requests_enqueued: int
+    requests_completed: int
+    decode_tokens: int
+    tokens_per_s: float                   # cluster throughput
+    queue_depth: int                      # backlog at window close
+    queue_depth_max: int
+    prefill_ms: float
+    decode_ms: float
+    decode_share: float
+    ttft_p50_ms: float
+    ttft_p95_ms: float
+    ttft_p99_ms: float
+    e2e_p50_ms: float
+    e2e_p95_ms: float
+    e2e_p99_ms: float
+    kv_headroom_min: Optional[float]      # None when never sampled
+    replicas: List[ServingReplicaStat]    # sorted by tokens/s asc (worst first)
+    slowest_replica: Optional[int]
+    latest_ts: Optional[float]
+
+    def as_dict(self) -> Dict[str, Any]:
+        return _asdict(self)
+
+
+def build_serving_view(
+    window: Any,
+    *,
+    latest_ts: Optional[float] = None,
+    series_tail: int = 60,
+) -> Optional[ServingView]:
+    """``window`` is a :class:`~traceml_tpu.utils.columnar.ServingWindow`
+    (TTFT/e2e percentiles already re-ranked over the raw populations)."""
+    if window is None or not window.n_steps:
+        return None
+    n = window.n_steps
+    offset = max(0, n - series_tail)
+    t = window.totals
+    kv_min = float(t.get("kv_headroom_min", -1.0))
+    replicas = [
+        ServingReplicaStat(
+            rank=int(r),
+            requests_completed=int(v.get("requests_completed", 0)),
+            requests_active=int(v.get("requests_active", 0)),
+            decode_tokens=int(v.get("decode_tokens", 0)),
+            tokens_per_s=round(float(v.get("tokens_per_s", 0.0)), 3),
+            queue_depth=int(v.get("queue_depth", 0)),
+            ttft_p99_ms=round(float(v.get("ttft_p99_ms", 0.0)), 3),
+            kv_headroom=(
+                round(float(v["kv_headroom"]), 4)
+                if float(v.get("kv_headroom", -1.0)) >= 0.0
+                else None
+            ),
+        )
+        for r, v in sorted(window.per_rank.items())
+    ]
+    replicas.sort(key=lambda s: s.tokens_per_s)
+    slowest = (
+        replicas[0].rank
+        if replicas and any(s.tokens_per_s > 0 for s in replicas)
+        else None
+    )
+    return ServingView(
+        n_steps=n,
+        replicas_present=len(window.ranks),
+        steps=list(window.steps[offset:]),
+        queue_depth_series=[
+            int(v) for v in window.per_step["queue_depth"][offset:]
+        ],
+        completed_series=[
+            int(v) for v in window.per_step["requests_completed"][offset:]
+        ],
+        tokens_per_s_series=[
+            round(float(v), 3) for v in window.per_step["tokens_per_s"][offset:]
+        ],
+        requests_enqueued=int(t.get("requests_enqueued", 0)),
+        requests_completed=int(t.get("requests_completed", 0)),
+        decode_tokens=int(t.get("decode_tokens", 0)),
+        tokens_per_s=round(float(t.get("tokens_per_s", 0.0)), 3),
+        queue_depth=int(t.get("queue_depth_last", 0)),
+        queue_depth_max=int(t.get("queue_depth_max", 0)),
+        prefill_ms=round(float(t.get("prefill_ms", 0.0)), 3),
+        decode_ms=round(float(t.get("decode_ms", 0.0)), 3),
+        decode_share=round(float(t.get("decode_share", 0.0)), 4),
+        ttft_p50_ms=round(float(t.get("ttft_p50_ms", 0.0)), 3),
+        ttft_p95_ms=round(float(t.get("ttft_p95_ms", 0.0)), 3),
+        ttft_p99_ms=round(float(t.get("ttft_p99_ms", 0.0)), 3),
+        e2e_p50_ms=round(float(t.get("e2e_p50_ms", 0.0)), 3),
+        e2e_p95_ms=round(float(t.get("e2e_p95_ms", 0.0)), 3),
+        e2e_p99_ms=round(float(t.get("e2e_p99_ms", 0.0)), 3),
+        kv_headroom_min=round(kv_min, 4) if kv_min >= 0.0 else None,
+        replicas=replicas,
+        slowest_replica=slowest,
+        latest_ts=latest_ts,
+    )
+
+
+# ---------------------------------------------------------------------------
 # system (host + devices), incl. the multi-node cluster rollup
 # ---------------------------------------------------------------------------
 

@@ -37,6 +37,7 @@ _FRAGMENT_KEYS: Dict[str, Tuple[str, ...]] = {
     "step_time": ("step_time",),
     "memory": ("memory",),
     "collectives": ("collectives",),
+    "serving": ("serving",),
     "system": ("system",),
     "process": ("process",),
     "stdout": ("stdout",),
@@ -56,12 +57,13 @@ FRAGMENT_DEPS: Dict[str, Tuple[str, ...]] = {
     "step_time": ("step_time", "model_stats", "topology"),
     "memory": ("step_memory", "topology"),
     "collectives": ("collectives", "step_time", "topology"),
+    "serving": ("serving", "topology"),
     "system": ("system", "topology"),
     "process": ("process",),
     "stdout": ("stdout",),
     "diagnosis": (
         "step_time", "model_stats", "topology", "step_memory",
-        "collectives", "system", "process",
+        "collectives", "serving", "system", "process",
     ),
 }
 
@@ -92,6 +94,17 @@ def _view_fragment(payload: Dict[str, Any], key: str) -> Dict[str, Any]:
     return {key: view.as_dict() if view is not None else None}
 
 
+def _serving_fragment(payload: Dict[str, Any]) -> Dict[str, Any]:
+    """Unlike the training domains, ``serving`` omits its key entirely
+    when the session recorded no serving rows: a training-only session's
+    payload (and the final report derived from it) must stay
+    byte-identical to the pre-serving-domain shape."""
+    view = (payload.get("views") or {}).get("serving")
+    if view is None:
+        return {}
+    return {"serving": view.as_dict()}
+
+
 def _diagnosis_fragment(payload: Dict[str, Any]) -> Dict[str, Any]:
     out: Dict[str, Any] = {"diagnosis": None, "findings": []}
     if not payload.get("db_exists"):
@@ -103,6 +116,7 @@ def _diagnosis_fragment(payload: Dict[str, Any]) -> Dict[str, Any]:
         "step_time": st_result,
         "step_memory": payload.get("step_memory_diagnosis"),
         "collectives": (payload.get("collectives") or {}).get("diagnosis"),
+        "serving": (payload.get("serving") or {}).get("diagnosis"),
         "system": payload.get("system_diagnosis"),
         "process": payload.get("process_diagnosis"),
     }
@@ -186,6 +200,8 @@ def build_fragment(
         return {"version": PAYLOAD_VERSION, "session": session}
     if name in ("step_time", "memory", "collectives", "system", "process"):
         return _view_fragment(payload, name)
+    if name == "serving":
+        return _serving_fragment(payload)
     if name == "stdout":
         return {
             "stdout": [

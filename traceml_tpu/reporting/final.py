@@ -25,6 +25,7 @@ from traceml_tpu.diagnostics.collectives.api import diagnose_collectives_window
 from traceml_tpu.diagnostics.common import DiagnosticResult
 from traceml_tpu.diagnostics.liveness.api import diagnose_rank_status
 from traceml_tpu.diagnostics.process.api import diagnose as diagnose_process
+from traceml_tpu.diagnostics.serving.api import diagnose_serving_window
 from traceml_tpu.diagnostics.step_memory.api import (
     diagnose_rank_rows as diagnose_memory,
 )
@@ -380,6 +381,74 @@ def _build_collectives_section(store, mode: str, step_time_ms=None,
     return section, result
 
 
+def _build_serving_section(store, mode: str, topology=None):
+    """Inference/serving section — built ONLY when serving rows exist
+    (the caller gates on ``has_serving_rows``): a training-only session's
+    summary stays byte-identical to the pre-serving-domain shape, with
+    no NO_DATA stub and no key at all."""
+    window = store.build_serving_window(max_steps=200)
+    result = diagnose_serving_window(window, mode=mode, topology=topology)
+    section: Dict[str, Any] = {
+        "status": "OK" if window else "NO_DATA",
+        "diagnosis": result.diagnosis.to_dict(),
+        "issues": [i.to_dict() for i in result.issues],
+        "units": {"time": "ms", "throughput": "tokens/s"},
+    }
+    if window:
+        t = window.totals
+        per_replica = {
+            str(r): {
+                "requests_completed": int(v.get("requests_completed", 0)),
+                "requests_active": int(v.get("requests_active", 0)),
+                "decode_tokens": int(v.get("decode_tokens", 0)),
+                "tokens_per_s": round(float(v.get("tokens_per_s", 0.0)), 3),
+                "queue_depth": int(v.get("queue_depth", 0)),
+                "ttft_p99_ms": round(float(v.get("ttft_p99_ms", 0.0)), 3),
+                "kv_headroom": (
+                    round(float(v["kv_headroom"]), 4)
+                    if float(v.get("kv_headroom", -1.0)) >= 0.0
+                    else None
+                ),
+            }
+            for r, v in sorted(window.per_rank.items())
+        }
+        tail = 120
+        kvh = float(t.get("kv_headroom_min", -1.0))
+        section["global"] = {
+            "n_windows": window.n_steps,
+            "window_range": [window.steps[0], window.steps[-1]],
+            "replicas": window.ranks,
+            "requests_enqueued": int(t.get("requests_enqueued", 0)),
+            "requests_completed": int(t.get("requests_completed", 0)),
+            "decode_tokens": int(t.get("decode_tokens", 0)),
+            "tokens_per_s": round(float(t.get("tokens_per_s", 0.0)), 3),
+            "queue_depth_last": int(t.get("queue_depth_last", 0)),
+            "queue_depth_max": int(t.get("queue_depth_max", 0)),
+            # percentiles re-ranked over the raw per-request populations
+            # across all replicas (never percentiles of percentiles)
+            "ttft_p50_ms": round(float(t.get("ttft_p50_ms", 0.0)), 3),
+            "ttft_p95_ms": round(float(t.get("ttft_p95_ms", 0.0)), 3),
+            "ttft_p99_ms": round(float(t.get("ttft_p99_ms", 0.0)), 3),
+            "e2e_p50_ms": round(float(t.get("e2e_p50_ms", 0.0)), 3),
+            "e2e_p95_ms": round(float(t.get("e2e_p95_ms", 0.0)), 3),
+            "e2e_p99_ms": round(float(t.get("e2e_p99_ms", 0.0)), 3),
+            "prefill_ms": round(float(t.get("prefill_ms", 0.0)), 3),
+            "decode_ms": round(float(t.get("decode_ms", 0.0)), 3),
+            "decode_share": round(float(t.get("decode_share", 0.0)), 4),
+            "kv_headroom_min": round(kvh, 4) if kvh >= 0.0 else None,
+            "per_replica": per_replica,
+            "series_windows": window.steps[-tail:],
+            "queue_depth_series": [
+                int(v) for v in window.per_step["queue_depth"][-tail:]
+            ],
+            "tokens_per_s_series": [
+                round(float(v), 3)
+                for v in window.per_step["tokens_per_s"][-tail:]
+            ],
+        }
+    return section, result
+
+
 def _build_system_section(store):
     host, devices = store.system_rows()
     if not host and not devices:
@@ -711,10 +780,44 @@ def _process_card(sec: Dict[str, Any]) -> str:
     return "\n".join(out)
 
 
+def _serving_card(sec: Dict[str, Any]) -> str:
+    g = sec.get("global") or {}
+    if not g:
+        return ""
+    out = [
+        f"{g.get('requests_completed', 0)} request(s) completed over "
+        f"{g.get('n_windows', 0)} window(s)  "
+        f"({g.get('tokens_per_s', 0.0):.1f} tokens/s pooled, "
+        f"queue depth {g.get('queue_depth_last', 0)} at close)",
+        f"TTFT p50/p95/p99: {fmt_ms(g.get('ttft_p50_ms'))} / "
+        f"{fmt_ms(g.get('ttft_p95_ms'))} / {fmt_ms(g.get('ttft_p99_ms'))}   "
+        f"e2e p99: {fmt_ms(g.get('e2e_p99_ms'))}",
+        f"prefill {fmt_ms(g.get('prefill_ms'))} vs decode "
+        f"{fmt_ms(g.get('decode_ms'))} "
+        f"({fmt_pct(g.get('decode_share'))} decode)",
+    ]
+    kvh = g.get("kv_headroom_min")
+    if kvh is not None:
+        out.append(f"min KV-cache HBM headroom: {fmt_pct(kvh)}")
+    for rank, info in sorted(
+        (g.get("per_replica") or {}).items(), key=lambda kv: int(kv[0])
+    ):
+        line = (
+            f"replica {rank}: {info.get('tokens_per_s', 0.0):.1f} tokens/s  "
+            f"{info.get('requests_completed', 0)} done  "
+            f"ttft p99 {fmt_ms(info.get('ttft_p99_ms'))}"
+        )
+        if info.get("kv_headroom") is not None:
+            line += f"  kv headroom {fmt_pct(info['kv_headroom'])}"
+        out.append(line)
+    return "\n".join(out)
+
+
 _CARD_BUILDERS = {
     "step_time": _step_time_card,
     "step_memory": _step_memory_card,
     "collectives": _collectives_card,
+    "serving": _serving_card,
     "system": _system_card,
     "process": _process_card,
 }
@@ -837,6 +940,7 @@ def render_text_summary(payload: Dict[str, Any]) -> str:
     # detail is the richer inline layout above)
     for key, title in (
         ("collectives", "Collectives (compute/comm overlap)"),
+        ("serving", "Serving (inference replicas)"),
         ("system", "System"),
         ("process", "Processes"),
     ):
@@ -848,8 +952,8 @@ def render_text_summary(payload: Dict[str, Any]) -> str:
             out.append("")
 
     for key in (
-        "liveness", "system", "process", "collectives", "step_memory",
-        "step_time",
+        "liveness", "system", "process", "serving", "collectives",
+        "step_memory", "step_time",
     ):
         sec = (payload.get("sections") or {}).get(key) or {}
         diag = sec.get("diagnosis") or {}
@@ -1019,6 +1123,11 @@ def generate_summary(
         results["liveness"] = result
         return section
 
+    def run_serving():
+        section, result = _build_serving_section(store, mode, topology=mesh)
+        results["serving"] = result
+        return section
+
     sections = {
         "system": _safe_section("system", run_system),
         "process": _safe_section("process", run_process),
@@ -1027,6 +1136,11 @@ def generate_summary(
         "collectives": _safe_section("collectives", run_collectives),
         "liveness": _safe_section("liveness", run_liveness),
     }
+    # sessions that never recorded a serving event get NO serving key at
+    # all (not a NO_DATA stub): the summary must stay byte-identical to
+    # the pre-serving-domain artifact for training-only runs
+    if store.has_serving_rows():
+        sections["serving"] = _safe_section("serving", run_serving)
     try:
         topology = store.topology()
     except Exception:
@@ -1040,6 +1154,7 @@ def generate_summary(
         step_time_error=sections["step_time"].get("error"),
         collectives=results.get("collectives"),
         liveness=results.get("liveness"),
+        serving=results.get("serving"),
     )
     meta: Dict[str, Any] = {
         "session_id": getattr(settings, "session_id", "unknown"),

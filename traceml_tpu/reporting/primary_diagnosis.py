@@ -28,6 +28,7 @@ def build_primary_diagnosis(
     step_time_error: Optional[str] = None,
     collectives: Optional[DiagnosticResult] = None,
     liveness: Optional[DiagnosticResult] = None,
+    serving: Optional[DiagnosticResult] = None,
 ) -> Dict[str, Any]:
     candidates = []
     if liveness is not None and not liveness.healthy:
@@ -57,6 +58,15 @@ def build_primary_diagnosis(
         issue = collectives.diagnosis
         candidates.append(
             (_SEV_ORDER.get(issue.severity, 0) + 0.5, "collectives", issue)
+        )
+    if serving is not None and not serving.healthy:
+        # serving sits at collectives priority: a saturated queue or a
+        # pressured KV cache IS the workload's performance story, but a
+        # step-time verdict (mixed training+serving sessions) still
+        # names where the time is actually spent
+        issue = serving.diagnosis
+        candidates.append(
+            (_SEV_ORDER.get(issue.severity, 0) + 0.5, "serving", issue)
         )
     for domain, result in (
         ("step_memory", step_memory),

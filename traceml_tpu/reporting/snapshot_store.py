@@ -58,10 +58,14 @@ from traceml_tpu.utils.columnar import (
     CollectivesWindow,
     ColumnarFallback,
     MemoryColumns,
+    RaggedEventColumns,
+    ServingWindow,
     StepTimeColumns,
     build_collectives_window_rows,
     build_columnar_collectives_window,
+    build_columnar_serving_window,
     build_columnar_step_time_window,
+    build_serving_window_rows,
     columnar_window_enabled,
 )
 from traceml_tpu.utils.error_log import get_error_log
@@ -82,6 +86,7 @@ DOMAINS = (
     "step_time",
     "step_memory",
     "collectives",
+    "serving",
     "system",
     "process",
     "stdout",
@@ -239,6 +244,32 @@ class _CollectivesBuffer(_RankBuffer):
         return changed
 
 
+class _ServingBuffer(_RankBuffer):
+    """Row deque + ragged serving ring in lockstep (same contract as
+    :class:`_StepTimeBuffer`; the CSR value buffers evict with the
+    ring's head — see ``utils/columnar.RaggedEventColumns``)."""
+
+    __slots__ = ("cols",)
+
+    def __init__(self, maxlen: int) -> None:
+        super().__init__(maxlen)
+        self.cols = RaggedEventColumns(maxlen)
+
+    def append(self, row_id: int, rank: Optional[int], row: Any) -> None:
+        super().append(row_id, rank, row)
+        self.cols.append(row)
+
+    def clear(self) -> bool:
+        had = super().clear()
+        self.cols.clear()
+        return had
+
+    def evict_below(self, min_id: int) -> bool:
+        changed = super().evict_below(min_id)
+        self.cols.evict_head(len(self.cols) - len(self.ids))
+        return changed
+
+
 class _TopologySource:
     """Accumulated identity sets for one projection table."""
 
@@ -281,6 +312,7 @@ class LiveSnapshotStore:
         window_steps: int = 120,
         memory_rows_per_rank: Optional[int] = None,
         collectives_rows_per_rank: Optional[int] = None,
+        serving_rows_per_rank: Optional[int] = None,
         system_rows: int = 300,
         process_rows: int = 300,
         stdout_rows: int = 64,
@@ -299,6 +331,13 @@ class LiveSnapshotStore:
             collectives_rows_per_rank
             if collectives_rows_per_rank is not None
             else window_steps * 8
+        )
+        # one aggregate row per sampler window per replica — the window
+        # index is the alignment key, so window_steps bounds it directly
+        self.serving_rows_per_rank = int(
+            serving_rows_per_rank
+            if serving_rows_per_rank is not None
+            else window_steps
         )
         self.max_system_rows = int(system_rows)
         self.max_process_rows = int(process_rows)
@@ -324,6 +363,7 @@ class LiveSnapshotStore:
         self._step_time: Dict[int, _StepTimeBuffer] = {}
         self._step_memory: Dict[int, _MemoryBuffer] = {}
         self._collectives: Dict[int, _CollectivesBuffer] = {}
+        self._serving: Dict[int, _ServingBuffer] = {}
         # system / process: globally-bounded (loader semantics), keyed rows
         self._system_host = _RankBuffer(self.max_system_rows)
         self._system_dev = _RankBuffer(self.max_system_rows)
@@ -438,6 +478,7 @@ class LiveSnapshotStore:
                 ("step_time_samples", self._read_step_time, "step_time"),
                 ("step_memory_samples", self._read_step_memory, "step_memory"),
                 ("collectives_samples", self._read_collectives, "collectives"),
+                ("serving_samples", self._read_serving, "serving"),
                 ("system_samples", self._read_system_host, "system"),
                 ("system_device_samples", self._read_system_dev, "system"),
                 ("process_samples", self._read_process, "process"),
@@ -688,6 +729,35 @@ class LiveSnapshotStore:
         )
         return bool(rows) or evicted
 
+    def _read_serving(self, conn, table, dirty) -> bool:
+        trimmed = self._begin_trim_check(conn, table)
+        cur = self._cursors.get(table, 0)
+        rows = conn.execute(
+            "SELECT id, global_rank, step, timestamp, requests_enqueued,"
+            " requests_completed, requests_active, queue_depth, decode_tokens,"
+            " prefill_ms, decode_ms, tokens_per_s, batch_occupancy,"
+            " ttft_p50_ms, ttft_p95_ms, ttft_p99_ms, e2e_p50_ms, e2e_p95_ms,"
+            " e2e_p99_ms, kv_bytes, kv_limit_bytes, kv_headroom,"
+            " ttft_ms_list, e2e_ms_list, tokens_list"
+            f" FROM {table} WHERE id > ? ORDER BY global_rank, step, id",
+            (cur,),
+        ).fetchall()
+        for r in rows:
+            rank = int(r["global_rank"])
+            buf = self._serving.get(rank)
+            if buf is None:
+                buf = self._serving[rank] = _ServingBuffer(
+                    self.serving_rows_per_rank
+                )
+            row = dict(r)
+            del row["id"], row["global_rank"]
+            buf.append(r["id"], rank, row)
+        self._advance_cursor(table, rows)
+        evicted = self._apply_trims(
+            conn, table, trimmed, rank_bufs=self._serving
+        )
+        return bool(rows) or evicted
+
     def _read_step_memory(self, conn, table, dirty) -> bool:
         trimmed = self._begin_trim_check(conn, table)
         cur = self._cursors.get(table, 0)
@@ -931,6 +1001,57 @@ class LiveSnapshotStore:
                 if buf.rows
             }
         return build_collectives_window_rows(rank_rows, max_steps=limit)
+
+    def serving_rows(self) -> Dict[int, List[Dict[str, Any]]]:
+        """global_rank → decoded per-window serving aggregate rows."""
+        with self._lock:
+            return {
+                rank: list(buf.rows)
+                for rank, buf in sorted(self._serving.items())
+                if buf.rows
+            }
+
+    def has_serving_rows(self) -> bool:
+        with self._lock:
+            return any(buf.rows for buf in self._serving.values())
+
+    def latest_serving_ts(self) -> Optional[float]:
+        with self._lock:
+            vals = [
+                buf.rows[-1].get("timestamp") or 0.0
+                for buf in self._serving.values()
+                if buf.rows
+            ]
+        return max(vals) if vals else None
+
+    def build_serving_window(
+        self, max_steps: Optional[int] = None
+    ) -> Optional[ServingWindow]:
+        """Cross-replica serving window (TTFT/e2e percentiles over the
+        raw ragged populations).  Columnar fast path over the per-replica
+        ragged rings; scalar reference fold over the row deques when a
+        buffer is flagged or the columnar engine is disabled.  Both
+        paths are golden-pinned bit-identical
+        (tests/utils/test_serving_window.py).
+        """
+        limit = self.window_steps if max_steps is None else int(max_steps)
+        with self._lock:
+            if columnar_window_enabled():
+                try:
+                    cols = {
+                        rank: buf.cols
+                        for rank, buf in self._serving.items()
+                        if buf.rows
+                    }
+                    return build_columnar_serving_window(cols, limit)
+                except ColumnarFallback:
+                    pass
+            rank_rows = {
+                rank: list(buf.rows)
+                for rank, buf in sorted(self._serving.items())
+                if buf.rows
+            }
+        return build_serving_window_rows(rank_rows, max_steps=limit)
 
     def step_memory_columns(self) -> Optional[Dict[int, MemoryColumns]]:
         """rank → memory ring buffer, or None when any rank's buffer is

@@ -32,6 +32,7 @@ SAMPLER_REGISTRY = Registry("samplers")
 def register_default_samplers() -> None:
     from traceml_tpu.samplers.collectives_sampler import CollectivesSampler
     from traceml_tpu.samplers.process_sampler import ProcessSampler
+    from traceml_tpu.samplers.serving_sampler import ServingSampler
     from traceml_tpu.samplers.step_memory_sampler import StepMemorySampler
     from traceml_tpu.samplers.step_time_sampler import StepTimeSampler
     from traceml_tpu.samplers.system_sampler import SystemSampler
@@ -42,6 +43,7 @@ def register_default_samplers() -> None:
         SamplerSpec("step_time", StepTimeSampler, drain_on_recording_stop=True),
         SamplerSpec("step_memory", StepMemorySampler, drain_on_recording_stop=True),
         SamplerSpec("collectives", CollectivesSampler, drain_on_recording_stop=True),
+        SamplerSpec("serving", ServingSampler, drain_on_recording_stop=True),
     ]
     for spec in defaults:
         if spec.key not in SAMPLER_REGISTRY:
@@ -68,6 +70,12 @@ def build_samplers(
             from traceml_tpu.instrumentation.collectives import collectives_enabled
 
             if not collectives_enabled():
+                continue
+        if key == "serving":
+            # TRACEML_SERVING=0 kill switch, same per-build contract
+            from traceml_tpu.instrumentation.serving import serving_enabled
+
+            if not serving_enabled():
                 continue
         if spec.node_primary_only and not identity.is_node_primary:
             continue

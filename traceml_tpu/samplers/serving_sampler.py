@@ -1,0 +1,219 @@
+"""Serving sampler — per-window inference/serving telemetry.
+
+Drains the global serving queue (fed by the lifecycle recorders in
+instrumentation/serving.py) and folds the raw per-event records into
+ONE aggregate row per sampler window::
+
+    {step, timestamp, requests_enqueued, requests_completed,
+     requests_active, queue_depth, decode_tokens, prefill_ms, decode_ms,
+     tokens_per_s, batch_occupancy, ttft_p50_ms, ttft_p95_ms,
+     ttft_p99_ms, e2e_p50_ms, e2e_p95_ms, e2e_p99_ms,
+     kv_bytes, kv_limit_bytes, kv_headroom,
+     ttft_ms_list, e2e_ms_list, tokens_list}
+
+``step`` is a per-replica window sequence number — serving has no
+training step, but a monotone window index gives the (rank × step)
+columnar cube the same alignment key the training domains use.  The
+``*_list`` columns carry the window's PER-REQUEST values packed as
+``%.3f`` comma strings: percentiles of percentiles are wrong, so the
+window build (utils/columnar.py ``RaggedEventColumns``) re-ranks the
+raw populations across windows and replicas instead of averaging the
+row-level p99s (which exist for ``traceml inspect`` convenience).
+
+Aggregating here bounds the wire at one row per window per replica
+regardless of request fan-out — a thousand requests in a window cost
+the same fixed columns plus ~12 bytes per completed request.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from traceml_tpu.instrumentation.serving import (
+    EV_DECODE,
+    EV_ENQUEUED,
+    EV_FINISHED,
+    EV_PREFILL_END,
+    EV_PREFILL_START,
+    GLOBAL_SERVING_QUEUE,
+    sample_kv_cache,
+)
+from traceml_tpu.samplers.base_sampler import BaseSampler
+
+TABLE = "serving"
+
+#: in-flight table bound — a leaked request (enqueued, never finished)
+#: must not grow state forever; oldest entries are dropped past this
+_MAX_INFLIGHT = 4096
+
+
+def percentile(sorted_vals: List[float], q: float) -> float:
+    """Index-style percentile over an ascending list — the exact formula
+    the window build and the diagnosis rules share (no interpolation, so
+    scalar and columnar paths pick the same element)."""
+    n = len(sorted_vals)
+    if n == 0:
+        return 0.0
+    return float(sorted_vals[min(n - 1, int(n * q))])
+
+
+def pack_floats(vals: List[float]) -> str:
+    """``%.3f`` comma packing — the one formatting both the packer and
+    the ragged-ring parser use, so parse(pack(x)) is bit-stable."""
+    return ",".join(f"{float(v):.3f}" for v in vals)
+
+
+class _Request:
+    __slots__ = ("enq_ts", "prefill_start_ts", "prefill_end_ts", "prompt_tokens", "tokens")
+
+    def __init__(self, enq_ts: float) -> None:
+        self.enq_ts = enq_ts
+        self.prefill_start_ts: Optional[float] = None
+        self.prefill_end_ts: Optional[float] = None
+        self.prompt_tokens = 0
+        self.tokens = 0
+
+
+class ServingAccumulator:
+    """Pure event→row fold (unit-testable and bench-drivable without a
+    runtime): ``feed()`` events in arrival order, then ``window_row()``
+    closes the current window and returns its aggregate row (or None
+    when the replica has never seen a serving event)."""
+
+    def __init__(self, now: Optional[float] = None) -> None:
+        self._inflight: Dict[str, _Request] = {}
+        self._window_start = time.time() if now is None else float(now)
+        self._seq = 0
+        self._seen_any = False
+        # per-window accumulators
+        self._enqueued = 0
+        self._decode_tokens = 0
+        self._ttft_ms: List[float] = []
+        self._e2e_ms: List[float] = []
+        self._req_tokens: List[int] = []
+        self._prefill_ms = 0.0
+        self._decode_ms = 0.0
+
+    def feed(self, events: List[Dict[str, Any]]) -> None:
+        for ev in events:
+            try:
+                kind = ev["ev"]
+                req = str(ev["req"])
+                ts = float(ev["ts"])
+            except (KeyError, TypeError, ValueError):
+                continue
+            self._seen_any = True
+            if kind == EV_ENQUEUED:
+                self._enqueued += 1
+                if len(self._inflight) >= _MAX_INFLIGHT:
+                    oldest = next(iter(self._inflight))
+                    del self._inflight[oldest]
+                self._inflight[req] = _Request(ts)
+                continue
+            r = self._inflight.get(req)
+            if r is None:
+                continue  # lifecycle event for an unknown/evicted request
+            if kind == EV_PREFILL_START:
+                r.prefill_start_ts = ts
+                r.prompt_tokens = int(ev.get("tokens", 0) or 0)
+            elif kind == EV_PREFILL_END:
+                r.prefill_end_ts = ts
+            elif kind == EV_DECODE:
+                n = int(ev.get("tokens", 0) or 0)
+                r.tokens += n
+                self._decode_tokens += n
+            elif kind == EV_FINISHED:
+                self._finish(req, r, ts)
+
+    def _finish(self, req: str, r: _Request, ts: float) -> None:
+        del self._inflight[req]
+        pe = r.prefill_end_ts if r.prefill_end_ts is not None else ts
+        ps = r.prefill_start_ts if r.prefill_start_ts is not None else r.enq_ts
+        self._ttft_ms.append(max(0.0, (pe - r.enq_ts) * 1000.0))
+        self._e2e_ms.append(max(0.0, (ts - r.enq_ts) * 1000.0))
+        self._req_tokens.append(r.tokens)
+        self._prefill_ms += max(0.0, (pe - ps) * 1000.0)
+        self._decode_ms += max(0.0, (ts - pe) * 1000.0)
+
+    @property
+    def seen_any(self) -> bool:
+        return self._seen_any
+
+    def window_row(
+        self, now: Optional[float] = None, kv: Optional[Dict[str, Any]] = None
+    ) -> Optional[Dict[str, Any]]:
+        """Close the window at ``now``; returns the aggregate row, or
+        None when no serving event was ever observed (a pure-training
+        session emits NOTHING — the byte-identity contract)."""
+        if not self._seen_any:
+            return None
+        now = time.time() if now is None else float(now)
+        dt_s = max(1e-9, now - self._window_start)
+        ttft = sorted(self._ttft_ms)
+        e2e = sorted(self._e2e_ms)
+        queue_depth = sum(
+            1 for r in self._inflight.values() if r.prefill_start_ts is None
+        )
+        kv = kv or {}
+        row = {
+            "step": self._seq,
+            "timestamp": now,
+            "requests_enqueued": self._enqueued,
+            "requests_completed": len(self._ttft_ms),
+            "requests_active": len(self._inflight),
+            "queue_depth": queue_depth,
+            "decode_tokens": self._decode_tokens,
+            "prefill_ms": round(self._prefill_ms, 3),
+            "decode_ms": round(self._decode_ms, 3),
+            "tokens_per_s": round(self._decode_tokens / dt_s, 3),
+            "batch_occupancy": round(self._decode_ms / (dt_s * 1000.0), 4),
+            "ttft_p50_ms": round(percentile(ttft, 0.50), 3),
+            "ttft_p95_ms": round(percentile(ttft, 0.95), 3),
+            "ttft_p99_ms": round(percentile(ttft, 0.99), 3),
+            "e2e_p50_ms": round(percentile(e2e, 0.50), 3),
+            "e2e_p95_ms": round(percentile(e2e, 0.95), 3),
+            "e2e_p99_ms": round(percentile(e2e, 0.99), 3),
+            "kv_bytes": int(kv.get("kv_bytes", -1) if kv else -1),
+            "kv_limit_bytes": int(kv.get("kv_limit_bytes", -1) if kv else -1),
+            "kv_headroom": round(float(kv.get("kv_headroom", -1.0)), 4)
+            if kv
+            else -1.0,
+            "ttft_ms_list": pack_floats(self._ttft_ms),
+            "e2e_ms_list": pack_floats(self._e2e_ms),
+            "tokens_list": ",".join(str(int(t)) for t in self._req_tokens),
+        }
+        # roll the window (in-flight requests carry over)
+        self._seq += 1
+        self._window_start = now
+        self._enqueued = 0
+        self._decode_tokens = 0
+        self._ttft_ms = []
+        self._e2e_ms = []
+        self._req_tokens = []
+        self._prefill_ms = 0.0
+        self._decode_ms = 0.0
+        return row
+
+
+class ServingSampler(BaseSampler):
+    name = "serving"
+
+    def __init__(self, *args: Any, **kw: Any):
+        super().__init__(*args, **kw)
+        self.acc = ServingAccumulator()
+        self.rows_emitted = 0
+
+    def _sample(self) -> None:
+        events = GLOBAL_SERVING_QUEUE.drain()
+        if events:
+            self.acc.feed(events)
+        row = self.acc.window_row(kv=sample_kv_cache())
+        if row is None:
+            return
+        self.db.add_record(TABLE, row)
+        self.rows_emitted += 1
+
+    def drain(self) -> None:
+        """End-of-run: fold whatever is still queued into a final row."""
+        self._sample()

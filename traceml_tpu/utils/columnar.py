@@ -1150,3 +1150,530 @@ def collectives_window_to_plain(
         "per_rank": {r: dict(v) for r, v in sorted(w.per_rank.items())},
         "totals": dict(w.totals),
     }
+
+
+# ---------------------------------------------------------------------------
+# Serving domain (round 16): ragged per-request populations → per-window
+# TTFT / e2e percentile window.  Requests are variable-length where steps
+# were regular, so the ring grows a CSR companion: per-row (offset, len)
+# into shared value buffers.
+# ---------------------------------------------------------------------------
+
+# int column layout
+(
+    SV_STEP,
+    SV_ENQ,
+    SV_DONE,
+    SV_ACTIVE,
+    SV_QDEPTH,
+    SV_DTOK,
+    SV_KVB,
+    SV_KVL,
+) = range(8)
+_SV_COUNT_FIELDS = (
+    (SV_ENQ, "requests_enqueued"),
+    (SV_DONE, "requests_completed"),
+    (SV_ACTIVE, "requests_active"),
+    (SV_QDEPTH, "queue_depth"),
+    (SV_DTOK, "decode_tokens"),
+)
+# float column layout
+SF_PREFILL, SF_DECODE, SF_TPS, SF_OCC, SF_KVH = range(5)
+# ragged column layout (CSR offset/len per row into one buffer each)
+RG_TTFT, RG_E2E, RG_TOK = range(3)
+_RG_FIELDS = ((RG_TTFT, "ttft_ms_list"), (RG_E2E, "e2e_ms_list"), (RG_TOK, "tokens_list"))
+
+_EMPTY_F64 = np.empty(0, dtype=np.float64)
+
+
+def parse_float_list(s: Optional[str]) -> List[float]:
+    """Parse a ``%.3f`` comma-packed population (the serving sampler's
+    ``pack_floats`` format).  THE one parser both the scalar reference
+    fold and :class:`RaggedEventColumns` use, so parse(pack(x)) yields
+    bit-identical floats on both paths.  Raises on malformed tokens —
+    the ring turns that into :class:`ColumnarFallback`, the scalar fold
+    treats the row's population as empty."""
+    if not s:
+        return []
+    return [float(tok) for tok in s.split(",")]
+
+
+def _parse_float_list_safe(s: Optional[str]) -> List[float]:
+    try:
+        return parse_float_list(s)
+    except (TypeError, ValueError):
+        return []
+
+
+def _population_percentile(sorted_vals, q: float) -> float:
+    """Index-style percentile (no interpolation) over an ascending
+    sequence — same element selection for a Python list and an ndarray,
+    and the same formula samplers/serving_sampler.percentile uses."""
+    n = len(sorted_vals)
+    if n == 0:
+        return 0.0
+    return float(sorted_vals[min(n - 1, int(n * q))])
+
+
+class _RaggedBuffer:
+    """Growable value store behind one CSR column.
+
+    Rows address their values by *virtual* offset — a monotone counter
+    over everything ever appended — so row eviction is free (the head
+    values just go dead) and compaction only rebases ``_virt0``, never
+    touches the offsets stored in the ring.  Because rows append values
+    contiguously and are only evicted from the head, any suffix of live
+    rows maps to ONE contiguous physical slice (zero-copy reads)."""
+
+    __slots__ = ("_vals", "_virt0", "_virt_end")
+
+    def __init__(self, cap_hint: int) -> None:
+        self._vals = np.empty(max(16, int(cap_hint)), dtype=np.float64)
+        self._virt0 = 0  # virtual offset of physical index 0
+        self._virt_end = 0  # next virtual offset
+
+    def append(self, vals: List[float], live_min_virt: int) -> int:
+        """Store ``vals``; returns their virtual offset.  ``live_min_virt``
+        is the oldest live row's offset — everything before it is dead
+        and reclaimable when the buffer needs room."""
+        n = len(vals)
+        end_phys = self._virt_end - self._virt0
+        if end_phys + n > self._vals.shape[0]:
+            live_phys = live_min_virt - self._virt0
+            if live_phys > 0:  # memmove live span to the front, rebase
+                live_n = end_phys - live_phys
+                self._vals[:live_n] = self._vals[live_phys:end_phys]
+                self._virt0 = live_min_virt
+                end_phys = live_n
+            if end_phys + n > self._vals.shape[0]:
+                grown = np.empty(
+                    max(2 * self._vals.shape[0], end_phys + n), dtype=np.float64
+                )
+                grown[:end_phys] = self._vals[:end_phys]
+                self._vals = grown
+        off = self._virt_end
+        if n:
+            self._vals[end_phys : end_phys + n] = vals
+        self._virt_end += n
+        return off
+
+    def view_span(self, virt_a: int, virt_b: int) -> np.ndarray:
+        return self._vals[virt_a - self._virt0 : virt_b - self._virt0]
+
+    @property
+    def virt_end(self) -> int:
+        return self._virt_end
+
+
+class RaggedEventColumns(_CompactRing):
+    """Per-replica serving columns mirroring the store's row deque.
+
+    Scalar columns ride the usual 2x-cap compacted arrays; the ragged
+    per-request populations (TTFT ms, e2e ms, tokens) live in CSR form —
+    per-row (virtual offset, length) pairs in ``_ragged`` pointing into
+    three :class:`_RaggedBuffer` value stores.  Row eviction (ring full
+    or retention trim) keeps the two in lockstep for free: offsets are
+    virtual, so dead head values are reclaimed lazily on the buffers'
+    next compaction.  Appends the vectorized build cannot reproduce
+    exactly — bool/duplicate/out-of-order window seq, non-int counts,
+    counts outside [0, 2**53), negative phase times, malformed packed
+    lists, or a population length disagreeing with
+    ``requests_completed`` — set sticky ``columnar_ok = False``."""
+
+    __slots__ = (
+        "_ints",
+        "_floats",
+        "_ragged",
+        "_bufs",
+        "_last_step",
+        "columnar_ok",
+    )
+
+    def __init__(self, cap: int) -> None:
+        super().__init__(cap)
+        n = 2 * self.cap
+        self._ints = np.empty((n, 8), dtype=np.int64)
+        self._floats = np.empty((n, 5), dtype=np.float64)
+        self._ragged = np.empty((n, 3, 2), dtype=np.int64)  # (row, col, {off, len})
+        # value capacity hint: ~8 completed requests per window row
+        self._bufs = tuple(_RaggedBuffer(8 * self.cap) for _ in range(3))
+        self._last_step: Optional[int] = None
+        self.columnar_ok = True
+
+    def _arrays(self):
+        return (self._ints, self._floats, self._ragged)
+
+    def clear(self) -> None:
+        self._reset()
+        self._last_step = None
+        self.columnar_ok = True
+        # value buffers rebase lazily; virtual offsets of cleared rows
+        # are simply never read again
+
+    def _live_min_virt(self, col: int, newest: int) -> int:
+        """Oldest live row's virtual offset for ``col`` (the compaction
+        floor), excluding the not-yet-filled slot ``newest``."""
+        if self._start < newest:
+            return int(self._ragged[self._start, col, 0])
+        return self._bufs[col].virt_end
+
+    def append(self, row: Mapping[str, Any]) -> None:
+        # always consume a slot (ring stays 1:1 with the row deque)
+        i = self._next_slot()
+        if not self.columnar_ok:
+            return
+        try:
+            if isinstance(row["step"], bool):
+                raise ColumnarFallback("bool step")
+            step = int(row["step"])
+            if self._last_step is not None and step <= self._last_step:
+                raise ColumnarFallback("duplicate or out-of-order window seq")
+            ints = self._ints[i]
+            for c, key in _SV_COUNT_FIELDS:
+                v = row.get(key, 0)
+                if v is None:
+                    v = 0
+                if not isinstance(v, int) or isinstance(v, bool):
+                    raise ColumnarFallback(key)
+                if v < 0 or v >= _MAX_EXACT_INT:
+                    raise ColumnarFallback(key)
+                ints[c] = v
+            for c, key in ((SV_KVB, "kv_bytes"), (SV_KVL, "kv_limit_bytes")):
+                v = row.get(key, -1)
+                if v is None:
+                    v = -1
+                if not isinstance(v, int) or isinstance(v, bool):
+                    raise ColumnarFallback(key)
+                if v < -1 or v >= _MAX_EXACT_INT:
+                    raise ColumnarFallback(key)
+                ints[c] = v
+            flts = self._floats[i]
+            pre = float(row.get("prefill_ms", 0.0) or 0.0)
+            dec = float(row.get("decode_ms", 0.0) or 0.0)
+            if pre < 0.0 or dec < 0.0:
+                raise ColumnarFallback("negative phase time")
+            flts[SF_PREFILL] = pre
+            flts[SF_DECODE] = dec
+            flts[SF_TPS] = float(row.get("tokens_per_s", 0.0) or 0.0)
+            flts[SF_OCC] = float(row.get("batch_occupancy", 0.0) or 0.0)
+            kvh = row.get("kv_headroom")
+            flts[SF_KVH] = float(kvh) if kvh is not None else -1.0
+            done = int(ints[SV_DONE])
+            for c, key in _RG_FIELDS:
+                vals = parse_float_list(row.get(key))  # raises → fallback
+                if len(vals) != done:
+                    raise ColumnarFallback(f"{key} length != requests_completed")
+                off = self._bufs[c].append(vals, self._live_min_virt(c, i))
+                self._ragged[i, c, 0] = off
+                self._ragged[i, c, 1] = len(vals)
+            ints[SV_STEP] = step
+            self._last_step = step
+        except Exception:
+            self.columnar_ok = False
+
+    # live views — valid until the next append/evict/clear
+    def steps_view(self) -> np.ndarray:
+        return self._ints[self._start : self._end, SV_STEP]
+
+    def ints_view(self) -> np.ndarray:
+        return self._ints[self._start : self._end]
+
+    def floats_view(self) -> np.ndarray:
+        return self._floats[self._start : self._end]
+
+    def ragged_suffix(self, col: int, k: int) -> np.ndarray:
+        """Concatenated population of live rows ``k..`` for ragged
+        column ``col``.  Window seqs are strictly increasing, so every
+        window tail is a row suffix — and a row suffix is ONE contiguous
+        physical slice (values were appended in row order and only the
+        head is ever evicted)."""
+        n = len(self)
+        if n == 0 or k >= n:
+            return _EMPTY_F64
+        i0 = self._start + k
+        i1 = self._end - 1
+        a = int(self._ragged[i0, col, 0])
+        b = int(self._ragged[i1, col, 0] + self._ragged[i1, col, 1])
+        return self._bufs[col].view_span(a, b)
+
+
+@dataclasses.dataclass
+class ServingWindow:
+    """Cross-replica serving aggregate over the last ``n_steps`` window
+    seqs.  Steps are the UNION of the replicas' window seqs; ``per_step``
+    series align to ``steps``.  Latency percentiles re-rank the
+    concatenated RAW per-request populations — never percentiles of the
+    row-level percentiles."""
+
+    steps: List[int]
+    n_steps: int
+    ranks: List[int]
+    per_step: Dict[str, List[float]]
+    per_rank: Dict[int, Dict[str, float]]
+    totals: Dict[str, float]
+
+
+def _serving_totals(
+    enq, done, dtok, qd, pre, dec, per_rank, kv_min, ttft_sorted, e2e_sorted
+) -> Dict[str, float]:
+    """Shared totals assembly: every fold here is over per-step series
+    or already-identical per-rank values, so the scalar and columnar
+    builds compute bit-identical totals by construction."""
+    total_pre = 0.0
+    total_dec = 0.0
+    for v in pre:
+        total_pre += v
+    for v in dec:
+        total_dec += v
+    phase = total_pre + total_dec
+    tps = 0.0
+    for r in per_rank:
+        tps += per_rank[r]["tokens_per_s"]
+    return {
+        "requests_enqueued": sum(enq),
+        "requests_completed": sum(done),
+        "decode_tokens": sum(dtok),
+        "queue_depth_last": sum(per_rank[r]["queue_depth"] for r in per_rank),
+        "queue_depth_max": max(qd) if qd else 0,
+        "prefill_ms": total_pre,
+        "decode_ms": total_dec,
+        "decode_share": (total_dec / phase) if phase > 0.0 else 0.0,
+        "tokens_per_s": tps,
+        "kv_headroom_min": kv_min,
+        "ttft_p50_ms": _population_percentile(ttft_sorted, 0.50),
+        "ttft_p95_ms": _population_percentile(ttft_sorted, 0.95),
+        "ttft_p99_ms": _population_percentile(ttft_sorted, 0.99),
+        "e2e_p50_ms": _population_percentile(e2e_sorted, 0.50),
+        "e2e_p95_ms": _population_percentile(e2e_sorted, 0.95),
+        "e2e_p99_ms": _population_percentile(e2e_sorted, 0.99),
+    }
+
+
+def build_serving_window_rows(
+    rank_rows: Mapping[int, Any],
+    max_steps: int,
+) -> Optional[ServingWindow]:
+    """Scalar reference fold over serving row dicts — the golden path
+    the columnar build below must reproduce bit-identically.  Ranks in
+    sorted order, rows in arrival order; malformed packed lists count
+    as empty populations (the columnar ring would have flagged them)."""
+    items = [(r, list(rows)) for r, rows in sorted(rank_rows.items()) if rows]
+    if not items:
+        return None
+    all_steps = sorted({int(row["step"]) for _, rows in items for row in rows})
+    steps = all_steps[-max_steps:]
+    lo = steps[0]
+    idx = {s: i for i, s in enumerate(steps)}
+    S = len(steps)
+
+    enq = [0] * S
+    done = [0] * S
+    qd = [0] * S
+    dtok = [0] * S
+    tps = [0.0] * S
+    pre = [0.0] * S
+    dec = [0.0] * S
+    ttft_all: List[float] = []
+    e2e_all: List[float] = []
+    per_rank: Dict[int, Dict[str, float]] = {}
+    kv_min = -1.0
+    for rank, rows in items:
+        r_done = 0
+        r_tok = 0
+        r_tps = 0.0
+        r_rows = 0
+        r_ttft: List[float] = []
+        r_qd = 0
+        r_active = 0
+        r_kvh = -1.0
+        for row in rows:
+            s = int(row["step"])
+            if s < lo:
+                continue
+            i = idx[s]
+            e = int(row.get("requests_enqueued", 0) or 0)
+            d = int(row.get("requests_completed", 0) or 0)
+            q = int(row.get("queue_depth", 0) or 0)
+            t = int(row.get("decode_tokens", 0) or 0)
+            v_tps = float(row.get("tokens_per_s", 0.0) or 0.0)
+            enq[i] += e
+            done[i] += d
+            qd[i] += q
+            dtok[i] += t
+            tps[i] += v_tps
+            pre[i] += float(row.get("prefill_ms", 0.0) or 0.0)
+            dec[i] += float(row.get("decode_ms", 0.0) or 0.0)
+            t_vals = _parse_float_list_safe(row.get("ttft_ms_list"))
+            e_vals = _parse_float_list_safe(row.get("e2e_ms_list"))
+            ttft_all.extend(t_vals)
+            e2e_all.extend(e_vals)
+            r_ttft.extend(t_vals)
+            r_done += d
+            r_tok += t
+            r_tps += v_tps
+            r_rows += 1
+            r_qd = q
+            r_active = int(row.get("requests_active", 0) or 0)
+            kvh = row.get("kv_headroom")
+            kvh = float(kvh) if kvh is not None else -1.0
+            if kvh >= 0.0:
+                r_kvh = kvh
+                kv_min = kvh if kv_min < 0.0 else min(kv_min, kvh)
+        r_ttft.sort()
+        per_rank[rank] = {
+            "requests_completed": r_done,
+            "requests_active": r_active,
+            "decode_tokens": r_tok,
+            "tokens_per_s": (r_tps / r_rows) if r_rows else 0.0,
+            "queue_depth": r_qd,
+            "ttft_p99_ms": _population_percentile(r_ttft, 0.99),
+            "kv_headroom": r_kvh,
+        }
+
+    ttft_all.sort()
+    e2e_all.sort()
+    return ServingWindow(
+        steps=steps,
+        n_steps=S,
+        ranks=[r for r, _ in items],
+        per_step={
+            "requests_enqueued": enq,
+            "requests_completed": done,
+            "queue_depth": qd,
+            "decode_tokens": dtok,
+            "tokens_per_s": tps,
+            "prefill_ms": pre,
+            "decode_ms": dec,
+        },
+        per_rank=per_rank,
+        totals=_serving_totals(
+            enq, done, dtok, qd, pre, dec, per_rank, kv_min, ttft_all, e2e_all
+        ),
+    )
+
+
+def build_columnar_serving_window(
+    rank_cols: Mapping[int, RaggedEventColumns],
+    max_steps: int,
+) -> Optional[ServingWindow]:
+    """Vectorized ``build_serving_window_rows`` over per-replica ragged
+    columns.  Per-slot accumulation uses ``np.add.at`` in sorted-rank
+    order (the scalar traversal); window seqs are strictly increasing
+    per replica, so the ``>= lo`` tail is a row suffix and each ragged
+    population is ONE contiguous slice.  Raises :class:`ColumnarFallback`
+    if any non-empty replica buffer is flagged."""
+    items = [
+        (r, c) for r, c in sorted(rank_cols.items(), key=lambda kv: kv[0]) if len(c)
+    ]
+    if not items:
+        return None
+    for _, c in items:
+        if not c.columnar_ok:
+            raise ColumnarFallback("flagged replica buffer")
+
+    uniq = np.unique(np.concatenate([c.steps_view() for _, c in items]))
+    common = uniq[-max_steps:]
+    S = int(common.size)
+    lo = int(common[0])
+
+    enq = np.zeros(S, dtype=np.int64)
+    done = np.zeros(S, dtype=np.int64)
+    qd = np.zeros(S, dtype=np.int64)
+    dtok = np.zeros(S, dtype=np.int64)
+    tps = np.zeros(S, dtype=np.float64)
+    pre = np.zeros(S, dtype=np.float64)
+    dec = np.zeros(S, dtype=np.float64)
+    ttft_parts: List[np.ndarray] = []
+    e2e_parts: List[np.ndarray] = []
+    per_rank: Dict[int, Dict[str, float]] = {}
+    kv_min = -1.0
+    for rank, c in items:
+        steps = c.steps_view()
+        k = int(np.searchsorted(steps, lo, side="left"))
+        slots = np.searchsorted(common, steps[k:])
+        ints = c.ints_view()[k:]
+        flts = c.floats_view()[k:]
+        np.add.at(enq, slots, ints[:, SV_ENQ])
+        np.add.at(done, slots, ints[:, SV_DONE])
+        np.add.at(qd, slots, ints[:, SV_QDEPTH])
+        np.add.at(dtok, slots, ints[:, SV_DTOK])
+        np.add.at(tps, slots, flts[:, SF_TPS])
+        np.add.at(pre, slots, flts[:, SF_PREFILL])
+        np.add.at(dec, slots, flts[:, SF_DECODE])
+        r_ttft = c.ragged_suffix(RG_TTFT, k)
+        ttft_parts.append(r_ttft)
+        e2e_parts.append(c.ragged_suffix(RG_E2E, k))
+        n_rows = int(ints.shape[0])
+        if n_rows:
+            r_done = int(np.cumsum(ints[:, SV_DONE])[-1])
+            r_tok = int(np.cumsum(ints[:, SV_DTOK])[-1])
+            r_tps = float(np.cumsum(flts[:, SF_TPS])[-1]) / n_rows
+            r_qd = int(ints[-1, SV_QDEPTH])
+            r_active = int(ints[-1, SV_ACTIVE])
+        else:
+            r_done = r_tok = r_qd = r_active = 0
+            r_tps = 0.0
+        kvh = flts[:, SF_KVH]
+        kv_ok = kvh >= 0.0
+        r_kvh = -1.0
+        if kv_ok.any():
+            r_kvh = float(kvh[np.flatnonzero(kv_ok)[-1]])
+            m = float(kvh[kv_ok].min())
+            kv_min = m if kv_min < 0.0 else min(kv_min, m)
+        per_rank[rank] = {
+            "requests_completed": r_done,
+            "requests_active": r_active,
+            "decode_tokens": r_tok,
+            "tokens_per_s": r_tps,
+            "queue_depth": r_qd,
+            "ttft_p99_ms": _population_percentile(np.sort(r_ttft), 0.99),
+            "kv_headroom": r_kvh,
+        }
+
+    ttft_sorted = np.sort(np.concatenate(ttft_parts)) if ttft_parts else _EMPTY_F64
+    e2e_sorted = np.sort(np.concatenate(e2e_parts)) if e2e_parts else _EMPTY_F64
+    enq_l = enq.tolist()
+    done_l = done.tolist()
+    qd_l = qd.tolist()
+    dtok_l = dtok.tolist()
+    return ServingWindow(
+        steps=common.tolist(),
+        n_steps=S,
+        ranks=[r for r, _ in items],
+        per_step={
+            "requests_enqueued": enq_l,
+            "requests_completed": done_l,
+            "queue_depth": qd_l,
+            "decode_tokens": dtok_l,
+            "tokens_per_s": tps.tolist(),
+            "prefill_ms": pre.tolist(),
+            "decode_ms": dec.tolist(),
+        },
+        per_rank=per_rank,
+        totals=_serving_totals(
+            enq_l,
+            done_l,
+            dtok_l,
+            qd_l,
+            pre.tolist(),
+            dec.tolist(),
+            per_rank,
+            kv_min,
+            ttft_sorted,
+            e2e_sorted,
+        ),
+    )
+
+
+def serving_window_to_plain(w: Optional[ServingWindow]) -> Optional[Dict[str, Any]]:
+    """Canonical plain-dict form for golden comparisons."""
+    if w is None:
+        return None
+    return {
+        "steps": list(w.steps),
+        "n_steps": w.n_steps,
+        "ranks": list(w.ranks),
+        "per_step": {k: list(v) for k, v in w.per_step.items()},
+        "per_rank": {r: dict(v) for r, v in sorted(w.per_rank.items())},
+        "totals": dict(w.totals),
+    }
