@@ -201,3 +201,74 @@ def test_ready_file_carries_display_port(tmp_path):
         (settings.session_dir / "aggregator_ready.json").read_text()
     )
     assert "display_port" not in ready
+
+
+# -- fleet index cache (federation satellite) ------------------------------
+# N routers polling /api/sessions must not make the shard re-stat and
+# re-build every entry per request: entries rebuild only when their
+# artifact stamp (mtime_ns/size of rank_status / final_summary / db, or
+# an open publisher's token) moves, and the whole index is TTL-gated.
+
+def test_repeated_fleet_index_reuses_cached_entries(tmp_path):
+    _session(tmp_path, "s1")
+    _session(tmp_path, "s2")
+    reg = SessionRegistry(tmp_path, default_session="s1")
+    reg.fleet_index()
+    builds = reg.entry_builds
+    assert builds >= 2
+    for _ in range(5):
+        reg.fleet_index()
+    # artifacts untouched: no entry was rebuilt
+    assert reg.entry_builds == builds
+    reg.close()
+
+
+def test_artifact_write_invalidates_only_that_entry(tmp_path):
+    d1 = _session(tmp_path, "s1").parent
+    _session(tmp_path, "s2")
+    reg = SessionRegistry(tmp_path, default_session="s1")
+    reg.fleet_index()
+    builds = reg.entry_builds
+    (d1 / "rank_status.json").write_text(json.dumps({
+        "ts": 1.0, "world_size": 2,
+        "ranks": {"0": {"state": "ACTIVE"}, "1": {"state": "LOST"}},
+    }))
+    index = reg.fleet_index()
+    # exactly the touched session rebuilt; the index reflects the write
+    assert reg.entry_builds == builds + 1
+    entry = {e["session"]: e for e in index["sessions"]}["s1"]
+    assert entry["ranks"].get("LOST") == 1
+    reg.close()
+
+
+def test_register_invalidates_cached_entry(tmp_path):
+    db = _session(tmp_path, "s1")
+    reg = SessionRegistry(tmp_path, default_session="s1")
+    reg.fleet_index()
+    builds = reg.entry_builds
+    reg.register("s1", db.parent)
+    reg.fleet_index()
+    assert reg.entry_builds == builds + 1
+    reg.close()
+
+
+def test_index_ttl_coalesces_router_polls(tmp_path):
+    _session(tmp_path, "s1")
+    reg = SessionRegistry(tmp_path, default_session="s1",
+                          fleet_cache_ttl=30.0)
+    first = reg.fleet_index()
+    builds = reg.entry_builds
+    # within the TTL the registry returns the cached index without even
+    # stamping artifacts — the hot path for fan-in router traffic
+    (tmp_path / "s1" / "rank_status.json").write_text(json.dumps({
+        "ts": 1.0, "world_size": 2, "ranks": {"0": {"state": "ACTIVE"}},
+    }))
+    again = reg.fleet_index()
+    assert again is first
+    assert reg.entry_builds == builds
+    # expire the TTL gate: the write is picked up
+    reg._index_cache = (reg._index_cache[0] - 120.0, reg._index_cache[1])
+    refreshed = reg.fleet_index()
+    assert refreshed is not first
+    assert reg.entry_builds == builds + 1
+    reg.close()

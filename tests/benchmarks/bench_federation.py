@@ -1,0 +1,498 @@
+"""Federation bench: 4 aggregator shards × 64 sessions × 256 viewers
+through one stateless fleet router (docs/developer_guide/federation.md).
+
+Scenario: 64 session DBs split across 4 shard logs_dirs, one
+``BrowserDisplayDriver`` per shard, one ``FleetRouter`` fronting all
+four with the shared edge fragment cache.  A writer keeps advancing a
+rotating subset of sessions between measurement rounds; 256 viewers
+(per session: 3 on the SSE live channel, 1 delta-polling on a
+keep-alive connection — the r13 serving tier's push path and its
+polling fallback) all connect THROUGH the router.
+
+Golden first: before any timing, a delta-replay viewer routed through
+the fleet router (with a deliberately dropped round) must reconstruct
+a payload canonically identical (``ts`` excluded) to a fresh full
+``GET /api/live`` taken directly from the owning shard.
+
+Asserted (the ISSUE 16 acceptance criteria):
+
+* p99 version-advance → viewer-receipt staleness ≤ 250 ms on the SSE
+  live channel proxied through the router;
+* router overhead ≤ 10 ms p99 per hop on the edge-cache hit path;
+* the edge cache makes shard upstream fetches independent of viewer
+  count: fresh-content upstream fetches (status 200 — 204/304 probes
+  are header exchanges) stay ≤ ~1 per session-version (slack 2×) under
+  the steady polling load, and a 32-concurrent-poller burst per
+  session costs the shards ≤ ~1 fresh fetch per session, not one per
+  viewer.
+
+Emits bench_common JSON lines (collected into BENCH_LOCAL_r17.json).
+"""
+
+import http.client
+import json
+import sys
+import threading
+import time
+import types
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent))
+import bench_common  # noqa: E402
+
+from traceml_tpu.aggregator.display_drivers.browser import (  # noqa: E402
+    BrowserDisplayDriver,
+    wait_until_ready,
+)
+from traceml_tpu.aggregator.sqlite_writer import SQLiteWriter  # noqa: E402
+from traceml_tpu.federation.router import FleetRouter  # noqa: E402
+from traceml_tpu.renderers import serving  # noqa: E402
+from traceml_tpu.utils import timing as T  # noqa: E402
+from traceml_tpu.telemetry.envelope import (  # noqa: E402
+    SenderIdentity,
+    build_telemetry_envelope,
+)
+
+pytestmark = pytest.mark.slow
+
+BENCH = "federation"
+N_SHARDS = 4
+SESSIONS_PER_SHARD = 16          # 4 × 16 = 64 sessions
+SSE_PER_SESSION = 3
+POLLERS_PER_SESSION = 1          # 64 × (3 + 1) = 256 viewers
+N_RANKS = 2
+WRITE_ROUNDS = 8
+WRITES_PER_ROUND = 16            # rotating subset: every session ×2
+ROUND_SPACING_S = 0.6
+VIEWER_POLL_S = 0.4
+CACHE_TTL_S = 0.08
+BURST_VIEWERS = 32
+BURST_SESSIONS = 8
+STALENESS_P99_BUDGET_S = 0.250
+HOP_OVERHEAD_P99_BUDGET_S = 0.010
+FETCHES_PER_VERSION_SLACK = 2.0
+
+
+def _rows(rank, start, n):
+    return [
+        {"step": s, "timestamp": float(s), "clock": "device",
+         "events": {
+             T.STEP_TIME: {"cpu_ms": 100.0 + (s % 9), "device_ms":
+                           100.0 + (s % 9), "count": 1},
+             T.DATALOADER_NEXT: {"cpu_ms": 30.0, "device_ms": None,
+                                 "count": 1},
+             T.COMPUTE_TIME: {"cpu_ms": 1.0, "device_ms": 60.0,
+                              "count": 1},
+         }}
+        for s in range(start, start + n)
+    ]
+
+
+def _write(db, start, n=3):
+    w = SQLiteWriter(db)
+    w.start()
+    for rank in range(N_RANKS):
+        ident = SenderIdentity(
+            session_id=db.parent.name, global_rank=rank,
+            world_size=N_RANKS,
+        )
+        w.ingest(build_telemetry_envelope(
+            "step_time", {"step_time": _rows(rank, start, n)}, ident))
+    assert w.force_flush()
+    w.finalize()
+
+
+def _get(port, path, headers=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        conn.request("GET", path, headers=headers or {})
+        resp = conn.getresponse()
+        return resp.status, dict(resp.getheaders()), resp.read()
+    finally:
+        conn.close()
+
+
+def _canon(payload):
+    return json.dumps(
+        {k: v for k, v in payload.items() if k != "ts"}, sort_keys=True
+    )
+
+
+def _start_shard(logs_dir, first_sid):
+    # serve_max_sessions covers the WHOLE fleet, not one shard: the four
+    # in-process drivers share one global publisher cache (in production
+    # each shard is its own process), so a per-shard cap would evict —
+    # and close — the other shards' publishers mid-stream
+    ctx = types.SimpleNamespace(
+        db_path=logs_dir / first_sid / "telemetry.sqlite",
+        settings=types.SimpleNamespace(
+            session_id=first_sid, session_dir=logs_dir / first_sid,
+            logs_dir=logs_dir,
+            serve_max_sessions=N_SHARDS * SESSIONS_PER_SHARD + 8,
+        ),
+    )
+    driver = BrowserDisplayDriver(port=0)
+    # frequent heartbeats are the SSE viewers' read wakeup: a client
+    # socket timeout poisons http.client's response object, so the
+    # stream itself must produce bytes at a steady cadence
+    driver.sse_heartbeat_sec = 0.5
+    driver.start(ctx)
+    assert driver.port and wait_until_ready("127.0.0.1", driver.port, 5.0)
+    return driver
+
+
+def _replay_golden_routed(router_port, shard_port, sid, db, pub):
+    """Delta replay THROUGH the router (with a dropped round) must equal
+    a fresh full payload taken directly from the owning shard."""
+    code, headers, body = _get(router_port, f"/api/live?session={sid}")
+    assert code == 200
+    state = json.loads(body)
+    token = headers["X-TraceML-Token"]
+    for round_i in range(3):
+        _write(db, 2000 + round_i * 5)
+        pub.poll(force=True)
+        if round_i == 1:
+            continue  # dropped round: the next delta must cover the gap
+        time.sleep(CACHE_TTL_S + 0.02)  # let stale edge entries expire
+        code, headers, body = _get(
+            router_port, f"/api/live?session={sid}&since={token}"
+        )
+        token = headers.get("X-TraceML-Token", token)
+        if code == 200:
+            m = json.loads(body)
+            for frag in m["fragments"].values():
+                state.update(frag)
+            token = m["token"]
+    time.sleep(CACHE_TTL_S + 0.02)
+    code, headers, body = _get(
+        router_port, f"/api/live?session={sid}&since={token}"
+    )
+    if code == 200:
+        for frag in json.loads(body)["fragments"].values():
+            state.update(frag)
+    code, _, full = _get(shard_port, f"/api/live?session={sid}")
+    assert code == 200
+    full_payload = json.loads(full)
+    assert full_payload["session"] == sid
+    assert full_payload["step_time"]["n_steps"] > 0
+    assert _canon(state) == _canon(full_payload), (
+        f"routed delta replay diverged from the shard's payload ({sid})"
+    )
+
+
+class _SSEViewer(threading.Thread):
+    """One live-channel tab: holds ``/api/stream`` through the router,
+    stamping receipt staleness when a fragment event's token matches a
+    version-advance stamp."""
+
+    def __init__(self, port, sid, stop_evt, token_pub_ts):
+        super().__init__(daemon=True)
+        self.port, self.sid = port, sid
+        self.stop_evt = stop_evt
+        self.token_pub_ts = token_pub_ts
+        self.events = 0
+        self.staleness = []
+        self.errors = 0
+
+    def run(self):
+        # the timeout must exceed the heartbeat cadence: http.client
+        # marks the response unreadable after ANY read timeout, so
+        # heartbeats (not timeouts) are the idle-loop wakeup
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", self.port, timeout=5.0
+        )
+        try:
+            conn.request("GET", f"/api/stream?session={self.sid}")
+            resp = conn.getresponse()
+            if resp.status != 200:
+                self.errors += 1
+                return
+            event_id = None
+            is_fragment = False
+            while not self.stop_evt.is_set():
+                try:
+                    line = resp.fp.readline()
+                except OSError:
+                    break
+                if not line:
+                    break
+                line = line.strip()
+                if line.startswith(b"id:"):
+                    event_id = line[3:].strip().decode()
+                elif line == b"event: fragment":
+                    is_fragment = True
+                elif not line:  # dispatch boundary
+                    if is_fragment and event_id:
+                        self.events += 1
+                        pub_ts = self.token_pub_ts.get(
+                            (self.sid, event_id)
+                        )
+                        if pub_ts is not None:
+                            self.staleness.append(
+                                time.monotonic() - pub_ts
+                            )
+                    is_fragment = False
+        except OSError:
+            self.errors += 1
+        finally:
+            conn.close()
+
+
+class _PollViewer(threading.Thread):
+    """The polling fallback: delta-polls its session on a persistent
+    keep-alive connection, driving the edge cache's steady-state load."""
+
+    def __init__(self, port, sid, stop_evt):
+        super().__init__(daemon=True)
+        self.port, self.sid = port, sid
+        self.stop_evt = stop_evt
+        self.requests = 0
+        self.errors = 0
+
+    def run(self):
+        token = None
+        conn = None
+        while not self.stop_evt.is_set():
+            try:
+                if conn is None:
+                    conn = http.client.HTTPConnection(
+                        "127.0.0.1", self.port, timeout=10
+                    )
+                if token:
+                    path = f"/api/live?session={self.sid}&since={token}"
+                else:
+                    path = f"/api/live?session={self.sid}"
+                conn.request("GET", path)
+                resp = conn.getresponse()
+                headers = dict(resp.getheaders())
+                resp.read()
+                self.requests += 1
+                token = headers.get("X-TraceML-Token") or token
+            except (OSError, http.client.HTTPException):
+                self.errors += 1
+                if conn is not None:
+                    conn.close()
+                conn = None
+            self.stop_evt.wait(VIEWER_POLL_S)
+        if conn is not None:
+            conn.close()
+
+
+def _pctile(values, q):
+    values = sorted(values)
+    assert values
+    return values[min(len(values) - 1, int(q * len(values)))]
+
+
+def test_federation_bench(tmp_path):
+    serving.close_all_publishers()
+    shard_dirs = [tmp_path / f"shard{i}" for i in range(N_SHARDS)]
+    sids, dbs, shard_of = [], {}, {}
+    for i, logs in enumerate(shard_dirs):
+        for j in range(SESSIONS_PER_SHARD):
+            sid = f"sess{i:02d}x{j:02d}"
+            (logs / sid).mkdir(parents=True)
+            dbs[sid] = logs / sid / "telemetry.sqlite"
+            _write(dbs[sid], 0, n=20)
+            sids.append(sid)
+            shard_of[sid] = i
+
+    drivers = [
+        _start_shard(shard_dirs[i], f"sess{i:02d}x00")
+        for i in range(N_SHARDS)
+    ]
+    shard_addrs = [f"127.0.0.1:{d.port}" for d in drivers]
+    router = FleetRouter(
+        shards=shard_addrs, cache_ttl=CACHE_TTL_S, probe_s=600.0
+    )
+    router.start()
+    for shard in shard_addrs:
+        router.health.probe(shard)  # learn every session's location
+    try:
+        # default min_poll_interval stays: the shared 0.2 s refresh is
+        # what keeps hundreds of SSE waiters from each re-polling the
+        # store — forced polls at write time notify them instantly
+        pubs = {
+            sid: serving.publisher_for(
+                dbs[sid], sid,
+                max_publishers=N_SHARDS * SESSIONS_PER_SHARD + 8,
+            )
+            for sid in sids
+        }
+
+        # -- golden: routed delta replay == owning shard's payload ------
+        golden_sids = [f"sess{i:02d}x00" for i in range(N_SHARDS)]
+        for sid in golden_sids:
+            _replay_golden_routed(
+                router.port, drivers[shard_of[sid]].port,
+                sid, dbs[sid], pubs[sid],
+            )
+        bench_common.emit(
+            BENCH, "golden_sessions", len(golden_sids), "sessions",
+            shards=N_SHARDS,
+        )
+
+        # -- rollup: one page covering all 64 sessions ------------------
+        t0 = time.monotonic()
+        code, _, body = _get(router.port, "/api/fleet?page_size=100")
+        rollup_ms = (time.monotonic() - t0) * 1e3
+        fleet = json.loads(body)
+        assert code == 200
+        assert fleet["totals"]["sessions"] == len(sids)
+        bench_common.emit(
+            BENCH, "fleet_rollup_ms", rollup_ms, "ms",
+            sessions=len(sids), shards=N_SHARDS,
+        )
+
+        # -- hop overhead: edge-cache hit latency -----------------------
+        warm_sid = golden_sids[0]
+        _get(router.port, f"/api/live?session={warm_sid}")
+        lat = []
+        for _ in range(300):
+            t0 = time.monotonic()
+            code, headers, _b = _get(
+                router.port, f"/api/live?session={warm_sid}"
+            )
+            dt = time.monotonic() - t0
+            if headers.get("X-TraceML-Edge-Cache") == "hit":
+                lat.append(dt)
+        assert len(lat) >= 200, "cache-hit path barely exercised"
+        hit_p50 = _pctile(lat, 0.50)
+        hit_p99 = _pctile(lat, 0.99)
+        bench_common.emit(
+            BENCH, "edge_hit_p50_ms", hit_p50 * 1e3, "ms"
+        )
+        bench_common.emit(
+            BENCH, "edge_hit_p99_ms", hit_p99 * 1e3, "ms",
+            budget_ms=HOP_OVERHEAD_P99_BUDGET_S * 1e3,
+        )
+        assert hit_p99 <= HOP_OVERHEAD_P99_BUDGET_S, (
+            f"router cache-hit p99 {hit_p99 * 1e3:.2f} ms exceeds the "
+            f"{HOP_OVERHEAD_P99_BUDGET_S * 1e3:.0f} ms per-hop budget"
+        )
+
+        # -- staleness + upstream independence under 256 viewers --------
+        stop_evt = threading.Event()
+        token_pub_ts = {}
+        sse_viewers = [
+            _SSEViewer(router.port, sid, stop_evt, token_pub_ts)
+            for sid in sids
+            for _ in range(SSE_PER_SESSION)
+        ]
+        pollers = [
+            _PollViewer(router.port, sid, stop_evt)
+            for sid in sids
+            for _ in range(POLLERS_PER_SESSION)
+        ]
+        viewers = sse_viewers + pollers
+        assert len(viewers) == 256
+        for v in viewers:
+            v.start()
+        time.sleep(1.5)  # SSE replay drained, pollers hold tokens
+        fetches0 = router.upstream_fetches
+        fetches0_200 = router.upstream_fetches_200
+        requests0 = sum(p.requests for p in pollers)
+
+        advances = 0
+        for round_i in range(WRITE_ROUNDS):
+            lo = (round_i * WRITES_PER_ROUND) % len(sids)
+            batch = [
+                sids[(lo + k) % len(sids)]
+                for k in range(WRITES_PER_ROUND)
+            ]
+            for sid in batch:
+                _write(dbs[sid], 3000 + round_i * 5)
+                tok = pubs[sid].poll(force=True)
+                token_pub_ts.setdefault(
+                    (sid, tok), time.monotonic()
+                )
+                advances += 1
+                # spread advances across the round — a fleet's shards
+                # write independently, not in one process-hogging burst
+                time.sleep(ROUND_SPACING_S / WRITES_PER_ROUND)
+        time.sleep(CACHE_TTL_S + 2 * VIEWER_POLL_S)  # drain receipts
+        fetches = router.upstream_fetches - fetches0
+        fetches_200 = router.upstream_fetches_200 - fetches0_200
+        viewer_requests = sum(p.requests for p in pollers) - requests0
+
+        staleness = [s for v in sse_viewers for s in v.staleness]
+        assert len(staleness) >= advances, (
+            "too few receipt samples to trust the percentile"
+        )
+        stale_p50 = _pctile(staleness, 0.50)
+        stale_p99 = _pctile(staleness, 0.99)
+        bench_common.emit(
+            BENCH, "staleness_p50_ms", stale_p50 * 1e3, "ms",
+            viewers=len(viewers), sessions=len(sids),
+            samples=len(staleness),
+        )
+        bench_common.emit(
+            BENCH, "staleness_p99_ms", stale_p99 * 1e3, "ms",
+            viewers=len(viewers), sessions=len(sids),
+            budget_ms=STALENESS_P99_BUDGET_S * 1e3,
+        )
+        assert stale_p99 <= STALENESS_P99_BUDGET_S, (
+            f"p99 staleness {stale_p99 * 1e3:.0f} ms through the router "
+            f"exceeds the {STALENESS_P99_BUDGET_S * 1e3:.0f} ms budget"
+        )
+
+        per_version = fetches_200 / max(1, advances)
+        bench_common.emit(
+            BENCH, "upstream_fetches_per_version", per_version,
+            "fetches", advances=advances, fresh_fetches=fetches_200,
+            probe_fetches=fetches - fetches_200,
+            viewer_requests=viewer_requests,
+        )
+        assert per_version <= FETCHES_PER_VERSION_SLACK, (
+            f"{per_version:.2f} fresh upstream fetches per "
+            f"session-version — the edge cache is not collapsing "
+            f"viewers"
+        )
+
+        # -- burst: viewer count must not multiply shard fetches --------
+        burst_sids = sids[:BURST_SESSIONS]
+        b0_200 = router.upstream_fetches_200
+        burst_threads = []
+        burst_errors = []
+
+        def _burst(sid):
+            try:
+                for _ in range(3):
+                    _get(router.port, f"/api/live?session={sid}")
+            except OSError as exc:
+                burst_errors.append(exc)
+
+        for sid in burst_sids:
+            for _ in range(BURST_VIEWERS):
+                t = threading.Thread(target=_burst, args=(sid,),
+                                     daemon=True)
+                burst_threads.append(t)
+        for t in burst_threads:
+            t.start()
+        for t in burst_threads:
+            t.join(timeout=30)
+        assert not burst_errors
+        burst_200 = router.upstream_fetches_200 - b0_200
+        per_session = burst_200 / len(burst_sids)
+        bench_common.emit(
+            BENCH, "burst_fetches_per_session", per_session, "fetches",
+            burst_viewers=BURST_VIEWERS, burst_requests=3,
+            sessions=len(burst_sids),
+        )
+        assert per_session <= FETCHES_PER_VERSION_SLACK, (
+            f"{BURST_VIEWERS} concurrent viewers cost the shard "
+            f"{per_session:.2f} fresh fetches per session — fetches "
+            f"scale with viewers, the edge cache is pass-through"
+        )
+
+        stop_evt.set()
+        for v in viewers:
+            v.join(timeout=10)
+        assert sum(v.errors for v in viewers) == 0
+    finally:
+        router.stop()
+        for d in drivers:
+            d.stop()
+        serving.close_all_publishers()

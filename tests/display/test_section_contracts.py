@@ -278,3 +278,67 @@ def test_fleet_js_ids_exist_in_markup():
     declared = set(re.findall(r'id="([\w-]+)"', _FLEET_PAGE))
     missing = used - declared
     assert not missing, f"fleet JS touches ids with no markup: {missing}"
+
+
+# --- federated fleet page (fleet router) ----------------------------------
+# The router renders rows merged from MANY shards' /api/sessions
+# indexes; session ids, diagnosis strings, and workload tags are still
+# telemetry-derived, and shard names come from operator config — the
+# federated page is held to the same escape-coverage contract.
+
+from traceml_tpu.aggregator.display_drivers.browser_sections.federation import (  # noqa: E402
+    FEDERATION_JS,
+    build_federation_page,
+)
+
+_FED_PAGE = build_federation_page()
+_FED_SAFE = _SAFE_MARKERS + ("encodeURIComponent(",)
+# audited locals: fedRanks/fedDiag/fedState/fedWorkload esc() every
+# payload string internally (fedState is a ternary over badge HTML
+# literals); `status` likewise; `states` is fedRanks output; the
+# textContent interpolations are inert and numeric/Date
+_FED_VETTED = {
+    "fedRanks(s.ranks)",
+    "fedDiag(s.primary_diagnosis)",
+    "fedDiag(x.worst_diagnosis)",
+    "fedState(s)",
+    "fedWorkload(s)",
+    "status",
+    "states",
+    "(x.totals||{}).sessions||0",
+    "new Date(x.ts*1000).toLocaleTimeString()",
+}
+
+
+def test_federation_every_interpolation_is_escaped_or_vetted():
+    bad = []
+    for m in re.finditer(r"\$\{([^{}]+)\}", FEDERATION_JS):
+        expr = m.group(1).strip()
+        if any(mark in expr for mark in _FED_SAFE):
+            continue
+        if expr in _FED_VETTED:
+            continue
+        bad.append(expr)
+    assert not bad, (
+        f"federated fleet page interpolates unvetted expressions "
+        f"(wrap in esc()/a formatter, or audit + add to _FED_VETTED): {bad}"
+    )
+
+
+def test_federation_session_and_shard_strings_are_escaped():
+    # ids shown as text go through esc(); the id placed in the owning
+    # shard's dashboard link additionally through encodeURIComponent();
+    # shard names are esc()'d in both text and URL position
+    assert "esc(s.session)" in FEDERATION_JS
+    assert "encodeURIComponent(s.session)" in FEDERATION_JS
+    assert "esc(s.shard)" in FEDERATION_JS
+    assert "esc(sh.shard)" in FEDERATION_JS
+    assert "esc(p.summary||p.kind||" in FEDERATION_JS
+    assert 'esc(p.severity||"info")' in FEDERATION_JS
+
+
+def test_federation_js_ids_exist_in_markup():
+    used = set(re.findall(r'getElementById\("([\w-]+)"\)', _FED_PAGE))
+    declared = set(re.findall(r'id="([\w-]+)"', _FED_PAGE))
+    missing = used - declared
+    assert not missing, f"federation JS touches ids with no markup: {missing}"

@@ -1,0 +1,69 @@
+"""Edge-cache entry semantics (docs/developer_guide/federation.md)."""
+
+from __future__ import annotations
+
+import gzip
+
+from traceml_tpu.federation.edge_cache import EdgeCache, GZIP_MIN_BYTES
+
+
+def test_fresh_within_ttl_then_stale():
+    cache = EdgeCache(ttl=60.0)
+    cache.put(("live", "s1"), 200, "3:1.2", b'{"x":1}')
+    entry, fresh = cache.get(("live", "s1"))
+    assert fresh and entry.status == 200 and entry.token == "3:1.2"
+    # expire by rewinding the build stamp, not by sleeping
+    entry.built_mono -= 120.0
+    stale_entry, fresh = cache.get(("live", "s1"))
+    assert stale_entry is entry and not fresh
+
+
+def test_renew_refreshes_ttl_without_new_body():
+    cache = EdgeCache(ttl=60.0)
+    entry = cache.put(("live", "s1"), 200, "t", b"body")
+    entry.built_mono -= 120.0
+    _, fresh = cache.get(("live", "s1"))
+    assert not fresh
+    cache.renew(("live", "s1"))
+    got, fresh = cache.get(("live", "s1"))
+    assert fresh and got.body == b"body"
+    assert cache.stats()["revalidations"] == 1
+
+
+def test_lru_bound_evicts_oldest():
+    cache = EdgeCache(ttl=60.0, max_entries=16)
+    for i in range(40):
+        cache.put(("delta", "s1", f"tok{i}"), 200, None, b"x")
+    assert cache.stats()["entries"] == 16
+    gone, _ = cache.get(("delta", "s1", "tok0"))
+    kept, _ = cache.get(("delta", "s1", "tok39"))
+    assert gone is None and kept is not None
+
+
+def test_invalidate_session_only_drops_that_session():
+    cache = EdgeCache(ttl=60.0)
+    cache.put(("live", "s1"), 200, "a", b"1")
+    cache.put(("delta", "s1", "t"), 200, "b", b"2")
+    cache.put(("live", "s2"), 200, "c", b"3")
+    cache.invalidate_session("s1")
+    assert cache.get(("live", "s1"))[0] is None
+    assert cache.get(("delta", "s1", "t"))[0] is None
+    assert cache.get(("live", "s2"))[0] is not None
+
+
+def test_gzip_form_is_lazy_shared_and_deterministic():
+    cache = EdgeCache(ttl=60.0)
+    body = b'{"k":"' + b"v" * GZIP_MIN_BYTES + b'"}'
+    entry = cache.put(("live", "s1"), 200, "t", body)
+    assert entry.gzip_body is None  # not built until asked
+    gz1 = entry.gzipped()
+    gz2 = entry.gzipped()
+    assert gz1 is gz2  # compressed once, shared
+    assert gzip.decompress(gz1) == body
+    assert gz1 == gzip.compress(body, mtime=0)  # deterministic (mtime=0)
+
+
+def test_small_bodies_never_gzip():
+    cache = EdgeCache(ttl=60.0)
+    entry = cache.put(("live", "s1"), 200, "t", b"tiny")
+    assert entry.gzipped() is None

@@ -1,0 +1,483 @@
+"""Fleet-router correctness: delta replay through the router
+byte-identical to direct shard access, edge-cache semantics, hostile-id
+rejection at the edge, shard-down degradation, SSE resume across a
+router restart, and hop compression
+(docs/developer_guide/federation.md)."""
+
+from __future__ import annotations
+
+import http.client
+import json
+import time
+import types
+import zlib
+
+import pytest
+
+from traceml_tpu.aggregator.display_drivers.browser import (
+    BrowserDisplayDriver,
+    wait_until_ready,
+)
+from traceml_tpu.federation.router import FleetRouter
+from traceml_tpu.renderers import serving
+
+from tests.display.test_browser_driver import _make_session_db
+from tests.display.test_serving_delta import (
+    _read_sse_event,
+    _write_rows,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_publishers():
+    serving.close_all_publishers()
+    yield
+    serving.close_all_publishers()
+
+
+def _start_shard(logs_dir, session="dash"):
+    """One aggregator shard: a browser driver over logs_dir/<session>."""
+    session_dir = logs_dir / session
+    session_dir.mkdir(parents=True, exist_ok=True)
+    if not (session_dir / "telemetry.sqlite").exists():
+        _make_session_db(session_dir)
+    db = session_dir / "telemetry.sqlite"
+    ctx = types.SimpleNamespace(
+        db_path=db,
+        settings=types.SimpleNamespace(
+            session_id=session,
+            session_dir=session_dir,
+            logs_dir=logs_dir,
+            serve_max_sessions=8,
+        ),
+    )
+    driver = BrowserDisplayDriver(port=0)
+    driver.sse_wait_slice = 0.02
+    driver.sse_heartbeat_sec = 0.2
+    driver.start(ctx)
+    assert driver.port and wait_until_ready("127.0.0.1", driver.port, 5.0)
+    serving.publisher_for(db, session).min_poll_interval = 0
+    return driver, db
+
+
+def _start_router(ports, cache_ttl=0.0, probe=True, **kw):
+    router = FleetRouter(
+        shards=[f"127.0.0.1:{p}" for p in ports],
+        cache_ttl=cache_ttl,
+        probe_s=600.0,  # tests drive probes explicitly
+        **kw,
+    )
+    router.start()
+    assert router.port
+    if probe:
+        for shard in router.ring.shards:
+            router.health.probe(shard)
+    return router
+
+
+def _get(port, path, headers=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        conn.request("GET", path, headers=headers or {})
+        resp = conn.getresponse()
+        return resp.status, dict(resp.getheaders()), resp.read()
+    finally:
+        conn.close()
+
+
+def _triple(result):
+    """(status, body, token) — the client-visible serving contract."""
+    status, headers, body = result
+    return status, body, headers.get("X-TraceML-Token")
+
+
+def _canon_triple(result):
+    """Like _triple but with the per-build ``ts`` stamp stripped from the
+    JSON body — delta bodies are rebuilt per request with a fresh ts
+    (full bodies are cached per version and stay byte-compared)."""
+    status, body, token = _triple(result)
+    if body:
+        payload = {
+            k: v for k, v in json.loads(body).items() if k != "ts"
+        }
+        body = json.dumps(payload, sort_keys=True)
+    return status, body, token
+
+
+# -- delta replay equivalence ----------------------------------------------
+
+def test_replay_through_router_matches_direct(tmp_path):
+    """Full → writes → delta → dropped rounds → garbled token: at every
+    step the router's answer is byte-identical to the shard's."""
+    driver, db = _start_shard(tmp_path)
+    router = _start_router([driver.port])
+    try:
+        q = "/api/live?session=dash"
+        direct = _triple(_get(driver.port, q))
+        routed = _triple(_get(router.port, q))
+        assert routed == direct
+        token = direct[2]
+
+        # version advances; delta from the old token (deltas are
+        # rebuilt per request with a fresh ts — compare canonical form)
+        _write_rows(db, step0=40)
+        dq = f"{q}&since={token}"
+        direct_d = _canon_triple(_get(driver.port, dq))
+        routed_d = _canon_triple(_get(router.port, dq))
+        assert routed_d == direct_d
+        assert direct_d[0] == 200
+
+        # dropped rounds: two more writes, client still at the OLD token
+        _write_rows(db, step0=45)
+        _write_rows(db, step0=50)
+        direct_d2 = _canon_triple(_get(driver.port, dq))
+        routed_d2 = _canon_triple(_get(router.port, dq))
+        assert routed_d2 == direct_d2
+
+        # garbled token ⇒ full serve (all fragments), identically on
+        # both paths — still a per-request delta body, so canonical form
+        gq = f"{q}&since=garbage!!token"
+        direct_g = _canon_triple(_get(driver.port, gq))
+        routed_g = _canon_triple(_get(router.port, gq))
+        assert routed_g == direct_g
+        assert "header" in json.loads(direct_g[1])["fragments"]
+
+        # idle delta: 204 + token on both paths
+        cur = routed_g[2]
+        iq = f"{q}&since={cur}"
+        assert _triple(_get(router.port, iq)) == _triple(
+            _get(driver.port, iq)
+        )
+    finally:
+        router.stop()
+        driver.stop()
+
+
+def test_summary_through_router_matches_direct(tmp_path):
+    driver, db = _start_shard(tmp_path)
+    router = _start_router([driver.port])
+    try:
+        q = "/api/summary?session=dash"
+        # not ready yet: same 404 body through both paths
+        assert _get(router.port, q)[0] == 404
+        (tmp_path / "dash" / "final_summary.json").write_text(
+            json.dumps({"primary_diagnosis": {
+                "kind": "ok", "severity": "info", "summary": "fine"}})
+        )
+        direct = _triple(_get(driver.port, q))
+        routed = _triple(_get(router.port, q))
+        assert routed[0] == direct[0] == 200
+        assert routed[1] == direct[1]
+    finally:
+        router.stop()
+        driver.stop()
+
+
+# -- edge cache ------------------------------------------------------------
+
+def test_viewer_count_does_not_multiply_upstream_fetches(tmp_path):
+    driver, db = _start_shard(tmp_path)
+    router = _start_router([driver.port], cache_ttl=30.0)
+    try:
+        base = router.upstream_fetches
+        results = [
+            _get(router.port, "/api/live?session=dash") for _ in range(12)
+        ]
+        assert router.upstream_fetches == base + 1
+        assert len({r[2] for r in results}) == 1  # all the same bytes
+        assert results[0][1]["X-TraceML-Edge-Cache"] == "miss"
+        assert results[-1][1]["X-TraceML-Edge-Cache"] == "hit"
+
+        # client-side If-None-Match answered at the edge, no upstream
+        token = results[0][1]["X-TraceML-Token"]
+        status, headers, body = _get(
+            router.port, "/api/live?session=dash",
+            headers={"If-None-Match": f'"{token}"'},
+        )
+        assert status == 304 and body == b""
+        assert router.upstream_fetches == base + 1
+
+        # deltas at the same since-token also collapse to one fetch
+        for _ in range(8):
+            _get(router.port, f"/api/live?session=dash&since={token}")
+        assert router.upstream_fetches == base + 2
+    finally:
+        router.stop()
+        driver.stop()
+
+
+def test_expired_entry_revalidates_with_if_none_match(tmp_path):
+    driver, db = _start_shard(tmp_path)
+    router = _start_router([driver.port], cache_ttl=0.05)
+    try:
+        base = router.upstream_fetches
+        first = _get(router.port, "/api/live?session=dash")
+        time.sleep(0.1)
+        # unchanged upstream: a 304 renews the entry — header exchange,
+        # no body
+        second = _get(router.port, "/api/live?session=dash")
+        assert second[1]["X-TraceML-Edge-Cache"] == "revalidated"
+        assert second[2] == first[2]
+        assert router.upstream_fetches == base + 2
+        assert router.cache.stats()["revalidations"] == 1
+
+        # advanced upstream: revalidation misses, new body replaces
+        _write_rows(db, step0=40)
+        time.sleep(0.1)
+        third = _get(router.port, "/api/live?session=dash")
+        assert third[1]["X-TraceML-Edge-Cache"] == "miss"
+        assert third[1]["X-TraceML-Token"] != first[1]["X-TraceML-Token"]
+    finally:
+        router.stop()
+        driver.stop()
+
+
+# -- hostile input ---------------------------------------------------------
+
+def test_hostile_session_ids_rejected_before_any_proxying(tmp_path):
+    driver, db = _start_shard(tmp_path)
+    router = _start_router([driver.port], probe=False)
+    try:
+        base = router.upstream_fetches
+        hostile = [
+            "../../../etc/passwd",
+            "..%2F..%2Fetc%2Fpasswd",
+            "<script>alert(1)</script>",
+            "a" * 200,
+            ".hidden",
+            "",
+        ]
+        for sid in hostile:
+            for route in ("/api/live", "/api/summary", "/api/stream"):
+                status, _, _ = _get(
+                    router.port, f"{route}?session={sid}"
+                )
+                assert status == 404, (route, sid)
+        # no session param at all
+        assert _get(router.port, "/api/live")[0] == 404
+        assert router.upstream_fetches == base, (
+            "hostile ids must never reach a shard"
+        )
+        # an over-long since token is refused, not proxied or cached
+        status, _, _ = _get(
+            router.port, "/api/live?session=dash&since=" + "x" * 500
+        )
+        assert status == 404
+        assert router.upstream_fetches == base
+    finally:
+        router.stop()
+        driver.stop()
+
+
+# -- shard-down degradation ------------------------------------------------
+
+def test_dead_shard_degrades_to_stale_rows_and_stale_cache(tmp_path):
+    shard_a, _ = _start_shard(tmp_path / "a", session="alpha")
+    shard_b, _ = _start_shard(tmp_path / "b", session="beta")
+    router = _start_router([shard_a.port, shard_b.port], cache_ttl=0.05)
+    b_name = f"127.0.0.1:{shard_b.port}"
+    try:
+        # warm: both sessions visible, beta's live body cached
+        status, _, body = _get(router.port, "/api/fleet")
+        fleet = json.loads(body)
+        sids = {r["session"] for r in fleet["sessions"]}
+        assert status == 200 and sids == {"alpha", "beta"}
+        live = _get(router.port, "/api/live?session=beta")
+        assert live[0] == 200
+
+        shard_b.stop()
+        for _ in range(3):  # past the is_down threshold
+            router.health.probe(b_name)
+        assert router.health.is_down(b_name)
+
+        time.sleep(0.1)  # expire the fleet + live cache entries
+        status, _, body = _get(router.port, "/api/fleet")
+        assert status == 200, "a dead shard must not error the page"
+        fleet = json.loads(body)
+        rows = {r["session"]: r for r in fleet["sessions"]}
+        assert rows["beta"]["stale"] is True, (
+            "dead shard's sessions degrade to marked-stale rows"
+        )
+        assert rows["alpha"]["stale"] is False
+        shard_rows = {r["shard"]: r for r in fleet["shards"]}
+        assert shard_rows[b_name]["alive"] is False
+
+        # the federated page itself stays 200 (502-free contract)
+        status, _, page = _get(router.port, "/fleet")
+        assert status == 200 and b"federated fleet" in page
+
+        # cached live body served stale-marked, not 50x
+        status, headers, _ = _get(router.port, "/api/live?session=beta")
+        assert status == 200
+        assert headers.get("X-TraceML-Stale") == "1"
+        assert headers["X-TraceML-Edge-Cache"] == "stale"
+
+        # a session that was never cached on the dead shard: clean 503
+        status, _, _ = _get(
+            router.port, "/api/summary?session=beta"
+        )
+        assert status == 503
+    finally:
+        router.stop()
+        shard_a.stop()
+        shard_b.stop()
+
+
+# -- SSE through the router ------------------------------------------------
+
+def test_sse_resume_across_router_restart(tmp_path):
+    driver, db = _start_shard(tmp_path)
+    router = _start_router([driver.port])
+    try:
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", router.port, timeout=10
+        )
+        conn.request("GET", "/api/stream?session=dash")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        first = _read_sse_event(resp)
+        assert first["event"] == "fragment"
+        token = first["id"]
+        assert json.loads(first["data"])
+        conn.close()
+
+        # the router dies and a NEW one takes the same address — no
+        # state to migrate, the client's Last-Event-ID carries resume
+        port = router.port
+        router.stop()
+        _write_rows(db, step0=40)
+        router = _start_router([driver.port], port=port)
+        assert router.port == port
+
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request(
+            "GET", "/api/stream?session=dash",
+            headers={"Last-Event-ID": token},
+        )
+        resp = conn.getresponse()
+        assert resp.status == 200
+        resumed = _read_sse_event(resp)
+        assert resumed["event"] == "fragment"
+        assert resumed["id"] != token
+        delta = json.loads(resumed["data"])
+        # a resume is a delta, not a replay: only advanced fragments
+        assert "step_time" in delta["fragments"]
+        conn.close()
+    finally:
+        router.stop()
+        driver.stop()
+
+
+# -- hop compression -------------------------------------------------------
+
+def test_shard_compresses_hop_when_asked(tmp_path):
+    driver, db = _start_shard(tmp_path)
+    try:
+        plain = _get(driver.port, "/api/live?session=dash")
+        status, headers, body = _get(
+            driver.port, "/api/live?session=dash",
+            headers={"X-TraceML-Hop-Compress": "zlib"},
+        )
+        assert status == 200
+        assert headers["Content-Encoding"] == "x-traceml-zlib"
+        orig = int(headers["X-TraceML-Orig-Len"])
+        restored = zlib.decompress(body)
+        assert len(restored) == orig
+        assert restored == plain[2]
+        assert len(body) < orig
+    finally:
+        driver.stop()
+
+
+def test_hop_compressed_bytes_identical_through_router(tmp_path):
+    driver, db = _start_shard(tmp_path)
+    router = _start_router([driver.port], hop_compress="zlib")
+    try:
+        assert router.hop_codec in ("zlib", "zstd")
+        direct = _triple(_get(driver.port, "/api/live?session=dash"))
+        routed = _triple(_get(router.port, "/api/live?session=dash"))
+        assert routed == direct
+    finally:
+        router.stop()
+        driver.stop()
+
+
+# -- rollup / fleet API ----------------------------------------------------
+
+def test_fleet_rollup_merges_both_shards(tmp_path):
+    shard_a, _ = _start_shard(tmp_path / "a", session="alpha")
+    shard_b, _ = _start_shard(tmp_path / "b", session="beta")
+    router = _start_router([shard_a.port, shard_b.port])
+    try:
+        status, headers, body = _get(router.port, "/api/fleet")
+        assert status == 200
+        fleet = json.loads(body)
+        assert fleet["totals"]["sessions"] == 2
+        by_sid = {r["session"]: r["shard"] for r in fleet["sessions"]}
+        assert by_sid["alpha"] == f"127.0.0.1:{shard_a.port}"
+        assert by_sid["beta"] == f"127.0.0.1:{shard_b.port}"
+        # the learned location map routes to the REAL owner even when
+        # the ring would guess otherwise
+        for sid, shard in by_sid.items():
+            assert router.owner_of(sid) == shard
+        # /api/sessions aliases the rollup for fleet-page compatibility
+        status, _, body = _get(router.port, "/api/sessions")
+        assert status == 200
+        assert {r["session"] for r in json.loads(body)["sessions"]} == {
+            "alpha", "beta"
+        }
+    finally:
+        router.stop()
+        shard_a.stop()
+        shard_b.stop()
+
+
+def test_healthz_reports_role_and_shards(tmp_path):
+    driver, db = _start_shard(tmp_path)
+    router = _start_router([driver.port])
+    try:
+        status, _, body = _get(router.port, "/healthz")
+        data = json.loads(body)
+        assert status == 200 and data["ok"] is True
+        assert data["role"] == "fleet-router"
+        assert data["shards"][0]["alive"] is True
+        assert "cache" in data
+    finally:
+        router.stop()
+        driver.stop()
+
+
+def test_concurrent_cold_misses_coalesce_to_one_upstream_fetch(tmp_path):
+    """A thundering herd on one uncached key is single-flighted: the
+    first request fetches, the rest wait on it and serve from cache —
+    the shard sees exactly one body-moving fetch."""
+    import threading
+
+    driver, db = _start_shard(tmp_path)
+    router = _start_router([driver.port], cache_ttl=60.0)
+    try:
+        before = router.upstream_fetches_200
+        results = []
+        results_lock = threading.Lock()
+        gate = threading.Barrier(16)
+
+        def hit():
+            gate.wait()
+            got = _triple(_get(router.port, "/api/live?session=dash"))
+            with results_lock:
+                results.append(got)
+
+        threads = [threading.Thread(target=hit) for _ in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(results) == 16
+        assert {r[0] for r in results} == {200}
+        # every follower saw the leader's body, byte for byte
+        assert len({r[1] for r in results}) == 1
+        assert len({r[2] for r in results}) == 1
+        assert router.upstream_fetches_200 - before == 1
+    finally:
+        router.stop()
+        driver.stop()

@@ -1,0 +1,118 @@
+"""Consistent-hash ring + shard-spec parsing contracts
+(docs/developer_guide/federation.md)."""
+
+from __future__ import annotations
+
+import json
+
+from traceml_tpu.federation.ring import (
+    DEFAULT_VNODES,
+    HashRing,
+    parse_shard_spec,
+    valid_shard,
+)
+
+SHARDS4 = ["10.0.0.1:9001", "10.0.0.2:9001", "10.0.0.3:9001",
+           "10.0.0.4:9001"]
+IDS = [f"run-{i:04d}" for i in range(2000)]
+
+
+# -- placement stability ---------------------------------------------------
+
+def test_owner_is_stable_across_ring_instances():
+    """Two independently-built rings over the same shard set agree on
+    every placement — the property that lets N stateless routers route
+    without coordination (sha1 points, never builtin hash())."""
+    a = HashRing(SHARDS4)
+    b = HashRing(list(reversed(SHARDS4)))  # input order must not matter
+    for sid in IDS[:200]:
+        assert a.owner(sid) == b.owner(sid)
+
+
+def test_distribution_is_near_uniform():
+    counts = HashRing(SHARDS4).counts(IDS)
+    assert set(counts) == set(SHARDS4)
+    for shard, n in counts.items():
+        # 64 vnodes keeps a 4-shard ring within ~2x of ideal (500)
+        assert 250 <= n <= 1000, f"{shard} got {n}/2000"
+
+
+def test_removing_one_shard_only_remaps_its_sessions():
+    full = HashRing(SHARDS4)
+    removed = SHARDS4[1]
+    smaller = HashRing([s for s in SHARDS4 if s != removed])
+    moved = 0
+    for sid in IDS:
+        before = full.owner(sid)
+        after = smaller.owner(sid)
+        if before == removed:
+            assert after != removed
+            moved += 1
+        else:
+            # the consistent-hashing contract: survivors keep theirs
+            assert after == before
+    assert moved == full.counts(IDS)[removed]
+
+
+def test_empty_ring_owns_nothing():
+    ring = HashRing([])
+    assert len(ring) == 0
+    assert ring.owner("anything") is None
+
+
+def test_vnode_count_default():
+    ring = HashRing(SHARDS4)
+    assert ring.vnodes == DEFAULT_VNODES
+    assert len(ring._points) == len(SHARDS4) * DEFAULT_VNODES
+
+
+# -- shard-spec parsing ----------------------------------------------------
+
+def test_parse_comma_list_tolerates_whitespace_and_dupes():
+    spec = " 127.0.0.1:9001, 127.0.0.1:9002 ,127.0.0.1:9001"
+    assert parse_shard_spec(spec) == [
+        "127.0.0.1:9001", "127.0.0.1:9002"
+    ]
+
+
+def test_parse_drops_invalid_entries_keeps_valid():
+    spec = "127.0.0.1:9001,not a shard,;rm -rf /;:99,host:9002"
+    assert parse_shard_spec(spec) == ["127.0.0.1:9001", "host:9002"]
+
+
+def test_parse_empty_and_none():
+    assert parse_shard_spec(None) == []
+    assert parse_shard_spec("") == []
+
+
+def test_parse_json_discovery_file_bare_list(tmp_path):
+    path = tmp_path / "shards.json"
+    path.write_text(json.dumps(["a:1", "b:2", 3, "bad entry"]))
+    assert parse_shard_spec(str(path)) == ["a:1", "b:2"]
+
+
+def test_parse_json_discovery_file_object(tmp_path):
+    path = tmp_path / "shards.json"
+    path.write_text(json.dumps({"shards": ["a:1", "b:2"], "extra": 1}))
+    assert parse_shard_spec(str(path)) == ["a:1", "b:2"]
+
+
+def test_parse_unreadable_or_garbage_json_is_empty(tmp_path):
+    missing = tmp_path / "nope.json"
+    assert parse_shard_spec(str(missing)) == []
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert parse_shard_spec(str(bad)) == []
+    scalar = tmp_path / "scalar.json"
+    scalar.write_text('"a:1"')
+    assert parse_shard_spec(str(scalar)) == []
+
+
+def test_valid_shard_charset():
+    assert valid_shard("host-1.example.com:8080")
+    assert valid_shard("[::1]:8080")
+    assert not valid_shard("host:notaport")
+    assert not valid_shard("host")
+    assert not valid_shard("host:123456")
+    assert not valid_shard("<script>:80")
+    assert not valid_shard(12345)

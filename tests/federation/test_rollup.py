@@ -1,0 +1,148 @@
+"""Fleet rollup merge + concurrent gather contracts
+(docs/developer_guide/federation.md)."""
+
+from __future__ import annotations
+
+import time
+
+from traceml_tpu.federation.rollup import (
+    gather_indexes,
+    merge_fleet,
+    severity_rank,
+)
+
+
+def _entry(sid, ranks=None, diag=None, finished=False, ts=0.0, **extra):
+    e = {
+        "session": sid,
+        "db_exists": True,
+        "last_update_ts": ts,
+        "ranks": ranks or {},
+        "finished": finished,
+        "primary_diagnosis": diag,
+    }
+    e.update(extra)
+    return e
+
+
+def _index(*entries):
+    return {"version": 1, "ts": 100.0, "sessions": list(entries)}
+
+
+def test_merge_totals_and_lost_ranks():
+    merged = merge_fleet({
+        "a:1": _index(
+            _entry("s1", ranks={"ACTIVE": 4}, ts=3.0,
+                   workload="training"),
+            _entry("s2", ranks={"ACTIVE": 2, "lost": 1}, ts=2.0),
+        ),
+        "b:2": _index(
+            _entry("s3", ranks={"FINISHED": 8}, finished=True, ts=1.0,
+                   workload="training+serving"),
+        ),
+    })
+    t = merged["totals"]
+    assert t["sessions"] == 3
+    assert t["finished"] == 1
+    assert t["live"] == 2
+    assert t["rank_states"] == {"ACTIVE": 6, "lost": 1, "FINISHED": 8}
+    assert t["lost_ranks"] == 1
+    assert t["workloads"] == {"training": 1, "training+serving": 1}
+    # every row is annotated with its shard
+    assert {(r["session"], r["shard"]) for r in merged["sessions"]} == {
+        ("s1", "a:1"), ("s2", "a:1"), ("s3", "b:2")
+    }
+
+
+def test_worst_diagnosis_ranks_severity_across_shards():
+    merged = merge_fleet({
+        "a:1": _index(_entry("s1", diag={
+            "kind": "dataloader_bottleneck", "severity": "warning",
+            "summary": "input-bound"})),
+        "b:2": _index(_entry("s2", diag={
+            "kind": "rank_lost", "severity": "critical",
+            "summary": "rank 3 lost"})),
+    })
+    worst = merged["worst_diagnosis"]
+    assert worst["kind"] == "rank_lost"
+    assert worst["session"] == "s2"
+    assert worst["shard"] == "b:2"
+
+
+def test_severity_rank_ordering():
+    assert severity_rank("critical") > severity_rank("warning")
+    assert severity_rank("warning") > severity_rank("info")
+    # unknown severities surface above warnings, below errors
+    assert severity_rank("weird") > severity_rank("warning")
+    assert severity_rank("weird") < severity_rank("error")
+
+
+def test_stale_shard_sessions_marked_not_dropped():
+    merged = merge_fleet(
+        {
+            "a:1": _index(_entry("s1", ts=2.0)),
+            "b:2": _index(_entry("s2", ts=1.0)),  # last good index
+        },
+        stale_shards=["b:2"],
+    )
+    by_sid = {r["session"]: r for r in merged["sessions"]}
+    assert by_sid["s1"]["stale"] is False
+    assert by_sid["s2"]["stale"] is True
+    shard_rows = {r["shard"]: r for r in merged["shards"]}
+    assert shard_rows["b:2"]["stale"] is True
+    assert shard_rows["b:2"]["alive"] is False
+    assert shard_rows["a:1"]["alive"] is True
+
+
+def test_dead_shard_with_no_cached_index_still_listed():
+    merged = merge_fleet({"a:1": _index(), "b:2": None},
+                         stale_shards=["b:2"])
+    shard_rows = {r["shard"]: r for r in merged["shards"]}
+    assert shard_rows["b:2"]["alive"] is False
+    assert shard_rows["b:2"]["sessions"] == 0
+
+
+def test_pagination_is_deterministic_and_complete():
+    entries = [_entry(f"s{i:02d}", ts=float(i % 3)) for i in range(25)]
+    per_shard = {"a:1": _index(*entries)}
+    seen = []
+    p0 = merge_fleet(per_shard, page=0, page_size=10)
+    assert p0["pages"] == 3
+    for page in range(p0["pages"]):
+        m = merge_fleet(per_shard, page=page, page_size=10)
+        seen.extend(r["session"] for r in m["sessions"])
+    assert sorted(seen) == sorted(e["session"] for e in entries)
+    assert len(seen) == len(set(seen))  # no row on two pages
+
+
+def test_page_past_end_is_empty_not_error():
+    merged = merge_fleet({"a:1": _index(_entry("s1"))}, page=99)
+    assert merged["sessions"] == []
+    assert merged["totals"]["sessions"] == 1
+
+
+def test_gather_respects_deadline_with_hung_shard():
+    def fetch(shard, timeout):
+        if shard == "hung:1":
+            time.sleep(5.0)
+        return _index(_entry(f"from-{shard}"))
+
+    t0 = time.monotonic()
+    results, failed = gather_indexes(
+        ["ok:1", "hung:1"], fetch, deadline_s=0.3
+    )
+    elapsed = time.monotonic() - t0
+    assert elapsed < 2.0, "gather must not wait out a hung shard"
+    assert failed == ["hung:1"]
+    assert results["ok:1"]["sessions"][0]["session"] == "from-ok:1"
+    assert results["hung:1"] is None
+
+
+def test_gather_collects_all_when_fast():
+    results, failed = gather_indexes(
+        ["a:1", "b:2"],
+        lambda shard, timeout: _index(_entry(f"s-{shard}")),
+        deadline_s=2.0,
+    )
+    assert failed == []
+    assert set(results) == {"a:1", "b:2"}

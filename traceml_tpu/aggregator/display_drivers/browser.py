@@ -153,11 +153,16 @@ class BrowserDisplayDriver(BaseDisplayDriver):
                             SessionRegistry,
                         )
 
+                        from traceml_tpu.config import flags
+
                         self._registry = SessionRegistry(
                             context.settings.logs_dir,
                             default_session=context.settings.session_id,
                             max_sessions=getattr(
                                 context.settings, "serve_max_sessions", 8
+                            ),
+                            fleet_cache_ttl=flags.FLEET_CACHE_TTL.get_float(
+                                0.5
                             ),
                         )
                         self._own_registry = True
@@ -199,6 +204,7 @@ class BrowserDisplayDriver(BaseDisplayDriver):
                     from traceml_tpu.renderers.serving import GZIP_MIN_BYTES
 
                     enc = None
+                    extra: Dict[str, str] = {}
                     if (
                         gzip_ok
                         and len(body) >= GZIP_MIN_BYTES
@@ -206,11 +212,39 @@ class BrowserDisplayDriver(BaseDisplayDriver):
                     ):
                         body = _gzip.compress(body, mtime=0)
                         enc = "gzip"
+                    elif (
+                        len(body) >= GZIP_MIN_BYTES
+                        and "Content-Encoding" not in (headers or {})
+                        and self.headers.get("X-TraceML-Hop-Compress")
+                    ):
+                        # router↔shard hop compression (federation tier):
+                        # the router names a codec; encode only when this
+                        # host has it AND it actually shrinks the body —
+                        # otherwise the identity bytes ship and the
+                        # router's decode path is simply skipped
+                        try:
+                            from traceml_tpu.transport import compression
+
+                            codec = compression.resolve_codec(
+                                self.headers["X-TraceML-Hop-Compress"]
+                            )
+                            if codec:
+                                z = compression.compress_bytes(body, codec)
+                                if len(z) < len(body):
+                                    extra["X-TraceML-Orig-Len"] = str(
+                                        len(body)
+                                    )
+                                    body = z
+                                    enc = f"x-traceml-{codec}"
+                        except Exception:
+                            pass  # hop compression is best-effort
                     self.send_response(code)
                     self.send_header("Content-Type", ctype)
                     if enc:
                         self.send_header("Content-Encoding", enc)
                     for k, v in (headers or {}).items():
+                        self.send_header(k, v)
+                    for k, v in extra.items():
                         self.send_header(k, v)
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()

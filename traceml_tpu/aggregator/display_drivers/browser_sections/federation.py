@@ -1,0 +1,181 @@
+"""Federated fleet page: every session across every aggregator shard
+(docs/developer_guide/federation.md).
+
+Served by the fleet router at ``GET /fleet`` (and ``/``); polls
+``GET /api/fleet`` — the aggregator-of-aggregators rollup — and renders
+the shard health strip, fleet totals (rank states, lost ranks, worst
+diagnosis), and the paginated session table.  Session rows link to the
+*owning shard's* per-session dashboard: the router is a read-path
+front-end, the shard page stays the deep-dive surface.
+
+Session ids, diagnosis strings, and workload tags are telemetry-derived
+(the shard ingest ports are unauthenticated) and shard names come from
+operator config that still must not break markup — EVERY interpolation
+routes through ``esc()`` (ids in URL position additionally through
+``encodeURIComponent()``), under the same escape-coverage contract as
+the single-shard fleet page (tests/display/test_section_contracts.py).
+"""
+
+from __future__ import annotations
+
+from traceml_tpu.aggregator.display_drivers.browser_sections import theme
+
+FEDERATION_HTML = """
+<div class="wrap">
+ <div class="card reveal" style="padding:13px 20px">
+  <div style="display:flex;align-items:center;gap:14px;flex-wrap:wrap">
+    <span class="wm">TraceML<b>-TPU</b></span>
+    <span class="eyebrow">federated fleet</span>
+    <span style="flex:1"></span>
+    <span class="muted" id="fed-meta">connecting…</span>
+    <span class="livedot"></span>
+  </div>
+ </div>
+ <div class="card reveal d1">
+  <div class="chead"><h2 class="ctitle">Shards</h2><span class="sp"></span>
+    <span class="cmeta" id="fed-totals"></span></div>
+  <table><thead><tr>
+    <th>shard</th><th>status</th><th class="num">sessions</th>
+  </tr></thead><tbody id="fed-shards">
+    <tr><td colspan="3" class="muted">no shards yet</td></tr>
+  </tbody></table>
+  <div class="muted" id="fed-worst" style="margin-top:8px"></div>
+ </div>
+ <div class="card reveal d2">
+  <div class="chead"><h2 class="ctitle">Sessions</h2><span class="sp"></span>
+    <span class="cmeta" id="fed-count"></span></div>
+  <table><thead><tr>
+    <th>session</th><th>shard</th><th>ranks</th><th>state</th>
+    <th>diagnosis</th><th class="num">updated</th>
+  </tr></thead><tbody id="fed-rows">
+    <tr><td colspan="6" class="muted">no sessions yet</td></tr>
+  </tbody></table>
+  <div style="display:flex;gap:10px;align-items:center;margin-top:8px">
+    <button class="badge" id="fed-prev" type="button">&#8592; prev</button>
+    <span class="cmeta" id="fed-page"></span>
+    <button class="badge" id="fed-next" type="button">next &#8594;</button>
+  </div>
+ </div>
+</div>
+<div id="tip"></div>
+"""
+
+FEDERATION_JS = """
+let fedPageNo=0,fedPages=0;
+function fedRanks(r){
+  const order=["ACTIVE","STALE","LOST","FINISHED"];
+  const keys=Object.keys(r||{});
+  keys.sort((a,b)=>(order.indexOf(a)+1||99)-(order.indexOf(b)+1||99));
+  return keys.map(k=>`${esc(k.toLowerCase())} ${esc(r[k])}`).join(" · ");
+}
+function fedDiag(p){
+  if(!p)return'<span class="muted">—</span>';
+  return`<span class="sevpill" style="background:${SEV[p.severity]||SEV.info}">${
+    esc(p.severity||"info")}</span> ${esc(p.summary||p.kind||"")}`;
+}
+function fedState(s){
+  const base=s.finished?'<span class="badge">finished</span>':
+    (s.db_exists?'<span class="badge" style="color:var(--good)">live</span>':
+     '<span class="badge stale">no data</span>');
+  return base+(s.stale?' <span class="badge stale">stale</span>':"");
+}
+function fedWorkload(s){
+  if(!s.workload)return"";
+  return '<br><span class="muted">workload '+esc(s.workload)+'</span>';
+}
+function fedRow(s){
+  const total=Object.values(s.ranks||{}).reduce((a,n)=>a+n,0);
+  const upd=s.last_update_ts?
+    new Date(s.last_update_ts*1000).toLocaleTimeString():"—";
+  return`<tr>
+    <td><a style="color:var(--accent)" href="http://${esc(s.shard)}/?session=${
+      encodeURIComponent(s.session)}">${esc(s.session)}</a>${
+      fedWorkload(s)}</td>
+    <td class="cmeta">${esc(s.shard)}</td>
+    <td>${total?esc(total):'<span class="muted">—</span>'}
+      <span class="muted">${fedRanks(s.ranks)}</span></td>
+    <td>${fedState(s)}</td>
+    <td>${fedDiag(s.primary_diagnosis)}</td>
+    <td class="num cmeta">${esc(upd)}</td></tr>`;
+}
+function fedShardRow(sh){
+  const status=sh.alive?
+    '<span class="badge" style="color:var(--good)">up</span>':
+    (sh.stale&&sh.sessions?
+      '<span class="badge stale">stale</span>':
+      '<span class="badge stale">down</span>');
+  return`<tr>
+    <td><a style="color:var(--accent)" href="http://${esc(sh.shard)}/fleet">${
+      esc(sh.shard)}</a></td>
+    <td>${status}</td>
+    <td class="num">${esc(sh.sessions)}</td></tr>`;
+}
+function fedTotals(t){
+  const states=fedRanks(t.rank_states);
+  return`${esc(t.sessions)} session(s) · ${esc(t.live)} live · ${
+    esc(t.finished)} finished${
+    t.lost_ranks?` · ${esc(t.lost_ranks)} lost rank(s)`:""}${
+    states?` · ${states}`:""}`;
+}
+async function tick(){
+ try{
+  const r=await fetch(`/api/fleet?page=${esc(fedPageNo)}`);
+  const x=await r.json();
+  fedPages=x.pages||0;
+  if(fedPageNo>0&&fedPageNo>=fedPages)fedPageNo=Math.max(0,fedPages-1);
+  document.getElementById("fed-shards").innerHTML=
+    (x.shards||[]).map(fedShardRow).join("")||
+    '<tr><td colspan="3" class="muted">no shards yet</td></tr>';
+  document.getElementById("fed-rows").innerHTML=
+    (x.sessions||[]).map(fedRow).join("")||
+    '<tr><td colspan="6" class="muted">no sessions yet</td></tr>';
+  document.getElementById("fed-totals").innerHTML=
+    fedTotals(x.totals||{});
+  const worst=document.getElementById("fed-worst");
+  if(x.worst_diagnosis){
+    worst.innerHTML=`worst: ${fedDiag(x.worst_diagnosis)} <span
+      class="cmeta">(${esc(x.worst_diagnosis.session||"?")} @ ${
+      esc(x.worst_diagnosis.shard||"?")})</span>`;
+  }else{worst.textContent="";}
+  document.getElementById("fed-count").textContent=
+    `${(x.totals||{}).sessions||0} session(s)`;
+  document.getElementById("fed-page").textContent=
+    fedPages>1?`page ${esc(fedPageNo+1)} / ${esc(fedPages)}`:"";
+  const meta=document.getElementById("fed-meta");
+  meta.textContent=`updated ${new Date(x.ts*1000).toLocaleTimeString()}`;
+  meta.className="muted";
+ }catch(e){const meta=document.getElementById("fed-meta");
+   meta.textContent="poll failed: "+e;meta.className="err"}
+ setTimeout(tick,2000);
+}
+document.getElementById("fed-prev").addEventListener("click",()=>{
+  fedPageNo=Math.max(0,fedPageNo-1);});
+document.getElementById("fed-next").addEventListener("click",()=>{
+  if(fedPageNo+1<fedPages)fedPageNo=fedPageNo+1;});
+tick();
+"""
+
+
+def build_federation_page() -> str:
+    return (
+        "<!doctype html><html><head><meta charset=\"utf-8\">\n"
+        "<title>TraceML-TPU federated fleet</title>\n"
+        f"{theme.head()}\n</head><body>\n"
+        + FEDERATION_HTML
+        + "\n<script>"
+        + f"{theme.HELPERS_JS}\n{FEDERATION_JS}"
+        + "</script></body></html>"
+    )
+
+
+_PAGE_CACHE: dict = {}
+
+
+def federation_page() -> str:
+    """The assembled page, built once per process (the router serves it
+    on every ``/fleet`` hit)."""
+    page = _PAGE_CACHE.get("page")
+    if page is None:
+        page = build_federation_page()
+        _PAGE_CACHE["page"] = page
+    return page

@@ -18,8 +18,9 @@ from __future__ import annotations
 
 import re
 import threading
+import time
 from pathlib import Path
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from traceml_tpu.renderers.serving import SessionPublisher, publisher_for
 
@@ -43,11 +44,16 @@ class SessionRegistry:
         default_session: Optional[str] = None,
         window_steps: int = 150,
         max_sessions: int = 8,
+        fleet_cache_ttl: float = 0.0,
     ) -> None:
         self.logs_dir = Path(logs_dir)
         self.default_session = default_session
         self.window_steps = window_steps
         self.max_sessions = max(1, int(max_sessions))
+        #: whole-index reuse window — the federation rollup polls
+        #: ``/api/sessions`` per shard per interval, multiplied by
+        #: routers; 0 keeps the historical rebuild-every-call behavior
+        self.fleet_cache_ttl = max(0.0, float(fleet_cache_ttl))
         self._lock = threading.Lock()
         # sessions opened THROUGH this registry — close() only touches
         # these, never publishers some other registry/test opened
@@ -56,6 +62,13 @@ class SessionRegistry:
         # may bind its own session to a DB outside logs_dir/<sid>/
         self._db_overrides: Dict[str, Path] = {}
         self._dir_overrides: Dict[str, Path] = {}
+        # per-session entry cache keyed by an artifact stamp (mtimes +
+        # sizes + live publisher token) — invalidation is the stamp
+        # changing, so a TTL-cached index never shows an update later
+        # than the artifacts it was built from
+        self._entry_cache: Dict[str, Tuple[tuple, Dict[str, Any]]] = {}
+        self._index_cache: Optional[Tuple[float, Dict[str, Any]]] = None
+        self.entry_builds = 0  # observability: cache-effectiveness tests
 
     def register(
         self,
@@ -72,6 +85,10 @@ class SessionRegistry:
             self._db_overrides[session_id] = Path(db_path)
             if session_dir is not None:
                 self._dir_overrides[session_id] = Path(session_dir)
+            # the binding changes where artifacts are read from — any
+            # cached entry/index for this session is now misaddressed
+            self._entry_cache.pop(session_id, None)
+            self._index_cache = None
 
     # -- lookup ----------------------------------------------------------
 
@@ -218,19 +235,72 @@ class SessionRegistry:
                     entry["workload"] = "+".join(kinds)
         return entry
 
-    def fleet_index(self) -> Dict[str, Any]:
-        import time
+    def _entry_stamp(self, session_id: str) -> tuple:
+        """Cheap invalidation key for one session's index entry: the
+        (mtime_ns, size) of each artifact the entry is derived from,
+        plus the open publisher's version token for live sessions —
+        any write that could change the entry changes the stamp."""
+        from traceml_tpu.sdk.protocol import get_final_summary_json_path
 
-        return {
+        session_dir = self.session_dir(session_id)
+        parts: list = []
+        for path in (
+            session_dir / "rank_status.json",
+            get_final_summary_json_path(session_dir),
+            self.db_path(session_id),
+        ):
+            try:
+                st = path.stat()
+                parts.append((st.st_mtime_ns, st.st_size))
+            except OSError:
+                parts.append(None)
+        with self._lock:
+            pub = self._open.get(session_id)
+        if pub is not None and not pub.closed:
+            try:
+                parts.append(pub.poll())
+            except Exception:
+                parts.append(None)
+        else:
+            parts.append(None)
+        return tuple(parts)
+
+    def _entry_cached(self, session_id: str) -> Dict[str, Any]:
+        stamp = self._entry_stamp(session_id)
+        with self._lock:
+            cached = self._entry_cache.get(session_id)
+        if cached is not None and cached[0] == stamp:
+            return cached[1]
+        entry = self._session_entry(session_id)
+        with self._lock:
+            self.entry_builds += 1
+            self._entry_cache[session_id] = (stamp, entry)
+        return entry
+
+    def fleet_index(self) -> Dict[str, Any]:
+        now = time.monotonic()
+        if self.fleet_cache_ttl > 0.0:
+            with self._lock:
+                cached_index = self._index_cache
+            if (
+                cached_index is not None
+                and (now - cached_index[0]) <= self.fleet_cache_ttl
+            ):
+                return cached_index[1]
+        index = {
             "version": 1,
             "ts": time.time(),
             "default_session": self.default_session
             if valid_session_id(self.default_session)
             else None,
             "sessions": [
-                self._session_entry(sid) for sid in self.sessions()
+                self._entry_cached(sid) for sid in self.sessions()
             ],
         }
+        if self.fleet_cache_ttl > 0.0:
+            with self._lock:
+                self._index_cache = (now, index)
+        return index
 
     def close(self) -> None:
         with self._lock:

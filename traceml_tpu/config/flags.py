@@ -198,6 +198,28 @@ UDS_PATH = declare(
     "Unix-domain socket path for the uds transport (default derived)")
 
 # --------------------------------------------------------------------
+# fleet federation (docs/developer_guide/federation.md)
+# --------------------------------------------------------------------
+FLEET_SHARDS = declare(
+    "TRACEML_FLEET_SHARDS", None,
+    "fleet router: comma-separated host:port shard list, or a shards.json path")
+FLEET_PORT = declare(
+    "TRACEML_FLEET_PORT", "0",
+    "fleet router: HTTP port the router front-end binds (0 = ephemeral)")
+FLEET_HOST = declare(
+    "TRACEML_FLEET_HOST", "127.0.0.1",
+    "fleet router: address the router front-end binds")
+FLEET_CACHE_TTL = declare(
+    "TRACEML_FLEET_CACHE_TTL", "0.5",
+    "fleet tier: edge-cache + fleet-index reuse window in seconds")
+FLEET_PROBE_S = declare(
+    "TRACEML_FLEET_PROBE_S", "2.0",
+    "fleet router: base shard health-probe interval (capped backoff on failure)")
+FLEET_STATE_DIR = declare(
+    "TRACEML_FLEET_STATE_DIR", None,
+    "fleet router: directory fleet_router_ready.json is written to (launcher contract)")
+
+# --------------------------------------------------------------------
 # fault tolerance / liveness
 # --------------------------------------------------------------------
 AGG_MAX_RESTARTS = declare(

@@ -1,0 +1,30 @@
+"""Fleet federation tier (docs/developer_guide/federation.md).
+
+A stateless router front-end over N aggregator shards: consistent-hash
+placement (ring.py), capped-backoff shard health + location learning
+(health.py), a shared edge cache preserving the r13 serving tier's
+compute-once-per-version semantics across the extra hop
+(edge_cache.py), and the aggregator-of-aggregators fleet rollup
+(rollup.py), all fronted by the HTTP proxy in router.py and launched
+via ``traceml fleet-router`` (python -m traceml_tpu.federation).
+"""
+
+from traceml_tpu.federation.edge_cache import EdgeCache
+from traceml_tpu.federation.health import HealthMonitor
+from traceml_tpu.federation.ring import (
+    HashRing,
+    parse_shard_spec,
+    valid_shard,
+)
+from traceml_tpu.federation.rollup import merge_fleet
+from traceml_tpu.federation.router import FleetRouter
+
+__all__ = [
+    "EdgeCache",
+    "FleetRouter",
+    "HashRing",
+    "HealthMonitor",
+    "merge_fleet",
+    "parse_shard_spec",
+    "valid_shard",
+]

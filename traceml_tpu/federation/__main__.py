@@ -1,0 +1,98 @@
+"""Standalone fleet-router process entry
+(docs/developer_guide/federation.md).
+
+Launched as ``python -m traceml_tpu.federation`` by ``traceml
+fleet-router`` with TRACEML_FLEET_* env config.  Binds the router HTTP
+server (port 0 → ephemeral, the bound port is advertised via
+``fleet_router_ready.json`` in ``TRACEML_FLEET_STATE_DIR``), then runs
+until SIGTERM/SIGINT — the same ready-file + signal contract as the
+aggregator child, so launcher/process.py supervision applies
+unchanged.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import threading
+import traceback
+from pathlib import Path
+
+from traceml_tpu.config import flags
+from traceml_tpu.federation.router import FleetRouter
+from traceml_tpu.utils.atomic_io import atomic_write_json
+from traceml_tpu.utils.error_log import get_error_log
+
+READY_FILE = "fleet_router_ready.json"
+
+
+def main() -> int:
+    stop_evt = threading.Event()
+
+    def _on_signal(signum, frame):  # noqa: ANN001
+        stop_evt.set()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+
+    from traceml_tpu.utils.orphan_watch import arm_parent_death_watch
+
+    arm_parent_death_watch(stop_evt.set)
+
+    state_dir = Path(flags.FLEET_STATE_DIR.get_str() or ".")
+    try:
+        router = FleetRouter(
+            shard_spec=flags.FLEET_SHARDS.get_str(),
+            host=flags.FLEET_HOST.get_str() or "127.0.0.1",
+            port=flags.FLEET_PORT.get_int(0),
+            cache_ttl=flags.FLEET_CACHE_TTL.get_float(0.5),
+            probe_s=flags.FLEET_PROBE_S.get_float(2.0),
+            hop_compress=flags.TRANSPORT_COMPRESS.get_str(),
+        )
+        if not router.ring.shards:
+            print(
+                "[TraceML] fleet-router: no shards configured "
+                "(set TRACEML_FLEET_SHARDS)",
+                file=sys.stderr,
+            )
+            return 2
+        router.start()
+        assert router.port is not None
+        atomic_write_json(
+            state_dir / READY_FILE,
+            {
+                "port": router.port,
+                "host": router.host,
+                "pid": os.getpid(),
+                "shards": router.ring.shards,
+            },
+        )
+        print(
+            f"[TraceML] fleet router: http://{router.host}:{router.port}/"
+            f"fleet ({len(router.ring.shards)} shards)"
+        )
+        while not stop_evt.wait(0.25):
+            pass
+        router.stop()
+        return 0
+    except Exception as exc:
+        get_error_log().error("fleet router fatal", exc)
+        try:
+            path = state_dir / "fleet_router_error.log"
+            path.parent.mkdir(parents=True, exist_ok=True)
+            with open(path, "a", encoding="utf-8") as fh:
+                fh.write(
+                    "".join(
+                        traceback.format_exception(
+                            type(exc), exc, exc.__traceback__
+                        )
+                    )
+                )
+        except Exception:
+            pass
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

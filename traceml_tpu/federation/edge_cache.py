@@ -1,0 +1,157 @@
+"""Shared edge cache for proxied shard responses
+(docs/developer_guide/federation.md).
+
+The router generalizes the r13 serving-tier cache shape — ``[token,
+raw, gzip]`` per entry, TTL-bounded — across the extra hop: however
+many viewers poll one hot session through the router, the owning shard
+sees at most ~one upstream fetch per (session, version) per TTL
+window.
+
+Three entry classes share the store, distinguished by key prefix:
+
+* ``("live", sid)`` — the assembled full payload.  Expired entries are
+  *revalidated*, not dropped: the refresh fetch carries
+  ``If-None-Match: "<token>"`` and a 304 renews the entry for free, so
+  an idle session costs the shard a header exchange per TTL, never a
+  body.
+* ``("delta", sid, since)`` — one delta response per client version
+  vector.  Viewers at the same ``since`` inside one TTL window share a
+  single upstream fetch (the common case: every tab of one dashboard
+  converges to the current token within a poll).  Idle 204s cache the
+  same way — an idle fleet costs ~one upstream poll per session per
+  TTL regardless of viewer count.
+* ``("summary", sid)`` — the final-summary body, revalidated by its
+  content-hash ETag like ``live``.
+
+Entries hold the *decoded* body (hop compression is stripped at fetch
+time); the gzip form clients negotiate is compressed once per entry
+and shared, exactly like ``SessionPublisher.full_body``.
+"""
+
+from __future__ import annotations
+
+import gzip
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, Optional, Tuple
+
+#: responses smaller than this are not worth gzipping (mirrors
+#: renderers/serving.GZIP_MIN_BYTES; duplicated to keep the federation
+#: tier importable without the renderer stack)
+GZIP_MIN_BYTES = 256
+
+#: bound on distinct cached responses — a hostile client cycling fake
+#: ``since`` tokens must not grow the router's memory unboundedly
+DEFAULT_MAX_ENTRIES = 4096
+
+
+class CacheEntry:
+    """One cached upstream response: status + validator + body forms."""
+
+    __slots__ = (
+        "status", "token", "body", "gzip_body", "built_mono", "headers"
+    )
+
+    def __init__(
+        self,
+        status: int,
+        token: Optional[str],
+        body: bytes,
+        headers: Dict[str, str],
+        built_mono: float,
+    ) -> None:
+        self.status = status
+        self.token = token
+        self.body = body
+        self.gzip_body: Optional[bytes] = None
+        self.built_mono = built_mono
+        self.headers = headers
+
+    def gzipped(self) -> Optional[bytes]:
+        """The shared gzip form (lazily built; None below the floor)."""
+        if len(self.body) < GZIP_MIN_BYTES:
+            return None
+        if self.gzip_body is None:
+            self.gzip_body = gzip.compress(self.body, mtime=0)
+        return self.gzip_body
+
+
+class EdgeCache:
+    """TTL + LRU bounded response cache; thread-safe (every router
+    handler thread reads and writes through it)."""
+
+    def __init__(
+        self, ttl: float = 0.5, max_entries: int = DEFAULT_MAX_ENTRIES
+    ) -> None:
+        self.ttl = max(0.0, float(ttl))
+        self.max_entries = max(16, int(max_entries))
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[Tuple, CacheEntry]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.revalidations = 0
+
+    def get(self, key: Tuple) -> Tuple[Optional[CacheEntry], bool]:
+        """(entry or None, fresh).  A stale entry is still returned —
+        the caller revalidates it upstream (If-None-Match) or serves it
+        marked stale when the owning shard is down."""
+        now = time.monotonic()
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                self.misses += 1
+                return None, False
+            self._entries.move_to_end(key)
+            fresh = (now - entry.built_mono) <= self.ttl
+            if fresh:
+                self.hits += 1
+            else:
+                self.misses += 1
+            return entry, fresh
+
+    def put(
+        self,
+        key: Tuple,
+        status: int,
+        token: Optional[str],
+        body: bytes,
+        headers: Optional[Dict[str, str]] = None,
+    ) -> CacheEntry:
+        entry = CacheEntry(
+            status, token, body, dict(headers or {}), time.monotonic()
+        )
+        with self._lock:
+            self._entries[key] = entry
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+        return entry
+
+    def renew(self, key: Tuple) -> None:
+        """Refresh an entry's TTL after an upstream 304 revalidation —
+        the body is proven current, only the clock moves."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                entry.built_mono = time.monotonic()
+                self.revalidations += 1
+
+    def invalidate_session(self, session_id: str) -> None:
+        """Drop every entry belonging to one session (shard flap —
+        the replacement shard may serve different content)."""
+        with self._lock:
+            doomed = [
+                k for k in self._entries if len(k) > 1 and k[1] == session_id
+            ]
+            for k in doomed:
+                del self._entries[k]
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "hits": self.hits,
+                "misses": self.misses,
+                "revalidations": self.revalidations,
+            }

@@ -1,0 +1,218 @@
+"""Shard health probing with capped-backoff
+(docs/developer_guide/federation.md).
+
+One daemon thread polls every shard's ``GET /api/sessions`` — the same
+document the rollup merges, so a single probe per interval buys three
+things at once:
+
+* **liveness** — a shard that stops answering flips to ``alive=False``
+  and its probe interval backs off exponentially (capped), so a dead
+  aggregator costs the router a bounded trickle of connection attempts,
+  not a hot retry loop;
+* **the location map** — each index names the sessions the shard
+  actually serves, which overrides the hash-ring guess for sessions
+  placed before the ring changed (the ring stays the fallback for
+  sessions no shard has claimed yet);
+* **a stale rollup fallback** — the last good index is retained, so a
+  dead shard's sessions degrade to marked-stale fleet rows instead of
+  vanishing or erroring the page.
+
+The router's own proxy traffic also feeds the monitor passively:
+``note_success``/``note_failure`` flip state without waiting for the
+next probe tick, so a shard crash surfaces at the first failed fetch.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from typing import Any, Callable, Dict, List, Optional
+
+#: backoff cap as a multiple of the base probe interval
+_BACKOFF_CAP_MULT = 16
+#: absolute ceiling on the probe interval, seconds
+_BACKOFF_CAP_S = 30.0
+
+
+class ShardState:
+    """Mutable per-shard record; reads/writes go through the monitor's
+    lock, snapshots hand out copies."""
+
+    __slots__ = (
+        "shard", "alive", "fail_count", "last_ok_ts",
+        "last_index", "next_probe_mono",
+    )
+
+    def __init__(self, shard: str) -> None:
+        self.shard = shard
+        self.alive = False  # unknown until the first probe answers
+        self.fail_count = 0
+        self.last_ok_ts: Optional[float] = None
+        self.last_index: Optional[Dict[str, Any]] = None
+        self.next_probe_mono = 0.0  # probe immediately on start
+
+    def summary(self) -> Dict[str, Any]:
+        return {
+            "shard": self.shard,
+            "alive": self.alive,
+            "fail_count": self.fail_count,
+            "last_ok_ts": self.last_ok_ts,
+            "sessions": len((self.last_index or {}).get("sessions") or []),
+        }
+
+
+def _default_fetch_index(shard: str, timeout: float) -> Dict[str, Any]:
+    """GET the shard's fleet index (raises on any failure)."""
+    req = urllib.request.Request(
+        f"http://{shard}/api/sessions",
+        headers={"Accept": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        data = json.loads(resp.read().decode("utf-8"))
+    if not isinstance(data, dict):
+        raise ValueError("fleet index is not an object")
+    return data
+
+
+class HealthMonitor:
+    """Probes shards on a capped-backoff schedule; thread-safe."""
+
+    def __init__(
+        self,
+        shards: List[str],
+        probe_s: float = 2.0,
+        fetch_index: Optional[Callable[[str, float], Dict[str, Any]]] = None,
+    ) -> None:
+        self.probe_s = max(0.05, float(probe_s))
+        self._fetch_index = fetch_index or _default_fetch_index
+        self._lock = threading.Lock()
+        self._states: Dict[str, ShardState] = {
+            s: ShardState(s) for s in shards
+        }
+        # session id → owning shard, learned from shard indexes; latest
+        # claim wins (a session never legitimately lives on two shards)
+        self._locations: Dict[str, str] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="traceml-fleet-health", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        thread = self._thread
+        self._thread = None
+        if thread is not None:
+            thread.join(timeout=5.0)
+
+    # -- probing ---------------------------------------------------------
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            now = time.monotonic()
+            with self._lock:
+                due = [
+                    st.shard
+                    for st in self._states.values()
+                    if st.next_probe_mono <= now
+                ]
+            for shard in due:
+                if self._stop.is_set():
+                    return
+                self.probe(shard)
+            # short slice so stop() and backoff-expiry are both prompt
+            self._stop.wait(min(self.probe_s, 0.25))
+
+    def probe(self, shard: str) -> bool:
+        """Probe one shard now (also callable from tests, which makes
+        the schedule deterministic)."""
+        timeout = min(max(self.probe_s, 0.25), 2.0)
+        try:
+            index = self._fetch_index(shard, timeout)
+        except Exception:
+            self.note_failure(shard)
+            return False
+        self.note_success(shard, index)
+        return True
+
+    def note_success(
+        self, shard: str, index: Optional[Dict[str, Any]] = None
+    ) -> None:
+        """Record a good exchange with ``shard`` (probe or proxy)."""
+        with self._lock:
+            st = self._states.get(shard)
+            if st is None:
+                return
+            st.alive = True
+            st.fail_count = 0
+            st.last_ok_ts = time.time()
+            st.next_probe_mono = time.monotonic() + self.probe_s
+            if index is not None:
+                st.last_index = index
+                for entry in index.get("sessions") or []:
+                    sid = (entry or {}).get("session")
+                    if isinstance(sid, str):
+                        self._locations[sid] = shard
+
+    def note_failure(self, shard: str) -> None:
+        """Record a failed exchange; backoff doubles per consecutive
+        failure up to the cap, so a dead shard is cheap to keep probing
+        and a recovered one is noticed within the cap."""
+        with self._lock:
+            st = self._states.get(shard)
+            if st is None:
+                return
+            st.alive = False
+            st.fail_count += 1
+            delay = min(
+                self.probe_s * (2 ** min(st.fail_count, 10)),
+                self.probe_s * _BACKOFF_CAP_MULT,
+                _BACKOFF_CAP_S,
+            )
+            st.next_probe_mono = time.monotonic() + delay
+
+    # -- reads -----------------------------------------------------------
+
+    def is_alive(self, shard: str) -> bool:
+        with self._lock:
+            st = self._states.get(shard)
+            return bool(st is not None and st.alive)
+
+    def is_down(self, shard: str, threshold: int = 2) -> bool:
+        """True once ``shard`` has failed ``threshold`` consecutive
+        exchanges — the router's short-circuit-to-stale trigger (one
+        transient failure must not flip live traffic to stale rows)."""
+        with self._lock:
+            st = self._states.get(shard)
+            return bool(
+                st is not None
+                and not st.alive
+                and st.fail_count >= int(threshold)
+            )
+
+    def location_of(self, session_id: str) -> Optional[str]:
+        """The shard that last claimed ``session_id`` in its index, or
+        None when no shard has (the caller falls back to the ring)."""
+        with self._lock:
+            return self._locations.get(session_id)
+
+    def last_index(self, shard: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            st = self._states.get(shard)
+            return st.last_index if st is not None else None
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [
+                self._states[s].summary() for s in sorted(self._states)
+            ]

@@ -1,0 +1,123 @@
+"""Consistent-hash ring: session ids → aggregator shards
+(docs/developer_guide/federation.md).
+
+The r13 delta protocol made shard affinity *optional* — the version
+token is entirely client-held and a garbled token means "full serve",
+so any shard can answer any viewer — but affinity is still what makes
+the edge cache and the per-shard publisher caches hot.  The ring gives
+every router instance the same session→shard mapping with zero
+coordination: hash points are derived from ``sha1("<shard>#<vnode>")``,
+which is stable across processes and Python versions (never the
+builtin ``hash()``, which is salted per process).
+
+Virtual nodes smooth the distribution: with ``vnodes=64`` per shard,
+a 4-shard ring keeps per-shard load within a few percent of even, and
+adding/removing one shard remaps only ~1/N of the sessions (pinned by
+tests/federation/test_hash_ring.py).
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import json
+import re
+from pathlib import Path
+from typing import List, Optional, Sequence
+
+#: hash points per shard — enough to keep a small ring near-uniform
+#: without making construction or the sorted-list bisect noticeable
+DEFAULT_VNODES = 64
+
+#: a shard address is host:port — the only shape the router dials;
+#: IPv6 hosts must be bracketed (``[::1]:9001``)
+_SHARD_RE = re.compile(
+    r"^(?:[A-Za-z0-9._\-]+|\[[0-9A-Fa-f:.]+\]):\d{1,5}$"
+)
+
+
+def valid_shard(shard: str) -> bool:
+    return bool(isinstance(shard, str) and _SHARD_RE.match(shard))
+
+
+def parse_shard_spec(spec: Optional[str]) -> List[str]:
+    """``TRACEML_FLEET_SHARDS`` value → ordered unique shard list.
+
+    Two grammars:
+
+    * a comma-separated ``host:port`` list (whitespace tolerated);
+    * a path ending in ``.json`` — a discovery file holding either a
+      bare list ``["h:p", ...]`` or ``{"shards": ["h:p", ...]}``, so an
+      external placement system can own the shard set.
+
+    Invalid entries are dropped (a fleet list with one typo must not
+    take the whole router down); an unreadable file yields ``[]``.
+    """
+    if not spec:
+        return []
+    spec = str(spec).strip()
+    if spec.endswith(".json"):
+        try:
+            data = json.loads(Path(spec).read_text(encoding="utf-8"))
+        except (OSError, ValueError):
+            return []
+        if isinstance(data, dict):
+            data = data.get("shards")
+        if not isinstance(data, list):
+            return []
+        raw = [s for s in data if isinstance(s, str)]
+    else:
+        raw = spec.split(",")
+    out: List[str] = []
+    for entry in raw:
+        entry = entry.strip()
+        if valid_shard(entry) and entry not in out:
+            out.append(entry)
+    return out
+
+
+def _point(shard: str, vnode: int) -> int:
+    digest = hashlib.sha1(f"{shard}#{vnode}".encode("utf-8")).digest()
+    return int.from_bytes(digest[:8], "big")
+
+
+class HashRing:
+    """Immutable after construction — the router swaps whole rings when
+    the shard set changes, so lookups never need a lock."""
+
+    def __init__(
+        self, shards: Sequence[str], vnodes: int = DEFAULT_VNODES
+    ) -> None:
+        self.shards: List[str] = list(dict.fromkeys(shards))
+        self.vnodes = max(1, int(vnodes))
+        points = []
+        for shard in self.shards:
+            for v in range(self.vnodes):
+                points.append((_point(shard, v), shard))
+        points.sort()
+        self._points = [p for p, _ in points]
+        self._owners = [s for _, s in points]
+
+    def __len__(self) -> int:
+        return len(self.shards)
+
+    def owner(self, session_id: str) -> Optional[str]:
+        """The shard owning ``session_id`` (None on an empty ring)."""
+        if not self._points:
+            return None
+        key = int.from_bytes(
+            hashlib.sha1(str(session_id).encode("utf-8")).digest()[:8], "big"
+        )
+        idx = bisect.bisect_right(self._points, key)
+        if idx == len(self._points):
+            idx = 0  # wrap: the ring is circular
+        return self._owners[idx]
+
+    def counts(self, session_ids: Sequence[str]) -> dict:
+        """Per-shard assignment counts — distribution diagnostics."""
+        out = {s: 0 for s in self.shards}
+        for sid in session_ids:
+            owner = self.owner(sid)
+            if owner is not None:
+                out[owner] += 1
+        return out

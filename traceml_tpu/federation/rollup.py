@@ -1,0 +1,179 @@
+"""Aggregator-of-aggregators rollup
+(docs/developer_guide/federation.md).
+
+``GET /api/fleet`` merges every shard's ``fleet_index()`` into one
+paginated view.  Per-shard fetches run concurrently under a single
+deadline — one slow shard delays the page by at most the deadline, and
+its sessions come from the health monitor's last good index, marked
+``stale`` — so the federated page is 502-free by construction: a shard
+can be slow, dead, or half-restarted and the worst outcome is a stale
+row.
+
+The merge is pure (dict in, dict out) so equivalence tests can pin it
+without sockets.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+#: default page size for the federated session table
+DEFAULT_PAGE_SIZE = 50
+MAX_PAGE_SIZE = 500
+
+#: diagnosis severity ranking for "worst primary diagnosis" — unknown
+#: severities rank between warning and error so they surface
+_SEVERITY_RANK = {
+    "info": 0,
+    "notice": 1,
+    "warning": 2,
+    "warn": 2,
+    "error": 4,
+    "critical": 5,
+    "fatal": 6,
+}
+
+
+def severity_rank(severity: Any) -> int:
+    return _SEVERITY_RANK.get(str(severity or "").strip().lower(), 3)
+
+
+def gather_indexes(
+    shards: List[str],
+    fetch_index,
+    deadline_s: float,
+) -> Tuple[Dict[str, Optional[Dict[str, Any]]], List[str]]:
+    """Fetch every shard's fleet index concurrently.
+
+    Returns ``(per_shard index-or-None, failed shard names)``.  Each
+    fetch gets the full deadline as its timeout; the join stops waiting
+    at the deadline, so total wall time ≈ ``deadline_s`` even when every
+    shard hangs.  Threads are daemon and abandoned on timeout — urllib's
+    socket timeout unblocks them shortly after.
+    """
+    results: Dict[str, Optional[Dict[str, Any]]] = {s: None for s in shards}
+    lock = threading.Lock()
+
+    def _one(shard: str) -> None:
+        try:
+            index = fetch_index(shard, deadline_s)
+        except Exception:
+            return
+        with lock:
+            results[shard] = index
+
+    threads = [
+        threading.Thread(
+            target=_one, args=(s,), name=f"traceml-fleet-gather", daemon=True
+        )
+        for s in shards
+    ]
+    for t in threads:
+        t.start()
+    stop_at = time.monotonic() + deadline_s
+    for t in threads:
+        t.join(timeout=max(0.0, stop_at - time.monotonic()))
+    with lock:
+        snapshot = dict(results)
+    failed = [s for s in shards if snapshot[s] is None]
+    return snapshot, failed
+
+
+def merge_fleet(
+    per_shard: Dict[str, Optional[Dict[str, Any]]],
+    stale_shards: Optional[List[str]] = None,
+    page: int = 0,
+    page_size: int = DEFAULT_PAGE_SIZE,
+) -> Dict[str, Any]:
+    """Merge per-shard fleet indexes into the federated rollup.
+
+    ``per_shard`` maps shard → its ``fleet_index()`` document (possibly
+    a cached one) or None when nothing is known.  Shards listed in
+    ``stale_shards`` contribute their sessions with ``stale: true`` —
+    the data is the last good observation, not live.
+    """
+    stale = set(stale_shards or [])
+    page = max(0, int(page))
+    page_size = min(max(1, int(page_size)), MAX_PAGE_SIZE)
+
+    sessions: List[Dict[str, Any]] = []
+    shard_rows: List[Dict[str, Any]] = []
+    state_counts: Dict[str, int] = {}
+    workload_counts: Dict[str, int] = {}
+    lost_ranks = 0
+    finished = 0
+    worst: Optional[Dict[str, Any]] = None
+    worst_rank = -1
+
+    for shard in sorted(per_shard):
+        index = per_shard[shard]
+        is_stale = shard in stale
+        entries = (index or {}).get("sessions") or []
+        shard_rows.append({
+            "shard": shard,
+            "alive": not is_stale and index is not None,
+            "stale": is_stale,
+            "sessions": len(entries),
+            "index_ts": (index or {}).get("ts"),
+        })
+        for entry in entries:
+            if not isinstance(entry, dict):
+                continue
+            row = dict(entry)
+            row["shard"] = shard
+            row["stale"] = is_stale
+            sessions.append(row)
+            if row.get("finished"):
+                finished += 1
+            ranks = row.get("ranks")
+            if isinstance(ranks, dict):
+                for state, n in ranks.items():
+                    if isinstance(n, int):
+                        state_counts[state] = state_counts.get(state, 0) + n
+                        if state == "lost":
+                            lost_ranks += n
+            workload = row.get("workload")
+            if isinstance(workload, str) and workload:
+                workload_counts[workload] = (
+                    workload_counts.get(workload, 0) + 1
+                )
+            diag = row.get("primary_diagnosis")
+            if isinstance(diag, dict) and diag.get("kind"):
+                rank = severity_rank(diag.get("severity"))
+                if rank > worst_rank:
+                    worst_rank = rank
+                    worst = dict(diag)
+                    worst["session"] = row.get("session")
+                    worst["shard"] = shard
+
+    # newest-activity first; (sid, shard) tiebreak keeps pagination
+    # deterministic when stamps collide
+    sessions.sort(
+        key=lambda r: (
+            -(r.get("last_update_ts") or 0.0),
+            str(r.get("session") or ""),
+            str(r.get("shard") or ""),
+        )
+    )
+    total = len(sessions)
+    start = page * page_size
+    return {
+        "version": 1,
+        "ts": time.time(),
+        "shards": shard_rows,
+        "totals": {
+            "sessions": total,
+            "finished": finished,
+            "live": total - finished,
+            "rank_states": state_counts,
+            "lost_ranks": lost_ranks,
+            "workloads": workload_counts,
+        },
+        "worst_diagnosis": worst,
+        "page": page,
+        "page_size": page_size,
+        "pages": (total + page_size - 1) // page_size if total else 0,
+        "sessions": sessions[start:start + page_size],
+    }

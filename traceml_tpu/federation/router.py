@@ -1,0 +1,652 @@
+"""Stateless fleet-router front-end
+(docs/developer_guide/federation.md).
+
+One HTTP server fronting N aggregator shards:
+
+* ``GET /api/live|/api/summary`` — proxied to the owning shard through
+  the :class:`~traceml_tpu.federation.edge_cache.EdgeCache`; validators
+  (``If-None-Match``/``ETag``/``X-TraceML-Token``) are honored on BOTH
+  hops, so a hot session costs the shard ~one upstream fetch per
+  version regardless of viewer count.
+* ``GET /api/stream`` — SSE piped through verbatim (no cache; the
+  publisher's per-connection delta state lives client-side as the
+  event id, so a router restart loses nothing — the browser reconnects
+  with ``Last-Event-ID`` and resumes on whichever router answers).
+* ``GET /api/fleet`` (+ ``/api/sessions`` alias, ``/fleet`` page) —
+  the aggregator-of-aggregators rollup (rollup.py).
+* ``GET /healthz`` — readiness + shard states + edge-cache stats.
+
+The router holds **no session state**: placement is the hash ring
+plus the health monitor's learned location map, and every cache entry
+is reconstructible from one upstream fetch.  Kill a router, start
+another, and every client resumes via its own tokens — the property
+the r13 protocol was designed around, preserved across the extra hop.
+
+Session ids arrive on an unauthenticated port and are validated with
+the SAME rule the shard registry enforces (``valid_session_id``)
+BEFORE any upstream URL is built — a hostile id is rejected at the
+edge, never proxied.
+"""
+
+from __future__ import annotations
+
+import gzip as _gzip
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+
+from traceml_tpu.aggregator.session_registry import valid_session_id
+from traceml_tpu.federation import rollup
+from traceml_tpu.federation.edge_cache import EdgeCache, GZIP_MIN_BYTES
+from traceml_tpu.federation.health import HealthMonitor
+from traceml_tpu.federation.ring import HashRing, parse_shard_spec
+from traceml_tpu.transport import compression
+from traceml_tpu.utils.error_log import get_error_log
+
+#: request header asking the shard to compress the hop body; the value
+#: is the codec name (resolved against the shard's available codecs)
+HOP_COMPRESS_HEADER = "X-TraceML-Hop-Compress"
+#: Content-Encoding prefix marking a hop-compressed body
+HOP_ENCODING_PREFIX = "x-traceml-"
+#: original body length of a hop-compressed response
+HOP_ORIG_LEN_HEADER = "X-TraceML-Orig-Len"
+
+#: a ``since`` token longer than this bypasses the edge cache (the
+#: publisher treats it as garbled anyway; not caching keeps a hostile
+#:  client from churning the LRU with garbage keys)
+_MAX_CACHED_SINCE = 256
+
+#: consecutive failures after which the router stops dialing a shard
+#: per-request and serves stale straight away (probes keep trying)
+_DOWN_AFTER_FAILURES = 2
+
+
+class ShardUnavailable(Exception):
+    """The owning shard could not be reached (or answered garbage)."""
+
+
+class FleetRouter:
+    """The router server.  Lifecycle mirrors BrowserDisplayDriver:
+    ``start()`` binds and serves on a daemon thread, ``stop()`` tears
+    down the server and the health monitor."""
+
+    def __init__(
+        self,
+        shards: Optional[List[str]] = None,
+        shard_spec: Optional[str] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        cache_ttl: float = 0.5,
+        probe_s: float = 2.0,
+        hop_compress: Optional[str] = None,
+        vnodes: Optional[int] = None,
+    ) -> None:
+        if shards is None:
+            shards = parse_shard_spec(shard_spec)
+        ring_kwargs = {} if vnodes is None else {"vnodes": vnodes}
+        self.ring = HashRing(shards, **ring_kwargs)
+        self.cache = EdgeCache(ttl=cache_ttl)
+        self.health = HealthMonitor(self.ring.shards, probe_s=probe_s)
+        self.hop_codec = compression.resolve_codec(hop_compress)
+        self._host = host
+        self._requested_port = port
+        self.port: Optional[int] = None
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stopping = threading.Event()
+        #: per-request upstream fetch timeout (tight: shards are LAN)
+        self.upstream_timeout = 5.0
+        #: rollup gather deadline — one slow shard stalls /api/fleet by
+        #: at most this long before its cached index substitutes
+        self.rollup_deadline = 1.0
+        #: SSE upstream read timeout; must exceed the shard heartbeat
+        self.sse_read_timeout = 30.0
+        self.upstream_fetches = 0  # bench/CI observability
+        #: the subset that moved a fresh body (status 200) — 204 delta
+        #: probes and 304 revalidations are header exchanges, so THIS is
+        #: the number the ≤ ~1-fetch-per-session-version gate bounds
+        self.upstream_fetches_200 = 0
+        self._counter_lock = threading.Lock()
+        #: single-flight: concurrent misses on one cache key coalesce
+        #: onto one upstream fetch (key → Event set when the leader's
+        #: fetch lands in the cache)
+        self._inflight: Dict[Tuple, threading.Event] = {}
+        self._inflight_lock = threading.Lock()
+
+    @property
+    def host(self) -> str:
+        return self._host
+
+    # -- placement -------------------------------------------------------
+
+    def owner_of(self, session_id: str) -> Optional[str]:
+        """Owning shard: the health monitor's learned location when a
+        shard has claimed the session, else the ring's guess."""
+        return self.health.location_of(session_id) or self.ring.owner(
+            session_id
+        )
+
+    def _shard_down(self, shard: str) -> bool:
+        return self.health.is_down(shard, _DOWN_AFTER_FAILURES)
+
+    # -- upstream fetch --------------------------------------------------
+
+    def _fetch(
+        self,
+        shard: str,
+        path_qs: str,
+        headers: Optional[Dict[str, str]] = None,
+        timeout: Optional[float] = None,
+    ) -> Tuple[int, Dict[str, str], bytes]:
+        """One upstream GET; hop compression decoded before return, so
+        callers and the cache always hold identity bodies."""
+        req = urllib.request.Request(
+            f"http://{shard}{path_qs}", headers=dict(headers or {})
+        )
+        if self.hop_codec:
+            req.add_header(HOP_COMPRESS_HEADER, self.hop_codec)
+        with self._counter_lock:
+            self.upstream_fetches += 1
+        try:
+            resp = urllib.request.urlopen(
+                req, timeout=timeout or self.upstream_timeout
+            )
+            with resp:
+                status = resp.status
+                rheaders = {k: v for k, v in resp.headers.items()}
+                body = resp.read()
+        except urllib.error.HTTPError as exc:
+            status = exc.code
+            rheaders = {k: v for k, v in (exc.headers or {}).items()}
+            body = exc.read() or b""
+        except (OSError, urllib.error.URLError) as exc:
+            self.health.note_failure(shard)
+            raise ShardUnavailable(f"{shard}: {exc}") from exc
+        enc = (rheaders.get("Content-Encoding") or "").lower()
+        if enc.startswith(HOP_ENCODING_PREFIX):
+            codec = enc[len(HOP_ENCODING_PREFIX):]
+            try:
+                orig = int(rheaders.get(HOP_ORIG_LEN_HEADER) or "0")
+                body = compression.decompress_bytes(body, codec, orig)
+            except (ValueError, compression.CompressionError) as exc:
+                self.health.note_failure(shard)
+                raise ShardUnavailable(
+                    f"{shard}: hop decompress failed: {exc}"
+                ) from exc
+            rheaders.pop("Content-Encoding", None)
+            rheaders.pop(HOP_ORIG_LEN_HEADER, None)
+        if status == 200:
+            with self._counter_lock:
+                self.upstream_fetches_200 += 1
+        self.health.note_success(shard)
+        return status, rheaders, body
+
+    def _fetch_index(self, shard: str, timeout: float) -> Dict[str, Any]:
+        """Fleet-index fetch for the rollup gather (hop-compressed)."""
+        status, _, body = self._fetch(
+            shard, "/api/sessions", timeout=timeout
+        )
+        if status != 200:
+            raise ShardUnavailable(f"{shard}: index status {status}")
+        data = json.loads(body.decode("utf-8"))
+        if not isinstance(data, dict):
+            raise ShardUnavailable(f"{shard}: index not an object")
+        return data
+
+    # -- rollup ----------------------------------------------------------
+
+    def fleet_rollup(
+        self, page: int = 0, page_size: int = rollup.DEFAULT_PAGE_SIZE
+    ) -> Dict[str, Any]:
+        per_shard, failed = rollup.gather_indexes(
+            self.ring.shards, self._fetch_index, self.rollup_deadline
+        )
+        stale: List[str] = []
+        for shard in list(per_shard):
+            index = per_shard[shard]
+            if index is not None:
+                self.health.note_success(shard, index)
+            else:
+                stale.append(shard)
+                per_shard[shard] = self.health.last_index(shard)
+        return rollup.merge_fleet(
+            per_shard, stale_shards=stale, page=page, page_size=page_size
+        )
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> None:
+        self._stopping.clear()
+        self.health.start()
+        router = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # keep-alive: dashboards poll every couple of seconds and a
+            # fleet of viewers polls constantly — per-request TCP + a
+            # fresh handler thread per connection is the dominant cost
+            # at fan-in scale.  `_send` always writes Content-Length, so
+            # persistent connections are framing-safe; the SSE proxy
+            # opts out below (its body has no length).
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # silence
+                pass
+
+            def _accepts_gzip(self) -> bool:
+                return "gzip" in (self.headers.get("Accept-Encoding") or "")
+
+            def _send(
+                self,
+                code: int,
+                body: bytes,
+                ctype: str,
+                headers: Optional[Dict[str, str]] = None,
+                gzip_body: Optional[bytes] = None,
+            ) -> None:
+                """``gzip_body`` is the entry's shared pre-compressed
+                form — the router never gzips per request."""
+                enc = None
+                if (
+                    gzip_body is not None
+                    and code == 200
+                    and self._accepts_gzip()
+                ):
+                    body = gzip_body
+                    enc = "gzip"
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                if enc:
+                    self.send_header("Content-Encoding", enc)
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _send_json_error(self, code: int, message: str) -> None:
+                self._send(
+                    code,
+                    json.dumps({"error": message}).encode(),
+                    "application/json",
+                )
+
+            def _resolve_session(
+                self, query: Dict[str, list]
+            ) -> Optional[str]:
+                """Validated session id, or None (already answered)."""
+                sid = (query.get("session") or [None])[0]
+                if not valid_session_id(sid):
+                    self._send_json_error(404, "unknown session")
+                    return None
+                return sid
+
+            # -- cached proxy core --------------------------------------
+
+            def _serve_entry(
+                self, entry, cache_state: str, shard: str, stale: bool
+            ) -> None:
+                headers: Dict[str, str] = {
+                    "X-TraceML-Edge-Cache": cache_state,
+                    "X-TraceML-Shard": shard,
+                }
+                if stale:
+                    headers["X-TraceML-Stale"] = "1"
+                if entry.token:
+                    headers["ETag"] = f'"{entry.token}"'
+                    headers["X-TraceML-Token"] = entry.token
+                inm = (self.headers.get("If-None-Match") or "").strip()
+                if (
+                    entry.status == 200
+                    and entry.token
+                    and inm == f'"{entry.token}"'
+                ):
+                    self._send(304, b"", "application/json", headers=headers)
+                    return
+                ctype = entry.headers.get(
+                    "Content-Type", "application/json"
+                )
+                gz = (
+                    entry.gzipped()
+                    if entry.status == 200
+                    and len(entry.body) >= GZIP_MIN_BYTES
+                    else None
+                )
+                self._send(
+                    entry.status, entry.body, ctype,
+                    headers=headers, gzip_body=gz,
+                )
+
+            def _token_of(self, headers: Dict[str, str]) -> Optional[str]:
+                token = headers.get("X-TraceML-Token")
+                if token:
+                    return token
+                etag = (headers.get("ETag") or "").strip()
+                if etag.startswith('"') and etag.endswith('"'):
+                    return etag[1:-1]
+                return etag or None
+
+            def _keep_headers(
+                self, headers: Dict[str, str]
+            ) -> Dict[str, str]:
+                out = {}
+                ctype = headers.get("Content-Type")
+                if ctype:
+                    out["Content-Type"] = ctype
+                return out
+
+            def _proxy_cached(
+                self, key: Tuple, sid: str, upstream_path: str,
+                revalidate: bool,
+            ) -> None:
+                """Serve ``upstream_path`` through the edge cache:
+                fresh → no upstream I/O; expired + validator →
+                If-None-Match revalidation; miss → plain fetch; owning
+                shard down → last entry marked stale (503 only when
+                nothing was ever cached).  Concurrent misses on one key
+                coalesce: one leader fetches, the rest wait for its
+                entry — a viewer stampede costs the shard ONE fetch."""
+                shard = router.owner_of(sid)
+                if shard is None:
+                    self._send_json_error(503, "no shards configured")
+                    return
+                entry, fresh = router.cache.get(key)
+                if entry is not None and fresh:
+                    self._serve_entry(entry, "hit", shard, stale=False)
+                    return
+                leader = False
+                with router._inflight_lock:
+                    flight = router._inflight.get(key)
+                    if flight is None:
+                        router._inflight[key] = threading.Event()
+                        leader = True
+                if not leader:
+                    flight.wait(router.upstream_timeout)
+                    entry, fresh = router.cache.get(key)
+                    if entry is not None and fresh:
+                        self._serve_entry(
+                            entry, "hit", shard, stale=False
+                        )
+                        return
+                    # leader failed or the entry aged out mid-wait:
+                    # fetch ourselves (without claiming leadership —
+                    # a duplicate fetch on this rare path is fine)
+                    self._proxy_fetch(key, sid, shard, upstream_path,
+                                      revalidate, entry)
+                    return
+                try:
+                    self._proxy_fetch(key, sid, shard, upstream_path,
+                                      revalidate, entry)
+                finally:
+                    with router._inflight_lock:
+                        done = router._inflight.pop(key, None)
+                    if done is not None:
+                        done.set()
+
+            def _proxy_fetch(
+                self, key: Tuple, sid: str, shard: str,
+                upstream_path: str, revalidate: bool, entry,
+            ) -> None:
+                """The leader's half of ``_proxy_cached``: one upstream
+                round-trip, landing the result in the cache."""
+                if router._shard_down(shard):
+                    if entry is not None:
+                        self._serve_entry(entry, "stale", shard, stale=True)
+                    else:
+                        self._send_json_error(503, "shard unavailable")
+                    return
+                upstream_headers: Dict[str, str] = {}
+                if revalidate and entry is not None and entry.token:
+                    upstream_headers["If-None-Match"] = f'"{entry.token}"'
+                try:
+                    status, rheaders, body = router._fetch(
+                        shard, upstream_path, headers=upstream_headers
+                    )
+                except ShardUnavailable:
+                    if entry is not None:
+                        self._serve_entry(entry, "stale", shard, stale=True)
+                    else:
+                        self._send_json_error(503, "shard unavailable")
+                    return
+                if status == 304 and entry is not None:
+                    router.cache.renew(key)
+                    self._serve_entry(
+                        entry, "revalidated", shard, stale=False
+                    )
+                    return
+                new = router.cache.put(
+                    key, status, self._token_of(rheaders), body,
+                    headers=self._keep_headers(rheaders),
+                )
+                self._serve_entry(new, "miss", shard, stale=False)
+
+            # -- routes -------------------------------------------------
+
+            def _api_live(self, query: Dict[str, list]) -> None:
+                sid = self._resolve_session(query)
+                if sid is None:
+                    return
+                since = (query.get("since") or [None])[0]
+                if since is None:
+                    self._proxy_cached(
+                        ("live", sid), sid,
+                        "/api/live?session="
+                        + urllib.parse.quote(sid, safe=""),
+                        revalidate=True,
+                    )
+                    return
+                if len(since) > _MAX_CACHED_SINCE:
+                    # hostile-length token: the publisher treats it as
+                    # garbled (full serve); don't let it churn the LRU
+                    self._send_json_error(404, "unknown session")
+                    return
+                self._proxy_cached(
+                    ("delta", sid, since), sid,
+                    "/api/live?session="
+                    + urllib.parse.quote(sid, safe="")
+                    + "&since="
+                    + urllib.parse.quote(since, safe=""),
+                    revalidate=False,
+                )
+
+            def _api_summary(self, query: Dict[str, list]) -> None:
+                sid = self._resolve_session(query)
+                if sid is None:
+                    return
+                self._proxy_cached(
+                    ("summary", sid), sid,
+                    "/api/summary?session="
+                    + urllib.parse.quote(sid, safe=""),
+                    revalidate=True,
+                )
+
+            def _api_stream(self, query: Dict[str, list]) -> None:
+                sid = self._resolve_session(query)
+                if sid is None:
+                    return
+                shard = router.owner_of(sid)
+                if shard is None or router._shard_down(shard):
+                    self._send_json_error(503, "shard unavailable")
+                    return
+                since = self.headers.get("Last-Event-ID") or (
+                    query.get("since") or [None]
+                )[0]
+                path = "/api/stream?session=" + urllib.parse.quote(
+                    sid, safe=""
+                )
+                headers = {}
+                if since:
+                    headers["Last-Event-ID"] = since
+                req = urllib.request.Request(
+                    f"http://{shard}{path}", headers=headers
+                )
+                try:
+                    resp = urllib.request.urlopen(
+                        req, timeout=router.sse_read_timeout
+                    )
+                except urllib.error.HTTPError as exc:
+                    body = exc.read() or b""
+                    self._send(
+                        exc.code, body, "application/json",
+                        headers={"X-TraceML-Shard": shard},
+                    )
+                    return
+                except (OSError, urllib.error.URLError):
+                    router.health.note_failure(shard)
+                    self._send_json_error(503, "shard unavailable")
+                    return
+                router.health.note_success(shard)
+                # unbounded body: end-of-stream is connection close
+                self.close_connection = True
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Connection", "close")
+                self.send_header("X-TraceML-Shard", shard)
+                self.end_headers()
+                try:
+                    while not router._stopping.is_set():
+                        try:
+                            chunk = resp.read1(65536)
+                        except socket.timeout:
+                            continue
+                        except OSError:
+                            break
+                        if not chunk:
+                            break  # shard closed: client reconnects
+                        self.wfile.write(chunk)
+                        self.wfile.flush()
+                finally:
+                    try:
+                        resp.close()
+                    except OSError:
+                        pass
+
+            def _api_fleet(
+                self, query: Dict[str, list],
+                page_size_default: int = rollup.DEFAULT_PAGE_SIZE,
+            ) -> None:
+                def _int(name: str, default: int) -> int:
+                    raw = (query.get(name) or [None])[0]
+                    try:
+                        return int(raw)
+                    except (TypeError, ValueError):
+                        return default
+
+                page = max(0, _int("page", 0))
+                page_size = _int("page_size", page_size_default)
+                key = ("fleet", None, page, page_size)
+                entry, fresh = router.cache.get(key)
+                if entry is not None and fresh:
+                    self._serve_entry(entry, "hit", "*", stale=False)
+                    return
+                merged = router.fleet_rollup(
+                    page=page, page_size=page_size
+                )
+                body = json.dumps(merged).encode()
+                new = router.cache.put(
+                    key, 200, None, body,
+                    headers={"Content-Type": "application/json"},
+                )
+                self._serve_entry(new, "miss", "*", stale=False)
+
+            def do_GET(self):  # noqa: N802
+                try:
+                    parts = urllib.parse.urlsplit(self.path)
+                    route = parts.path
+                    query = urllib.parse.parse_qs(parts.query)
+                    if route == "/" or route.startswith((
+                        "/fleet", "/index"
+                    )):
+                        from traceml_tpu.aggregator.display_drivers.\
+                            browser_sections.federation import (
+                            federation_page,
+                        )
+
+                        self._send(
+                            200,
+                            federation_page().encode(),
+                            "text/html; charset=utf-8",
+                        )
+                    elif route.startswith("/healthz"):
+                        self._send(
+                            200,
+                            json.dumps({
+                                "ok": True,
+                                "role": "fleet-router",
+                                "ts": time.time(),
+                                "shards": router.health.snapshot(),
+                                "cache": router.cache.stats(),
+                                "upstream_fetches":
+                                    router.upstream_fetches,
+                                "upstream_fetches_200":
+                                    router.upstream_fetches_200,
+                            }).encode(),
+                            "application/json",
+                        )
+                    elif route.startswith("/api/fleet"):
+                        self._api_fleet(query)
+                    elif route.startswith("/api/sessions"):
+                        self._api_fleet(
+                            query,
+                            page_size_default=rollup.MAX_PAGE_SIZE,
+                        )
+                    elif route.startswith("/api/stream"):
+                        self._api_stream(query)
+                    elif route.startswith("/api/live"):
+                        self._api_live(query)
+                    elif route.startswith("/api/summary"):
+                        self._api_summary(query)
+                    else:
+                        self._send(404, b"not found", "text/plain")
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+                except Exception as exc:
+                    try:
+                        self._send_json_error(500, str(exc))
+                    except Exception:
+                        pass
+
+        class _Server(ThreadingHTTPServer):
+            # same deep backlog rationale as the shard dashboard: the
+            # router concentrates EVERY viewer's connections
+            request_queue_size = 128
+            # handler threads are daemons and may sit in readline on a
+            # kept-alive connection — server_close must not wait on them
+            block_on_close = False
+
+        try:
+            self._httpd = _Server(
+                (self._host, self._requested_port), Handler
+            )
+        except OSError as exc:
+            self.health.stop()
+            get_error_log().warning("fleet router bind failed", exc)
+            raise
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="traceml-fleet-router",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stopping.set()
+        if self._httpd is not None:
+            try:
+                self._httpd.shutdown()
+                self._httpd.server_close()
+            except Exception:
+                pass
+            self._httpd = None
+        thread = self._thread
+        self._thread = None
+        if thread is not None:
+            thread.join(timeout=5.0)
+        self.health.stop()

@@ -1,7 +1,8 @@
 """``traceml-tpu`` CLI
 (reference: src/traceml_ai/launcher/cli.py:24-320).
 
-Subcommands: run, watch, view, compare, inspect.
+Subcommands: run, watch, view, compare, inspect, lint, profile,
+fleet-router.
 """
 
 from __future__ import annotations
@@ -83,6 +84,54 @@ def _build_parser() -> argparse.ArgumentParser:
     watch.add_argument(
         "--browser", action="store_true",
         help="serve the browser dashboard over this session",
+    )
+    watch.add_argument(
+        "--host", default=None,
+        help="bind address for --browser (default 127.0.0.1)",
+    )
+    watch.add_argument(
+        "--port", type=int, default=None,
+        help=(
+            "bind port for --browser (default ephemeral; pin it when "
+            "the dashboard is a fleet-router shard)"
+        ),
+    )
+
+    fleet = sub.add_parser(
+        "fleet-router",
+        help=(
+            "front N aggregator shards with one stateless router: "
+            "consistent-hash session placement, shared edge cache, "
+            "federated /fleet rollup"
+        ),
+    )
+    fleet.add_argument(
+        "--shards", default=None,
+        help=(
+            "comma-separated host:port shard list, or a shards.json "
+            "discovery file (default: TRACEML_FLEET_SHARDS)"
+        ),
+    )
+    fleet.add_argument("--host", default=None, help="router bind address")
+    fleet.add_argument(
+        "--port", type=int, default=None,
+        help="router bind port (default ephemeral)",
+    )
+    fleet.add_argument(
+        "--cache-ttl", dest="cache_ttl", type=float, default=None,
+        help="edge-cache reuse window in seconds",
+    )
+    fleet.add_argument(
+        "--probe-interval", dest="probe_s", type=float, default=None,
+        help="base shard health-probe interval in seconds",
+    )
+    fleet.add_argument(
+        "--state-dir", dest="state_dir", default=None,
+        help="directory for the ready file + crash logs (default temp)",
+    )
+    fleet.add_argument(
+        "--max-restarts", dest="max_restarts", type=int, default=None,
+        help="bounded crash-resume budget for the router process",
     )
 
     view = sub.add_parser("view", help="print a stored final summary")
@@ -217,6 +266,20 @@ def main(argv: Optional[List[str]] = None) -> int:
             Path(args.session_dir),
             interval=args.interval,
             browser=args.browser,
+            host=args.host,
+            port=args.port,
+        )
+    if args.command == "fleet-router":
+        from traceml_tpu.launcher.fleet_cmd import run_fleet_router
+
+        return run_fleet_router(
+            shards=args.shards,
+            host=args.host,
+            port=args.port,
+            cache_ttl=args.cache_ttl,
+            probe_s=args.probe_s,
+            state_dir=Path(args.state_dir) if args.state_dir else None,
+            max_restarts=args.max_restarts,
         )
     if args.command == "lint":
         from traceml_tpu.launcher.lint_cmd import run_lint_cmd

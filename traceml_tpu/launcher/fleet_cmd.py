@@ -1,0 +1,157 @@
+"""``traceml-tpu fleet-router`` — supervise the fleet-router process
+(docs/developer_guide/federation.md).
+
+The router runs as its own child (``python -m traceml_tpu.federation``)
+under the same supervision contract as the aggregator: env-serialized
+config, a ready file advertising the bound port, a stderr ring for
+crash logs, and bounded crash-resume pinned to the original port so
+every viewer's reconnect lands — the router is stateless, so a restart
+loses nothing but a warm edge cache.
+"""
+
+from __future__ import annotations
+
+import sys
+import tempfile
+import threading
+from pathlib import Path
+from typing import Dict, Optional
+
+from traceml_tpu.config import flags
+from traceml_tpu.launcher.process import (
+    SupervisedChild,
+    python_argv,
+    spawn_supervised,
+    terminate,
+    wait_for_ready_file,
+)
+
+READY_FILE = "fleet_router_ready.json"
+DEFAULT_MAX_RESTARTS = 3
+
+
+def _router_env(
+    shards: str,
+    host: str,
+    port: int,
+    cache_ttl: Optional[float],
+    probe_s: Optional[float],
+    state_dir: Path,
+) -> Dict[str, str]:
+    env = {
+        flags.FLEET_SHARDS.name: shards,
+        flags.FLEET_HOST.name: host,
+        flags.FLEET_PORT.name: str(port),
+        flags.FLEET_STATE_DIR.name: str(state_dir),
+    }
+    if cache_ttl is not None:
+        env[flags.FLEET_CACHE_TTL.name] = str(cache_ttl)
+    if probe_s is not None:
+        env[flags.FLEET_PROBE_S.name] = str(probe_s)
+    return env
+
+
+def _spawn_router(
+    env: Dict[str, str], state_dir: Path
+) -> Optional[SupervisedChild]:
+    ready_path = state_dir / READY_FILE
+    try:
+        ready_path.unlink()  # a stale file advertises a dead pid
+    except OSError:
+        pass
+    child = spawn_supervised(
+        python_argv("traceml_tpu.federation"),
+        label="fleet-router",
+        env=env,
+    )
+    ready = wait_for_ready_file(ready_path, timeout=20.0)
+    if ready is None or child.poll() is not None:
+        terminate(child.proc, grace_sec=2)
+        return None
+    return child
+
+
+def run_fleet_router(
+    shards: Optional[str] = None,
+    host: Optional[str] = None,
+    port: Optional[int] = None,
+    cache_ttl: Optional[float] = None,
+    probe_s: Optional[float] = None,
+    state_dir: Optional[Path] = None,
+    max_restarts: Optional[int] = None,
+) -> int:
+    """Run the supervised router in the foreground until ^C."""
+    shards = shards or flags.FLEET_SHARDS.get_str()
+    if not shards:
+        print(
+            "traceml-tpu fleet-router: no shards — pass --shards "
+            "host:port,host:port (or a shards.json path), or set "
+            f"{flags.FLEET_SHARDS.name}",
+            file=sys.stderr,
+        )
+        return 2
+    host = host or flags.FLEET_HOST.get_str() or "127.0.0.1"
+    port = flags.FLEET_PORT.get_int(0) if port is None else int(port)
+    if max_restarts is None:
+        max_restarts = flags.AGG_MAX_RESTARTS.get_int(DEFAULT_MAX_RESTARTS)
+    if state_dir is None:
+        state_dir = Path(tempfile.mkdtemp(prefix="traceml-fleet-"))
+    state_dir = Path(state_dir)
+    state_dir.mkdir(parents=True, exist_ok=True)
+
+    env = _router_env(shards, host, port, cache_ttl, probe_s, state_dir)
+    child = _spawn_router(env, state_dir)
+    if child is None:
+        print(
+            "traceml-tpu fleet-router: router failed to start "
+            f"(see {state_dir})",
+            file=sys.stderr,
+        )
+        return 1
+    ready = wait_for_ready_file(state_dir / READY_FILE, timeout=1.0) or {}
+    bound_port = int(ready.get("port") or 0)
+    print(
+        f"[TraceML] fleet router up: http://{host}:{bound_port}/fleet "
+        f"(ready file: {state_dir / READY_FILE})"
+    )
+
+    stop_evt = threading.Event()
+    from traceml_tpu.utils.orphan_watch import arm_parent_death_watch
+
+    arm_parent_death_watch(stop_evt.set)
+    restarts = 0
+    try:
+        while not stop_evt.wait(0.25):
+            if child.poll() is None:
+                continue
+            child.write_crash_log(state_dir)
+            if restarts >= max_restarts:
+                print(
+                    "traceml-tpu fleet-router: router died "
+                    f"({child.describe_exit()}) after {restarts} "
+                    "restart(s) — giving up",
+                    file=sys.stderr,
+                )
+                return 1
+            restarts += 1
+            print(
+                f"[TraceML] fleet router died ({child.describe_exit()}); "
+                f"restart {restarts}/{max_restarts} on port {bound_port}",
+                file=sys.stderr,
+            )
+            # pin the original port: bookmarked pages and dashboards
+            # keep their URL across the respawn
+            env[flags.FLEET_PORT.name] = str(bound_port)
+            child = _spawn_router(env, state_dir)
+            if child is None:
+                print(
+                    "traceml-tpu fleet-router: restart failed",
+                    file=sys.stderr,
+                )
+                return 1
+        return 0
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        if child is not None:
+            terminate(child.proc, grace_sec=5)

@@ -123,14 +123,18 @@ def _snapshot(session_dir: Path, state: Optional[_WatchState] = None) -> str:
 
 
 def run_watch(
-    session_dir: Path, interval: float = 1.0, browser: bool = False
+    session_dir: Path,
+    interval: float = 1.0,
+    browser: bool = False,
+    host: Optional[str] = None,
+    port: Optional[int] = None,
 ) -> int:
     session_dir = Path(session_dir)
     if not session_dir.exists():
         print(f"no session at {session_dir}")
         return 1
     if browser:
-        return _run_watch_browser(session_dir)
+        return _run_watch_browser(session_dir, host=host, port=port)
     state: Optional[_WatchState] = None
     try:
         while True:
@@ -152,9 +156,15 @@ def run_watch(
             state.close()
 
 
-def _run_watch_browser(session_dir: Path) -> int:
+def _run_watch_browser(
+    session_dir: Path,
+    host: Optional[str] = None,
+    port: Optional[int] = None,
+) -> int:
     """Serve the browser dashboard over an existing session (live or
-    post-hoc): `traceml-tpu watch --browser <session_dir>`."""
+    post-hoc): `traceml-tpu watch --browser <session_dir>`.  A pinned
+    ``--port`` makes the dashboard addressable as a fleet-router shard
+    (docs/developer_guide/federation.md)."""
     import dataclasses
 
     from traceml_tpu.aggregator.display_drivers.browser import (
@@ -171,7 +181,9 @@ def _run_watch_browser(session_dir: Path) -> int:
         db_path: Path
         settings: TraceMLSettings
 
-    driver = BrowserDisplayDriver()
+    driver = BrowserDisplayDriver(
+        host=host or "127.0.0.1", port=port or 0
+    )
     driver.start(_Ctx(session_dir / "telemetry.sqlite", settings))
     if driver.port is None:
         print("dashboard failed to start")
