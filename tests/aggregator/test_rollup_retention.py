@@ -1,0 +1,320 @@
+"""Rollup tier goldens (ISSUE r18): bit-exact fold, prune atomicity,
+and stitched reads matching an unbounded reference.
+
+The fold runs vectorized (numpy) on the hot prune path with the scalar
+Python loop as its golden reference — the ColumnarFallback discipline:
+the vectorized sums are cumsum prefix-differences, the exact IEEE
+left-fold the scalar loop replays, so equality below is ``==``, not
+``approx``.
+"""
+
+from __future__ import annotations
+
+import random
+import sqlite3
+
+import pytest
+
+from traceml_tpu.aggregator import rollup
+from traceml_tpu.aggregator.rollup import (
+    DEFAULT_TIERS,
+    RollupEngine,
+    fold_buckets,
+    fold_buckets_reference,
+    parse_tiers,
+)
+from traceml_tpu.aggregator.sqlite_writer import SQLiteWriter
+from traceml_tpu.telemetry.envelope import (
+    SenderIdentity,
+    build_telemetry_envelope,
+)
+
+
+# -- fold goldens ----------------------------------------------------------
+
+
+def _ragged_arrivals(rng, n):
+    """Out-of-order, duplicate-timestamp, cluster-y arrivals — the shape
+    retries and multi-rank interleave actually produce."""
+    ts, steps, vals = [], [], []
+    t = rng.uniform(0, 50)
+    for i in range(n):
+        if rng.random() < 0.15:
+            t -= rng.uniform(0, 5)  # out-of-order replay
+        elif rng.random() < 0.2:
+            pass  # duplicate timestamp
+        else:
+            t += rng.expovariate(1.0)
+        ts.append(t)
+        steps.append(i if rng.random() > 0.1 else None)
+        vals.append(rng.uniform(-1e3, 1e6))
+    return ts, steps, vals
+
+
+@pytest.mark.parametrize("width", [10.0, 60.0, 7.5])
+def test_fold_bit_exact_on_ragged_arrivals(width):
+    rng = random.Random(20260808)
+    for _ in range(60):
+        ts, steps, vals = _ragged_arrivals(rng, rng.randrange(1, 400))
+        fast = fold_buckets(ts, steps, vals, width)
+        ref = fold_buckets_reference(ts, steps, vals, width)
+        assert len(fast) == len(ref)
+        for f, r in zip(fast, ref):
+            # tuple-wide equality: bucket_ts, count, sum, min, max,
+            # sumsq, step_min, step_max — all bit-exact
+            assert f == r
+
+
+def test_fold_empty_and_singleton():
+    assert fold_buckets([], [], [], 10.0) == []
+    one = fold_buckets([12.3], [7], [4.5], 10.0)
+    assert one == fold_buckets_reference([12.3], [7], [4.5], 10.0)
+    assert one[0][0] == 10.0  # bucket floor
+    assert one[0][1] == 1
+    assert one[0][6] == 7 and one[0][7] == 7
+
+
+def test_fold_all_none_steps_keeps_value_stats():
+    ts = [1.0, 2.0, 11.0]
+    vals = [3.0, 4.0, 5.0]
+    out = fold_buckets(ts, [None] * 3, vals, 10.0)
+    assert [b[1] for b in out] == [2, 1]
+    assert all(b[6] is None and b[7] is None for b in out)
+    assert out == fold_buckets_reference(ts, [None] * 3, vals, 10.0)
+
+
+def test_parse_tiers_grammar_and_fallback():
+    assert parse_tiers("10:21600,60:1209600") == DEFAULT_TIERS
+    assert parse_tiers("5:100") == ((5.0, 100.0),)
+    # malformed → defaults, never raises (env flags must not throw)
+    for bad in ("", "abc", "10:-5", "0:100", "10:100,junk", None):
+        assert parse_tiers(bad) == DEFAULT_TIERS
+
+
+# -- writer integration: fold-at-prune invariant ---------------------------
+
+
+def _ident(session, rank):
+    return SenderIdentity(
+        session_id=session, global_rank=rank, local_rank=rank,
+        world_size=2, node_rank=0, hostname="host-0", pid=100 + rank,
+    )
+
+
+def _ingest_steps(w, session, rank, n, base_ms=100.0, dt=0.4):
+    for step in range(1, n + 1):
+        w.ingest(build_telemetry_envelope(
+            "step_time",
+            {"step_time": [{
+                "step": step, "timestamp": step * dt, "clock": "host",
+                "events": {"_traceml_internal:step_time": {
+                    "cpu_ms": base_ms + (step % 7) * 0.3, "count": 1,
+                }},
+            }]},
+            identity=_ident(session, rank),
+        ))
+
+
+def test_prune_folds_doomed_rows_every_row_raw_or_rolled(tmp_path):
+    db = tmp_path / "t.sqlite"
+    w = SQLiteWriter(db, summary_window_rows=20, retention_factor=1.5)
+    w.start()
+    for rank in (0, 1):
+        _ingest_steps(w, "s1", rank, 200)
+    w.force_flush()
+    assert w.finalize()
+
+    conn = sqlite3.connect(db)
+    for rank in (0, 1):
+        raw = conn.execute(
+            "SELECT COUNT(*) FROM step_time_samples WHERE global_rank=?",
+            (rank,),
+        ).fetchone()[0]
+        folded = conn.execute(
+            "SELECT COALESCE(SUM(count), 0) FROM rollup_samples_10s"
+            " WHERE grain='rank' AND grain_key=? AND metric='step_ms'",
+            (str(rank),),
+        ).fetchone()[0]
+        # THE invariant: every ingested row is raw or rolled up, never
+        # neither (the fold commits in the prune's transaction)
+        assert raw + folded == 200
+        assert raw == 30  # 20 × 1.5
+    # both tiers written by every fold (1m decay-safety)
+    m1 = conn.execute(
+        "SELECT COALESCE(SUM(count), 0) FROM rollup_samples_1m"
+        " WHERE grain='rank' AND metric='step_ms'"
+    ).fetchone()[0]
+    assert m1 == 2 * 170
+    # host grain merges both ranks via the UPSERT
+    host = conn.execute(
+        "SELECT COALESCE(SUM(count), 0) FROM rollup_samples_10s"
+        " WHERE grain='host' AND grain_key='host-0' AND metric='step_ms'"
+    ).fetchone()[0]
+    assert host == 2 * 170
+    conn.close()
+
+
+def test_rollup_kill_switch_discards_history(tmp_path, monkeypatch):
+    monkeypatch.setenv("TRACEML_ROLLUP", "0")
+    db = tmp_path / "t.sqlite"
+    w = SQLiteWriter(db, summary_window_rows=20, retention_factor=1.5)
+    w.start()
+    _ingest_steps(w, "s1", 0, 200)
+    w.force_flush()
+    assert w.finalize()
+    assert w.stats()["rollup"] == {"enabled": False}
+    conn = sqlite3.connect(db)
+    assert conn.execute(
+        "SELECT name FROM sqlite_master WHERE name='rollup_samples_10s'"
+    ).fetchone() is None
+    conn.close()
+
+
+def test_crash_atomicity_rollback_leaves_raw_rows_intact(tmp_path):
+    """A crash between fold and delete must never surface: both ride
+    one transaction, so a rollback restores 'all rows raw' and a commit
+    lands 'doomed rows rolled + deleted' — never neither."""
+    db = tmp_path / "t.sqlite"
+    w = SQLiteWriter(db, summary_window_rows=500, retention_factor=1.0)
+    w.start()
+    _ingest_steps(w, "s1", 0, 100)  # under retention: no prune yet
+    w.force_flush()
+    assert w.finalize()
+
+    conn = sqlite3.connect(db)
+    conn.row_factory = sqlite3.Row
+    engine = RollupEngine()
+    engine.init_schema(conn)
+    conn.commit()
+    watermark = conn.execute(
+        "SELECT id FROM step_time_samples ORDER BY id LIMIT 1 OFFSET 59"
+    ).fetchone()[0]
+
+    def prune_txn(c):
+        engine.fold_doomed(c, "step_time_samples", "s1", 0, watermark)
+        c.execute(
+            "DELETE FROM step_time_samples WHERE session_id='s1'"
+            " AND global_rank=0 AND id<=?", (watermark,)
+        )
+
+    # simulated crash: the transaction never commits
+    prune_txn(conn)
+    conn.rollback()
+    assert conn.execute(
+        "SELECT COUNT(*) FROM step_time_samples"
+    ).fetchone()[0] == 100
+    assert conn.execute(
+        "SELECT COUNT(*) FROM rollup_samples_10s"
+    ).fetchone()[0] == 0
+
+    # the retried prune commits: folded + surviving == everything
+    prune_txn(conn)
+    conn.commit()
+    raw = conn.execute(
+        "SELECT COUNT(*) FROM step_time_samples"
+    ).fetchone()[0]
+    folded = conn.execute(
+        "SELECT COALESCE(SUM(count), 0) FROM rollup_samples_10s"
+        " WHERE grain='rank' AND grain_key='0'"
+    ).fetchone()[0]
+    assert raw == 40 and folded == 60
+    conn.close()
+
+
+# -- stitched reads vs unbounded reference ---------------------------------
+
+
+def test_stitched_series_matches_unbounded_reference(tmp_path):
+    """With aggressive retention, the stitched read must still equal the
+    reference fold over ALL rows ever ingested: counts/min/max exact,
+    sums bit-exact per disjoint contribution (tier buckets hold only
+    deleted rows; raw folds through the same math)."""
+    from traceml_tpu.reporting import tiers
+
+    db = tmp_path / "t.sqlite"
+    w = SQLiteWriter(db, summary_window_rows=20, retention_factor=1.5)
+    w.start()
+    full_log = {0: [], 1: []}
+    for rank in (0, 1):
+        for step in range(1, 301):
+            ms = 90.0 + rank * 2.0 + (step % 11) * 0.7
+            ts = step * 0.4
+            full_log[rank].append((ts, step, ms))
+            w.ingest(build_telemetry_envelope(
+                "step_time",
+                {"step_time": [{
+                    "step": step, "timestamp": ts, "clock": "host",
+                    "events": {"_traceml_internal:step_time": {
+                        "cpu_ms": ms, "count": 1,
+                    }},
+                }]},
+                identity=_ident("s1", rank),
+            ))
+    w.force_flush()
+    assert w.finalize()
+
+    conn = sqlite3.connect(f"file:{db}?mode=ro", uri=True)
+    conn.row_factory = sqlite3.Row
+    assert tiers.has_rollups(conn)
+    stitched = tiers.load_stitched_series(
+        conn, "step_time_samples", "step_ms"
+    )
+    conn.close()
+
+    for rank in (0, 1):
+        log = full_log[rank]
+        ref = fold_buckets_reference(
+            [r[0] for r in log], [r[1] for r in log], [r[2] for r in log],
+            10.0,
+        )
+        got = stitched[str(rank)]
+        assert [p["t"] for p in got] == [b[0] for b in ref]
+        for p, b in zip(got, ref):
+            assert p["n"] == b[1]
+            assert p["min"] == b[3] and p["max"] == b[4]
+            assert p["step_min"] == b[6] and p["step_max"] == b[7]
+            # the stitched sum merges two disjoint exact folds; the
+            # reference folds everything in one sequence — identical
+            # row sets, possibly one extra addition at the seam
+            assert p["sum"] == pytest.approx(b[2], rel=1e-12)
+        # the whole run is covered even though raw keeps only 30 rows
+        assert got[0]["t"] == ref[0][0]
+        assert {p["res"] for p in got} <= {"raw", "10s"}
+
+
+def test_tier_decay_keeps_db_bounded_but_stitched_covers_run(
+    tmp_path, monkeypatch
+):
+    """A short 10s horizon forces decay; the 1m tier (long horizon)
+    backfills the decayed region in the stitched read — bounded bytes,
+    unbounded coverage."""
+    monkeypatch.setenv("TRACEML_ROLLUP_TIERS", "10:120,60:1209600")
+    from traceml_tpu.reporting import tiers
+
+    db = tmp_path / "t.sqlite"
+    w = SQLiteWriter(db, summary_window_rows=20, retention_factor=1.5)
+    w.start()
+    # 2s per step → 1200s of run, 10× the 10s-tier horizon
+    _ingest_steps(w, "s1", 0, 600, dt=2.0)
+    w.force_flush()
+    assert w.finalize()
+
+    conn = sqlite3.connect(f"file:{db}?mode=ro", uri=True)
+    conn.row_factory = sqlite3.Row
+    lo, hi = conn.execute(
+        "SELECT MIN(bucket_ts), MAX(bucket_ts) FROM rollup_samples_10s"
+        " WHERE grain='rank'"
+    ).fetchone()
+    # decay is amortized (re-checked when the cutoff advances ≥16
+    # widths), so allow that slack beyond the 120s horizon
+    assert hi - lo <= 120 + 16 * 10
+    stitched = tiers.load_stitched_series(
+        conn, "step_time_samples", "step_ms"
+    )["0"]
+    conn.close()
+    # coverage from the first bucket of the run
+    assert stitched[0]["t"] == 0.0
+    assert stitched[0]["res"] == "1m"
+    assert {p["res"] for p in stitched} >= {"1m"}
+    total = sum(p["n"] for p in stitched)
+    assert total == 600

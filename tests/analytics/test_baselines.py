@@ -1,0 +1,230 @@
+"""Cross-run baseline store (analytics/baselines.py): fingerprints,
+robust bands, evaluate-before-ingest ordering, per-fingerprint trim,
+and r14 attribution of cross-run step regressions."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from traceml_tpu.analytics import baselines
+from traceml_tpu.analytics.baselines import (
+    BaselineStore,
+    evaluate,
+    evaluate_and_record,
+    fingerprint_from_summary,
+    fingerprint_key,
+    robust_band,
+    summary_stats,
+)
+
+
+def _payload(
+    session="s1",
+    run_name="train-7b",
+    world=4,
+    step_ms=100.0,
+    per_rank=None,
+    overlap=0.9,
+    mem_slope=0.1,
+    tokens=None,
+    axes=(("data", 4, "ici"),),
+):
+    if per_rank is None:
+        per_rank = {str(r): step_ms for r in range(world)}
+    serving = {}
+    if tokens is not None:
+        serving = {"serving": {"global": {"tokens_per_s": tokens}}}
+    return {
+        "meta": {
+            "session_id": session,
+            "run_name": run_name,
+            "topology": {
+                "world_size": world,
+                "mesh": {
+                    "axes": [
+                        {"name": n, "size": s, "kind": k} for n, s, k in axes
+                    ]
+                },
+            },
+        },
+        "sections": {
+            "step_time": {
+                "global": {
+                    "steady_state": {
+                        "median_ms": step_ms,
+                        "per_rank_median_ms": per_rank,
+                    }
+                }
+            },
+            "collectives": {"global": {"overlap_efficiency": overlap}},
+            "step_memory": {
+                "global": {
+                    "per_rank": {
+                        "0": {"trend": {"slope_pct_per_100": mem_slope}}
+                    }
+                }
+            },
+            **serving,
+        },
+    }
+
+
+def test_fingerprint_covers_name_mesh_and_world():
+    fp = fingerprint_from_summary(_payload())
+    assert fp == {
+        "run_name": "train-7b",
+        "mesh_axes": "data:4@ici",
+        "world_size": 4,
+    }
+    other = fingerprint_from_summary(
+        _payload(axes=(("data", 2, "ici"), ("model", 2, "dcn")))
+    )
+    assert fingerprint_key(fp) != fingerprint_key(other)
+    assert other["mesh_axes"] == "data:2@ici,model:2@dcn"
+
+
+def test_summary_stats_extraction():
+    s = summary_stats(_payload(step_ms=123.0, tokens=456.0))
+    assert s["steady_step_ms"] == 123.0
+    assert s["overlap_efficiency"] == 0.9
+    assert s["memory_slope_pct_per_100"] == 0.1
+    assert s["tokens_per_s"] == 456.0
+    assert s["per_rank_step_ms"] == {str(r): 123.0 for r in range(4)}
+
+
+def test_robust_band_small_n_fallbacks():
+    assert robust_band([], 0.1) is None
+    b1 = robust_band([100.0], 0.1)
+    assert b1["low"] == 50.0 and b1["high"] == 150.0
+    b2 = robust_band([100.0, 102.0], 0.1)
+    assert b2["center"] == 101.0
+    assert b2["high"] == pytest.approx(101.0 + 30.3)
+    # n≥3: MAD-based, but never narrower than the relative floor
+    b3 = robust_band([100.0, 100.0, 100.0], 0.15)
+    assert b3["high"] == pytest.approx(115.0)
+
+
+def test_evaluate_directionality():
+    history = [{"stats": {"tokens_per_s": 1000.0, "steady_step_ms": 100.0}}
+               for _ in range(5)]
+    # tokens/s DROP is a regression; step-time drop is an improvement
+    res = evaluate(
+        {"tokens_per_s": 500.0, "steady_step_ms": 60.0}, history
+    )
+    by_metric = {c["metric"]: c for c in res["checks"]}
+    assert by_metric["tokens_per_s"]["status"] == "regression"
+    assert by_metric["steady_step_ms"]["status"] == "improved"
+    assert res["status"] == "regression"
+    assert any(
+        i["kind"] == "PERF_REGRESSION" and i["metric"] == "tokens_per_s"
+        for i in res["issues"]
+    )
+
+
+def test_evaluate_and_record_orders_eval_before_ingest(tmp_path):
+    logs = tmp_path / "logs"
+    (logs / "a").mkdir(parents=True)
+    (logs / "b").mkdir()
+    (logs / "c").mkdir()
+    r1 = evaluate_and_record(logs / "a", _payload(session="a"))
+    assert r1["status"] == "no_baseline" and r1["baseline_runs"] == 0
+    r2 = evaluate_and_record(logs / "b", _payload(session="b", step_ms=101.0))
+    assert r2["status"] == "ok" and r2["baseline_runs"] == 1
+    # a 60% slowdown must be judged against the PRIOR runs only — if it
+    # ingested first it would widen its own band
+    r3 = evaluate_and_record(logs / "c", _payload(session="c", step_ms=160.0))
+    assert r3["status"] == "regression"
+    assert [c["metric"] for c in r3["checks"]
+            if c["status"] == "regression"] == ["steady_step_ms"]
+    assert (logs / baselines.STORE_FILENAME).exists()
+
+
+def test_refinalize_does_not_self_match(tmp_path):
+    logs = tmp_path / "logs"
+    (logs / "a").mkdir(parents=True)
+    first = evaluate_and_record(logs / "a", _payload(session="a"))
+    again = evaluate_and_record(logs / "a", _payload(session="a"))
+    # the re-finalized session is excluded from its own baseline
+    assert first["baseline_runs"] == 0
+    assert again["baseline_runs"] == 0
+    store = BaselineStore(logs / baselines.STORE_FILENAME)
+    fp = fingerprint_from_summary(_payload(session="a"))
+    assert len(store.matching_runs(fp)) == 1  # upsert, not duplicate
+    store.close()
+
+
+def test_fingerprint_mismatch_isolates_baselines(tmp_path):
+    logs = tmp_path / "logs"
+    for name in ("a", "b"):
+        (logs / name).mkdir(parents=True)
+    evaluate_and_record(logs / "a", _payload(session="a", world=4))
+    # different world size → different fingerprint → fresh baseline
+    r = evaluate_and_record(
+        logs / "b", _payload(session="b", world=8, step_ms=500.0)
+    )
+    assert r["status"] == "no_baseline"
+
+
+def test_trim_respects_max_runs_flag(tmp_path, monkeypatch):
+    monkeypatch.setenv("TRACEML_BASELINE_MAX_RUNS", "3")
+    logs = tmp_path / "logs"
+    fp = fingerprint_from_summary(_payload())
+    for i in range(6):
+        sd = logs / f"s{i}"
+        sd.mkdir(parents=True)
+        evaluate_and_record(sd, _payload(session=f"s{i}"))
+    store = BaselineStore(logs / baselines.STORE_FILENAME)
+    kept = store.matching_runs(fp)
+    store.close()
+    assert len(kept) == 3
+    assert [r["session_id"] for r in kept] == ["s3", "s4", "s5"]
+
+
+def test_unusable_payload_returns_none(tmp_path):
+    sd = tmp_path / "logs" / "a"
+    sd.mkdir(parents=True)
+    empty = {"meta": {"session_id": "a"}, "sections": {}}
+    assert evaluate_and_record(sd, empty) is None
+    assert not (tmp_path / "logs" / baselines.STORE_FILENAME).exists()
+
+
+def test_step_regression_carries_r14_attribution(tmp_path):
+    from traceml_tpu.utils.topology import topology_from_rank_rows
+
+    rows = [
+        {
+            "global_rank": r,
+            "node_rank": r // 2,
+            "hostname": f"host-{r // 2}",
+            "axes_json": json.dumps([{"name": "data", "size": 4,
+                                      "kind": "ici"}]),
+            "coords_json": json.dumps([r]),
+            "source": "mesh",
+        }
+        for r in range(4)
+    ]
+    topo = topology_from_rank_rows(rows)
+    assert topo is not None
+
+    baseline_pr = {str(r): 100.0 for r in range(4)}
+    history = [
+        {"stats": {"steady_step_ms": 100.0,
+                   "per_rank_step_ms": baseline_pr}}
+        for _ in range(4)
+    ]
+    # host-1's ranks (2, 3) regress; host-0 stays put
+    current_pr = {"0": 101.0, "1": 101.0, "2": 220.0, "3": 222.0}
+    res = evaluate(
+        {"steady_step_ms": 161.0, "per_rank_step_ms": current_pr},
+        history,
+        topology=topo,
+    )
+    issue = next(
+        i for i in res["issues"] if i["metric"] == "steady_step_ms"
+    )
+    assert issue["kind"] == "PERF_REGRESSION"
+    attribution = issue.get("attribution")
+    assert attribution is not None
+    assert sorted(attribution["ranks"]) == [2, 3]

@@ -35,7 +35,12 @@ def _make_session_db(tmp_path, n_ranks=2):
     from traceml_tpu.utils import timing as T
 
     db = tmp_path / "telemetry.sqlite"
-    w = SQLiteWriter(db)
+    # retention smaller than the 39 ingested steps (and zero hysteresis
+    # slack) so the writer prunes — and therefore FOLDS — in-window:
+    # the payload then carries the top-level `history` fragment the
+    # page's JS reads, keeping the d.<key> contract check real
+    w = SQLiteWriter(db, summary_window_rows=20)
+    w._prune_slack = 0
     w.start()
     for rank in range(n_ranks):
         ident = SenderIdentity(

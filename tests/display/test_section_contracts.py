@@ -36,6 +36,16 @@ _EFFICIENCY_KEYS = {
 _ISSUE_KEYS = {"kind", "severity", "summary", "action", "domain",
                "confidence", "confidence_label"}
 
+# history fragment (renderers/compute.py _compute_history): untyped
+# dict, so its shape is pinned here as a nested schema — True marks a
+# scalar leaf, sets mark dicts whose keys are all scalar leaves
+_HISTORY_SCHEMA = {
+    "step_time": {
+        "points": {"t", "mean_ms", "min_ms", "max_ms", "res"},
+        "ranks": True,
+    },
+}
+
 _ROOTS = {
     "ts": None,  # scalar in build_web_payload
     "step_time": V.StepTimeView,
@@ -45,6 +55,7 @@ _ROOTS = {
     "diagnosis": _ISSUE_KEYS,
     "findings": _ISSUE_KEYS,
     "stdout": {"stream", "line"},
+    "history": _HISTORY_SCHEMA,
 }
 
 # dataclass field name → element dataclass for list/dict-of-dataclass
@@ -80,6 +91,13 @@ def _resolve(path: str) -> bool:
         return True
     node = root
     for i, seg in enumerate(parts[1:], start=1):
+        if isinstance(node, dict):
+            node = node.get(seg, False)
+            if node is False:
+                return False
+            if node is True:
+                return i == len(parts) - 1
+            continue
         if isinstance(node, set):
             return seg in node and i == len(parts) - 1
         if not dataclasses.is_dataclass(node):
@@ -178,7 +196,11 @@ _VETTED = {
              "st.n_steps", "st.clock", "cov.ranks_present", "cov.world_size"},
     "step_time": {"h", "bars", "paths", "stepId", "i",
                   "rankPair",  # built from esc()'d parts two lines up
-                  'rankHidden.has(r)?" off":""'},
+                  'rankHidden.has(r)?" off":""',
+                  # history strip: accumulated "x,y x,y" point strings
+                  # whose every coordinate was .toFixed(1)'d above, and
+                  # a numeric count assigned via textContent (inert)
+                  "band", "mean", "pts.length"},
     "memory": {"spark", "worst", "hot",
                "g?(g>0?\"+\":\"-\")+fmtB(Math.abs(g)):\"—\"",
                _STALE_TERNARY},

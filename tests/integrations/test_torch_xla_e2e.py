@@ -70,7 +70,7 @@ def test_torch_xla_fake_e2e(tmp_path):
         cwd=str(tmp_path),
     )
     assert proc.returncode == 0, proc.stderr[-3000:] + proc.stdout[-2000:]
-    session = next(iter(logs.iterdir()))
+    session = next(p for p in logs.iterdir() if p.is_dir())
     payload = json.loads((session / "final_summary.json").read_text())
 
     # the mark_step barrier is a first-class collective phase

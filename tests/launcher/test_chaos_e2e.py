@@ -69,7 +69,7 @@ def _run(tmp_path, name, steps, nprocs=2, extra_env=None, check=True,
     )
     if check:
         assert proc.returncode == 0, proc.stderr[-3000:] + proc.stdout[-2000:]
-    session = next(iter(logs.iterdir()))
+    session = next(p for p in logs.iterdir() if p.is_dir())
     return session, proc
 
 

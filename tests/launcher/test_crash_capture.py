@@ -50,7 +50,7 @@ def _launch(tmp_path, script_text, name, wait=True, extra=()):
             argv, env=env, capture_output=True, text=True,
             timeout=180, cwd=str(tmp_path),
         )
-        session = next(iter(logs.iterdir()))
+        session = next(p for p in logs.iterdir() if p.is_dir())
         return proc, session
     return subprocess.Popen(
         argv, env=env, cwd=str(tmp_path),
@@ -93,7 +93,9 @@ def test_sigterm_to_launcher_tears_down_tree(tmp_path):
     deadline = time.monotonic() + 60
     session = None
     while time.monotonic() < deadline:
-        sessions = list(logs.iterdir()) if logs.exists() else []
+        sessions = (
+            [p for p in logs.iterdir() if p.is_dir()] if logs.exists() else []
+        )
         if sessions:
             session = sessions[0]
             manifest = json.loads((session / "manifest.json").read_text())

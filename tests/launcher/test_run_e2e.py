@@ -73,7 +73,9 @@ def test_run_summary_mode_input_bound(tmp_path):
         cwd=str(tmp_path),
     )
     assert proc.returncode == 0, proc.stderr[-3000:]
-    sessions = list(logs.iterdir())
+    # only directories are sessions — the baseline store file
+    # (traceml_baselines.sqlite) also lives at the logs-dir top level
+    sessions = [p for p in logs.iterdir() if p.is_dir()]
     assert len(sessions) == 1
     session = sessions[0]
     summary_path = session / "final_summary.json"

@@ -41,7 +41,9 @@ def _run(tmp_path, name, steps, nprocs=1, extra_args=()):
         env=env, capture_output=True, text=True, timeout=300, cwd=str(tmp_path),
     )
     assert proc.returncode == 0, proc.stderr[-3000:]
-    session = next(iter(logs.iterdir()))
+    # the logs dir holds session DIRS plus the cross-run baseline store
+    # file (traceml_baselines.sqlite) — only directories are sessions
+    session = next(p for p in logs.iterdir() if p.is_dir())
     payload = json.loads((session / "final_summary.json").read_text())
     return payload
 

@@ -55,7 +55,7 @@ def test_torch_mlp_phase_split(tmp_path):
         env=env, capture_output=True, text=True, timeout=240, cwd=str(tmp_path),
     )
     assert proc.returncode == 0, proc.stderr[-3000:]
-    session = next(iter(logs.iterdir()))
+    session = next(p for p in logs.iterdir() if p.is_dir())
     payload = json.loads((session / "final_summary.json").read_text())
     st = payload["sections"]["step_time"]
     assert st["status"] == "OK"

@@ -23,13 +23,19 @@ _HTML = """
 <div class="legend" id="st-ranks" style="margin-top:.5rem"></div>
 <svg id="st-spark" class="spark" viewBox="0 0 600 64" preserveAspectRatio="none"></svg>
 <div class="muted">per-rank step time (window tail) — click a rank chip to toggle</div>
+<div id="st-history-wrap" style="display:none">
+  <svg id="st-history" class="spark" viewBox="0 0 600 48" preserveAspectRatio="none"></svg>
+  <div class="muted" id="st-history-meta">full-run history (stitched rollup tiers)</div>
+</div>
 """
 
 _JS = r"""
 const rankHidden=new Set();
-let stLast=null,stLastTs=null;
+let stLast=null,stLastTs=null,stHistLast=null;
 function render_step_time(d){
   const st=d.step_time;badge("st-badge",d.ts,st&&st.latest_ts);
+  if(d.history)stHistLast=d.history;
+  renderStHistory((d.history||stHistLast||{}).step_time);
   if(!st)return;
   stLast=st;stLastTs=d.ts;
   document.getElementById("st-occ").textContent=
@@ -105,6 +111,34 @@ function stToggleRank(r){
   // repaint with the SERVER timestamp of the cached payload — a client
   // clock here would cross clocks in the staleness badge
   if(stLast)render_step_time({step_time:stLast,ts:stLastTs})}
+// full-run history strip: stitched rollup tiers (raw/10s/1m) as a
+// min–max band + cross-rank mean line over the WHOLE run, not just the
+// live window tail.  Hidden until the first fold lands in the payload.
+function renderStHistory(hist){
+  const wrap=document.getElementById("st-history-wrap");
+  const pts=hist&&hist.points;
+  if(!pts||pts.length<2){wrap.style.display="none";return}
+  wrap.style.display="";
+  const t0=pts[0].t,t1=pts[pts.length-1].t,span=Math.max(1e-9,t1-t0);
+  let hmax=1;for(const p of pts)hmax=Math.max(hmax,p.max_ms||0);
+  const X=p=>(p.t-t0)/span*600;
+  const Y=v=>46-(v/hmax*44);
+  let band="";for(const p of pts)band+=`${X(p).toFixed(1)},${Y(p.max_ms).toFixed(1)} `;
+  for(let i=pts.length-1;i>=0;i--)band+=`${X(pts[i]).toFixed(1)},${Y(pts[i].min_ms).toFixed(1)} `;
+  let mean="";for(const p of pts)mean+=`${X(p).toFixed(1)},${Y(p.mean_ms).toFixed(1)} `;
+  document.getElementById("st-history").innerHTML=
+    `<polygon points="${band}" fill="rgba(110,145,220,.22)" stroke="none"></polygon>`+
+    `<polyline fill="none" stroke="#6e91dc" stroke-width="1.2" points="${mean}"></polyline>`;
+  const res=[...new Set(pts.map(p=>p.res))].join("/");
+  document.getElementById("st-history-meta").textContent=
+    `full-run history: ${pts.length} buckets · ${(span/3600).toFixed(1)} h · `+
+    `${Math.round(hist.ranks||0)} rank(s) · ${esc(res)} resolution (stitched rollup tiers)`;
+  hookTip("st-history",frac=>{
+    const i=Math.min(pts.length-1,Math.floor(frac*pts.length));
+    const p=pts[i];
+    return `<b>+${((p.t-t0)/60).toFixed(1)} min</b> (${esc(p.res)})`+
+      `<br>mean ${fmtMs(p.mean_ms)}<br>min ${fmtMs(p.min_ms)} · max ${fmtMs(p.max_ms)}`});
+}
 """
 
 SECTION = Section(
@@ -127,5 +161,11 @@ SECTION = Section(
         "step_time.phases.median_rank",
         "step_time.phases.skew_pct",
         "step_time.step_series",
+        "history.step_time.points.t",
+        "history.step_time.points.mean_ms",
+        "history.step_time.points.min_ms",
+        "history.step_time.points.max_ms",
+        "history.step_time.points.res",
+        "history.step_time.ranks",
     ),
 )

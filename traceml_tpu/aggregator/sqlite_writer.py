@@ -48,6 +48,7 @@ from collections import deque
 from pathlib import Path
 from typing import Deque, Dict, List, Optional, Tuple
 
+from traceml_tpu.aggregator import rollup
 from traceml_tpu.aggregator.sqlite_writers import ALL_WRITERS, writer_for
 from traceml_tpu.telemetry.envelope import TelemetryEnvelope
 from traceml_tpu.utils.error_log import get_error_log
@@ -199,6 +200,11 @@ class SQLiteWriter:
         # rejecting envelopes its previous incarnation already committed
         self._seq_max: Dict[Tuple[str, int, str], int] = {}
         self.replay_duplicates = 0
+
+        # tiered rollup decay: folds each prune's doomed id-range into
+        # rollup_samples_10s/_1m inside the same transaction as the
+        # delete (None when TRACEML_ROLLUP=0 — prunes discard history)
+        self._rollup = rollup.build_engine()
 
     # -- producer side (aggregator loop) --------------------------------
     def start(self) -> None:
@@ -363,6 +369,11 @@ class SQLiteWriter:
                 "prune_max_ms": round(self._prune_max_ms, 3),
                 "retention_rows": self._retention_rows,
             },
+            "rollup": (
+                self._rollup.stats()
+                if self._rollup is not None
+                else {"enabled": False}
+            ),
         }
 
     # -- writer thread ---------------------------------------------------
@@ -416,6 +427,8 @@ class SQLiteWriter:
                     f"CREATE INDEX IF NOT EXISTS idx_{table}_retention"
                     f" ON {table} (session_id, global_rank)"
                 )
+        if self._rollup is not None:
+            self._rollup.init_schema(conn)
         conn.commit()
         self._seed_partition_counts(conn)
         self._seed_seq_max(conn)
@@ -721,6 +734,23 @@ class SQLiteWriter:
                 self._part_counts[key] = self._retention_rows
                 return 0
             watermark = int(row[0])
+            # fold the doomed id-range into the rollup tiers BEFORE the
+            # delete, inside this same transaction: commit lands
+            # fold+delete+journal together, rollback restores all-raw —
+            # a crash can never leave rows neither raw nor rolled up.
+            # A fold failure degrades to plain (history-discarding)
+            # retention rather than blocking the prune: partial tier
+            # upserts still cover only doomed rows, so deleting keeps
+            # the invariant while double-fold on retry would not.
+            if self._rollup is not None and table in self._rollup.sources:
+                try:
+                    self._rollup.fold_doomed(
+                        conn, table, session_id, rank, watermark
+                    )
+                except Exception as exc:
+                    get_error_log().warning(
+                        f"rollup fold failed for {table}", exc
+                    )
             cur = conn.execute(
                 f"DELETE FROM {table} WHERE session_id=? AND global_rank=?"
                 " AND id <= ?",

@@ -79,6 +79,11 @@ CONTRACT: Dict[str, Set[str]] = {
     "topology": {"writer", "store"},
     "model_stats": {"store"},
     "liveness": {"diag_pkg", "diagnosis"},
+    # rollup tiers have no sampler/writer/ring of their own: folds are a
+    # side effect of the retention prune (aggregator/rollup.py inside
+    # sqlite_writer._prune_partition); the store serves stitched reads
+    # and the payload surfaces them as the ``history`` fragment
+    "rollup": {"store", "fragment"},
 }
 
 #: per-layer translation of layer-local names to canonical domains
@@ -88,7 +93,7 @@ ALIASES: Dict[str, Dict[str, str]] = {
     # RaggedEventColumns is the serving domain's ring: CSR-style ragged
     # per-request latency lists riding the same compacting ring engine
     "ring": {"memory": "step_memory", "ragged_event": "serving"},
-    "fragment": {"memory": "step_memory"},
+    "fragment": {"memory": "step_memory", "history": "rollup"},
 }
 
 #: layer names that are infrastructure, not domains

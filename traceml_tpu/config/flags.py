@@ -271,6 +271,15 @@ OVERHEAD_BUDGET = declare(
 MESH = declare(
     "TRACEML_MESH", None,
     "mesh override grammar name:size[@kind],... for topology capture")
+ROLLUP = declare(
+    "TRACEML_ROLLUP", "1",
+    "0 disables tiered rollup decay (watermark prunes discard history)")
+ROLLUP_TIERS = declare(
+    "TRACEML_ROLLUP_TIERS", None,
+    "rollup tier grammar width[:horizon],... seconds (default 10:21600,60:1209600)")
+BASELINE_MAX_RUNS = declare(
+    "TRACEML_BASELINE_MAX_RUNS", "20",
+    "cross-run baseline store: matching sessions kept per fingerprint")
 
 # --------------------------------------------------------------------
 # dev / CI tooling

@@ -214,7 +214,7 @@ def _run_once(name: str, steps: int, nprocs: int, timeout: float = 360):
         if proc.returncode != 0:
             return None, f"rc={proc.returncode}: {proc.stderr[-500:]}"
         try:
-            session = next(iter(logs.iterdir()))
+            session = next(p for p in logs.iterdir() if p.is_dir())
             return (
                 json.loads((session / "final_summary.json").read_text()),
                 None,

@@ -175,7 +175,9 @@ def _check_scenario_e2e(report: dict) -> bool:
                                   "stderr": proc.stderr[-1500:]}
         print(f"[tpu-acceptance] scenario e2e FAILED rc={proc.returncode}")
         return False
-    session = next(iter(logs.iterdir()))
+    # sessions are the DIRECTORIES under logs (the cross-run baseline
+    # store traceml_baselines.sqlite shares the top level)
+    session = next(p for p in logs.iterdir() if p.is_dir())
     payload = json.loads((session / "final_summary.json").read_text())
     st = payload["sections"]["step_time"]
     diag = st["diagnosis"]["kind"]

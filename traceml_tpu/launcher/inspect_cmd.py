@@ -23,6 +23,11 @@ table — with a clean message for pre-topology session DBs.
 pooled request/latency totals plus a per-replica table (requests,
 TTFT p99, tokens/s, queue depth, KV headroom) — with a clean message
 for training-only sessions.
+
+``--domain rollup`` reads the stitched full-run series out of the
+rollup tier tables (reporting/tiers.py): per source/metric coverage
+plus the tail of the step-time series at whatever resolution survives
+(raw/10s/1m).
 """
 
 from __future__ import annotations
@@ -168,6 +173,72 @@ def _inspect_serving(path: Path) -> int:
     return 0
 
 
+def _inspect_rollup(path: Path, limit: int = 20) -> int:
+    """Stitched full-run series (reporting/tiers.py): per source/metric,
+    the bucket coverage, resolutions in play, and the last ``limit``
+    stitched buckets of the step-time series — the from-the-terminal
+    answer to "did the retention prune keep my history?"."""
+    import sqlite3
+
+    from traceml_tpu.reporting import tiers
+
+    db = _find_session_db(path)
+    if db is None:
+        print(f"no telemetry.sqlite at or under {path}")
+        return 1
+    conn = sqlite3.connect(f"file:{db}?mode=ro", uri=True)
+    conn.row_factory = sqlite3.Row
+    try:
+        if not tiers.has_rollups(conn):
+            print(
+                f"no rollup tiers in {db}\n"
+                "(run too short for a watermark prune, or TRACEML_ROLLUP=0)"
+            )
+            return 1
+        print(f"── rollup tiers ({db})")
+        for source in tiers.ROLLUP_SOURCES:
+            for metric in tiers.SOURCE_METRICS.get(source, ()):
+                series = tiers.load_stitched_series(conn, source, metric)
+                if not series:
+                    continue
+                n_pts = sum(len(p) for p in series.values())
+                t_lo = min(p[0]["t"] for p in series.values())
+                t_hi = max(p[-1]["t"] for p in series.values())
+                res = sorted(
+                    {pt["res"] for p in series.values() for pt in p}
+                )
+                print(
+                    f"{source.replace('_samples', ''):>12}.{metric:<18} "
+                    f"{len(series)} rank(s)  {n_pts} buckets  "
+                    f"{(t_hi - t_lo) / 60.0:8.1f} min span  "
+                    f"res {'/'.join(res)}"
+                )
+        series = tiers.load_stitched_series(
+            conn, "step_time_samples", "step_ms"
+        )
+    finally:
+        conn.close()
+    if series:
+        print(f"\nstep_ms tail (last {limit} buckets per rank):")
+        print(
+            f"{'rank':>6}  {'bucket':>12}  {'res':>4}  {'n':>5}  "
+            f"{'mean':>10}  {'min':>10}  {'max':>10}  steps"
+        )
+        for rank in sorted(series, key=lambda r: int(r) if r.isdigit() else 0):
+            for p in series[rank][-limit:]:
+                steps = (
+                    f"{p['step_min']}–{p['step_max']}"
+                    if p.get("step_min") is not None
+                    else "n/a"
+                )
+                print(
+                    f"{rank:>6}  {p['t']:>12.1f}  {p['res']:>4}  "
+                    f"{p['n']:>5}  {p['mean']:>8.2f}ms  "
+                    f"{p['min']:>8.2f}ms  {p['max']:>8.2f}ms  {steps}"
+                )
+    return 0
+
+
 def run_inspect(
     path: Path, limit: int = 20, domain: Optional[str] = None
 ) -> int:
@@ -176,6 +247,8 @@ def run_inspect(
         return _inspect_topology(path)
     if domain == "serving":
         return _inspect_serving(path)
+    if domain == "rollup":
+        return _inspect_rollup(path, limit=limit)
     files = []
     if path.is_file():
         files = [path]

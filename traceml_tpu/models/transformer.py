@@ -25,6 +25,8 @@ import jax
 import jax.numpy as jnp
 import flax.linen as nn
 
+from traceml_tpu.utils.jax_compat import shard_map
+
 
 @dataclasses.dataclass(frozen=True)
 class ModelConfig:
@@ -219,7 +221,7 @@ class Attention(nn.Module):
                 "single-mesh GSPMD partitioning"
             )
         spec = seq_parallel_spec(cfg, batch_size=q.shape[0])
-        return jax.shard_map(
+        return shard_map(
             lambda a, b, c: op(a, b, c, cfg.context_axis),
             mesh=cfg.mesh,
             in_specs=(spec, spec, spec),

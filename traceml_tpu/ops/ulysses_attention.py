@@ -36,6 +36,9 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from traceml_tpu.utils import jax_compat
+from traceml_tpu.utils.jax_compat import shard_map
+
 
 def _full_causal_attention(q, k, v):
     """Ordinary causal attention on full-sequence local tensors.
@@ -62,7 +65,7 @@ def ulysses_attention(
     q,k,v: local (B, S_local, H, D); H must be divisible by the axis
     size.  Returns the local (B, S_local, H, D) output shard.
     """
-    P = jax.lax.axis_size(axis_name)
+    P = jax_compat.axis_size(axis_name)
     B, S_loc, H, D = q.shape
     if H % P != 0:
         raise ValueError(
@@ -106,7 +109,7 @@ def make_ulysses_attention(mesh, axis_name: str = "context"):
         return ulysses_attention(q, k, v, axis_name)
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False,
         )

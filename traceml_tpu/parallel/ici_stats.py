@@ -75,6 +75,8 @@ class IciStatAggregator:
         import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
 
+        from traceml_tpu.utils.jax_compat import shard_map
+
         axes = self.axes
 
         def gather(local: jnp.ndarray) -> jnp.ndarray:
@@ -93,7 +95,7 @@ class IciStatAggregator:
         # (all_gather makes it so), but static replication inference
         # can't always prove it across multiple chained axes.
         return jax.jit(
-            jax.shard_map(
+            shard_map(
                 gather,
                 mesh=self.mesh,
                 in_specs=P(axes),

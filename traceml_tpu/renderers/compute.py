@@ -43,6 +43,10 @@ _DOMAIN_DEPS: Dict[str, Tuple[Tuple[str, ...], Optional[str]]] = {
     "system": (("system", "topology"), "system"),
     "process": (("process",), "process"),
     "stdout": (("stdout",), None),
+    # full-run history strip: stitched rollup tiers + the raw step_time
+    # tail (the stitch re-folds surviving raw rows, so new raw steps
+    # move the series even between prunes)
+    "history": (("rollup", "step_time"), None),
 }
 
 
@@ -332,3 +336,50 @@ class LiveComputer:
             return {"stdout": self._store.stdout_tail()}, None
         except Exception:
             return {"stdout": []}, None
+
+    def _compute_history(self) -> Tuple[Dict[str, Any], Any]:
+        """Full-run step-time history for the dashboard strip: stitched
+        rank-grain series (raw tail + 10s + 1m tiers), downsampled to a
+        cross-rank mean/min/max band per bucket.  {} until the first
+        fold lands (short runs never show the strip)."""
+        try:
+            if not self._store.has_rollups():
+                return {"history": {}}, None
+            series = self._store.stitched_series(
+                "step_time_samples", "step_ms"
+            )
+            if not series:
+                return {"history": {}}, None
+            band: Dict[float, Dict[str, Any]] = {}
+            for points in series.values():
+                for p in points:
+                    if p.get("mean") is None:
+                        continue
+                    slot = band.get(p["t"])
+                    if slot is None:
+                        band[p["t"]] = {
+                            "t": p["t"], "mean_sum": p["mean"], "ranks": 1,
+                            "min": p["min"], "max": p["max"], "res": p["res"],
+                        }
+                    else:
+                        slot["mean_sum"] += p["mean"]
+                        slot["ranks"] += 1
+                        slot["min"] = min(slot["min"], p["min"])
+                        slot["max"] = max(slot["max"], p["max"])
+            points = [
+                {
+                    "t": s["t"],
+                    "mean_ms": s["mean_sum"] / s["ranks"],
+                    "min_ms": s["min"],
+                    "max_ms": s["max"],
+                    "res": s["res"],
+                }
+                for s in (band[t] for t in sorted(band))
+            ]
+            return {
+                "history": {
+                    "step_time": {"points": points, "ranks": len(series)},
+                }
+            }, None
+        except Exception as exc:
+            return {"history": {"error": str(exc)}}, None

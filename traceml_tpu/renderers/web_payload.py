@@ -41,8 +41,9 @@ _FRAGMENT_KEYS: Dict[str, Tuple[str, ...]] = {
     "system": ("system",),
     "process": ("process",),
     "stdout": ("stdout",),
+    "history": ("history",),
     "diagnosis": ("diagnosis", "findings"),
-    "meta": ("ingest", "rank_status", "mesh"),
+    "meta": ("ingest", "rank_status", "mesh", "regressions"),
 }
 
 #: serving order — also the position of each counter in the version token
@@ -61,6 +62,7 @@ FRAGMENT_DEPS: Dict[str, Tuple[str, ...]] = {
     "system": ("system", "topology"),
     "process": ("process",),
     "stdout": ("stdout",),
+    "history": ("rollup", "step_time"),
     "diagnosis": (
         "step_time", "model_stats", "topology", "step_memory",
         "collectives", "serving", "system", "process",
@@ -103,6 +105,18 @@ def _serving_fragment(payload: Dict[str, Any]) -> Dict[str, Any]:
     if view is None:
         return {}
     return {"serving": view.as_dict()}
+
+
+def _history_fragment(payload: Dict[str, Any]) -> Dict[str, Any]:
+    """Full-run history strip (stitched rollup tiers).  Like
+    ``serving``, the key is omitted entirely until the first fold lands
+    — a short run's payload keeps the pre-rollup shape byte-identical."""
+    history = payload.get("history")
+    if not history or not isinstance(history, dict):
+        return {}
+    if not history.get("step_time"):
+        return {}
+    return {"history": history}
 
 
 def _diagnosis_fragment(payload: Dict[str, Any]) -> Dict[str, Any]:
@@ -183,6 +197,18 @@ def _meta_fragment(
     mesh = (payload.get("topology") or {}).get("mesh")
     if mesh:
         out["mesh"] = mesh
+    # cross-run regression verdict (analytics/baselines.py): written at
+    # finalize as regressions.json; served live so a dashboard left open
+    # shows the verdict the moment the run completes.  Absent file ==
+    # absent key (pre-baseline sessions keep their exact shape).
+    try:
+        from traceml_tpu.reporting.loaders import load_regressions
+
+        regressions = load_regressions(session_dir)
+        if regressions:
+            out["regressions"] = regressions
+    except Exception:
+        pass
     return out
 
 
@@ -209,6 +235,8 @@ def build_fragment(
                 for s, l in (payload.get("stdout") or [])
             ]
         }
+    if name == "history":
+        return _history_fragment(payload)
     if name == "diagnosis":
         return _diagnosis_fragment(payload)
     if name == "meta":

@@ -35,7 +35,7 @@ def build_compare_payload(
 
     step = sections.get("step_time")
     step_metric = (step.metrics.get("step_median_ms") or {}) if step else {}
-    return {
+    payload = {
         "schema": "traceml-tpu-compare/2",
         "verdict": verdict,
         "baseline": {
@@ -50,6 +50,25 @@ def build_compare_payload(
         "findings": findings,
         "sections": {name: comp.as_dict() for name, comp in sections.items()},
     }
+    # the candidate's own cross-run verdict (analytics/baselines.py):
+    # a pairwise compare answers "vs THIS baseline run"; the baseline
+    # store answers "vs the fleet of matching runs" — both belong in
+    # the report.  Key absent when the candidate predates baselines.
+    reg = candidate.get("regressions")
+    if isinstance(reg, dict) and reg.get("checks"):
+        payload["candidate_baseline"] = {
+            "status": reg.get("status"),
+            "baseline_runs": reg.get("baseline_runs"),
+            "regressed_metrics": [
+                c.get("metric")
+                for c in reg.get("checks") or []
+                if c.get("status") == "regression"
+            ],
+            "issues": [
+                i.get("summary") for i in reg.get("issues") or []
+            ],
+        }
+    return payload
 
 
 def render_compare_text(payload: Dict[str, Any]) -> str:
@@ -65,6 +84,21 @@ def render_compare_text(payload: Dict[str, Any]) -> str:
         lines.append(f"[{f['significance']}] {f['section']}: {f['summary']}")
     if not payload["findings"]:
         lines.append("No significant differences.")
+    cb = payload.get("candidate_baseline")
+    if cb:
+        if cb.get("status") == "regression":
+            lines.append(
+                "candidate vs its baseline store "
+                f"({cb.get('baseline_runs')} matching runs): REGRESSION "
+                f"in {', '.join(cb.get('regressed_metrics') or [])}"
+            )
+            for s in cb.get("issues") or []:
+                lines.append(f"  {s}")
+        else:
+            lines.append(
+                "candidate vs its baseline store "
+                f"({cb.get('baseline_runs')} matching runs): ok"
+            )
     # section status footer — says which domains actually compared
     lines.append("")
     for name, sec in (payload.get("sections") or {}).items():

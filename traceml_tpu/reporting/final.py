@@ -951,6 +951,45 @@ def render_text_summary(payload: Dict[str, Any]) -> str:
             out.extend(f"  {l}" for l in card.splitlines())
             out.append("")
 
+    # cross-run verdict (analytics/baselines.py): one line when healthy,
+    # the full per-metric deltas when something regressed
+    reg = payload.get("regressions")
+    if reg and reg.get("checks"):
+        if reg.get("status") == "regression":
+            out.append(
+                f"Cross-run regression vs last {reg.get('baseline_runs')} "
+                "matching run(s):"
+            )
+            for c in reg["checks"]:
+                if c.get("status") != "regression":
+                    continue
+                delta = c.get("delta_pct")
+                out.append(
+                    f"  {c['metric']}: {c['current']:.4g} vs baseline "
+                    f"{c['baseline_median']:.4g}"
+                    + (f" ({delta:+.1f}%)" if delta is not None else "")
+                )
+            out.append("")
+        else:
+            out.append(
+                f"Cross-run baseline: within bands of last "
+                f"{reg.get('baseline_runs')} matching run(s)."
+            )
+            out.append("")
+
+    # full-run history coverage line (stitched rollup tiers)
+    hist = (payload.get("history") or {}).get("step_time") or {}
+    pts = (hist.get("step_ms") or {}).get("points")
+    if pts:
+        span_s = pts[-1]["t"] - pts[0]["t"]
+        out.append(
+            f"History: {len(pts)} stitched buckets covering "
+            f"{span_s / 3600.0:.1f} h "
+            f"({'/'.join((hist.get('step_ms') or {}).get('resolutions', []))}"
+            " resolution)"
+        )
+        out.append("")
+
     for key in (
         "liveness", "system", "process", "serving", "collectives",
         "step_memory", "step_time",
@@ -959,6 +998,11 @@ def render_text_summary(payload: Dict[str, Any]) -> str:
         diag = sec.get("diagnosis") or {}
         if diag and diag.get("status") == "issue":
             out.append(f"[{key}] {diag.get('kind')}: {diag.get('summary')}")
+    reg_issues = (payload.get("regressions") or {}).get("issues") or []
+    for issue in reg_issues:
+        out.append(
+            f"[baseline] {issue.get('kind')}: {issue.get('summary')}"
+        )
     return "\n".join(out) + "\n"
 
 
@@ -1000,6 +1044,89 @@ def _build_liveness_section(session_dir: Path, mode: str, topology=None):
         if warn.get("missing_rank_states"):
             section["unfinished_rank_states"] = warn["missing_rank_states"]
     return section, result
+
+
+_HISTORY_MAX_POINTS = 1500
+
+
+def _cross_rank_band(series: Dict[str, Any]) -> list:
+    """Collapse per-rank stitched points into one band series: per
+    bucket, mean of rank means, min of mins, max of maxs.  The final
+    report shows the fleet envelope; per-rank depth stays available via
+    ``inspect --domain rollup``."""
+    buckets: Dict[float, Dict[str, Any]] = {}
+    for points in series.values():
+        for p in points:
+            if p.get("mean") is None:
+                continue
+            b = buckets.get(p["t"])
+            if b is None:
+                buckets[p["t"]] = {
+                    "t": p["t"], "means": [p["mean"]],
+                    "min": p["min"], "max": p["max"], "res": p["res"],
+                }
+            else:
+                b["means"].append(p["mean"])
+                b["min"] = min(b["min"], p["min"])
+                b["max"] = max(b["max"], p["max"])
+    out = []
+    for t in sorted(buckets):
+        b = buckets[t]
+        out.append({
+            "t": round(t, 3),
+            "mean": sum(b["means"]) / len(b["means"]),
+            "min": b["min"],
+            "max": b["max"],
+            "res": b["res"],
+        })
+    return out
+
+
+def _decimate_band(points: list, cap: int = _HISTORY_MAX_POINTS) -> list:
+    """Bound the history block's JSON size for arbitrarily long runs:
+    merge fixed-size groups of adjacent band points (mean of means, min
+    of mins, max of maxs) until under ``cap``."""
+    if len(points) <= cap:
+        return points
+    stride = -(-len(points) // cap)  # ceil division
+    out = []
+    for i in range(0, len(points), stride):
+        group = points[i:i + stride]
+        out.append({
+            "t": group[0]["t"],
+            "mean": sum(p["mean"] for p in group) / len(group),
+            "min": min(p["min"] for p in group),
+            "max": max(p["max"] for p in group),
+            "res": group[-1]["res"],
+        })
+    return out
+
+
+def _build_history_section(store) -> Dict[str, Any]:
+    """Full-run cross-rank band series per domain/metric from the
+    stitched rollup tiers; empty dict (→ key omitted) when no fold ever
+    landed or the stitch fails (fail-open, like every other section)."""
+    try:
+        if not store.has_rollups():
+            return {}
+        overview = store.stitched_overview()
+    except Exception as exc:
+        get_error_log().warning("history stitch failed", exc)
+        return {}
+    out: Dict[str, Any] = {}
+    for domain, metrics in (overview or {}).items():
+        per_metric: Dict[str, Any] = {}
+        for metric, series in metrics.items():
+            band = _decimate_band(_cross_rank_band(series))
+            if band:
+                per_metric[metric] = {
+                    "points": band,
+                    "ranks": len(series),
+                    "resolutions": sorted({p["res"] for p in band}),
+                }
+        if per_metric:
+            out[domain] = per_metric
+    return out
 
 
 # -- entrypoint ----------------------------------------------------------
@@ -1145,6 +1272,12 @@ def generate_summary(
         topology = store.topology()
     except Exception:
         topology = {"mode": "unknown", "world_size": 0}
+    # full-run history at bounded cost: stitched rollup tiers (raw where
+    # surviving, 10s then 1m beyond the retention watermark) — the final
+    # report renders the WHOLE run even though the hot tables only keep
+    # the last `retention` rows per rank.  Omitted entirely (key absent)
+    # for sessions where no fold ever landed: pre-rollup shape pin.
+    history = _build_history_section(store)
     store.close()
     primary = build_primary_diagnosis(
         results.get("step_time"),
@@ -1184,6 +1317,25 @@ def generate_summary(
         "primary_diagnosis": primary,
         "sections": sections,
     }
+    if history:
+        payload["history"] = history
+    # cross-run regression check (analytics/baselines.py): evaluate this
+    # run against the last N matching sessions, then ingest it.  The
+    # verdict lands in the payload AND as regressions.json so the live
+    # dashboard's meta fragment can serve it the moment the run ends.
+    try:
+        from traceml_tpu.analytics import baselines
+
+        regressions = baselines.evaluate_and_record(
+            session_dir, payload, topology=mesh
+        )
+        if regressions is not None:
+            payload["regressions"] = regressions
+            atomic_write_json(
+                Path(session_dir) / "regressions.json", regressions
+            )
+    except Exception as exc:
+        get_error_log().warning("baseline regression check failed", exc)
     attach_section_cards(payload)
     atomic_write_json(protocol.get_final_summary_json_path(session_dir), payload)
     atomic_write_text(

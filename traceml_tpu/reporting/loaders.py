@@ -52,6 +52,24 @@ def _table_exists(conn: sqlite3.Connection, table: str) -> bool:
     return row is not None
 
 
+def load_stitched_history(
+    db_path: Path,
+    conn: Optional[sqlite3.Connection] = None,
+) -> Dict[str, Any]:
+    """One-shot resolution-aware full-run read (``reporting/tiers.py``):
+    per-source stitched rank-grain series — raw rows where they
+    survive, 10s buckets beyond the watermark, 1m beyond the 10s
+    horizon.  ``{}`` when the DB holds no rollups (short runs, or
+    ``TRACEML_ROLLUP=0``)."""
+    from traceml_tpu.reporting import tiers
+
+    with _reading(db_path, conn) as c:
+        try:
+            return tiers.stitched_overview(c)
+        except sqlite3.Error:
+            return {}
+
+
 def load_step_time_rows(
     db_path: Path,
     max_steps_per_rank: int = 600,
@@ -405,4 +423,33 @@ def load_rank_status(session_dir: Path) -> Dict[str, Any]:
     if not isinstance(data, dict):
         return {}
     _RANK_STATUS_CACHE[str(path)] = (stamp, data)
+    return data
+
+
+# regressions.json is written once at finalize (analytics/baselines.py)
+# but polled live by the dashboard meta fragment; same (mtime, size)
+# cache as the other file-backed meta inputs.
+_REGRESSIONS_CACHE: Dict[str, Tuple[Tuple[float, int], Dict[str, Any]]] = {}
+
+
+def load_regressions(session_dir: Path) -> Dict[str, Any]:
+    """Cross-run regression verdict (``regressions.json``: status,
+    fingerprint, per-metric checks against the baseline bands, issues)
+    as written at finalize.  Returns ``{}`` when the file is missing or
+    unreadable — pre-baseline sessions have no ``regressions`` key."""
+    from traceml_tpu.utils.atomic_io import read_json
+
+    path = Path(session_dir) / "regressions.json"
+    try:
+        st = path.stat()
+    except OSError:
+        return {}
+    stamp = (st.st_mtime, st.st_size)
+    cached = _REGRESSIONS_CACHE.get(str(path))
+    if cached is not None and cached[0] == stamp:
+        return cached[1]
+    data = read_json(path)
+    if not isinstance(data, dict):
+        return {}
+    _REGRESSIONS_CACHE[str(path)] = (stamp, data)
     return data

@@ -68,11 +68,14 @@ from traceml_tpu.utils.columnar import (
     build_serving_window_rows,
     columnar_window_enabled,
 )
+from traceml_tpu.aggregator.rollup import ROLLUP_SOURCES as _ROLLUP_SOURCES
 from traceml_tpu.utils.error_log import get_error_log
 from traceml_tpu.utils.step_time_window import (
     StepTimeWindow,
     build_step_time_window as _build_window_from_rows,
 )
+
+_ROLLUP_SOURCE_SET = frozenset(_ROLLUP_SOURCES)
 
 _READ_PRAGMAS = (
     "PRAGMA busy_timeout=200",
@@ -92,6 +95,7 @@ DOMAINS = (
     "stdout",
     "model_stats",
     "topology",
+    "rollup",
 )
 
 
@@ -357,6 +361,11 @@ class LiveSnapshotStore:
         # from retention_watermarks rows, consumed by each table reader
         self._journal_mode = False
         self._pending_trims: Dict[str, Dict[int, int]] = {}
+        # tiered rollups: every fold commits with its prune's journal
+        # row, so journal rows naming a rollup source ARE the rollup
+        # dirty signal; stitched reads cache per (rollup, raw) version
+        self._rollup_dirty = False
+        self._stitched_cache: Dict[Tuple, Tuple[Tuple[int, ...], Any]] = {}
 
         # step_time / step_memory: per-rank bounded windows (row deque
         # + columnar ring per rank, kept in lockstep)
@@ -498,6 +507,12 @@ class LiveSnapshotStore:
                         f"snapshot refresh failed for {table}", exc
                     )
                     clean_scan = False
+            if self._rollup_dirty:
+                # folds commit atomically with their prune's journal
+                # row, so the journal naming a rollup source is the
+                # exact "tier tables changed" signal — no tier scan
+                dirty.add("rollup")
+                self._rollup_dirty = False
             if clean_scan:
                 # only mark the DB state consumed when every table
                 # scanned cleanly — a busy/locked table retries next tick
@@ -551,11 +566,14 @@ class LiveSnapshotStore:
             (cur,),
         ).fetchall()
         for r in rows:
-            trims = self._pending_trims.setdefault(str(r["table_name"]), {})
+            table_name = str(r["table_name"])
+            trims = self._pending_trims.setdefault(table_name, {})
             rank = int(r["global_rank"])
             wm = int(r["watermark_id"])
             if wm > trims.get(rank, -1):
                 trims[rank] = wm
+            if table_name in _ROLLUP_SOURCE_SET:
+                self._rollup_dirty = True
         self._advance_cursor("retention_watermarks", rows)
         return True
 
@@ -1014,6 +1032,80 @@ class LiveSnapshotStore:
     def has_serving_rows(self) -> bool:
         with self._lock:
             return any(buf.rows for buf in self._serving.values())
+
+    # -- stitched rollup reads (reporting/tiers.py) ----------------------
+
+    def has_rollups(self) -> bool:
+        """True when the session DB carries folded history — the
+        omit-when-empty gate for the history fragment / final block."""
+        from traceml_tpu.reporting import tiers
+
+        with self._lock:
+            conn = self._conn
+            if conn is None:
+                return False
+            try:
+                return tiers.has_rollups(conn)
+            except sqlite3.Error:
+                return False
+
+    def stitched_series(
+        self, source_table: str, metric: str, grain: str = "rank"
+    ) -> Dict[str, List[Dict[str, Any]]]:
+        """Full-run resolution-aware series (raw tail + 10s + 1m tiers)
+        per grain key.  Cached per (rollup version, raw-domain version)
+        — a refresh that touched neither returns the cached stitch."""
+        from traceml_tpu.reporting import tiers
+
+        domain = source_table.replace("_samples", "")
+        with self._lock:
+            conn = self._conn
+            if conn is None:
+                return {}
+            vkey = (
+                self._versions.get("rollup", 0),
+                self._versions.get(domain, 0),
+            )
+            ckey = (source_table, metric, grain)
+            hit = self._stitched_cache.get(ckey)
+            if hit is not None and hit[0] == vkey:
+                return hit[1]
+            try:
+                result = tiers.load_stitched_series(
+                    conn, source_table, metric, grain=grain
+                )
+            except sqlite3.Error as exc:
+                get_error_log().warning(
+                    f"stitched read failed for {source_table}/{metric}", exc
+                )
+                return {}
+            self._stitched_cache[ckey] = (vkey, result)
+            return result
+
+    def stitched_overview(self) -> Dict[str, Any]:
+        """Per-source stitched series for every served metric (the
+        final report's ``history`` block shape); {} when no rollups."""
+        from traceml_tpu.reporting import tiers
+
+        with self._lock:
+            conn = self._conn
+        if conn is None:
+            return {}
+        out: Dict[str, Any] = {}
+        try:
+            if not tiers.has_rollups(conn):
+                return {}
+        except sqlite3.Error:
+            return {}
+        for source in _ROLLUP_SOURCES:
+            per_metric: Dict[str, Any] = {}
+            for metric in tiers.SOURCE_METRICS.get(source, ()):
+                series = self.stitched_series(source, metric)
+                if series:
+                    per_metric[metric] = series
+            if per_metric:
+                out[source.replace("_samples", "")] = per_metric
+        return out
 
     def latest_serving_ts(self) -> Optional[float]:
         with self._lock:

@@ -1,0 +1,59 @@
+"""Version-portable jax API shims.
+
+``jax.shard_map`` graduated out of ``jax.experimental.shard_map`` and,
+in the same move, renamed its replication-checking kwarg
+(``check_rep`` → ``check_vma``).  The jax pinned in this environment
+(0.4.x) only has the experimental spelling; newer jax only documents
+the top-level one.  Every TraceML call site goes through
+:func:`shard_map` here so the parallel ops and examples run on both —
+pass the NEW kwarg name (``check_vma``) and the shim translates
+backwards when it has to.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+
+
+def shard_map(
+    f: Callable[..., Any],
+    *,
+    mesh: Any,
+    in_specs: Any,
+    out_specs: Any,
+    check_vma: Optional[bool] = None,
+    **kwargs: Any,
+) -> Callable[..., Any]:
+    """``jax.shard_map`` when this jax has it, else the experimental
+    one with ``check_vma`` mapped back to its old name ``check_rep``.
+    ``check_vma=None`` means "library default" on either path."""
+    native = getattr(jax, "shard_map", None)
+    if native is not None:
+        if check_vma is not None:
+            kwargs["check_vma"] = check_vma
+        return native(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+        )
+    from jax.experimental.shard_map import shard_map as _experimental
+
+    if check_vma is not None:
+        kwargs["check_rep"] = check_vma
+    return _experimental(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+    )
+
+
+def axis_size(axis_name: Any) -> int:
+    """Static size of a mapped mesh axis from inside ``shard_map``.
+    ``jax.lax.axis_size`` where it exists; on 0.4.x the same int comes
+    from the trace context's axis env (``jax.core.axis_frame``).  The
+    result is a plain Python int either way — callers use it for
+    ``range()``/``fori_loop`` bounds and permutation tables."""
+    native = getattr(jax.lax, "axis_size", None)
+    if native is not None:
+        return native(axis_name)
+    from jax import core
+
+    return int(core.axis_frame(axis_name))
