@@ -792,6 +792,101 @@ def _run_micro_benches() -> int:
     ).returncode
 
 
+#: sizing dimensions that distinguish same-metric rows within one record
+#: (e.g. window_compute at 256 vs 1024 ranks) — folded into the label
+_TREND_DIM_KEYS = (
+    "ranks", "steps", "rows", "sessions", "viewers", "world", "tiers",
+)
+
+
+def _trend_rows(payload) -> list:
+    """Normalize one BENCH_LOCAL ``result`` payload to
+    ``[(bench, metric, dims, unit, value), …]``.  Handles both shapes in
+    the repo's history: a list of bench_common JSON lines (r07+) and a
+    single headline dict (r05/r06/r10)."""
+    rows = []
+    if isinstance(payload, list):
+        for r in payload:
+            if not isinstance(r, dict) or "value" not in r:
+                continue
+            dims = tuple(
+                (k, r[k]) for k in _TREND_DIM_KEYS if k in r
+            )
+            rows.append((
+                str(r.get("bench", "?")), str(r.get("metric", "?")),
+                dims, str(r.get("unit", "")), r["value"],
+            ))
+    elif isinstance(payload, dict):
+        if "metric" in payload and "value" in payload:
+            rows.append((
+                str(payload.get("bench", "headline")),
+                str(payload["metric"]), (),
+                str(payload.get("unit", "")), payload["value"],
+            ))
+        else:  # flat metric→value dict (r10)
+            for k, v in sorted(payload.items()):
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    rows.append(("headline", str(k), (), "", v))
+    return rows
+
+
+def _print_trend() -> int:
+    """Consolidate the repo's BENCH_LOCAL_r*.json records into one
+    printed trajectory table: bench → metric → per-round values.  Most
+    metrics live in one or two rounds (each round benchmarks what it
+    built); metrics re-measured across rounds show their trajectory on
+    a single line."""
+    import re
+
+    records = []
+    for path in sorted(REPO.glob("BENCH_LOCAL_r*.json")):
+        m = re.search(r"r(\d+)", path.name)
+        if not m:
+            continue
+        try:
+            data = json.loads(path.read_text())
+        except (OSError, ValueError) as exc:
+            print(f"[trend] skipping {path.name}: {exc}", file=sys.stderr)
+            continue
+        records.append((int(m.group(1)), data.get("result")))
+    if not records:
+        print("[trend] no BENCH_LOCAL_r*.json records found")
+        return 1
+    records.sort()
+    table: dict = {}
+    for rnd, payload in records:
+        for bench, metric, dims, unit, value in _trend_rows(payload):
+            table.setdefault((bench, metric, dims, unit), {})[rnd] = value
+    rounds = [rnd for rnd, _ in records]
+    print(
+        f"[trend] BENCH_LOCAL trajectory — {len(records)} rounds "
+        f"(r{rounds[0]:02d}–r{rounds[-1]:02d}), {len(table)} metrics"
+    )
+    width_b = max(len(k[0]) for k in table)
+    labels = {}
+    for key in table:
+        bench, metric, dims, unit = key
+        qual = (
+            "{" + ",".join(f"{k}={v}" for k, v in dims) + "}" if dims else ""
+        )
+        labels[key] = (metric + qual, unit)
+    width_m = max(len(lbl) for lbl, _ in labels.values())
+    for key in sorted(table):
+        bench = key[0]
+        lbl, unit = labels[key]
+        cells = "  ".join(
+            f"r{rnd:02d}={_trend_fmt(v)}" for rnd, v in sorted(table[key].items())
+        )
+        print(f"{bench:<{width_b}}  {lbl:<{width_m}}  {unit:<6} {cells}")
+    return 0
+
+
+def _trend_fmt(v) -> str:
+    if isinstance(v, float):
+        return f"{v:.4g}"
+    return str(v)
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--pair", action="store_true")
@@ -802,12 +897,19 @@ def main() -> int:
         help="run the slow-marker component benches (tests/benchmarks) "
         "instead of the tracer-overhead measurement",
     )
+    parser.add_argument(
+        "--trend", action="store_true",
+        help="print the consolidated BENCH_LOCAL_r* trajectory table "
+        "(bench → metric → per-round values) and exit",
+    )
     # None = lane defaults; explicit values size BOTH lanes (CI smoke)
     parser.add_argument("--rounds", type=int, default=None)
     parser.add_argument("--steps", type=int, default=None)
     parser.add_argument("--out", type=str)
     args = parser.parse_args()
 
+    if args.trend:
+        return _print_trend()
     if args.micro:
         return _run_micro_benches()
     if args.pair:

@@ -247,6 +247,9 @@ COLLECTIVES = declare(
 COLUMNAR_WINDOW = declare(
     "TRACEML_COLUMNAR_WINDOW", "1",
     "0 forces the scalar window-build reference path")
+INCR_WINDOW = declare(
+    "TRACEML_INCR_WINDOW", "1",
+    "0 disables the incremental window caches (full rebuild every tick)")
 SERVING = declare(
     "TRACEML_SERVING", "1",
     "0 turns every serving-capture entry point into a no-op")

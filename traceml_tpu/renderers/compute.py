@@ -26,6 +26,7 @@ from typing import Any, Dict, Optional, Tuple
 from traceml_tpu.diagnostics.step_time.api import diagnose_window
 from traceml_tpu.renderers import views as V
 from traceml_tpu.reporting.snapshot_store import LiveSnapshotStore
+from traceml_tpu.utils.columnar import incr_window_enabled
 
 # payload domain → (store versions it depends on, views key or None)
 # collectives also depends on step_time: COMM_BOUND needs the mean step
@@ -123,6 +124,10 @@ class LiveComputer:
         fragment cache on these, so the pair must be consistent."""
         with self._lock:
             payload = self.payload()
+            if incr_window_enabled():
+                stats = self._store.window_build_stats()
+                if stats:
+                    payload["window_build_stats"] = stats
             return payload, dict(self._store.versions)
 
     def _attach_rank_status(self, out: Dict[str, Any]) -> None:

@@ -43,7 +43,7 @@ _FRAGMENT_KEYS: Dict[str, Tuple[str, ...]] = {
     "stdout": ("stdout",),
     "history": ("history",),
     "diagnosis": ("diagnosis", "findings"),
-    "meta": ("ingest", "rank_status", "mesh", "regressions"),
+    "meta": ("ingest", "rank_status", "mesh", "regressions", "window_build"),
 }
 
 #: serving order — also the position of each counter in the version token
@@ -209,6 +209,13 @@ def _meta_fragment(
             out["regressions"] = regressions
     except Exception:
         pass
+    # incremental window-engine health (round 19): per-domain incr-tick
+    # vs full-rebuild counters + invalidation reasons, attached by
+    # payload_with_versions when TRACEML_INCR_WINDOW is on.  Absent key
+    # when the engine is off or never consulted (pre-r19 shape).
+    window_build = payload.get("window_build_stats")
+    if window_build:
+        out["window_build"] = window_build
     return out
 
 

@@ -35,6 +35,7 @@ from traceml_tpu.reporting import loaders
 from traceml_tpu.reporting.primary_diagnosis import build_primary_diagnosis
 from traceml_tpu.sdk import protocol
 from traceml_tpu.utils.atomic_io import atomic_write_json, atomic_write_text, read_json
+from traceml_tpu.utils.columnar import incr_window_enabled
 from traceml_tpu.utils.error_log import get_error_log
 from traceml_tpu.utils.formatting import fmt_bytes, fmt_ms, fmt_pct
 from traceml_tpu.utils.step_time_window import (
@@ -1311,6 +1312,14 @@ def generate_summary(
             )
             if k in stats
         }
+    # incremental window-engine counters (round 19): in a live session
+    # these show incr-tick vs full-rebuild ratios and invalidation
+    # reasons; in this one-shot summary they at least record which
+    # domains built columnar windows.  Absent when the engine is off.
+    if incr_window_enabled():
+        window_build = store.window_build_stats()
+        if window_build:
+            meta["window_build"] = window_build
     payload = {
         "schema": SCHEMA_VERSION,
         "meta": meta,

@@ -56,17 +56,21 @@ from typing import Any, Dict, List, Optional, Tuple
 from traceml_tpu.utils.columnar import (
     CollectivesColumns,
     CollectivesWindow,
+    CollectivesWindowCache,
     ColumnarFallback,
     MemoryColumns,
     RaggedEventColumns,
     ServingWindow,
+    ServingWindowCache,
     StepTimeColumns,
+    StepTimeWindowCache,
     build_collectives_window_rows,
     build_columnar_collectives_window,
     build_columnar_serving_window,
     build_columnar_step_time_window,
     build_serving_window_rows,
     columnar_window_enabled,
+    incr_window_enabled,
 )
 from traceml_tpu.aggregator.rollup import ROLLUP_SOURCES as _ROLLUP_SOURCES
 from traceml_tpu.utils.error_log import get_error_log
@@ -373,6 +377,10 @@ class LiveSnapshotStore:
         self._step_memory: Dict[int, _MemoryBuffer] = {}
         self._collectives: Dict[int, _CollectivesBuffer] = {}
         self._serving: Dict[int, _ServingBuffer] = {}
+        # incremental window caches (round 19): per-domain persistent
+        # aligned-cube/slot caches fed by the rings' monotone counters;
+        # created lazily on the first columnar build of each domain
+        self._window_caches: Dict[str, Any] = {}
         # system / process: globally-bounded (loader semantics), keyed rows
         self._system_host = _RankBuffer(self.max_system_rows)
         self._system_dev = _RankBuffer(self.max_system_rows)
@@ -947,6 +955,26 @@ class LiveSnapshotStore:
             ]
         return max(vals) if vals else None
 
+    def _window_cache(self, domain: str, factory):
+        """Lazily create the domain's incremental window cache (caller
+        holds the lock).  The cache survives for the store's lifetime:
+        every structural change it cannot follow (rank churn, eviction
+        into the window, clock flip, fallback) self-invalidates via the
+        rings' monotone counters, so no explicit reset hooks exist."""
+        cache = self._window_caches.get(domain)
+        if cache is None:
+            cache = self._window_caches[domain] = factory()
+        return cache
+
+    def window_build_stats(self) -> Dict[str, Dict[str, Any]]:
+        """Per-domain incremental-vs-full build counters (empty until a
+        columnar build ran with the incremental engine enabled)."""
+        with self._lock:
+            return {
+                domain: cache.stats.snapshot()
+                for domain, cache in sorted(self._window_caches.items())
+            }
+
     def build_step_time_window(
         self, max_steps: Optional[int] = None
     ) -> Optional[StepTimeWindow]:
@@ -968,6 +996,10 @@ class LiveSnapshotStore:
                         for rank, buf in self._step_time.items()
                         if buf.rows
                     }
+                    if incr_window_enabled():
+                        return self._window_cache(
+                            "step_time", StepTimeWindowCache
+                        ).build(cols, limit)
                     return build_columnar_step_time_window(cols, limit)
                 except ColumnarFallback:
                     pass
@@ -1010,6 +1042,10 @@ class LiveSnapshotStore:
                         for rank, buf in self._collectives.items()
                         if buf.rows
                     }
+                    if incr_window_enabled():
+                        return self._window_cache(
+                            "collectives", CollectivesWindowCache
+                        ).build(cols, limit)
                     return build_columnar_collectives_window(cols, limit)
                 except ColumnarFallback:
                     pass
@@ -1135,6 +1171,10 @@ class LiveSnapshotStore:
                         for rank, buf in self._serving.items()
                         if buf.rows
                     }
+                    if incr_window_enabled():
+                        return self._window_cache(
+                            "serving", ServingWindowCache
+                        ).build(cols, limit)
                     return build_columnar_serving_window(cols, limit)
                 except ColumnarFallback:
                     pass

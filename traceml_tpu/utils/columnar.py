@@ -39,12 +39,24 @@ Exactness rules the implementation leans on (and the golden tests pin):
   ``float()`` first — ``np.float64`` is not JSON serializable and its
   ``__round__`` differs from the float one.
 
-Kill switch: ``TRACEML_COLUMNAR_WINDOW=0`` forces the scalar path.
+Round 19 adds the *incremental window engine* (the ``*WindowCache``
+classes at the bottom of this module): each domain window keeps a
+persistent aligned-cube / per-slot cache owned by the snapshot store,
+and a dirty tick extends it by only the newly appended/aligned columns.
+Any condition the delta path cannot represent exactly — realignment,
+ring eviction crossing the window start, a clock flip, a flagged buffer
+— invalidates back to the full build above, which stays the golden
+reference: incremental output is bit-identical to a from-scratch build
+every tick (pinned by tests/utils/test_incremental_window.py).
+
+Kill switches: ``TRACEML_COLUMNAR_WINDOW=0`` forces the scalar path;
+``TRACEML_INCR_WINDOW=0`` forces full rebuilds (cache never consulted).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Any, Dict, List, Mapping, Optional
 
 import numpy as np
@@ -77,6 +89,10 @@ def columnar_window_enabled() -> bool:
     return flags.COLUMNAR_WINDOW.enabled()
 
 
+def incr_window_enabled() -> bool:
+    return flags.INCR_WINDOW.enabled()
+
+
 class ColumnarFallback(Exception):
     """Raised when the columnar build cannot reproduce the scalar path
     exactly; the caller must rerun the scalar reference on the rows."""
@@ -89,12 +105,17 @@ class _CompactRing:
     store's ``deque(maxlen=cap)`` exactly, so views are always
     contiguous and eviction is an O(1) ``start`` bump."""
 
-    __slots__ = ("cap", "_start", "_end")
+    __slots__ = ("cap", "_start", "_end", "appended_total", "evicted_total")
 
     def __init__(self, cap: int) -> None:
         self.cap = max(1, int(cap))
         self._start = 0
         self._end = 0
+        # monotone lifetime counters — the incremental window caches
+        # compare these against their last-build values to detect new
+        # rows and evictions without touching the arrays
+        self.appended_total = 0
+        self.evicted_total = 0
 
     def __len__(self) -> int:
         return self._end - self._start
@@ -111,17 +132,22 @@ class _CompactRing:
             self._start, self._end = 0, n
         if len(self) == self.cap:
             self._start += 1
+            self.evicted_total += 1
         i = self._end
         self._end += 1
+        self.appended_total += 1
         return i
 
     def evict_head(self, n: int) -> None:
         """Drop the oldest ``n`` entries (retention-trim lockstep with
         the snapshot store's deque eviction)."""
         if n > 0:
+            dropped = min(n, len(self))
             self._start = min(self._start + n, self._end)
+            self.evicted_total += dropped
 
     def _reset(self) -> None:
+        self.evicted_total += len(self)
         self._start = 0
         self._end = 0
 
@@ -207,6 +233,12 @@ class StepTimeColumns(_CompactRing):
     def clock_all_device(self) -> bool:
         return bool(self._clock_ok[self._start : self._end].all())
 
+    def clock_tail_device(self, k: int) -> bool:
+        """True when the newest ``k`` live rows are all device-clocked —
+        the incremental tick's O(new) "still all-device" check (old live
+        rows were already all-device and rows never mutate)."""
+        return bool(self._clock_ok[self._end - k : self._end].all())
+
 
 # MemoryColumns layout: one int64 matrix, -1 == NULL.  Integer columns
 # (not float) so byte counts survive exactly into view payloads
@@ -290,24 +322,44 @@ class MemoryColumns(_CompactRing):
 
 class _ColumnarData:
     """Raw arrays behind a built window (the ``window.col`` namespace
-    the renderers/diagnostics fast paths read)."""
+    the renderers/diagnostics fast paths read).  ``medians`` are
+    computed lazily from the cube on first access — diagnosis rules
+    that never touch a median don't pay the (R, 11, S) partition, and
+    the incremental tick skips it entirely unless a consumer asks."""
 
     __slots__ = (
         "ranks",
         "steps",
         "series_cube",
         "averages",
-        "medians",
+        "_medians",
         "occupancy",
+        "occ_num",
+        "occ_host",
     )
 
-    def __init__(self, ranks, steps, series_cube, averages, medians, occupancy):
+    def __init__(
+        self, ranks, steps, series_cube, averages, medians, occupancy,
+        occ_num=None, occ_host=None,
+    ):
         self.ranks: List[int] = ranks
         self.steps: np.ndarray = steps  # (S,) int64 aligned step ids
         self.series_cube: np.ndarray = series_cube  # (R, 11, S) ALL_KEYS order
         self.averages: np.ndarray = averages  # (R, 11)
-        self.medians: np.ndarray = medians  # (R, 11)
+        self._medians: Optional[np.ndarray] = medians  # (R, 11) or lazy
         self.occupancy: np.ndarray = occupancy  # (R,), NaN == None
+        # zero-filled occupancy numerator/denominator parts (R, S) — kept
+        # so the incremental cache can re-fold occupancy after a window
+        # slide without re-reading the rings
+        self.occ_num: Optional[np.ndarray] = occ_num
+        self.occ_host: Optional[np.ndarray] = occ_host
+
+    @property
+    def medians(self) -> np.ndarray:
+        m = self._medians
+        if m is None:
+            m = self._medians = np.median(self.series_cube, axis=2)
+        return m
 
 
 class _LazySeries(dict):
@@ -427,6 +479,107 @@ class ColumnarStepTimeWindow(StepTimeWindow):
         return out
 
 
+def _left_fold_last(cube: np.ndarray) -> np.ndarray:
+    """Exact sequential left-fold sum along the LAST axis.
+    ``np.cumsum`` runs ``np.add.accumulate`` — a strictly sequential
+    left-to-right scan per lane (unlike ``np.sum``'s pairwise tree), so
+    the last prefix IS the left fold, bit for bit, at C speed.  Shared
+    by the full build and the incremental tick so their averages cannot
+    diverge.  The ``.copy()`` frees the (…, S) prefix array instead of
+    pinning it behind the returned view for the payload's lifetime."""
+    if cube.shape[-1] == 1:
+        return np.copy(cube[..., 0])
+    return np.cumsum(cube, axis=-1)[..., -1].copy()
+
+
+def _select_clamp_slab(cube_raw: np.ndarray, clock: str) -> np.ndarray:
+    """(R, n, N_EVENTS, 2) raw gathered values → (R, 11, n) series slab:
+    clock selection, missing → 0.0, residual clamp, explicit accounted
+    left-fold in PHASES order (exactly the scalar accumulation).  Every
+    output column depends only on its own raw column, which is what lets
+    the incremental tick compute bit-identical columns one slab at a
+    time."""
+    if clock == "device":
+        dev = cube_raw[..., 1]
+        cpu = cube_raw[..., 0]
+        sel = np.where(np.isnan(dev), cpu, dev)
+    else:
+        sel = cube_raw[..., 0]
+    sel = np.where(np.isnan(sel), 0.0, sel)  # missing -> 0.0, like the scalar `or 0.0`
+    step = sel[:, :, 0]  # (R, n)
+    phases = sel[:, :, 1:]  # (R, n, 9)
+    clamped = np.where(
+        (step > 0)[:, :, None], np.minimum(phases, step[:, :, None]), phases
+    )
+    accounted = clamped[:, :, 0].copy()
+    for k in range(1, len(ACCOUNTED_PHASES)):
+        accounted += clamped[:, :, k]
+    residual = np.maximum(0.0, step - accounted)
+    slab = np.empty((step.shape[0], len(ALL_KEYS), step.shape[1]), dtype=np.float64)
+    slab[:, 0] = step
+    slab[:, 1 : 1 + len(ACCOUNTED_PHASES)] = np.moveaxis(clamped, 2, 1)
+    slab[:, len(ALL_KEYS) - 1] = residual
+    return slab
+
+
+def _zeroed_occ_parts(occ_parts: np.ndarray) -> "tuple[np.ndarray, np.ndarray]":
+    """(R, n, 2) raw occupancy parts → zero-filled (num, host) pair."""
+    num = np.where(np.isnan(occ_parts[:, :, 0]), 0.0, occ_parts[:, :, 0])
+    host = np.where(np.isnan(occ_parts[:, :, 1]), 0.0, occ_parts[:, :, 1])
+    return num, host
+
+
+def _occupancy_from_sums(
+    num_sum: np.ndarray, host_sum: np.ndarray
+) -> np.ndarray:
+    """Shared tail of :func:`_occupancy_fold` and the incremental
+    cache's mirror fold: sum/sum with the scalar path's 1.0 clamp, NaN
+    where no host time."""
+    with np.errstate(divide="ignore", invalid="ignore"):
+        return np.where(host_sum > 0, np.minimum(num_sum / host_sum, 1.0), np.nan)
+
+
+def _occupancy_fold(num: np.ndarray, host: np.ndarray) -> np.ndarray:
+    """Per-rank occupancy from zero-filled (R, S) part planes — the
+    scalar fold's sum/sum with the 1.0 clamp, NaN where no host time."""
+    return _occupancy_from_sums(_left_fold_last(num), _left_fold_last(host))
+
+
+def _fold_step_major(arr_t: np.ndarray, lo: int, hi: int) -> np.ndarray:
+    """Exact left fold over ``[lo, hi)`` of a STEP-MAJOR mirror
+    ``(step, ...)``.  Per lane this performs the identical add sequence
+    as :func:`_left_fold_last` over the lane-major cube — same bits —
+    but each ``arr_t[j]`` slice is contiguous, so the loop runs at
+    memcpy speed instead of gathering one strided element per lane."""
+    acc = arr_t[lo].copy()
+    for j in range(lo + 1, hi):
+        acc += arr_t[j]
+    return acc
+
+
+def _step_time_metrics(
+    averages: np.ndarray, ranks: List[int]
+) -> Dict[str, StepCombinedTimeMetric]:
+    """Cross-rank metrics from the (R, 11) averages (native floats
+    throughout; first-max tie-break matching the scalar ``max()``)."""
+    metrics: Dict[str, StepCombinedTimeMetric] = {}
+    avg_rows = averages.tolist()  # R x 11 native floats
+    for ki, key in enumerate(ALL_KEYS):
+        col_vals = [row[ki] for row in avg_rows]
+        med = float(np.median(averages[:, ki]))
+        wi = int(np.argmax(averages[:, ki]))  # first max == scalar max() tie-break
+        worst = col_vals[wi]
+        metrics[key] = StepCombinedTimeMetric(
+            key=key,
+            per_rank_avg_ms=dict(zip(ranks, col_vals)),
+            median_ms=med,
+            worst_ms=worst,
+            worst_rank=ranks[wi],
+            skew_pct=(worst - med) / med if med > 0 else 0.0,
+        )
+    return metrics
+
+
 def build_columnar_step_time_window(
     rank_cols: Mapping[int, StepTimeColumns],
     max_steps: int,
@@ -469,61 +622,20 @@ def build_columnar_step_time_window(
         cube_raw[i] = c.vals_view()[idx]
         occ_parts[i] = c.occ_view()[idx]
 
-    if clock == "device":
-        dev = cube_raw[..., 1]
-        cpu = cube_raw[..., 0]
-        sel = np.where(np.isnan(dev), cpu, dev)
-    else:
-        sel = cube_raw[..., 0]
-    sel = np.where(np.isnan(sel), 0.0, sel)  # missing -> 0.0, like the scalar `or 0.0`
+    # 4. clock select + residual clamp + accounted left-fold (shared
+    # with the incremental tick — see _select_clamp_slab)
+    series_cube = _select_clamp_slab(cube_raw, clock)
 
-    # 4. residual clamp: any phase capped to the step envelope, then an
-    # explicit left-fold in PHASES order (exactly the scalar accumulation)
-    step = sel[:, :, 0]  # (R, S)
-    phases = sel[:, :, 1:]  # (R, S, 9)
-    clamped = np.where(
-        (step > 0)[:, :, None], np.minimum(phases, step[:, :, None]), phases
-    )
-    accounted = clamped[:, :, 0].copy()
-    for k in range(1, len(ACCOUNTED_PHASES)):
-        accounted += clamped[:, :, k]
-    residual = np.maximum(0.0, step - accounted)
-
-    series_cube = np.empty((R, len(ALL_KEYS), S), dtype=np.float64)
-    series_cube[:, 0] = step
-    series_cube[:, 1 : 1 + len(ACCOUNTED_PHASES)] = np.moveaxis(clamped, 2, 1)
-    series_cube[:, len(ALL_KEYS) - 1] = residual
-
-    # 5. per-rank stats: cumsum[-1] is the exact left-fold sum
-    averages = np.cumsum(series_cube, axis=2)[:, :, -1] / S
-    medians = np.median(series_cube, axis=2)
+    # 5. per-rank averages: an exact left-fold sum (medians are lazy on
+    # _ColumnarData — most consumers never touch them)
+    averages = _left_fold_last(series_cube) / S
 
     # 6. occupancy: fold the precomputed (device_busy, host) parts
-    num = np.where(np.isnan(occ_parts[:, :, 0]), 0.0, occ_parts[:, :, 0])
-    host = np.where(np.isnan(occ_parts[:, :, 1]), 0.0, occ_parts[:, :, 1])
-    num_sum = np.cumsum(num, axis=1)[:, -1]
-    host_sum = np.cumsum(host, axis=1)[:, -1]
-    with np.errstate(divide="ignore", invalid="ignore"):
-        occupancy = np.where(
-            host_sum > 0, np.minimum(num_sum / host_sum, 1.0), np.nan
-        )
+    occ_num, occ_host = _zeroed_occ_parts(occ_parts)
+    occupancy = _occupancy_fold(occ_num, occ_host)
 
     # 7. cross-rank metrics (native floats throughout)
-    metrics: Dict[str, StepCombinedTimeMetric] = {}
-    avg_rows = averages.tolist()  # R x 11 native floats
-    for ki, key in enumerate(ALL_KEYS):
-        col_vals = [row[ki] for row in avg_rows]
-        med = float(np.median(averages[:, ki]))
-        wi = int(np.argmax(averages[:, ki]))  # first max == scalar max() tie-break
-        worst = col_vals[wi]
-        metrics[key] = StepCombinedTimeMetric(
-            key=key,
-            per_rank_avg_ms=dict(zip(ranks, col_vals)),
-            median_ms=med,
-            worst_ms=worst,
-            worst_rank=ranks[wi],
-            skew_pct=(worst - med) / med if med > 0 else 0.0,
-        )
+    metrics = _step_time_metrics(averages, ranks)
 
     phases_present = [
         k
@@ -537,8 +649,10 @@ def build_columnar_step_time_window(
         steps=common,
         series_cube=series_cube,
         averages=averages,
-        medians=medians,
+        medians=None,
         occupancy=occupancy,
+        occ_num=occ_num,
+        occ_host=occ_host,
     )
     return ColumnarStepTimeWindow(
         col=col,
@@ -1677,3 +1791,905 @@ def serving_window_to_plain(w: Optional[ServingWindow]) -> Optional[Dict[str, An
         "per_rank": {r: dict(v) for r, v in sorted(w.per_rank.items())},
         "totals": dict(w.totals),
     }
+
+
+# ---------------------------------------------------------------------------
+# Incremental window engine (round 19): persistent per-domain caches that
+# turn a steady-state dirty tick into O(Δ) work.  The full builds above
+# stay the golden reference — every code path below either reproduces
+# their output bit-identically or invalidates back to them.
+# ---------------------------------------------------------------------------
+
+_EMPTY_I64 = np.empty(0, dtype=np.int64)
+
+# invalidation reasons (the observability vocabulary surfaced through
+# WindowBuildStats; tests pin these strings)
+INVALIDATE_COLD = "cold_start"
+INVALIDATE_RANKS = "rank_set_changed"
+INVALIDATE_CLOCK = "clock_flip"
+INVALIDATE_EVICTED = "window_evicted"
+INVALIDATE_SIZE = "window_size_changed"
+INVALIDATE_REALIGNED = "realigned"
+INVALIDATE_FALLBACK = "fallback"
+
+
+class _CacheInvalid(Exception):
+    """Internal: the delta path cannot represent this tick exactly —
+    fall back to a full rebuild (carrying the reason for the stats)."""
+
+    def __init__(self, reason: str) -> None:
+        super().__init__(reason)
+        self.reason = reason
+
+
+class WindowBuildStats:
+    """Per-domain window-build counters: how many ticks were served
+    incrementally vs full rebuilds, why the cache invalidated, and the
+    last build's wall time.  Surfaced through the snapshot store →
+    ``payload_with_versions`` meta → dashboard / final report, so a
+    session silently degrading to full rebuilds is visible."""
+
+    __slots__ = (
+        "incr_ticks", "full_rebuilds", "invalidations",
+        "last_build_ms", "last_path",
+    )
+
+    def __init__(self) -> None:
+        self.incr_ticks = 0
+        self.full_rebuilds = 0
+        self.invalidations: Dict[str, int] = {}
+        self.last_build_ms = 0.0
+        self.last_path = ""
+
+    def note_incr(self, ms: float) -> None:
+        self.incr_ticks += 1
+        self.last_build_ms = ms
+        self.last_path = "incremental"
+
+    def note_full(self, ms: float, reason: str) -> None:
+        self.full_rebuilds += 1
+        self.invalidations[reason] = self.invalidations.get(reason, 0) + 1
+        self.last_build_ms = ms
+        self.last_path = "full"
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "incr_ticks": self.incr_ticks,
+            "full_rebuilds": self.full_rebuilds,
+            "invalidations": dict(self.invalidations),
+            "last_build_ms": self.last_build_ms,
+            "last_path": self.last_path,
+        }
+
+
+class _WindowCacheBase:
+    """Shared build shell: try the delta tick, invalidate to the full
+    (golden) build on any condition the delta path cannot represent
+    exactly, re-prime the cache from the full result, and keep the
+    counters honest.  ``ColumnarFallback`` propagates to the caller
+    (the store runs the scalar reference) after noting the reason."""
+
+    def __init__(self) -> None:
+        self.stats = WindowBuildStats()
+        self._valid = False
+
+    def invalidate(self) -> None:
+        self._valid = False
+
+    # subclass hooks -----------------------------------------------------
+    def _tick(self, rank_cols, max_steps):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def _full_build(self, rank_cols, max_steps):  # pragma: no cover
+        raise NotImplementedError
+
+    def _prime(self, window, rank_cols, max_steps):  # pragma: no cover
+        raise NotImplementedError
+
+    # --------------------------------------------------------------------
+    def build(self, rank_cols, max_steps: int):
+        t0 = time.perf_counter()
+        try:
+            window = self._tick(rank_cols, max_steps)
+        except _CacheInvalid as inv:
+            self._valid = False
+            try:
+                window = self._full_build(rank_cols, max_steps)
+            except ColumnarFallback:
+                self.stats.note_full(
+                    (time.perf_counter() - t0) * 1000.0, INVALIDATE_FALLBACK
+                )
+                raise
+            self._prime(window, rank_cols, max_steps)
+            self.stats.note_full(
+                (time.perf_counter() - t0) * 1000.0, inv.reason
+            )
+            return window
+        except ColumnarFallback:
+            self._valid = False
+            self.stats.note_full(
+                (time.perf_counter() - t0) * 1000.0, INVALIDATE_FALLBACK
+            )
+            raise
+        self.stats.note_incr((time.perf_counter() - t0) * 1000.0)
+        return window
+
+    @staticmethod
+    def _sorted_items(rank_cols):
+        items = [
+            (int(r), c)
+            for r, c in sorted(rank_cols.items(), key=lambda kv: kv[0])
+            if len(c)
+        ]
+        for _, c in items:
+            if not c.columnar_ok:
+                raise ColumnarFallback("flagged rank buffer")
+        return items
+
+
+class StepTimeWindowCache(_WindowCacheBase):
+    """Persistent aligned-cube cache for the step_time window.
+
+    The cache owns a (rank, 11, step) series-cube buffer with slack
+    along the step axis (2x the window, compacted with a memmove like
+    :class:`_CompactRing`).  A dirty tick:
+
+    * gathers/clamps ONLY the newly-common aligned columns and appends
+      them (each column depends only on its own raw values, so a column
+      built at tick t is bit-identical to the same column inside a
+      from-scratch cube);
+    * slides the window head past ring-evicted steps (head-only
+      eviction + strictly-ascending per-rank steps mean a surviving
+      cached step is still common — mid-window membership changes are
+      impossible, so a slide is exact, not an approximation);
+    * re-folds averages/occupancy over the cached cube with the same
+      exact left-fold the full build uses (float window sums cannot be
+      delta-updated bit-exactly — ``(a+b)-a != b`` in IEEE — but the
+      fold over the cached cube is cheap); medians stay lazy.
+
+    Invalidation → full rebuild: rank-set change, clock flip, window
+    length change, the whole cache evicted, or ``ColumnarFallback``.
+
+    Aliasing contract: emitted windows hand out views into the cache
+    buffers and are valid until the next ``build()`` — the same
+    lifetime the ring views already have.  Consumers (LiveComputer)
+    serialize within the tick.
+    """
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._max_steps = 0
+        self._ranks: List[int] = []
+        self._clock = "host"
+        self._last_aligned = 0
+        self._cap = 0
+        self._lo = 0
+        self._hi = 0
+        self._steps: Optional[np.ndarray] = None
+        self._cube: Optional[np.ndarray] = None
+        self._num: Optional[np.ndarray] = None
+        self._host: Optional[np.ndarray] = None
+        self._phase_any: Optional[np.ndarray] = None
+        # step-major mirrors of cube/num/host: the per-tick re-folds
+        # walk contiguous (R, …) slices instead of strided lanes
+        # (same adds, same order, same bits — see _fold_step_major)
+        self._cube_t: Optional[np.ndarray] = None
+        self._num_t: Optional[np.ndarray] = None
+        self._host_t: Optional[np.ndarray] = None
+        # per-rank (sorted order) bookkeeping that lets the warm tick
+        # skip binary searches: appended_total snapshot at last tick,
+        # and whether the rank's newest row WAS the aligned tail
+        self._seen_appended: List[int] = []
+        self._aligned: List[bool] = []
+
+    def _full_build(self, rank_cols, max_steps):
+        return build_columnar_step_time_window(rank_cols, max_steps)
+
+    def _tick(self, rank_cols, max_steps):
+        if not self._valid:
+            raise _CacheInvalid(INVALIDATE_COLD)
+        if int(max_steps) != self._max_steps:
+            raise _CacheInvalid(INVALIDATE_SIZE)
+        items = self._sorted_items(rank_cols)
+        if [r for r, _ in items] != self._ranks:
+            raise _CacheInvalid(INVALIDATE_RANKS)
+        R = len(items)
+        la = self._last_aligned
+        dev_cached = self._clock == "device"
+
+        head_floor = None
+        svs: List[np.ndarray] = []
+        tails: List[np.ndarray] = []
+        lasts: List[int] = []
+        new_app: List[int] = []
+        any_empty_tail = False
+        for i, (_, c) in enumerate(items):
+            sv = c.steps_view()
+            svs.append(sv)
+            first = int(sv[0])
+            if head_floor is None or first > head_floor:
+                head_floor = first
+            lasts.append(int(sv[-1]))
+            n_new = c.appended_total - self._seen_appended[i]
+            new_app.append(c.appended_total)
+            k = n_new if n_new < sv.size else sv.size
+            if k > 0:
+                if int(sv[sv.size - k]) <= la:
+                    # a cleared-and-restarted rank re-reported an old
+                    # step; the intersection delta cannot express that
+                    raise _CacheInvalid(INVALIDATE_REALIGNED)
+                if dev_cached and not c.clock_tail_device(k):
+                    raise _CacheInvalid(INVALIDATE_CLOCK)
+            if self._aligned[i]:
+                # the rank's newest row WAS the aligned tail, so its
+                # candidate rows are exactly the surviving appends since
+                # last tick (strict per-rank ascent puts them above la)
+                # — no binary search needed on the warm path
+                t = sv[sv.size - k :] if k > 0 else _EMPTY_I64
+            else:
+                # rank ran ahead of the aligned tail last tick: older
+                # rows above la are candidates too
+                t = sv[int(np.searchsorted(sv, la, side="right")):]
+            tails.append(t)
+            if t.size == 0:
+                any_empty_tail = True
+        if dev_cached:
+            clock = "device"
+        else:
+            # host → device flips only when every host-clocked row has
+            # evicted; the scan short-circuits on the first host row
+            for _, c in items:
+                if not c.clock_all_device():
+                    break
+            else:
+                raise _CacheInvalid(INVALIDATE_CLOCK)
+            clock = "host"
+        # every check that can invalidate has passed — commit counters
+        self._seen_appended = new_app
+
+        # newly-common steps: present in EVERY rank's post-cache tail
+        # (a step at or below the cached tail cannot gain membership —
+        # per-rank steps are strictly ascending)
+        if any_empty_tail:
+            new_common = _EMPTY_I64
+        elif R == 1:
+            new_common = tails[0]
+        else:
+            uniq, counts = np.unique(np.concatenate(tails), return_counts=True)
+            new_common = uniq[counts == R]
+
+        if new_common.size:
+            new_common = new_common[-self._max_steps:]
+            n_new = int(new_common.size)
+            self._ensure_capacity(n_new)
+            hi = self._hi
+            cube_raw = np.empty((R, n_new, N_EVENTS, 2), dtype=np.float64)
+            occ_parts = np.empty((R, n_new, 2), dtype=np.float64)
+            for i, (_, c) in enumerate(items):
+                t = tails[i]
+                base = svs[i].size - t.size
+                if t.size == n_new:
+                    # new_common ⊆ every tail, so equal size means equal
+                    # content — the gather is a plain tail slice (the
+                    # warm steady-state path: one new step, all ranks)
+                    cube_raw[i] = c.vals_view()[base:]
+                    occ_parts[i] = c.occ_view()[base:]
+                else:
+                    idx = base + np.searchsorted(t, new_common)
+                    cube_raw[i] = c.vals_view()[idx]
+                    occ_parts[i] = c.occ_view()[idx]
+            slab = _select_clamp_slab(cube_raw, clock)
+            self._cube[:, :, hi : hi + n_new] = slab
+            num, host = _zeroed_occ_parts(occ_parts)
+            self._num[:, hi : hi + n_new] = num
+            self._host[:, hi : hi + n_new] = host
+            self._cube_t[hi : hi + n_new] = np.moveaxis(slab, 2, 0)
+            self._num_t[hi : hi + n_new] = num.T
+            self._host_t[hi : hi + n_new] = host.T
+            for j in range(len(ACCOUNTED_PHASES)):
+                self._phase_any[j, hi : hi + n_new] = (
+                    slab[:, 1 + j, :] > 0
+                ).any(axis=0)
+            self._steps[hi : hi + n_new] = new_common
+            self._hi = hi + n_new
+            self._last_aligned = int(new_common[-1])
+        new_la = self._last_aligned
+        self._aligned = [l == new_la for l in lasts]
+
+        # slide the head past evicted steps, then clamp to the window
+        lo = self._lo + int(
+            np.searchsorted(
+                self._steps[self._lo : self._hi], head_floor, side="left"
+            )
+        )
+        self._lo = max(lo, self._hi - self._max_steps)
+        if self._hi == self._lo:
+            return None  # intersection empty — matches the full build
+        return self._emit(clock)
+
+    def _ensure_capacity(self, n_new: int) -> None:
+        if self._hi + n_new <= self._cap:
+            return
+        live = self._hi - self._lo
+        lo, hi = self._lo, self._hi
+        # live + n_new <= 2*max_steps == cap by construction (new
+        # columns are pre-clamped to the window length)
+        self._steps[:live] = self._steps[lo:hi]
+        self._cube[:, :, :live] = self._cube[:, :, lo:hi]
+        self._num[:, :live] = self._num[:, lo:hi]
+        self._host[:, :live] = self._host[:, lo:hi]
+        self._cube_t[:live] = self._cube_t[lo:hi]
+        self._num_t[:live] = self._num_t[lo:hi]
+        self._host_t[:live] = self._host_t[lo:hi]
+        self._phase_any[:, :live] = self._phase_any[:, lo:hi]
+        self._lo, self._hi = 0, live
+
+    def _emit(self, clock: str) -> ColumnarStepTimeWindow:
+        lo, hi = self._lo, self._hi
+        S = hi - lo
+        steps = self._steps[lo:hi]
+        cube = self._cube[:, :, lo:hi]
+        averages = _fold_step_major(self._cube_t, lo, hi) / S
+        occupancy = _occupancy_from_sums(
+            _fold_step_major(self._num_t, lo, hi),
+            _fold_step_major(self._host_t, lo, hi),
+        )
+        metrics = _step_time_metrics(averages, self._ranks)
+        phases_present = [
+            k
+            for j, k in enumerate(ACCOUNTED_PHASES)
+            if bool(self._phase_any[j, lo:hi].any())
+        ]
+        steps_list = steps.tolist()
+        col = _ColumnarData(
+            ranks=list(self._ranks),
+            steps=steps,
+            series_cube=cube,
+            averages=averages,
+            medians=None,
+            occupancy=occupancy,
+        )
+        return ColumnarStepTimeWindow(
+            col=col,
+            clock=clock,
+            steps=steps_list,
+            ranks=list(self._ranks),
+            rank_windows=_LazyRankWindows(col, steps_list, clock),
+            metrics=metrics,
+            phases_present=phases_present,
+            n_steps=S,
+        )
+
+    def _prime(self, window, rank_cols, max_steps) -> None:
+        if window is None:
+            self._valid = False
+            return
+        col = window.col
+        R = len(col.ranks)
+        S = window.n_steps
+        self._max_steps = int(max_steps)
+        self._cap = 2 * self._max_steps
+        self._steps = np.empty(self._cap, dtype=np.int64)
+        self._cube = np.empty((R, len(ALL_KEYS), self._cap), dtype=np.float64)
+        self._num = np.empty((R, self._cap), dtype=np.float64)
+        self._host = np.empty((R, self._cap), dtype=np.float64)
+        self._cube_t = np.empty(
+            (self._cap, R, len(ALL_KEYS)), dtype=np.float64
+        )
+        self._num_t = np.empty((self._cap, R), dtype=np.float64)
+        self._host_t = np.empty((self._cap, R), dtype=np.float64)
+        self._phase_any = np.empty(
+            (len(ACCOUNTED_PHASES), self._cap), dtype=np.bool_
+        )
+        self._steps[:S] = col.steps
+        self._cube[:, :, :S] = col.series_cube
+        self._num[:, :S] = col.occ_num
+        self._host[:, :S] = col.occ_host
+        self._cube_t[:S] = np.moveaxis(col.series_cube, 2, 0)
+        self._num_t[:S] = col.occ_num.T
+        self._host_t[:S] = col.occ_host.T
+        for j in range(len(ACCOUNTED_PHASES)):
+            self._phase_any[j, :S] = (col.series_cube[:, 1 + j, :] > 0).any(
+                axis=0
+            )
+        self._lo, self._hi = 0, S
+        self._ranks = list(col.ranks)
+        self._clock = window.clock
+        self._last_aligned = int(col.steps[-1])
+        items = self._sorted_items(rank_cols)
+        self._seen_appended = [c.appended_total for _, c in items]
+        self._aligned = [
+            int(c.steps_view()[-1]) == self._last_aligned for _, c in items
+        ]
+        self._valid = True
+
+
+class _SlotWindowCacheBase(_WindowCacheBase):
+    """Shared machinery for the union-aligned (collectives/serving)
+    caches: per-step slot arrays with 2x slack, a delta scan that
+    classifies newly appended rows against the cached window, and a
+    conservative eviction guard keyed on the rings' monotone
+    ``appended_total``/``evicted_total`` counters.
+
+    Slot exactness: a cached slot value equals the fold (in sorted-rank,
+    row-order — ``np.add.at`` element order) over ALL live rows carrying
+    that step.  A new row landing on a cached step makes the slot
+    "touched"; touched and new slots are recomputed from scratch from
+    the raw rows, so partial-sum merging (which would change IEEE fold
+    grouping) never happens.
+
+    Invalidation: a mid-window union insert (new step ≤ cached max not
+    already cached) or a below-window insert while the union is still
+    shorter than the window ("realigned"), and any eviction whose
+    surviving head sits at/above the cached window start
+    ("window_evicted" — the evicted rows might have contributed to
+    cached slots).  Evictions strictly below the window are provably
+    harmless: head-only eviction + non-decreasing steps mean every
+    evicted step ≤ the surviving oldest step < window start."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._max_steps = 0
+        self._cap = 0
+        self._lo = 0
+        self._hi = 0
+        self._steps: Optional[np.ndarray] = None
+        self._ranks: List[int] = []
+        self._seen_appended: List[int] = []
+        self._seen_evicted: List[int] = []
+
+    def _slot_arrays(self):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def _scan_delta(self, items, max_steps: int):
+        """Classify each rank's newly appended rows.  Returns
+        ``(touched_steps, new_union_steps)`` or raises
+        :class:`_CacheInvalid`.  Seen counters advance only when every
+        check passed (a raise re-primes them anyway)."""
+        if [r for r, _ in items] != self._ranks:
+            raise _CacheInvalid(INVALIDATE_RANKS)
+        lo_step = int(self._steps[self._lo])
+        cached_max = int(self._steps[self._hi - 1])
+        cached_S = self._hi - self._lo
+        cs = self._steps[self._lo : self._hi]
+        touched: set = set()
+        tail_parts: List[np.ndarray] = []
+        new_app: List[int] = []
+        new_ev: List[int] = []
+        for i, (_, c) in enumerate(items):
+            new_app.append(c.appended_total)
+            new_ev.append(c.evicted_total)
+            sv = c.steps_view()
+            if c.evicted_total != self._seen_evicted[i] and int(sv[0]) >= lo_step:
+                raise _CacheInvalid(INVALIDATE_EVICTED)
+            n_new = c.appended_total - self._seen_appended[i]
+            if n_new <= 0:
+                continue
+            ns = sv[max(0, sv.size - n_new):]
+            pos = int(np.searchsorted(ns, cached_max, side="right"))
+            old_part = ns[:pos]
+            if old_part.size:
+                below = old_part[old_part < lo_step]
+                if below.size and cached_S < max_steps:
+                    # the union (== cached window) would grow downward
+                    raise _CacheInvalid(INVALIDATE_REALIGNED)
+                within = old_part[old_part >= lo_step]
+                if within.size:
+                    at = np.searchsorted(cs, within)
+                    if bool((cs[at] != within).any()):
+                        # mid-window union insert
+                        raise _CacheInvalid(INVALIDATE_REALIGNED)
+                    touched.update(int(x) for x in within)
+            if pos < ns.size:
+                tail_parts.append(ns[pos:])
+        if tail_parts:
+            if len(tail_parts) > 1:
+                new_steps = np.unique(np.concatenate(tail_parts))
+            else:
+                new_steps = np.unique(tail_parts[0])
+        else:
+            new_steps = _EMPTY_I64
+        self._seen_appended = new_app
+        self._seen_evicted = new_ev
+        return touched, new_steps
+
+    def _ensure_slot_capacity(self, n_new: int) -> None:
+        if self._hi + n_new <= self._cap:
+            return
+        live = self._hi - self._lo
+        lo, hi = self._lo, self._hi
+        self._steps[:live] = self._steps[lo:hi]
+        for a in self._slot_arrays():
+            a[:live] = a[lo:hi]
+        self._lo, self._hi = 0, live
+
+    def _append_and_touch(self, touched, new_steps):
+        """Append zeroed slots for the new union steps (pre-clamped to
+        the window), slide the window, and mark them for recompute."""
+        if new_steps.size:
+            new_steps = new_steps[-self._max_steps:]
+            n_new = int(new_steps.size)
+            self._ensure_slot_capacity(n_new)
+            hi = self._hi
+            self._steps[hi : hi + n_new] = new_steps
+            for a in self._slot_arrays():
+                a[hi : hi + n_new] = 0
+            self._hi = hi + n_new
+            self._lo = max(self._lo, self._hi - self._max_steps)
+            touched.update(int(x) for x in new_steps)
+        return touched
+
+    def _prime_common(self, window, rank_cols) -> None:
+        self._lo, self._hi = 0, window.n_steps
+        items = self._sorted_items(rank_cols)
+        self._ranks = [r for r, _ in items]
+        self._seen_appended = [c.appended_total for _, c in items]
+        self._seen_evicted = [c.evicted_total for _, c in items]
+        self._valid = True
+
+
+class CollectivesWindowCache(_SlotWindowCacheBase):
+    """Incremental collectives window: per-step count/bytes/duration/
+    exposed/allreduce-fp32 slots are cached and delta-maintained; the
+    per-op / per-rank / group aggregates are re-folded each tick over
+    the live row suffixes with the exact full-build fold (their fold
+    start moves with the window head, so a cached partial sum cannot be
+    reused bit-exactly — the refold over ring views is still far
+    cheaper than the full gather + per-slot scatter)."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._count: Optional[np.ndarray] = None
+        self._bytes: Optional[np.ndarray] = None
+        self._ar: Optional[np.ndarray] = None
+        self._dur: Optional[np.ndarray] = None
+        self._exp: Optional[np.ndarray] = None
+
+    def _slot_arrays(self):
+        return (self._count, self._bytes, self._ar, self._dur, self._exp)
+
+    def _full_build(self, rank_cols, max_steps):
+        return build_columnar_collectives_window(rank_cols, max_steps)
+
+    def _tick(self, rank_cols, max_steps):
+        if not self._valid:
+            raise _CacheInvalid(INVALIDATE_COLD)
+        if int(max_steps) != self._max_steps:
+            raise _CacheInvalid(INVALIDATE_SIZE)
+        items = self._sorted_items(rank_cols)
+        if not items:
+            raise _CacheInvalid(INVALIDATE_RANKS)
+        touched, new_steps = self._scan_delta(items, self._max_steps)
+        touched = self._append_and_touch(touched, new_steps)
+        lo_step = int(self._steps[self._lo])
+        buf = self._steps[: self._hi]
+        for s in sorted(touched):
+            if s < lo_step:
+                continue
+            self._recompute_slot(int(np.searchsorted(buf, s)), s, items)
+        return self._emit(items)
+
+    def _recompute_slot(self, j: int, s: int, items) -> None:
+        # from-scratch fold over ALL live rows carrying step s, in
+        # sorted-rank row order — np.add.at element order, so the slot
+        # is bit-identical to the full build's scatter
+        ar_code = _COLL_OP_INDEX["all_reduce"]
+        cnt = 0
+        byt = 0
+        ar = 0
+        d_acc = 0.0
+        e_acc = 0.0
+        for _, c in items:
+            sv = c.steps_view()
+            a = int(np.searchsorted(sv, s, side="left"))
+            b = int(np.searchsorted(sv, s, side="right"))
+            if a == b:
+                continue
+            ints = c.ints_view()
+            flts = c.floats_view()
+            ops = c.ops_view()
+            dts = c.dtypes_view()
+            fp32 = c._dtype_index.get("float32", -1)
+            for t in range(a, b):
+                cnt += int(ints[t, CC_COUNT])
+                byt += int(ints[t, CC_BYTES])
+                d_acc += float(flts[t, 0])
+                e_acc += float(flts[t, 1])
+                if int(ops[t]) == ar_code and int(dts[t]) == fp32:
+                    ar += int(ints[t, CC_BYTES])
+        self._count[j] = cnt
+        self._bytes[j] = byt
+        self._ar[j] = ar
+        self._dur[j] = d_acc
+        self._exp[j] = e_acc
+
+    def _emit(self, items) -> CollectivesWindow:
+        lo, hi = self._lo, self._hi
+        S = hi - lo
+        common = self._steps[lo:hi]
+        lo_step = int(common[0])
+        n_ops = len(COLLECTIVE_OPS)
+        op_count = np.zeros(n_ops, dtype=np.int64)
+        op_bytes = np.zeros(n_ops, dtype=np.int64)
+        op_dur = np.zeros(n_ops, dtype=np.float64)
+        op_exp = np.zeros(n_ops, dtype=np.float64)
+        op_seen = np.zeros(n_ops, dtype=np.bool_)
+        per_rank: Dict[int, Dict[str, float]] = {}
+        group = 1
+        for rank, c in items:
+            sv = c.steps_view()
+            k = int(np.searchsorted(sv, lo_step, side="left"))
+            ints = c.ints_view()[k:]
+            floats = c.floats_view()[k:]
+            ops = c.ops_view()[k:].astype(np.int64)
+            np.add.at(op_count, ops, ints[:, CC_COUNT])
+            np.add.at(op_bytes, ops, ints[:, CC_BYTES])
+            np.add.at(op_dur, ops, floats[:, 0])
+            np.add.at(op_exp, ops, floats[:, 1])
+            op_seen[ops] = True
+            if ints.shape[0]:
+                group = max(group, int(ints[:, CC_GROUP].max()))
+                r_dur = float(np.cumsum(floats[:, 0])[-1])
+                r_exp = float(np.cumsum(floats[:, 1])[-1])
+                r_bytes = int(np.cumsum(ints[:, CC_BYTES])[-1])
+            else:
+                r_dur = r_exp = 0.0
+                r_bytes = 0
+            per_rank[rank] = {
+                "duration_ms": r_dur,
+                "exposed_ms": r_exp,
+                "bytes": r_bytes,
+                "overlap_efficiency": _overlap_efficiency(r_dur, r_exp),
+            }
+
+        count = self._count[lo:hi]
+        nbytes = self._bytes[lo:hi]
+        dur = self._dur[lo:hi]
+        exp = self._exp[lo:hi]
+        dur_l = dur.tolist()
+        exp_l = exp.tolist()
+        total_dur = float(np.cumsum(dur)[-1]) if S else 0.0
+        total_exp = float(np.cumsum(exp)[-1]) if S else 0.0
+        per_op: Dict[str, Dict[str, float]] = {}
+        for oi, op in enumerate(COLLECTIVE_OPS):
+            if not op_seen[oi]:
+                continue
+            per_op[op] = {
+                "count": int(op_count[oi]),
+                "bytes": int(op_bytes[oi]),
+                "duration_ms": float(op_dur[oi]),
+                "exposed_ms": float(op_exp[oi]),
+            }
+        return CollectivesWindow(
+            steps=common.tolist(),
+            n_steps=S,
+            ranks=list(self._ranks),
+            group_size=group,
+            per_step={
+                "count": count.tolist(),
+                "bytes": nbytes.tolist(),
+                "duration_ms": dur_l,
+                "exposed_ms": exp_l,
+                "overlap_efficiency": [
+                    _overlap_efficiency(dur_l[i], exp_l[i]) for i in range(S)
+                ],
+                "allreduce_fp32_bytes": self._ar[lo:hi].tolist(),
+            },
+            per_op=per_op,
+            per_rank=per_rank,
+            totals={
+                "count": int(np.cumsum(count)[-1]) if S else 0,
+                "bytes": int(np.cumsum(nbytes)[-1]) if S else 0,
+                "duration_ms": total_dur,
+                "exposed_ms": total_exp,
+                "overlap_efficiency": _overlap_efficiency(total_dur, total_exp),
+            },
+        )
+
+    def _prime(self, window, rank_cols, max_steps) -> None:
+        if window is None:
+            self._valid = False
+            return
+        self._max_steps = int(max_steps)
+        self._cap = 2 * self._max_steps
+        S = window.n_steps
+        self._steps = np.empty(self._cap, dtype=np.int64)
+        self._count = np.empty(self._cap, dtype=np.int64)
+        self._bytes = np.empty(self._cap, dtype=np.int64)
+        self._ar = np.empty(self._cap, dtype=np.int64)
+        self._dur = np.empty(self._cap, dtype=np.float64)
+        self._exp = np.empty(self._cap, dtype=np.float64)
+        self._steps[:S] = np.asarray(window.steps, dtype=np.int64)
+        ps = window.per_step
+        self._count[:S] = np.asarray(ps["count"], dtype=np.int64)
+        self._bytes[:S] = np.asarray(ps["bytes"], dtype=np.int64)
+        self._ar[:S] = np.asarray(ps["allreduce_fp32_bytes"], dtype=np.int64)
+        self._dur[:S] = np.asarray(ps["duration_ms"], dtype=np.float64)
+        self._exp[:S] = np.asarray(ps["exposed_ms"], dtype=np.float64)
+        self._prime_common(window, rank_cols)
+
+
+class ServingWindowCache(_SlotWindowCacheBase):
+    """Incremental serving window: per-seq enqueue/complete/queue-depth/
+    decode-token/tps/prefill/decode slots are cached and delta-
+    maintained; per-replica aggregates, KV headroom, and the latency
+    percentiles (order statistics over RAW populations — value-
+    determined, so a refold over the ragged CSR suffixes reproduces the
+    full build's bits) are re-folded each tick."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._enq: Optional[np.ndarray] = None
+        self._done: Optional[np.ndarray] = None
+        self._qd: Optional[np.ndarray] = None
+        self._dtok: Optional[np.ndarray] = None
+        self._tps: Optional[np.ndarray] = None
+        self._pre: Optional[np.ndarray] = None
+        self._dec: Optional[np.ndarray] = None
+
+    def _slot_arrays(self):
+        return (
+            self._enq, self._done, self._qd, self._dtok,
+            self._tps, self._pre, self._dec,
+        )
+
+    def _full_build(self, rank_cols, max_steps):
+        return build_columnar_serving_window(rank_cols, max_steps)
+
+    def _tick(self, rank_cols, max_steps):
+        if not self._valid:
+            raise _CacheInvalid(INVALIDATE_COLD)
+        if int(max_steps) != self._max_steps:
+            raise _CacheInvalid(INVALIDATE_SIZE)
+        items = self._sorted_items(rank_cols)
+        if not items:
+            raise _CacheInvalid(INVALIDATE_RANKS)
+        touched, new_steps = self._scan_delta(items, self._max_steps)
+        touched = self._append_and_touch(touched, new_steps)
+        lo_step = int(self._steps[self._lo])
+        buf = self._steps[: self._hi]
+        for s in sorted(touched):
+            if s < lo_step:
+                continue
+            self._recompute_slot(int(np.searchsorted(buf, s)), s, items)
+        return self._emit(items)
+
+    def _recompute_slot(self, j: int, s: int, items) -> None:
+        e_acc = 0
+        d_acc = 0
+        q_acc = 0
+        t_acc = 0
+        tps_acc = 0.0
+        pre_acc = 0.0
+        dec_acc = 0.0
+        for _, c in items:
+            sv = c.steps_view()
+            a = int(np.searchsorted(sv, s, side="left"))
+            b = int(np.searchsorted(sv, s, side="right"))
+            if a == b:
+                continue
+            ints = c.ints_view()
+            flts = c.floats_view()
+            for t in range(a, b):
+                e_acc += int(ints[t, SV_ENQ])
+                d_acc += int(ints[t, SV_DONE])
+                q_acc += int(ints[t, SV_QDEPTH])
+                t_acc += int(ints[t, SV_DTOK])
+                tps_acc += float(flts[t, SF_TPS])
+                pre_acc += float(flts[t, SF_PREFILL])
+                dec_acc += float(flts[t, SF_DECODE])
+        self._enq[j] = e_acc
+        self._done[j] = d_acc
+        self._qd[j] = q_acc
+        self._dtok[j] = t_acc
+        self._tps[j] = tps_acc
+        self._pre[j] = pre_acc
+        self._dec[j] = dec_acc
+
+    def _emit(self, items) -> ServingWindow:
+        lo, hi = self._lo, self._hi
+        S = hi - lo
+        common = self._steps[lo:hi]
+        lo_step = int(common[0])
+        ttft_parts: List[np.ndarray] = []
+        e2e_parts: List[np.ndarray] = []
+        per_rank: Dict[int, Dict[str, float]] = {}
+        kv_min = -1.0
+        for rank, c in items:
+            sv = c.steps_view()
+            k = int(np.searchsorted(sv, lo_step, side="left"))
+            ints = c.ints_view()[k:]
+            flts = c.floats_view()[k:]
+            r_ttft = c.ragged_suffix(RG_TTFT, k)
+            ttft_parts.append(r_ttft)
+            e2e_parts.append(c.ragged_suffix(RG_E2E, k))
+            n_rows = int(ints.shape[0])
+            if n_rows:
+                r_done = int(np.cumsum(ints[:, SV_DONE])[-1])
+                r_tok = int(np.cumsum(ints[:, SV_DTOK])[-1])
+                r_tps = float(np.cumsum(flts[:, SF_TPS])[-1]) / n_rows
+                r_qd = int(ints[-1, SV_QDEPTH])
+                r_active = int(ints[-1, SV_ACTIVE])
+            else:
+                r_done = r_tok = r_qd = r_active = 0
+                r_tps = 0.0
+            kvh = flts[:, SF_KVH]
+            kv_ok = kvh >= 0.0
+            r_kvh = -1.0
+            if kv_ok.any():
+                r_kvh = float(kvh[np.flatnonzero(kv_ok)[-1]])
+                m = float(kvh[kv_ok].min())
+                kv_min = m if kv_min < 0.0 else min(kv_min, m)
+            per_rank[rank] = {
+                "requests_completed": r_done,
+                "requests_active": r_active,
+                "decode_tokens": r_tok,
+                "tokens_per_s": r_tps,
+                "queue_depth": r_qd,
+                "ttft_p99_ms": _population_percentile(np.sort(r_ttft), 0.99),
+                "kv_headroom": r_kvh,
+            }
+
+        ttft_sorted = (
+            np.sort(np.concatenate(ttft_parts)) if ttft_parts else _EMPTY_F64
+        )
+        e2e_sorted = (
+            np.sort(np.concatenate(e2e_parts)) if e2e_parts else _EMPTY_F64
+        )
+        enq_l = self._enq[lo:hi].tolist()
+        done_l = self._done[lo:hi].tolist()
+        qd_l = self._qd[lo:hi].tolist()
+        dtok_l = self._dtok[lo:hi].tolist()
+        return ServingWindow(
+            steps=common.tolist(),
+            n_steps=S,
+            ranks=list(self._ranks),
+            per_step={
+                "requests_enqueued": enq_l,
+                "requests_completed": done_l,
+                "queue_depth": qd_l,
+                "decode_tokens": dtok_l,
+                "tokens_per_s": self._tps[lo:hi].tolist(),
+                "prefill_ms": self._pre[lo:hi].tolist(),
+                "decode_ms": self._dec[lo:hi].tolist(),
+            },
+            per_rank=per_rank,
+            totals=_serving_totals(
+                enq_l,
+                done_l,
+                dtok_l,
+                qd_l,
+                self._pre[lo:hi].tolist(),
+                self._dec[lo:hi].tolist(),
+                per_rank,
+                kv_min,
+                ttft_sorted,
+                e2e_sorted,
+            ),
+        )
+
+    def _prime(self, window, rank_cols, max_steps) -> None:
+        if window is None:
+            self._valid = False
+            return
+        self._max_steps = int(max_steps)
+        self._cap = 2 * self._max_steps
+        S = window.n_steps
+        self._steps = np.empty(self._cap, dtype=np.int64)
+        self._enq = np.empty(self._cap, dtype=np.int64)
+        self._done = np.empty(self._cap, dtype=np.int64)
+        self._qd = np.empty(self._cap, dtype=np.int64)
+        self._dtok = np.empty(self._cap, dtype=np.int64)
+        self._tps = np.empty(self._cap, dtype=np.float64)
+        self._pre = np.empty(self._cap, dtype=np.float64)
+        self._dec = np.empty(self._cap, dtype=np.float64)
+        self._steps[:S] = np.asarray(window.steps, dtype=np.int64)
+        ps = window.per_step
+        self._enq[:S] = np.asarray(ps["requests_enqueued"], dtype=np.int64)
+        self._done[:S] = np.asarray(ps["requests_completed"], dtype=np.int64)
+        self._qd[:S] = np.asarray(ps["queue_depth"], dtype=np.int64)
+        self._dtok[:S] = np.asarray(ps["decode_tokens"], dtype=np.int64)
+        self._tps[:S] = np.asarray(ps["tokens_per_s"], dtype=np.float64)
+        self._pre[:S] = np.asarray(ps["prefill_ms"], dtype=np.float64)
+        self._dec[:S] = np.asarray(ps["decode_ms"], dtype=np.float64)
+        self._prime_common(window, rank_cols)
