@@ -776,8 +776,11 @@ def _run_micro_benches() -> int:
     comparisons — live tick, window compute, codec, TCP drain, the
     high-rank ingest write path (watermark retention vs the seed
     windowed prune), the serving tier (delta protocol + shared
-    payload cache under 8 sessions × 32 viewers), and the topology
-    attribution pass (mesh axis reductions + η² scoring).  They run
+    payload cache under 8 sessions × 32 viewers), the topology
+    attribution pass (mesh axis reductions + η² scoring), and the
+    end-to-end tick pipeline (vectorized diagnosis + per-version
+    caches vs the scalar legacy arm, with per-stage TICK_STAGES
+    profile lines).  They run
     under pytest so their assertions (speedup floors, payload equality)
     gate the same way CI's slow lane runs them; ``-s`` keeps the
     bench_common JSON lines on stdout for collection into BENCH_LOCAL_*
@@ -796,6 +799,7 @@ def _run_micro_benches() -> int:
 #: (e.g. window_compute at 256 vs 1024 ranks) — folded into the label
 _TREND_DIM_KEYS = (
     "ranks", "steps", "rows", "sessions", "viewers", "world", "tiers",
+    "arm", "domain", "stage",
 )
 
 

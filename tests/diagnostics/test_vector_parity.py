@@ -1,0 +1,440 @@
+"""Scalar-vs-vectorized diagnosis parity: bit-identical, always.
+
+Contract (docs/developer_guide/diagnosis-engine.md): the vectorized
+gate layer (``diagnostics/<pack>/vector.py``) computes every rule input
+as a numpy reduction over the window's cubes / rank-slot arrays, and the
+emitted issue lists must be **byte-identical** to the scalar reference
+arm — the same equivalence the columnar window engine pins via
+``ColumnarFallback``.  Every fixture below is swept through BOTH arms of
+``TRACEML_VECTOR_DIAGNOSIS`` and compared as
+``json.dumps(result.to_dict(), sort_keys=True)`` bytes.
+
+The sweep covers the four window packs (step_time, step_memory,
+collectives, serving), deterministic rule-firing fixtures AND seeded
+randomized windows, with and without a mesh topology (attribution +
+the grouping memo ride the same kill switch).
+"""
+
+import json
+import random
+
+from traceml_tpu.diagnostics.collectives.api import diagnose_collectives_window
+from traceml_tpu.diagnostics.serving.api import diagnose_serving_window
+from traceml_tpu.diagnostics.step_memory.api import (
+    diagnose_rank_rows as diagnose_memory,
+)
+from traceml_tpu.diagnostics.step_time.api import diagnose_window
+from traceml_tpu.diagnostics.step_time import vector as st_vector
+from traceml_tpu.samplers.serving_sampler import pack_floats
+from traceml_tpu.utils import timing as T
+from traceml_tpu.utils.columnar import (
+    StepTimeColumns,
+    build_collectives_window_rows,
+    build_columnar_step_time_window,
+    build_serving_window_rows,
+    note_vector_fallback,
+    vector_diagnosis_enabled,
+    vector_fallback_counts,
+)
+from traceml_tpu.utils.topology import (
+    MeshTopology,
+    _coords_for_rank,
+    parse_mesh_spec,
+)
+
+
+# -- arm sweep helper ----------------------------------------------------
+
+
+def _dump(result) -> bytes:
+    return json.dumps(result.to_dict(), sort_keys=True).encode()
+
+
+def _assert_arms_identical(monkeypatch, fn):
+    """Run ``fn`` under the vectorized and scalar arms; the serialized
+    issue lists must be byte-identical."""
+    monkeypatch.setenv("TRACEML_VECTOR_DIAGNOSIS", "1")
+    assert vector_diagnosis_enabled()
+    on = _dump(fn())
+    monkeypatch.setenv("TRACEML_VECTOR_DIAGNOSIS", "0")
+    assert not vector_diagnosis_enabled()
+    off = _dump(fn())
+    assert on == off
+    return on
+
+
+def _mesh(spec, world):
+    axes = parse_mesh_spec(spec)
+    assert axes, spec
+    sizes = [a.size for a in axes]
+    return MeshTopology(
+        axes=axes,
+        rank_coords={
+            r: tuple(_coords_for_rank(r, sizes)) for r in range(world)
+        },
+        rank_hosts={r: r // 4 for r in range(world)},
+        rank_hostnames={},
+        source="env",
+    )
+
+
+# -- step_time -----------------------------------------------------------
+
+
+def _st_row(step, step_ms, input_ms=0.0, h2d_ms=0.0, compute_ms=0.0,
+            backward_ms=0.0, compile_ms=0.0):
+    events = {
+        T.STEP_TIME: {"cpu_ms": step_ms, "device_ms": step_ms, "count": 1},
+    }
+    if input_ms:
+        events[T.DATALOADER_NEXT] = {
+            "cpu_ms": input_ms, "device_ms": None, "count": 1,
+        }
+    if h2d_ms:
+        events[T.H2D_TIME] = {
+            "cpu_ms": 0.2, "device_ms": h2d_ms, "count": 1,
+        }
+    if compute_ms:
+        events[T.COMPUTE_TIME] = {
+            "cpu_ms": 0.5, "device_ms": compute_ms, "count": 1,
+        }
+    if backward_ms:
+        events[T.BACKWARD_TIME] = {
+            "cpu_ms": backward_ms, "device_ms": backward_ms, "count": 1,
+        }
+    if compile_ms:
+        events[T.COMPILE_TIME] = {
+            "cpu_ms": compile_ms, "device_ms": None, "count": 1,
+        }
+    return {
+        "step": step,
+        "timestamp": 100.0 + step,
+        "clock": "device",
+        "late_markers": 0,
+        "events": events,
+    }
+
+
+def _st_window(rank_rows, max_steps=200):
+    cols = {}
+    for rank, rows in rank_rows.items():
+        c = StepTimeColumns(512)
+        for row in rows:
+            c.append(row)
+        cols[rank] = c
+    w = build_columnar_step_time_window(cols, max_steps)
+    assert w is not None and getattr(w, "col", None) is not None
+    return w
+
+
+def _st_fixtures():
+    def steady(n, step_ms, **kw):
+        return [_st_row(s, step_ms, **kw) for s in range(1, n + 1)]
+
+    # healthy / compute bound
+    yield {r: steady(60, 100.0, input_ms=3.0, compute_ms=92.0)
+           for r in range(4)}
+    # input bound, critical
+    yield {r: steady(60, 100.0, input_ms=45.0, compute_ms=50.0)
+           for r in range(4)}
+    # input straggler on one rank
+    rows = {r: steady(60, 100.0, input_ms=4.0, compute_ms=90.0)
+            for r in range(7)}
+    rows[7] = steady(60, 280.0, input_ms=184.0, compute_ms=90.0)
+    yield rows
+    # clean straggler: rank 0 slow in residual, others inflated by sync
+    rows = {}
+    for r in range(8):
+        if r == 0:
+            rows[r] = steady(60, 200.0, input_ms=4.0, backward_ms=60.0)
+        else:
+            rows[r] = steady(60, 200.0, input_ms=4.0, backward_ms=156.0)
+    yield rows
+    # compile storm + residual heavy
+    rows = {0: [], 1: []}
+    for s in range(1, 61):
+        compile_ms = 400.0 if s % 3 == 0 else 0.0
+        for r in (0, 1):
+            rows[r].append(_st_row(
+                s, 100.0 + compile_ms, compute_ms=55.0,
+                compile_ms=compile_ms,
+            ))
+    yield rows
+    # randomized ragged multi-rank windows
+    for seed in range(6):
+        rng = random.Random(seed)
+        yield {
+            r: [
+                _st_row(
+                    s,
+                    rng.uniform(80.0, 160.0),
+                    input_ms=rng.uniform(0.0, 30.0),
+                    h2d_ms=rng.uniform(0.0, 8.0),
+                    compute_ms=rng.uniform(20.0, 90.0),
+                    backward_ms=rng.uniform(0.0, 40.0),
+                )
+                for s in range(rng.randint(1, 5), 64)
+            ]
+            for r in range(rng.randint(2, 8))
+        }
+
+
+def test_step_time_parity_all_fixtures(monkeypatch):
+    for i, rank_rows in enumerate(_st_fixtures()):
+        w = _st_window(rank_rows)
+        topo = _mesh("dp:8", max(8, len(rank_rows)))
+        _assert_arms_identical(
+            monkeypatch, lambda: diagnose_window(w, mode="live")
+        )
+        _assert_arms_identical(
+            monkeypatch,
+            lambda: diagnose_window(w, mode="live", topology=topo),
+        ), i
+
+
+def test_step_time_vector_gate_respects_kill_switch(monkeypatch):
+    w = _st_window(
+        {r: [_st_row(s, 100.0, compute_ms=90.0) for s in range(1, 40)]
+         for r in range(2)}
+    )
+    monkeypatch.setenv("TRACEML_VECTOR_DIAGNOSIS", "0")
+    assert st_vector.gate(w) is None
+    monkeypatch.setenv("TRACEML_VECTOR_DIAGNOSIS", "1")
+    assert st_vector.gate(w) is w.col
+    # scalar windows have no cube — the gate stays closed either way
+    assert st_vector.gate(object()) is None
+
+
+# -- step_memory ---------------------------------------------------------
+
+GiB = 1024 ** 3
+
+
+def _mem_row(step, cur, limit=16 * GiB, dev=0):
+    return {
+        "step": step,
+        "device_id": dev,
+        "current_bytes": cur,
+        "step_peak_bytes": cur,
+        "limit_bytes": limit,
+    }
+
+
+def _mem_fixtures():
+    yield {0: [_mem_row(s, 4 * GiB) for s in range(100)]}
+    yield {0: [_mem_row(s, int(15.8 * GiB)) for s in range(100)]}
+    # imbalance with pressure (fires; worst rank + skew via argmax)
+    yield {
+        0: [_mem_row(s, 9 * GiB) for s in range(50)],
+        1: [_mem_row(s, 14 * GiB) for s in range(50)],
+        2: [_mem_row(s, 9 * GiB) for s in range(50)],
+    }
+    for seed in range(4):
+        rng = random.Random(100 + seed)
+        yield {
+            r: [
+                _mem_row(s, rng.randint(1 * GiB, 15 * GiB))
+                for s in range(60)
+            ]
+            for r in range(rng.randint(2, 6))
+        }
+
+
+def test_memory_parity_all_fixtures(monkeypatch):
+    for rank_rows in _mem_fixtures():
+        topo = _mesh("dp:8", 8)
+        _assert_arms_identical(
+            monkeypatch, lambda: diagnose_memory(rank_rows)
+        )
+        _assert_arms_identical(
+            monkeypatch, lambda: diagnose_memory(rank_rows, topology=topo)
+        )
+
+
+# -- collectives ---------------------------------------------------------
+
+
+def _coll_row(step, op="all_reduce", dtype="float32", nbytes=1 << 20,
+              dur=4.0, exposed=None, group=8):
+    return {
+        "step": step,
+        "timestamp": 100.0 + step,
+        "op": op,
+        "dtype": dtype,
+        "count": 1,
+        "bytes": nbytes,
+        "group_size": group,
+        "duration_ms": dur,
+        "exposed_ms": dur if exposed is None else exposed,
+    }
+
+
+def _coll_fixtures():
+    # poor overlap: everything exposed
+    yield {r: [_coll_row(s, dur=8.0) for s in range(1, 61)]
+           for r in range(4)}
+    # good overlap on most ranks, one laggard
+    rows = {r: [_coll_row(s, dur=8.0, exposed=0.5) for s in range(1, 61)]
+            for r in range(4)}
+    rows[4] = [_coll_row(s, dur=8.0, exposed=7.5) for s in range(1, 61)]
+    yield rows
+    # fp32 allreduce heavy (quantizable)
+    yield {
+        0: [_coll_row(s, nbytes=1 << 24, dur=6.0, exposed=1.0)
+            for s in range(1, 61)]
+    }
+    # randomized ragged participation
+    for seed in range(5):
+        rng = random.Random(200 + seed)
+        out = {}
+        for r in range(rng.randint(1, 6)):
+            rows = []
+            for s in range(1, 50):
+                for op in ("all_reduce", "all_gather", "reduce_scatter"):
+                    if rng.random() < 0.3:
+                        continue
+                    dur = rng.uniform(0.0, 8.0)
+                    rows.append(_coll_row(
+                        s, op=op,
+                        dtype=rng.choice(("float32", "bfloat16")),
+                        nbytes=rng.randint(0, 1 << 22),
+                        dur=dur, exposed=dur * rng.random(),
+                    ))
+            out[r] = rows
+        yield out
+
+
+def test_collectives_parity_all_fixtures(monkeypatch):
+    for rank_rows in _coll_fixtures():
+        w = build_collectives_window_rows(rank_rows, max_steps=60)
+        topo = _mesh("dp:8", 8)
+        for st_ms in (None, 100.0):
+            _assert_arms_identical(
+                monkeypatch,
+                lambda: diagnose_collectives_window(
+                    w, mode="live", step_time_ms=st_ms, topology=topo,
+                ),
+            )
+
+
+# -- serving -------------------------------------------------------------
+
+
+def _srv_row(step, done=2, qd=0, dtok=32, tps=100.0, kvh=None):
+    ttft = [30.0] * done
+    return {
+        "step": step,
+        "timestamp": 100.0 + step,
+        "requests_enqueued": done,
+        "requests_completed": done,
+        "requests_active": 1,
+        "queue_depth": qd,
+        "decode_tokens": dtok,
+        "prefill_ms": 20.0,
+        "decode_ms": 40.0,
+        "tokens_per_s": tps,
+        "batch_occupancy": 0.4,
+        "kv_bytes": -1,
+        "kv_limit_bytes": -1,
+        "kv_headroom": -1.0 if kvh is None else kvh,
+        "ttft_ms_list": pack_floats(ttft),
+        "e2e_ms_list": pack_floats([60.0] * done),
+        "tokens_list": ",".join("16" for _ in range(done)),
+    }
+
+
+def _srv_fixtures():
+    # queue saturated: backlog across every slot
+    yield {0: [_srv_row(s, qd=6) for s in range(1, 41)]}
+    # replica skew: one slow replica among four
+    rows = {r: [_srv_row(s, tps=400.0) for s in range(1, 41)]
+            for r in range(3)}
+    rows[3] = [_srv_row(s, tps=120.0) for s in range(1, 41)]
+    yield rows
+    # kv pressure
+    yield {0: [_srv_row(s, kvh=0.04) for s in range(1, 41)]}
+    # randomized
+    for seed in range(4):
+        rng = random.Random(300 + seed)
+        yield {
+            r: [
+                _srv_row(
+                    s,
+                    done=rng.randint(0, 5),
+                    qd=rng.randint(0, 6),
+                    dtok=rng.randint(0, 200),
+                    tps=rng.uniform(0.0, 500.0),
+                    kvh=rng.uniform(0.0, 0.9)
+                    if rng.random() < 0.5 else None,
+                )
+                for s in range(1, 40)
+            ]
+            for r in range(rng.randint(1, 5))
+        }
+
+
+def test_serving_parity_all_fixtures(monkeypatch):
+    for rank_rows in _srv_fixtures():
+        w = build_serving_window_rows(rank_rows, max_steps=40)
+        topo = _mesh("dp:8", 8)
+        _assert_arms_identical(
+            monkeypatch, lambda: diagnose_serving_window(w, mode="live")
+        )
+        _assert_arms_identical(
+            monkeypatch,
+            lambda: diagnose_serving_window(w, mode="live", topology=topo),
+        )
+
+
+# -- view-layer parity ---------------------------------------------------
+
+
+def test_view_tables_parity(monkeypatch):
+    """The vectorized per-rank view tables (collectives efficiency map,
+    serving replica list) must serialize identically to their scalar
+    twins — same as_dict(), both arms."""
+    from traceml_tpu.renderers import views as V
+
+    for rank_rows in _coll_fixtures():
+        w = build_collectives_window_rows(rank_rows, max_steps=60)
+        monkeypatch.setenv("TRACEML_VECTOR_DIAGNOSIS", "1")
+        on = json.dumps(
+            V.build_collectives_view(w, step_time_ms=100.0).as_dict(),
+            sort_keys=True,
+        )
+        monkeypatch.setenv("TRACEML_VECTOR_DIAGNOSIS", "0")
+        off = json.dumps(
+            V.build_collectives_view(w, step_time_ms=100.0).as_dict(),
+            sort_keys=True,
+        )
+        assert on == off
+    for rank_rows in _srv_fixtures():
+        w = build_serving_window_rows(rank_rows, max_steps=40)
+        if w is None:
+            continue
+        monkeypatch.setenv("TRACEML_VECTOR_DIAGNOSIS", "1")
+        on = json.dumps(
+            V.build_serving_view(w).as_dict(), sort_keys=True
+        )
+        monkeypatch.setenv("TRACEML_VECTOR_DIAGNOSIS", "0")
+        off = json.dumps(
+            V.build_serving_view(w).as_dict(), sort_keys=True
+        )
+        assert on == off
+
+
+# -- fallback accounting -------------------------------------------------
+
+
+def test_vector_fallback_warns_once_then_counts(caplog):
+    import logging
+
+    domain = "parity_test_domain"
+    assert domain not in vector_fallback_counts()
+    with caplog.at_level(logging.WARNING, logger="traceml_tpu.utils.columnar"):
+        note_vector_fallback(domain)
+        note_vector_fallback(domain)
+        note_vector_fallback(domain)
+    warnings = [r for r in caplog.records if domain in r.getMessage()]
+    assert len(warnings) == 1  # first fallback logs, the rest count
+    assert vector_fallback_counts()[domain] == 3

@@ -20,6 +20,9 @@ store       the ``DOMAINS`` tuple in ``reporting/snapshot_store.py``
 ring        ``class <Name>Columns`` definitions in ``utils/columnar.py``
 fragment    ``_FRAGMENT_KEYS`` dict keys in ``renderers/web_payload.py``
 diag_pkg    subdirectories of ``diagnostics/``
+diag_vector ``diagnostics/`` subdirectories carrying a ``vector.py``
+            gate module (the r20 vectorized rule arm — every
+            windowed diagnosis pack must ship one)
 diagnosis   ``## <Title>`` headings in ``diagnostics/DIAGNOSIS.md``
 ========== ===========================================================
 
@@ -45,7 +48,8 @@ RULE_UNDECLARED_DOMAIN = "TLW001"
 RULE_MISSING_LAYER = "TLW002"
 
 LAYERS = (
-    "sampler", "writer", "store", "ring", "fragment", "diag_pkg", "diagnosis"
+    "sampler", "writer", "store", "ring", "fragment", "diag_pkg",
+    "diag_vector", "diagnosis",
 )
 
 #: canonical domain → layers it must be wired through.  ``topology``
@@ -57,19 +61,19 @@ LAYERS = (
 CONTRACT: Dict[str, Set[str]] = {
     "step_time": {
         "sampler", "writer", "store", "ring", "fragment", "diag_pkg",
-        "diagnosis",
+        "diag_vector", "diagnosis",
     },
     "step_memory": {
         "sampler", "writer", "store", "ring", "fragment", "diag_pkg",
-        "diagnosis",
+        "diag_vector", "diagnosis",
     },
     "collectives": {
         "sampler", "writer", "store", "ring", "fragment", "diag_pkg",
-        "diagnosis",
+        "diag_vector", "diagnosis",
     },
     "serving": {
         "sampler", "writer", "store", "ring", "fragment", "diag_pkg",
-        "diagnosis",
+        "diag_vector", "diagnosis",
     },
     "system": {"sampler", "writer", "store", "fragment", "diag_pkg",
                "diagnosis"},
@@ -100,6 +104,7 @@ ALIASES: Dict[str, Dict[str, str]] = {
 IGNORED: Dict[str, Set[str]] = {
     "fragment": {"header", "meta", "diagnosis"},
     "diag_pkg": {"__pycache__"},
+    "diag_vector": {"__pycache__"},
     "diagnosis": set(),
 }
 
@@ -111,6 +116,7 @@ LAYER_FILES: Dict[str, str] = {
     "ring": "utils/columnar.py",
     "fragment": "renderers/web_payload.py",
     "diag_pkg": "diagnostics",
+    "diag_vector": "diagnostics",
     "diagnosis": "diagnostics/DIAGNOSIS.md",
 }
 
@@ -230,6 +236,17 @@ def _parse_diag_pkg_layer(path: Path) -> Optional[Set[str]]:
     } or None
 
 
+def _parse_diag_vector_layer(path: Path) -> Optional[Set[str]]:
+    """Diagnosis packs shipping a vectorized gate arm (``vector.py``)."""
+    if not path.is_dir():
+        return None
+    return {
+        p.name
+        for p in path.iterdir()
+        if p.is_dir() and (p / "vector.py").exists()
+    } or None
+
+
 #: DIAGNOSIS.md section title → canonical domain
 _DIAGNOSIS_TITLES = {
     "step time": "step_time",
@@ -267,6 +284,7 @@ _PARSERS = {
     "ring": _parse_ring_layer,
     "fragment": _parse_fragment_layer,
     "diag_pkg": _parse_diag_pkg_layer,
+    "diag_vector": _parse_diag_vector_layer,
     "diagnosis": _parse_diagnosis_layer,
 }
 

@@ -253,6 +253,9 @@ INCR_WINDOW = declare(
 SERVING = declare(
     "TRACEML_SERVING", "1",
     "0 turns every serving-capture entry point into a no-op")
+VECTOR_DIAGNOSIS = declare(
+    "TRACEML_VECTOR_DIAGNOSIS", "1",
+    "0 forces the scalar rule-evaluation reference arm in diagnosis")
 SERVING_QUEUE_MAX = declare(
     "TRACEML_SERVING_QUEUE_MAX", "8192",
     "serving domain: bounded request-event queue capacity per rank")

@@ -22,10 +22,20 @@ diagnosis.
 
 from __future__ import annotations
 
+import time
 from typing import Mapping, Optional
 
 from traceml_tpu.diagnostics.common import DiagnosticResult, STATUS_ISSUE
 from traceml_tpu.utils.topology import MeshTopology, attribute_ranks
+
+# lifetime nanoseconds spent attributing: the tick profiler reads the
+# delta around each diagnose call to split "attribute" out of the
+# "diagnose" stage without threading a profiler through the pack APIs
+_ATTR_NS = 0
+
+
+def attribution_ns_total() -> int:
+    return _ATTR_NS
 
 
 def attach_attribution(
@@ -35,12 +45,16 @@ def attach_attribution(
 ) -> DiagnosticResult:
     """Annotate fired issues in ``result`` with the best-explaining
     physical grouping; no-op without a topology or per-rank values."""
+    global _ATTR_NS
     if topology is None or not per_rank_values:
         return result
+    t0 = time.perf_counter_ns()
     try:
         attr = attribute_ranks(per_rank_values, topology)
     except Exception:
+        _ATTR_NS += time.perf_counter_ns() - t0
         return result
+    _ATTR_NS += time.perf_counter_ns() - t0
     if attr is None:
         return result
     attr_dict = attr.to_dict()

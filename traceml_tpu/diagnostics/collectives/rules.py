@@ -18,6 +18,7 @@ from traceml_tpu.diagnostics.common import (
     SEVERITY_WARNING,
     confidence_from,
 )
+from traceml_tpu.diagnostics.collectives import vector
 from traceml_tpu.diagnostics.collectives.policy import CollectivesPolicy
 from traceml_tpu.utils.columnar import CollectivesWindow
 
@@ -133,32 +134,42 @@ class PoorOverlapRule:
         if eff >= p.overlap_eff_warn:
             return []
         w = ctx.window
-        # headroom vs the run's own best steps: 75th percentile of
-        # per-step efficiency over steps that actually communicated
-        per_step_eff = [
-            e
-            for e, d in zip(
-                w.per_step["overlap_efficiency"], w.per_step["duration_ms"]
+        stats = (
+            vector.poor_overlap_stats(
+                w.per_step, w.per_rank, p.overlap_headroom_gate
             )
-            if d > 0.0
-        ]
-        best_eff = None
-        if per_step_eff:
-            ranked = sorted(per_step_eff)
-            best_eff = ranked[min(len(ranked) - 1, int(len(ranked) * 0.75))]
-        # peers: ranks overlapping much worse than the median rank
-        rank_eff = {
-            r: v["overlap_efficiency"] for r, v in w.per_rank.items()
-        }
-        lag_ranks: List[int] = []
-        median_rank_eff = None
-        if rank_eff:
-            median_rank_eff = statistics.median(rank_eff.values())
-            lag_ranks = sorted(
-                r
-                for r, v in rank_eff.items()
-                if median_rank_eff - v >= p.overlap_headroom_gate
-            )
+            if vector.enabled()
+            else None
+        )
+        if stats is not None:
+            best_eff, median_rank_eff, lag_ranks = stats
+        else:  # scalar golden-reference arm
+            # headroom vs the run's own best steps: 75th percentile of
+            # per-step efficiency over steps that actually communicated
+            per_step_eff = [
+                e
+                for e, d in zip(
+                    w.per_step["overlap_efficiency"], w.per_step["duration_ms"]
+                )
+                if d > 0.0
+            ]
+            best_eff = None
+            if per_step_eff:
+                ranked = sorted(per_step_eff)
+                best_eff = ranked[min(len(ranked) - 1, int(len(ranked) * 0.75))]
+            # peers: ranks overlapping much worse than the median rank
+            rank_eff = {
+                r: v["overlap_efficiency"] for r, v in w.per_rank.items()
+            }
+            lag_ranks: List[int] = []
+            median_rank_eff = None
+            if rank_eff:
+                median_rank_eff = statistics.median(rank_eff.values())
+                lag_ranks = sorted(
+                    r
+                    for r, v in rank_eff.items()
+                    if median_rank_eff - v >= p.overlap_headroom_gate
+                )
         step_headroom = (
             best_eff is not None and best_eff - eff >= p.overlap_headroom_gate
         )
@@ -219,14 +230,21 @@ class AllreduceQuantizableRule:
     def evaluate(self, ctx: CollectivesContext) -> List[DiagnosticIssue]:
         p = ctx.policy
         series = ctx.window.per_step.get("allreduce_fp32_bytes") or []
-        nz = [float(v) for v in series if v > 0]
-        if not nz or ctx.n_steps <= 0:
+        stats = (
+            vector.fp32_allreduce_stats(series) if vector.enabled() else None
+        )
+        if stats is not None:
+            n_nz, mean_bytes, nz = stats
+        else:  # scalar golden-reference arm
+            nz = [float(v) for v in series if v > 0]
+            n_nz = len(nz)
+            mean_bytes = (sum(nz) / n_nz) if nz else 0.0
+        if not n_nz or ctx.n_steps <= 0:
             return []
-        share = len(nz) / ctx.n_steps
-        mean_bytes = sum(nz) / len(nz)
+        share = n_nz / ctx.n_steps
         if share < p.quantizable_min_share or mean_bytes < p.quantizable_min_bytes:
             return []
-        cv = (statistics.pstdev(nz) / mean_bytes) if len(nz) > 1 else 0.0
+        cv = (statistics.pstdev(nz) / mean_bytes) if n_nz > 1 else 0.0
         if cv > p.quantizable_cv_max:
             return []
         mib = mean_bytes / (1 << 20)

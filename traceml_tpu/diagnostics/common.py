@@ -155,9 +155,20 @@ class DiagnosticRule(Protocol):
     def evaluate(self, ctx: Any) -> List[DiagnosticIssue]: ...
 
 
+# lifetime rule-evaluation counters per domain: the tick profiler reads
+# these to prove a diagnosis-cache hit really ran ZERO rules (pinned by
+# the version-idle assertions in tests and bench_tick_pipeline)
+_RULE_EVALS: Dict[str, int] = {}
+
+
+def rule_eval_counts() -> Dict[str, int]:
+    return dict(_RULE_EVALS)
+
+
 def run_rules(domain: str, rules: Sequence[DiagnosticRule], ctx: Any) -> DiagnosticResult:
     issues: List[DiagnosticIssue] = []
     for rule in rules:
+        _RULE_EVALS[domain] = _RULE_EVALS.get(domain, 0) + 1
         try:
             issues.extend(rule.evaluate(ctx) or [])
         except Exception:

@@ -18,6 +18,7 @@ from traceml_tpu.diagnostics.common import (
     SEVERITY_WARNING,
     confidence_from,
 )
+from traceml_tpu.diagnostics.serving import vector
 from traceml_tpu.diagnostics.serving.policy import ServingPolicy
 from traceml_tpu.utils.columnar import ServingWindow
 
@@ -41,9 +42,11 @@ class ServingContext:
 
 def build_context(window: ServingWindow, policy: ServingPolicy) -> ServingContext:
     qd = window.per_step.get("queue_depth") or []
-    backlog_share = (
-        sum(1 for v in qd if v > 0) / len(qd) if qd else 0.0
-    )
+    backlog_share = vector.backlog_share(qd) if vector.enabled() else None
+    if backlog_share is None:  # scalar golden-reference arm
+        backlog_share = (
+            sum(1 for v in qd if v > 0) / len(qd) if qd else 0.0
+        )
     t = window.totals
     return ServingContext(
         window=window,
@@ -215,24 +218,37 @@ class ReplicaSkewRule:
 
     def evaluate(self, ctx: ServingContext) -> List[DiagnosticIssue]:
         p = ctx.policy
-        rank_tps = {
-            r: float(v.get("tokens_per_s", 0.0) or 0.0)
-            for r, v in ctx.window.per_rank.items()
-        }
-        if len(rank_tps) < 2:
+        per_rank = ctx.window.per_rank
+        if len(per_rank) < 2:
             return []
-        med = statistics.median(rank_tps.values())
-        if med <= 0.0:
-            return []
-        worst = min(rank_tps.values())
+        stats = (
+            vector.replica_skew(per_rank, p.skew_warn)
+            if vector.enabled()
+            else None
+        )
+        if stats is not None:
+            med, worst, lag = stats
+            if med <= 0.0:
+                return []
+        else:  # scalar golden-reference arm
+            rank_tps = {
+                r: float(v.get("tokens_per_s", 0.0) or 0.0)
+                for r, v in per_rank.items()
+            }
+            med = statistics.median(rank_tps.values())
+            if med <= 0.0:
+                return []
+            worst = min(rank_tps.values())
+            lag = sorted(
+                r
+                for r, v in rank_tps.items()
+                if (med - v) / med >= p.skew_warn
+            )
         skew = (med - worst) / med
         if skew < p.skew_warn:
             return []
         severity = (
             SEVERITY_CRITICAL if skew >= p.skew_critical else SEVERITY_WARNING
-        )
-        lag = sorted(
-            r for r, v in rank_tps.items() if (med - v) / med >= p.skew_warn
         )
         evidence: Dict[str, Any] = {
             "median_tokens_per_s": round(med, 3),

@@ -1,0 +1,72 @@
+"""Vectorized gate arm for the serving diagnosis pack.
+
+Lifts the two remaining per-element scalar scans — the backlog-share
+count over the queue-depth slot series and ReplicaSkewRule's per-replica
+tokens/s median / min / lag filter — into numpy reductions that match
+the scalar arm bit-for-bit (integer counts and float64 medians are
+exact; lagging-replica masks evaluate the identical ``(med − v) / med``
+float arithmetic elementwise).
+
+``enabled()`` is the pack's kill-switch gate
+(``TRACEML_VECTOR_DIAGNOSIS=0`` forces the scalar reference arm); a
+helper that cannot reproduce its loop returns ``None`` and counts a
+fallback instead of logging per tick.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from traceml_tpu.utils.columnar import (
+    note_vector_fallback,
+    vector_diagnosis_enabled,
+)
+
+DOMAIN = "serving"
+
+
+def enabled() -> bool:
+    return vector_diagnosis_enabled()
+
+
+def backlog_share(queue_depth: List[float]) -> Optional[float]:
+    """Share of window seqs with a non-empty queue (an integer count
+    over the slot series — exact).  ``None`` → scalar arm."""
+    if not queue_depth:
+        return 0.0
+    try:
+        arr = np.asarray(queue_depth)
+        return int((arr > 0).sum()) / len(queue_depth)
+    except Exception:
+        note_vector_fallback(DOMAIN)
+        return None
+
+
+def replica_skew(
+    per_rank: Dict[int, Dict[str, float]],
+    skew_warn: float,
+) -> Optional[Tuple[float, float, List[int]]]:
+    """ReplicaSkewRule's per-replica scan: (median tokens/s, min
+    tokens/s, lagging replicas sorted).  Caller guards ``len >= 2`` and
+    ``med > 0``.  ``None`` → scalar arm."""
+    try:
+        ranks = np.asarray(list(per_rank), dtype=np.int64)
+        vals = np.asarray(
+            [
+                float(v.get("tokens_per_s", 0.0) or 0.0)
+                for v in per_rank.values()
+            ],
+            dtype=np.float64,
+        )
+        med = float(np.median(vals))
+        worst = float(np.min(vals))
+        if med > 0.0:
+            lag = np.sort(ranks[(med - vals) / med >= skew_warn]).tolist()
+        else:
+            lag = []
+        return med, worst, lag
+    except Exception:
+        note_vector_fallback(DOMAIN)
+        return None

@@ -29,6 +29,7 @@ from traceml_tpu.diagnostics.common import (
     DiagnosticIssue,
     confidence_from,
 )
+from traceml_tpu.diagnostics.step_memory import vector
 from traceml_tpu.diagnostics.step_memory.policy import DEFAULT_POLICY, StepMemoryPolicy
 from traceml_tpu.utils.columnar import MemoryColumns, MemorySeries
 from traceml_tpu.utils.formatting import fmt_bytes
@@ -147,11 +148,17 @@ class ImbalanceRule:
                 )
         if len(per_rank) < 2:
             return []
-        med = statistics.median(per_rank.values())
+        stats = (
+            vector.median_worst_skew(per_rank) if vector.enabled() else None
+        )
+        if stats is not None:
+            med, worst_rank, skew = stats
+        else:  # scalar golden-reference arm
+            med = statistics.median(per_rank.values())
+            worst_rank = max(per_rank, key=lambda r: per_rank[r])
+            skew = ((per_rank[worst_rank] - med) / med) if med > 0 else 0.0
         if med <= 0:
             return []
-        worst_rank = max(per_rank, key=lambda r: per_rank[r])
-        skew = (per_rank[worst_rank] - med) / med
         if skew < p.imbalance_warn:
             return []
         # only interesting when somebody is actually under pressure

@@ -45,6 +45,7 @@ from traceml_tpu.diagnostics.common import (
     DiagnosticIssue,
     confidence_from,
 )
+from traceml_tpu.diagnostics.step_time import vector
 from traceml_tpu.diagnostics.step_time.policy import StepTimePolicy
 from traceml_tpu.utils.columnar import KEY_INDEX
 from traceml_tpu.utils.step_time_window import RESIDUAL_KEY, STEP_KEY, StepTimeWindow
@@ -105,7 +106,7 @@ class InputBoundRule:
         step: median input ≈ 0 on every rank) cannot be suppressed by
         a statistic mismatch."""
         w = ctx.window
-        col = getattr(w, "col", None)
+        col = vector.gate(w)
         if col is not None:
             step = col.averages[:, KEY_INDEX[STEP_KEY]]
             mask = step > 0
@@ -203,7 +204,7 @@ class CleanStragglerRule:
         only statistic that can SEE spiky per-rank pathologies (a rank
         checkpointing/recompiling on 1-in-10 steps has median ≈ healthy;
         cf. CompileBoundRule's means-over-medians rationale)."""
-        col = getattr(w, "col", None)
+        col = vector.gate(w)
         if col is not None:
             stats = col.medians if stat_name == "medians" else col.averages
             step_a = stats[:, KEY_INDEX[STEP_KEY]]
@@ -289,18 +290,26 @@ class CleanStragglerRule:
         # Component attribution on the worst rank: per-phase delta vs the
         # cross-rank median, with the sync phase replaced by its clean
         # form — read from the SAME statistic that produced the score.
-        deltas: Dict[str, float] = {}
-        for key in list(w.phases_present) + [RESIDUAL_KEY]:
-            per_rank = {
-                r: (
-                    clean_sync[r]
-                    if key == sync_phase
-                    else getattr(w.rank_windows[r], stat_name).get(key, 0.0)
-                )
-                for r in w.ranks
-            }
-            med = statistics.median(per_rank.values())
-            deltas[key] = max(0.0, per_rank[worst_rank] - med)
+        keys = list(w.phases_present) + [RESIDUAL_KEY]
+        deltas: Optional[Dict[str, float]] = None
+        col = vector.gate(w)
+        if col is not None:
+            deltas = vector.component_deltas(
+                col, stat_name, keys, sync_phase, clean_sync, worst_rank
+            )
+        if deltas is None:  # scalar golden-reference arm
+            deltas = {}
+            for key in keys:
+                per_rank = {
+                    r: (
+                        clean_sync[r]
+                        if key == sync_phase
+                        else getattr(w.rank_windows[r], stat_name).get(key, 0.0)
+                    )
+                    for r in w.ranks
+                }
+                med = statistics.median(per_rank.values())
+                deltas[key] = max(0.0, per_rank[worst_rank] - med)
         ordered = sorted(deltas.items(), key=lambda kv: -kv[1])
         kind = "STRAGGLER"
         dominant_phase: Optional[str] = None
@@ -450,7 +459,7 @@ class CompileBoundRule:
         if step is None or step.mean_ms <= 0:
             return []
         p = ctx.policy
-        col = getattr(w, "col", None)
+        col = vector.gate(w)
         if col is not None:
             comp = col.series_cube[:, KEY_INDEX["compile"], :]  # (R, S)
             mask = (comp > 0) & (col.steps > p.compile_warmup_steps)

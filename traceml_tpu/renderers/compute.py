@@ -23,10 +23,15 @@ import time
 from pathlib import Path
 from typing import Any, Dict, Optional, Tuple
 
+from traceml_tpu.diagnostics.attribution import attribution_ns_total
+from traceml_tpu.diagnostics.common import rule_eval_counts
 from traceml_tpu.diagnostics.step_time.api import diagnose_window
 from traceml_tpu.renderers import views as V
 from traceml_tpu.reporting.snapshot_store import LiveSnapshotStore
-from traceml_tpu.utils.columnar import incr_window_enabled
+from traceml_tpu.utils.columnar import (
+    incr_window_enabled,
+    vector_diagnosis_enabled,
+)
 
 # payload domain → (store versions it depends on, views key or None)
 # collectives also depends on step_time: COMM_BOUND needs the mean step
@@ -66,6 +71,27 @@ class LiveComputer:
         self._computed_at: Dict[str, Tuple[int, ...]] = {}
         # domain → (payload updates, view object or None)
         self._fragments: Dict[str, Tuple[Dict[str, Any], Any]] = {}
+        # per-(domain, version-key) diagnosis cache: a dirty DOMAIN tick
+        # whose diagnosis INPUTS did not change (collectives re-runs on
+        # every step_time advance, but its diagnosis only reads the
+        # median step ms, which is usually bit-stable between ticks)
+        # reuses the previous DiagnosticResult and runs ZERO rules.
+        # Disabled with TRACEML_VECTOR_DIAGNOSIS=0 (legacy behavior).
+        self._diag_cache: Dict[str, Tuple[Any, Any]] = {}
+        # r20 O(Δ)-aware memos (also flag-gated): window OBJECTS per
+        # store version (a model_stats-only tick must not re-construct
+        # the 1024-rank step_time window, and _compute_collectives
+        # shares the step_time window instead of building it twice),
+        # the derived median step ms, the step_time view's per-rank
+        # tables, and the whole collectives result (its re-dirty via
+        # the step_time dep usually changes nothing it reads)
+        self._window_memo: Dict[str, Tuple[Tuple, Any]] = {}
+        self._step_ms_memo: Optional[Tuple[Tuple, Optional[float]]] = None
+        self._st_tables_memo: Dict[str, Any] = {}
+        self._coll_memo: Optional[Tuple[Tuple, Tuple[Dict[str, Any], Any]]] = None
+        # last exported window_build_stats snapshot, keyed on the store
+        # versions it was taken at (see payload_with_versions)
+        self._stats_export: Optional[Tuple[Tuple, Dict[str, Any]]] = None
 
     @property
     def store(self) -> LiveSnapshotStore:
@@ -76,8 +102,11 @@ class LiveComputer:
 
     def payload(self) -> Dict[str, Any]:
         with self._lock:
+            prof = self._store.tick_profile
             try:
+                t0 = time.perf_counter_ns()
                 self._store.refresh()
+                prof.note_stage("store", "refresh", time.perf_counter_ns() - t0)
             except Exception:
                 pass
             if not self._store.connected:
@@ -87,6 +116,7 @@ class LiveComputer:
                     "db_exists": self.db_path.exists(),
                     "views": {},
                 }
+            prof.note_tick()
             versions = self._store.versions
             dirty = [
                 domain
@@ -125,7 +155,22 @@ class LiveComputer:
         with self._lock:
             payload = self.payload()
             if incr_window_enabled():
-                stats = self._store.window_build_stats()
+                # the exported stats block is version-gated: the live
+                # profile accumulates on every poll (refresh ns, idle
+                # serializations), but serving a fresh snapshot each
+                # time would churn the meta fragment's bytes forever
+                # and break the idle-tick 204 contract.  Idle polls
+                # re-serve the previous snapshot; any store-version
+                # change exports a fresh one (with the idle time in it)
+                vkey = tuple(sorted(self._store.versions.items()))
+                if (
+                    self._stats_export is not None
+                    and self._stats_export[0] == vkey
+                ):
+                    stats = self._stats_export[1]
+                else:
+                    stats = self._store.window_build_stats()
+                    self._stats_export = (vkey, stats)
                 if stats:
                     payload["window_build_stats"] = stats
             return payload, dict(self._store.versions)
@@ -161,6 +206,84 @@ class LiveComputer:
         except Exception:
             return None
 
+    def _diagnose_cached(self, domain: str, cache_key: Tuple, build):
+        """Run a pack's diagnose under the per-(domain, version-key)
+        cache and the tick profiler.  ``cache_key`` must capture every
+        diagnosis input that can change between ticks (store versions
+        of the tables the pack reads, plus value-level inputs like the
+        collectives step-time denominator); a key match returns the
+        previous DiagnosticResult without evaluating a single rule.
+        The profiler splits the pack's attribution time out of the
+        diagnose stage via the module-level ns accumulator."""
+        prof = self._store.tick_profile
+        if vector_diagnosis_enabled():
+            hit = self._diag_cache.get(domain)
+            if hit is not None and hit[0] == cache_key:
+                prof.bump("diag_cache_hits")
+                return hit[1]
+        r0 = sum(rule_eval_counts().values())
+        a0 = attribution_ns_total()
+        t0 = time.perf_counter_ns()
+        result = build()
+        total_ns = time.perf_counter_ns() - t0
+        attr_ns = attribution_ns_total() - a0
+        prof.note_stage(domain, "diagnose", max(0, total_ns - attr_ns))
+        prof.note_stage(domain, "attribute", attr_ns)
+        prof.bump("rule_evals", sum(rule_eval_counts().values()) - r0)
+        if vector_diagnosis_enabled():
+            prof.bump("diag_cache_misses")
+            self._diag_cache[domain] = (cache_key, result)
+        return result
+
+    def _window_cached(self, domain: str, key: Tuple, build):
+        """Build (and stage-time) a window object, memoized per store
+        version — reused across ticks whose backing rows did not change
+        and across the two call sites that read the step_time window.
+        Safe because a version match means the ring buffers the window's
+        arrays alias were not written since the build."""
+        prof = self._store.tick_profile
+        if vector_diagnosis_enabled():
+            hit = self._window_memo.get(domain)
+            if hit is not None and hit[0] == key:
+                prof.bump("window_memo_hits")
+                return hit[1]
+        t0 = time.perf_counter_ns()
+        window = build()
+        prof.note_stage(domain, "build", time.perf_counter_ns() - t0)
+        if vector_diagnosis_enabled():
+            prof.bump("window_memo_misses")
+            self._window_memo[domain] = (key, window)
+        return window
+
+    def _median_step_ms(self, versions: Dict[str, int]) -> Optional[float]:
+        """Cross-rank median step ms (the collectives share denominator),
+        memoized per step_time version — ``metric()`` re-reduces the
+        whole cube on every call otherwise."""
+        key = (versions["step_time"],)
+        if (
+            vector_diagnosis_enabled()
+            and self._step_ms_memo is not None
+            and self._step_ms_memo[0] == key
+        ):
+            return self._step_ms_memo[1]
+        step_time_ms: Optional[float] = None
+        try:
+            st = self._window_cached(
+                "step_time", key,
+                lambda: self._store.build_step_time_window(
+                    max_steps=self.window_steps
+                ),
+            )
+            if st is not None:
+                m = st.metric("step_time")
+                if m is not None and m.median_ms > 0:
+                    step_time_ms = m.median_ms
+        except Exception:
+            pass
+        if vector_diagnosis_enabled():
+            self._step_ms_memo = (key, step_time_ms)
+        return step_time_ms
+
     # -- per-domain builders ---------------------------------------------
     # Each returns (top-level payload updates, typed view or None) and
     # mirrors the seed's error contract: a failing domain degrades to an
@@ -177,12 +300,19 @@ class LiveComputer:
 
     def _compute_step_time(self) -> Tuple[Dict[str, Any], Any]:
         world = int((self._store.topology() or {}).get("world_size") or 0)
+        prof = self._store.tick_profile
         try:
+            versions = self._store.versions
             # columnar window build straight off the store's ring
             # buffers (scalar fallback inside the store when a rank's
-            # buffer is flagged); no per-tick row-dict walk
-            window = self._store.build_step_time_window(
-                max_steps=self.window_steps
+            # buffer is flagged); no per-tick row-dict walk, and the
+            # window OBJECT is version-memoized (a model_stats-only
+            # tick reuses it outright)
+            window = self._window_cached(
+                "step_time", (versions["step_time"],),
+                lambda: self._store.build_step_time_window(
+                    max_steps=self.window_steps
+                ),
             )
             # newest telemetry timestamp drives the staleness badge
             latest = self._store.latest_step_time_ts()
@@ -190,17 +320,38 @@ class LiveComputer:
                 model_stats = self._store.model_stats()
             except Exception:
                 model_stats = {}
+            # the view's per-rank tables are pure window functions —
+            # memoize them per step_time version so a model_stats-only
+            # tick rebuilds only the MFU block (scalar arm: None →
+            # full legacy rebuild)
+            table_cache = None
+            if vector_diagnosis_enabled():
+                tkey = (versions["step_time"],)
+                if self._st_tables_memo.get("key") != tkey:
+                    self._st_tables_memo = {"key": tkey}
+                elif "tables" in self._st_tables_memo:
+                    prof.bump("view_table_hits")
+                table_cache = self._st_tables_memo
+            t0 = time.perf_counter_ns()
             view = V.build_step_time_view(
                 window, world_size=world, latest_ts=latest,
-                model_stats=model_stats,
+                model_stats=model_stats, table_cache=table_cache,
             )
+            prof.note_stage("step_time", "view", time.perf_counter_ns() - t0)
             updates = {
                 "latest_row_ts": latest,
                 "step_time": {
                     "window": window,
-                    "diagnosis": diagnose_window(
-                        window, mode="live",
-                        topology=self._mesh_topology(),
+                    # the diagnosis reads only the window + mesh, so it
+                    # keys on those versions — a model_stats-only tick
+                    # (the MFU block) reuses the cached result
+                    "diagnosis": self._diagnose_cached(
+                        "step_time",
+                        (versions["step_time"], versions["topology"]),
+                        lambda: diagnose_window(
+                            window, mode="live",
+                            topology=self._mesh_topology(),
+                        ),
                     )
                     if self._store.has_step_time_rows()
                     else None,
@@ -211,21 +362,34 @@ class LiveComputer:
             return {"step_time": {"error": str(exc)}}, None
 
     def _compute_memory(self) -> Tuple[Dict[str, Any], Any]:
+        prof = self._store.tick_profile
         try:
+            versions = self._store.versions
+            t0 = time.perf_counter_ns()
             mem_rows = self._store.step_memory_rows()
             mem_cols = self._store.step_memory_columns()
+            prof.note_stage("memory", "build", time.perf_counter_ns() - t0)
+            t0 = time.perf_counter_ns()
             view = V.build_memory_view(mem_rows, columns=mem_cols)
+            prof.note_stage("memory", "view", time.perf_counter_ns() - t0)
             from traceml_tpu.diagnostics.step_memory.api import (
                 diagnose_columns as diagnose_memory_columns,
                 diagnose_rank_rows as diagnose_memory,
             )
 
             mesh = self._mesh_topology()
+            key = (versions["step_memory"], versions["topology"])
             if mem_cols is not None:
-                diagnosis = diagnose_memory_columns(mem_cols, topology=mesh)
+                diagnosis = self._diagnose_cached(
+                    "memory", key,
+                    lambda: diagnose_memory_columns(mem_cols, topology=mesh),
+                )
             else:
                 diagnosis = (
-                    diagnose_memory(mem_rows, topology=mesh)
+                    self._diagnose_cached(
+                        "memory", key,
+                        lambda: diagnose_memory(mem_rows, topology=mesh),
+                    )
                     if mem_rows else None
                 )
             updates = {
@@ -237,22 +401,37 @@ class LiveComputer:
             return {"step_memory": {"error": str(exc)}}, None
 
     def _compute_collectives(self) -> Tuple[Dict[str, Any], Any]:
+        prof = self._store.tick_profile
         try:
-            window = self._store.build_collectives_window(
-                max_steps=self.window_steps
+            versions = self._store.versions
+            # the share denominator first: dirty-gating re-runs this
+            # domain on EVERY step_time advance, but everything below
+            # only reads the MEDIAN step ms — so the whole result is
+            # memoized on (collectives, topology, median) and a tick
+            # that left those bit-stable returns the previous
+            # (updates, view) pair without touching the window
+            step_time_ms = self._median_step_ms(versions)
+            rkey = (
+                versions["collectives"],
+                versions["topology"],
+                step_time_ms,
             )
-            step_time_ms: Optional[float] = None
-            try:
-                st = self._store.build_step_time_window(
+            if (
+                vector_diagnosis_enabled()
+                and self._coll_memo is not None
+                and self._coll_memo[0] == rkey
+            ):
+                prof.bump("domain_memo_hits")
+                return self._coll_memo[1]
+            window = self._window_cached(
+                "collectives", (versions["collectives"],),
+                lambda: self._store.build_collectives_window(
                     max_steps=self.window_steps
-                )
-                if st is not None:
-                    m = st.metric("step_time")
-                    if m is not None and m.median_ms > 0:
-                        step_time_ms = m.median_ms
-            except Exception:
-                pass
+                ),
+            )
+            t0 = time.perf_counter_ns()
             view = V.build_collectives_view(window, step_time_ms=step_time_ms)
+            prof.note_stage("collectives", "view", time.perf_counter_ns() - t0)
             from traceml_tpu.diagnostics.collectives.api import (
                 diagnose_collectives_window,
             )
@@ -260,26 +439,38 @@ class LiveComputer:
             updates = {
                 "collectives": {
                     "window": window,
-                    "diagnosis": diagnose_collectives_window(
-                        window, mode="live", step_time_ms=step_time_ms,
-                        topology=self._mesh_topology(),
+                    "diagnosis": self._diagnose_cached(
+                        "collectives",
+                        rkey,
+                        lambda: diagnose_collectives_window(
+                            window, mode="live", step_time_ms=step_time_ms,
+                            topology=self._mesh_topology(),
+                        ),
                     )
                     if self._store.has_collectives_rows()
                     else None,
                 },
             }
+            if vector_diagnosis_enabled():
+                self._coll_memo = (rkey, (updates, view))
             return updates, view
         except Exception as exc:
             return {"collectives": {"error": str(exc)}}, None
 
     def _compute_serving(self) -> Tuple[Dict[str, Any], Any]:
+        prof = self._store.tick_profile
         try:
+            versions = self._store.versions
+            t0 = time.perf_counter_ns()
             window = self._store.build_serving_window(
                 max_steps=self.window_steps
             )
+            prof.note_stage("serving", "build", time.perf_counter_ns() - t0)
+            t0 = time.perf_counter_ns()
             view = V.build_serving_view(
                 window, latest_ts=self._store.latest_serving_ts()
             )
+            prof.note_stage("serving", "view", time.perf_counter_ns() - t0)
             from traceml_tpu.diagnostics.serving.api import (
                 diagnose_serving_window,
             )
@@ -287,9 +478,13 @@ class LiveComputer:
             updates = {
                 "serving": {
                     "window": window,
-                    "diagnosis": diagnose_serving_window(
-                        window, mode="live",
-                        topology=self._mesh_topology(),
+                    "diagnosis": self._diagnose_cached(
+                        "serving",
+                        (versions["serving"], versions["topology"]),
+                        lambda: diagnose_serving_window(
+                            window, mode="live",
+                            topology=self._mesh_topology(),
+                        ),
                     )
                     if self._store.has_serving_rows()
                     else None,

@@ -152,7 +152,15 @@ class SessionPublisher:
                 obj = build_fragment(
                     name, payload, session=self.session, db_path=self.db_path
                 )
+                t0 = time.perf_counter_ns()
                 raw = json.dumps(obj).encode("utf-8")
+                ser_ns = time.perf_counter_ns() - t0
+                try:  # profiling is garnish — never fail a publish over it
+                    self._computer._store.tick_profile.note_stage(
+                        name, "serialize", ser_ns
+                    )
+                except Exception:
+                    pass
                 self.stats["builds"][name] += 1
                 if deps is not None:
                     self._computed_deps[name] = at

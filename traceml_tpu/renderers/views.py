@@ -20,7 +20,12 @@ from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
 
-from traceml_tpu.utils.columnar import KEY_INDEX, MemoryColumns
+from traceml_tpu.utils.columnar import (
+    KEY_INDEX,
+    MemoryColumns,
+    note_vector_fallback,
+    vector_diagnosis_enabled,
+)
 from traceml_tpu.utils.step_time_window import (
     ALL_KEYS,
     RESIDUAL_KEY,
@@ -31,7 +36,33 @@ from traceml_tpu.utils.step_time_window import (
 _STALE_AFTER_S = 5.0
 
 
+def _fast_asdict(obj: Any) -> Any:
+    """Value-identical replacement for the ``dataclasses.asdict`` walk.
+
+    ``asdict`` routes every leaf through ``copy.deepcopy`` — ~100 ms per
+    tick at 1024 ranks for view payloads that are pure primitives.  This
+    walk builds fresh dicts/lists (callers may cache the result) but
+    passes primitives through untouched; the inline float/int test keeps
+    the numeric-series whale (rank → per-step ms lists) out of the
+    recursion.  json output is byte-identical to the asdict path."""
+    if type(obj) is dict:
+        return {k: _fast_asdict(v) for k, v in obj.items()}
+    if type(obj) is list or type(obj) is tuple:
+        return [
+            v if type(v) is float or type(v) is int else _fast_asdict(v)
+            for v in obj
+        ]
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {
+            f.name: _fast_asdict(getattr(obj, f.name))
+            for f in dataclasses.fields(obj)
+        }
+    return obj
+
+
 def _asdict(obj: Any) -> Any:
+    if vector_diagnosis_enabled():
+        return _fast_asdict(obj)
     if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
         return {k: _asdict(v) for k, v in dataclasses.asdict(obj).items()}
     return obj
@@ -89,16 +120,13 @@ class StepTimeView:
         return _asdict(self)
 
 
-def build_step_time_view(
-    window: Optional[StepTimeWindow],
-    *,
-    world_size: Optional[int] = None,
-    latest_ts: Optional[float] = None,
-    series_tail: int = 60,
-    model_stats: Optional[Dict[int, Dict[str, Any]]] = None,
-) -> Optional[StepTimeView]:
-    if window is None:
-        return None
+def _step_time_tables(
+    window: StepTimeWindow, series_tail: int
+) -> Dict[str, Any]:
+    """Every window-derived table in the step_time view — pure function
+    of the window, so LiveComputer memoizes the result per step_time
+    store version (``table_cache``): a model_stats-only tick then
+    rebuilds only the MFU block instead of re-reducing the cube."""
     from traceml_tpu.utils.rankstats import closest_rank_to_median
 
     phases: List[PhaseStat] = []
@@ -161,6 +189,37 @@ def build_step_time_view(
             r: {k: round(v, 4) for k, v in w.averages.items()}
             for r, w in window.rank_windows.items()
         }
+    return {
+        "phases": phases,
+        "tail": tail,
+        "step_series": step_series,
+        "phase_stack": phase_stack,
+        "per_rank_avg": per_rank_avg,
+        "occupancy": {
+            str(r): round(v, 4) for r, v in window.occupancy_by_rank.items()
+        },
+        "median_occupancy": window.median_occupancy,
+    }
+
+
+def build_step_time_view(
+    window: Optional[StepTimeWindow],
+    *,
+    world_size: Optional[int] = None,
+    latest_ts: Optional[float] = None,
+    series_tail: int = 60,
+    model_stats: Optional[Dict[int, Dict[str, Any]]] = None,
+    table_cache: Optional[Dict[str, Any]] = None,
+) -> Optional[StepTimeView]:
+    if window is None:
+        return None
+    if table_cache is not None and "tables" in table_cache:
+        t = table_cache["tables"]
+    else:
+        t = _step_time_tables(window, series_tail)
+        if table_cache is not None:
+            table_cache["tables"] = t
+    per_rank_avg = t["per_rank_avg"]
     world = max(world_size or 0, len(window.ranks))
     return StepTimeView(
         clock=window.clock,
@@ -172,15 +231,13 @@ def build_step_time_view(
             last_step=window.steps[-1] if window.steps else None,
             incomplete=len(window.ranks) < world,
         ),
-        phases=phases,
+        phases=t["phases"],
         per_rank_avg_ms=per_rank_avg,
-        steps=tail,
-        step_series=step_series,
-        phase_stack=phase_stack,
-        occupancy_by_rank={
-            str(r): round(v, 4) for r, v in window.occupancy_by_rank.items()
-        },
-        median_occupancy=window.median_occupancy,
+        steps=t["tail"],
+        step_series=t["step_series"],
+        phase_stack=t["phase_stack"],
+        occupancy_by_rank=t["occupancy"],
+        median_occupancy=t["median_occupancy"],
         efficiency=_efficiency_from_stats(model_stats, per_rank_avg),
         latest_ts=latest_ts,
     )
@@ -339,6 +396,43 @@ class CollectivesView:
         return _asdict(self)
 
 
+def _collectives_rank_table(
+    per_rank: Mapping[int, Mapping[str, float]],
+) -> Optional[Tuple[Dict[str, float], Optional[int]]]:
+    """Vectorized per-rank table: gather the window's per-rank dicts
+    into rank-slot arrays once, then do the cross-rank reductions
+    (sort, masked first-min) in numpy.  tolist() BEFORE round() so the
+    values are native floats, identical to the scalar twin.  None on
+    any surprise — the caller falls back to the scalar arm."""
+    try:
+        items = list(per_rank.items())
+        ranks = np.asarray([r for r, _ in items], dtype=np.int64)
+        eff = np.asarray(
+            [float(v["overlap_efficiency"]) for _, v in items],
+            dtype=np.float64,
+        )
+        dur = np.asarray(
+            [float(v.get("duration_ms", 0.0)) for _, v in items],
+            dtype=np.float64,
+        )
+        order = np.argsort(ranks, kind="stable")
+        table = {
+            str(r): round(v, 4)
+            for r, v in zip(ranks[order].tolist(), eff[order].tolist())
+        }
+        # first minimum among comm-active ranks in insertion order ==
+        # the scalar arm's min()-with-key tie-break
+        mask = dur > 0.0
+        worst = None
+        if bool(mask.any()):
+            idx = np.flatnonzero(mask)
+            worst = int(ranks[idx[int(np.argmin(eff[idx]))]])
+        return table, worst
+    except Exception:
+        note_vector_fallback("collectives_view")
+        return None
+
+
 def build_collectives_view(
     window: Any,
     *,
@@ -379,20 +473,28 @@ def build_collectives_view(
         for op, v in window.per_op.items()
     ]
     ops.sort(key=lambda o: -o.duration_ms)
-    per_rank_eff = {
-        str(r): round(float(v["overlap_efficiency"]), 4)
-        for r, v in sorted(window.per_rank.items())
-    }
-    comm_ranks = [
-        (r, v)
-        for r, v in window.per_rank.items()
-        if v.get("duration_ms", 0.0) > 0
-    ]
-    worst = (
-        min(comm_ranks, key=lambda kv: kv[1]["overlap_efficiency"])[0]
-        if comm_ranks
+    vec = (
+        _collectives_rank_table(window.per_rank)
+        if vector_diagnosis_enabled()
         else None
     )
+    if vec is not None:
+        per_rank_eff, worst = vec
+    else:  # scalar golden-reference arm (TRACEML_VECTOR_DIAGNOSIS=0)
+        per_rank_eff = {
+            str(r): round(float(v["overlap_efficiency"]), 4)
+            for r, v in sorted(window.per_rank.items())
+        }
+        comm_ranks = [
+            (r, v)
+            for r, v in window.per_rank.items()
+            if v.get("duration_ms", 0.0) > 0
+        ]
+        worst = (
+            min(comm_ranks, key=lambda kv: kv[1]["overlap_efficiency"])[0]
+            if comm_ranks
+            else None
+        )
     return CollectivesView(
         n_steps=n,
         ranks_present=len(window.ranks),
@@ -464,6 +566,73 @@ class ServingView:
         return _asdict(self)
 
 
+def _serving_replica_table(
+    per_rank: Mapping[int, Mapping[str, float]],
+) -> Optional[Tuple[List["ServingReplicaStat"], Optional[int]]]:
+    """Vectorized replica table: per-field rank-slot arrays, stable
+    argsort on the ROUNDED throughput (the scalar twin sorts the
+    already-rounded dataclasses, and stable order among ties is
+    ascending rank).  None on any surprise — caller falls back."""
+    try:
+        items = sorted(per_rank.items())
+        ranks = [int(r) for r, _ in items]
+        comp = np.asarray(
+            [v.get("requests_completed", 0) for _, v in items], dtype=np.int64
+        ).tolist()
+        act = np.asarray(
+            [v.get("requests_active", 0) for _, v in items], dtype=np.int64
+        ).tolist()
+        dtok = np.asarray(
+            [v.get("decode_tokens", 0) for _, v in items], dtype=np.int64
+        ).tolist()
+        qd = np.asarray(
+            [v.get("queue_depth", 0) for _, v in items], dtype=np.int64
+        ).tolist()
+        tps = [
+            round(v, 3)
+            for v in np.asarray(
+                [float(v.get("tokens_per_s", 0.0)) for _, v in items],
+                dtype=np.float64,
+            ).tolist()
+        ]
+        p99 = [
+            round(v, 3)
+            for v in np.asarray(
+                [float(v.get("ttft_p99_ms", 0.0)) for _, v in items],
+                dtype=np.float64,
+            ).tolist()
+        ]
+        kv = np.asarray(
+            [float(v.get("kv_headroom", -1.0)) for _, v in items],
+            dtype=np.float64,
+        ).tolist()
+        order = np.argsort(
+            np.asarray(tps, dtype=np.float64), kind="stable"
+        ).tolist()
+        replicas = [
+            ServingReplicaStat(
+                rank=ranks[i],
+                requests_completed=comp[i],
+                requests_active=act[i],
+                decode_tokens=dtok[i],
+                tokens_per_s=tps[i],
+                queue_depth=qd[i],
+                ttft_p99_ms=p99[i],
+                kv_headroom=round(kv[i], 4) if kv[i] >= 0.0 else None,
+            )
+            for i in order
+        ]
+        slowest = (
+            replicas[0].rank
+            if replicas and any(t > 0 for t in tps)
+            else None
+        )
+        return replicas, slowest
+    except Exception:
+        note_vector_fallback("serving_view")
+        return None
+
+
 def build_serving_view(
     window: Any,
     *,
@@ -478,29 +647,37 @@ def build_serving_view(
     offset = max(0, n - series_tail)
     t = window.totals
     kv_min = float(t.get("kv_headroom_min", -1.0))
-    replicas = [
-        ServingReplicaStat(
-            rank=int(r),
-            requests_completed=int(v.get("requests_completed", 0)),
-            requests_active=int(v.get("requests_active", 0)),
-            decode_tokens=int(v.get("decode_tokens", 0)),
-            tokens_per_s=round(float(v.get("tokens_per_s", 0.0)), 3),
-            queue_depth=int(v.get("queue_depth", 0)),
-            ttft_p99_ms=round(float(v.get("ttft_p99_ms", 0.0)), 3),
-            kv_headroom=(
-                round(float(v["kv_headroom"]), 4)
-                if float(v.get("kv_headroom", -1.0)) >= 0.0
-                else None
-            ),
-        )
-        for r, v in sorted(window.per_rank.items())
-    ]
-    replicas.sort(key=lambda s: s.tokens_per_s)
-    slowest = (
-        replicas[0].rank
-        if replicas and any(s.tokens_per_s > 0 for s in replicas)
+    vec = (
+        _serving_replica_table(window.per_rank)
+        if vector_diagnosis_enabled()
         else None
     )
+    if vec is not None:
+        replicas, slowest = vec
+    else:  # scalar golden-reference arm (TRACEML_VECTOR_DIAGNOSIS=0)
+        replicas = [
+            ServingReplicaStat(
+                rank=int(r),
+                requests_completed=int(v.get("requests_completed", 0)),
+                requests_active=int(v.get("requests_active", 0)),
+                decode_tokens=int(v.get("decode_tokens", 0)),
+                tokens_per_s=round(float(v.get("tokens_per_s", 0.0)), 3),
+                queue_depth=int(v.get("queue_depth", 0)),
+                ttft_p99_ms=round(float(v.get("ttft_p99_ms", 0.0)), 3),
+                kv_headroom=(
+                    round(float(v["kv_headroom"]), 4)
+                    if float(v.get("kv_headroom", -1.0)) >= 0.0
+                    else None
+                ),
+            )
+            for r, v in sorted(window.per_rank.items())
+        ]
+        replicas.sort(key=lambda s: s.tokens_per_s)
+        slowest = (
+            replicas[0].rank
+            if replicas and any(s.tokens_per_s > 0 for s in replicas)
+            else None
+        )
     return ServingView(
         n_steps=n,
         replicas_present=len(window.ranks),

@@ -1187,7 +1187,27 @@ def generate_summary(
         system_rows=2000,
         process_rows=2000,
     )
+    # the one-shot report is a single profiled "tick": refresh + each
+    # section's diagnose/attribute land in the same stage vocabulary the
+    # live tick profiler uses, so meta.window_build.tick_profile shows
+    # where summary time went (TICK_STAGES in utils/columnar.py)
+    prof = store.tick_profile
+    _t0 = time.perf_counter_ns()
     store.refresh()
+    prof.note_stage("store", "refresh", time.perf_counter_ns() - _t0)
+    prof.note_tick()
+
+    def _timed_section(key, builder):
+        from traceml_tpu.diagnostics.attribution import attribution_ns_total
+
+        a0 = attribution_ns_total()
+        t0 = time.perf_counter_ns()
+        out = _safe_section(key, builder)
+        total_ns = time.perf_counter_ns() - t0
+        attr_ns = attribution_ns_total() - a0
+        prof.note_stage(key, "diagnose", max(0, total_ns - attr_ns))
+        prof.note_stage(key, "attribute", attr_ns)
+        return out
 
     try:
         identities = loaders.load_rank_identities(db_path, conn=store.connection)
@@ -1257,18 +1277,18 @@ def generate_summary(
         return section
 
     sections = {
-        "system": _safe_section("system", run_system),
-        "process": _safe_section("process", run_process),
-        "step_time": _safe_section("step_time", run_step_time),
-        "step_memory": _safe_section("step_memory", run_step_memory),
-        "collectives": _safe_section("collectives", run_collectives),
-        "liveness": _safe_section("liveness", run_liveness),
+        "system": _timed_section("system", run_system),
+        "process": _timed_section("process", run_process),
+        "step_time": _timed_section("step_time", run_step_time),
+        "step_memory": _timed_section("step_memory", run_step_memory),
+        "collectives": _timed_section("collectives", run_collectives),
+        "liveness": _timed_section("liveness", run_liveness),
     }
     # sessions that never recorded a serving event get NO serving key at
     # all (not a NO_DATA stub): the summary must stay byte-identical to
     # the pre-serving-domain artifact for training-only runs
     if store.has_serving_rows():
-        sections["serving"] = _safe_section("serving", run_serving)
+        sections["serving"] = _timed_section("serving", run_serving)
     try:
         topology = store.topology()
     except Exception:

@@ -64,6 +64,7 @@ from traceml_tpu.utils.columnar import (
     ServingWindowCache,
     StepTimeColumns,
     StepTimeWindowCache,
+    TickProfile,
     build_collectives_window_rows,
     build_columnar_collectives_window,
     build_columnar_serving_window,
@@ -71,6 +72,7 @@ from traceml_tpu.utils.columnar import (
     build_serving_window_rows,
     columnar_window_enabled,
     incr_window_enabled,
+    vector_fallback_counts,
 )
 from traceml_tpu.aggregator.rollup import ROLLUP_SOURCES as _ROLLUP_SOURCES
 from traceml_tpu.utils.error_log import get_error_log
@@ -381,6 +383,10 @@ class LiveSnapshotStore:
         # aligned-cube/slot caches fed by the rings' monotone counters;
         # created lazily on the first columnar build of each domain
         self._window_caches: Dict[str, Any] = {}
+        # per-stage warm-tick profiler (refresh/build/diagnose/attribute/
+        # view/serialize ns + cache counters): LiveComputer and the
+        # serving tier write into it; window_build_stats surfaces it
+        self.tick_profile = TickProfile()
         # system / process: globally-bounded (loader semantics), keyed rows
         self._system_host = _RankBuffer(self.max_system_rows)
         self._system_dev = _RankBuffer(self.max_system_rows)
@@ -968,12 +974,24 @@ class LiveSnapshotStore:
 
     def window_build_stats(self) -> Dict[str, Dict[str, Any]]:
         """Per-domain incremental-vs-full build counters (empty until a
-        columnar build ran with the incremental engine enabled)."""
+        columnar build ran with the incremental engine enabled), plus —
+        once the tick profiler saw a tick — a ``tick_profile`` entry
+        holding the per-stage ns breakdown and cache counters (r20)."""
         with self._lock:
-            return {
+            out: Dict[str, Dict[str, Any]] = {
                 domain: cache.stats.snapshot()
                 for domain, cache in sorted(self._window_caches.items())
             }
+            if self.tick_profile.ticks or self.tick_profile.stage_ns:
+                from traceml_tpu.utils.topology import grouping_cache_counts
+
+                prof = self.tick_profile.snapshot()
+                for domain, n in sorted(vector_fallback_counts().items()):
+                    prof["counters"][f"vector_fallbacks_{domain}"] = n
+                for k, n in sorted(grouping_cache_counts().items()):
+                    prof["counters"][f"grouping_cache_{k}"] = n
+                out["tick_profile"] = prof
+            return out
 
     def build_step_time_window(
         self, max_steps: Optional[int] = None

@@ -50,12 +50,15 @@ reference: incremental output is bit-identical to a from-scratch build
 every tick (pinned by tests/utils/test_incremental_window.py).
 
 Kill switches: ``TRACEML_COLUMNAR_WINDOW=0`` forces the scalar path;
-``TRACEML_INCR_WINDOW=0`` forces full rebuilds (cache never consulted).
+``TRACEML_INCR_WINDOW=0`` forces full rebuilds (cache never consulted);
+``TRACEML_VECTOR_DIAGNOSIS=0`` forces the scalar rule-evaluation arm
+(and disables the per-(domain, version) diagnosis cache).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import logging
 import time
 from typing import Any, Dict, List, Mapping, Optional
 
@@ -91,6 +94,32 @@ def columnar_window_enabled() -> bool:
 
 def incr_window_enabled() -> bool:
     return flags.INCR_WINDOW.enabled()
+
+
+def vector_diagnosis_enabled() -> bool:
+    return flags.VECTOR_DIAGNOSIS.enabled()
+
+
+# vectorized-diagnosis fallback accounting: the vector arm never spams
+# the log on a pathological session — the first fallback per domain is
+# warned once, the rest are counted and surfaced through the tick
+# profiler (the r09 shed-warning pattern)
+_VECTOR_FALLBACKS: Dict[str, int] = {}
+_VECTOR_FALLBACK_WARNED: set = set()
+
+
+def note_vector_fallback(domain: str) -> None:
+    _VECTOR_FALLBACKS[domain] = _VECTOR_FALLBACKS.get(domain, 0) + 1
+    if domain not in _VECTOR_FALLBACK_WARNED:
+        _VECTOR_FALLBACK_WARNED.add(domain)
+        logging.getLogger(__name__).warning(
+            "vectorized %s diagnosis fell back to the scalar arm "
+            "(further fallbacks counted, not logged)", domain,
+        )
+
+
+def vector_fallback_counts() -> Dict[str, int]:
+    return dict(_VECTOR_FALLBACKS)
 
 
 class ColumnarFallback(Exception):
@@ -1859,6 +1888,54 @@ class WindowBuildStats:
             "invalidations": dict(self.invalidations),
             "last_build_ms": self.last_build_ms,
             "last_path": self.last_path,
+        }
+
+
+#: tick-profiler stage vocabulary (docs/developer_guide/diagnosis-engine.md);
+#: tests pin these strings the same way they pin INVALIDATE_*
+TICK_STAGES = (
+    "refresh", "build", "diagnose", "attribute", "view", "serialize",
+)
+
+
+class TickProfile:
+    """Per-stage warm-tick profiler: cumulative nanoseconds per
+    (domain, stage) plus counters (diagnosis cache hits/misses, rule
+    evaluations, vector fallbacks, attribution grouping reuse).
+
+    Extends r19's :class:`WindowBuildStats` from "where did the window
+    build go" to "where did the whole tick go": refresh → build →
+    diagnose → attribute → view → serialize.  Lives on the snapshot
+    store and is surfaced through the same ``window_build`` meta
+    fragment / final-report channel, so per-stage overhead is visible
+    without attaching a profiler (the T3 motivation: the observer's own
+    cost must itself be observable)."""
+
+    __slots__ = ("ticks", "stage_ns", "counters")
+
+    def __init__(self) -> None:
+        self.ticks = 0
+        self.stage_ns: Dict[str, Dict[str, int]] = {}
+        self.counters: Dict[str, int] = {}
+
+    def note_tick(self) -> None:
+        self.ticks += 1
+
+    def note_stage(self, domain: str, stage: str, ns: int) -> None:
+        per = self.stage_ns.setdefault(domain, {})
+        per[stage] = per.get(stage, 0) + int(ns)
+
+    def bump(self, counter: str, n: int = 1) -> None:
+        self.counters[counter] = self.counters.get(counter, 0) + n
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "ticks": self.ticks,
+            "stage_ns": {
+                d: {s: per[s] for s in sorted(per)}
+                for d, per in sorted(self.stage_ns.items())
+            },
+            "counters": {k: self.counters[k] for k in sorted(self.counters)},
         }
 
 

@@ -370,12 +370,39 @@ class Attribution:
         }
 
 
+# grouping-memo counters, surfaced through the tick profiler: the memo
+# lives on the MeshTopology instance and the snapshot store caches ONE
+# instance per topology version, so a hit means "same (topology
+# version, rank set) as an earlier diagnose call" — the per-call
+# host/axis scans the r20 satellite removes from the warm tick
+_GROUPING_CACHE_STATS: Dict[str, int] = {"hits": 0, "misses": 0}
+
+
+def grouping_cache_counts() -> Dict[str, int]:
+    return dict(_GROUPING_CACHE_STATS)
+
+
 def candidate_groupings(
     topo: MeshTopology, ranks: Sequence[int]
 ) -> List[Grouping]:
     """Host grouping (from identity node_rank) + one grouping per mesh
     axis of size > 1 (DCN axes become boundary-side groupings).  Only
-    ranks present in ``ranks`` participate."""
+    ranks present in ``ranks`` participate.
+
+    Memoized per (topology instance, rank tuple) when the vectorized
+    diagnosis arm is on — groupings depend on nothing else, and every
+    window domain asks with the same rank set tick after tick.  The
+    callers only read the returned Grouping objects."""
+    from traceml_tpu.utils.columnar import vector_diagnosis_enabled
+
+    key: Optional[Tuple[int, ...]] = None
+    cache: Optional[Dict[Tuple[int, ...], List[Grouping]]] = None
+    if vector_diagnosis_enabled():
+        key = tuple(int(r) for r in ranks)
+        cache = topo.__dict__.get("_groupings_cache")
+        if cache is not None and key in cache:
+            _GROUPING_CACHE_STATS["hits"] += 1
+            return cache[key]
     out: List[Grouping] = []
     hosts: Dict[Any, List[int]] = {}
     for r in ranks:
@@ -402,6 +429,13 @@ def candidate_groupings(
                     groups=groups,
                 )
             )
+    if key is not None:
+        if cache is None:
+            cache = topo.__dict__["_groupings_cache"] = {}
+        elif len(cache) >= 8:  # rank-set churn: keep the memo bounded
+            cache.clear()
+        cache[key] = out
+        _GROUPING_CACHE_STATS["misses"] += 1
     return out
 
 
